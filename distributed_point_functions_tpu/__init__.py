@@ -1,0 +1,44 @@
+"""distributed_point_functions_tpu: a TPU-native function-secret-sharing
+framework.
+
+From-scratch JAX/XLA/Pallas re-design of Google's distributed_point_functions
+C++ library: incremental Distributed Point Functions (DPF), Distributed
+Comparison Functions (DCF), and FSS gates, over the same value-type system and
+a byte-compatible key format. Key generation runs on the CPU host; key
+evaluation (the fixed-key AES-128 PRG tree expansion) runs on TPU as bitsliced
+vector/Pallas kernels driven by `jax.lax.scan`, with `jax.sharding` for
+multi-chip full-domain expansion and PIR-style reductions.
+"""
+
+from .core.dpf import DistributedPointFunction, NumpyBackend
+from .core.keys import CorrectionWord, DpfKey, EvaluationContext, PartialEvaluation
+from .core.params import DpfParameters, ParameterValidator
+from .core.value_types import Int, IntModN, TupleType, ValueType, XorWrapper
+from .utils.errors import (
+    DpfError,
+    FailedPreconditionError,
+    InvalidArgumentError,
+    UnimplementedError,
+)
+
+__all__ = [
+    "DistributedPointFunction",
+    "NumpyBackend",
+    "DpfParameters",
+    "ParameterValidator",
+    "DpfKey",
+    "CorrectionWord",
+    "EvaluationContext",
+    "PartialEvaluation",
+    "ValueType",
+    "Int",
+    "IntModN",
+    "TupleType",
+    "XorWrapper",
+    "DpfError",
+    "InvalidArgumentError",
+    "FailedPreconditionError",
+    "UnimplementedError",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,140 @@
+"""Vectorized numpy evaluation backend.
+
+This is the CPU twin of the JAX/TPU backend in ops/: the same three hot
+primitives the reference implements in its Highway SIMD kernel
+(/root/reference/dpf/internal/evaluate_prg_hwy.cc) and in ExpandSeeds /
+HashExpandedSeeds (/root/reference/dpf/distributed_point_function.cc:271-349,
+500-524), expressed as vectorized numpy over uint32[N, 4] limb arrays. It
+serves as (a) the differential-test oracle for every TPU kernel, and (b) a
+working CPU backend for small workloads.
+
+Seed layout: uint32[N, 4], little-endian limbs (see core/uint128.py).
+Control bits: bool[N]. Paths: uint32[N, 4] limbs of the tree index.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from . import constants
+from .aes_numpy import Aes128FixedKeyHash
+
+_PRG_LEFT = Aes128FixedKeyHash(constants.PRG_KEY_LEFT)
+_PRG_RIGHT = Aes128FixedKeyHash(constants.PRG_KEY_RIGHT)
+_PRG_VALUE = Aes128FixedKeyHash(constants.PRG_KEY_VALUE)
+
+
+def get_bit(limbs: np.ndarray, bit_index: int) -> np.ndarray:
+    """bool[N]: bit `bit_index` of each uint128 in uint32[N, 4]."""
+    return ((limbs[:, bit_index // 32] >> np.uint32(bit_index % 32)) & 1).astype(bool)
+
+
+def evaluate_seeds(
+    seeds: np.ndarray,
+    control_bits: np.ndarray,
+    paths: np.ndarray,
+    correction_seeds: np.ndarray,
+    correction_controls_left: np.ndarray,
+    correction_controls_right: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Walks all seeds down `num_levels` tree levels along `paths`.
+
+    Semantics of dpf_internal::EvaluateSeeds (scalar fallback at
+    evaluate_prg_hwy.cc:415-491): per level, pick the left/right PRG by the
+    path bit, XOR the correction seed where the control bit is set, then pull
+    the new control bit out of the seed's lowest bit and correct it.
+
+    Args:
+      seeds: uint32[N, 4]. control_bits: bool[N]. paths: uint32[N, 4].
+      correction_seeds: uint32[L, 4];
+      correction_controls_{left,right}: bool[L].
+    Returns: (uint32[N, 4] seeds, bool[N] control bits).
+    """
+    seeds = np.array(seeds, dtype=np.uint32)
+    control = np.asarray(control_bits, dtype=bool).copy()
+    num_levels = len(correction_seeds)
+    for level in range(num_levels):
+        bit_index = num_levels - level - 1
+        path_bits = get_bit(paths, bit_index) if bit_index < 128 else np.zeros(
+            len(seeds), dtype=bool
+        )
+        left = _PRG_LEFT.evaluate_limbs(seeds)
+        right = _PRG_RIGHT.evaluate_limbs(seeds)
+        seeds = np.where(path_bits[:, None], right, left)
+        seeds ^= np.where(control[:, None], correction_seeds[level][None, :], 0).astype(
+            np.uint32
+        )
+        new_control = (seeds[:, 0] & 1).astype(bool)
+        seeds[:, 0] &= np.uint32(0xFFFFFFFE)
+        cc = np.where(
+            path_bits,
+            bool(correction_controls_right[level]),
+            bool(correction_controls_left[level]),
+        )
+        control = new_control ^ (control & cc)
+    return seeds, control
+
+
+def expand_seeds(
+    seeds: np.ndarray,
+    control_bits: np.ndarray,
+    correction_seeds: np.ndarray,
+    correction_controls_left: np.ndarray,
+    correction_controls_right: np.ndarray,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Full doubling expansion over len(correction_seeds) levels.
+
+    Semantics of DistributedPointFunction::ExpandSeeds
+    (distributed_point_function.cc:271-349): each level hashes every seed with
+    both PRGs, applies the seed/control corrections, and interleaves children
+    as [left_0, right_0, left_1, right_1, ...].
+    """
+    seeds = np.array(seeds, dtype=np.uint32)
+    control = np.asarray(control_bits, dtype=bool).copy()
+    num_levels = len(correction_seeds)
+    for level in range(num_levels):
+        n = seeds.shape[0]
+        left = _PRG_LEFT.evaluate_limbs(seeds)
+        right = _PRG_RIGHT.evaluate_limbs(seeds)
+        correction = np.where(
+            control[:, None], correction_seeds[level][None, :], 0
+        ).astype(np.uint32)
+        left ^= correction
+        right ^= correction
+        children = np.stack([left, right], axis=1).reshape(2 * n, 4)
+        child_control = (children[:, 0] & 1).astype(bool)
+        children[:, 0] &= np.uint32(0xFFFFFFFE)
+        cc = np.stack(
+            [
+                control & bool(correction_controls_left[level]),
+                control & bool(correction_controls_right[level]),
+            ],
+            axis=1,
+        ).reshape(2 * n)
+        control = child_control ^ cc
+        seeds = children
+    return seeds, control
+
+
+def hash_expanded_seeds(seeds: np.ndarray, blocks_needed: int) -> np.ndarray:
+    """Value-PRG hash of seeds[i] + j for j < blocks_needed.
+
+    Semantics of DistributedPointFunction::HashExpandedSeeds
+    (distributed_point_function.cc:500-524). Returns uint32[N, blocks_needed, 4].
+    """
+    seeds = np.asarray(seeds, dtype=np.uint32)
+    n = seeds.shape[0]
+    inputs = np.repeat(seeds[:, None, :], blocks_needed, axis=1)  # [N, bn, 4]
+    # uint128 addition of the small constant j, with carry propagation.
+    for j in range(blocks_needed):
+        carry = np.uint32(j)
+        for limb in range(4):
+            old = inputs[:, j, limb].copy()
+            inputs[:, j, limb] += carry
+            carry = (inputs[:, j, limb] < old).astype(np.uint32)
+            if not carry.any():
+                break
+    hashed = _PRG_VALUE.evaluate_limbs(inputs.reshape(n * blocks_needed, 4))
+    return hashed.reshape(n, blocks_needed, 4)

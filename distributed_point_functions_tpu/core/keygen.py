@@ -1,0 +1,194 @@
+"""DPF key generation (host / CPU).
+
+Faithful re-implementation of DistributedPointFunction::GenerateKeysIncremental
+and GenerateNext (/root/reference/dpf/distributed_point_function.cc:619-687,
+103-204), which follow Fig. 11 of the Incremental DPF paper
+(https://arxiv.org/pdf/2012.14884.pdf). Key generation is sequential in tree
+depth with only 4-6 AES blocks per level, so it stays on the CPU (SURVEY.md
+north star); evaluation is what runs on TPU.
+
+Keys produced here are bit-exact with the reference implementation given the
+same random seeds, so they can be exchanged with C++ evaluators.
+"""
+
+from __future__ import annotations
+
+import secrets
+from typing import List, Optional, Sequence, Tuple
+
+from ..utils.errors import InvalidArgumentError
+from . import constants, uint128
+from .aes_numpy import Aes128FixedKeyHash
+from .keys import CorrectionWord, DpfKey
+from .params import ParameterValidator
+from .uint128 import MASK128
+from .value_types import compute_value_correction
+
+
+def _extract_and_clear_lowest_bit(x: int) -> Tuple[int, int]:
+    """Returns (bit, x with bit 0 cleared); mirrors
+    dpf_internal::ExtractAndClearLowestBit
+    (/root/reference/dpf/internal/evaluate_prg_hwy.h:31-35)."""
+    return x & 1, x & ~1
+
+
+class KeyGenerator:
+    """Generates incremental DPF keys for a validated parameter set."""
+
+    def __init__(self, validator: ParameterValidator):
+        self._v = validator
+        self._prg_left = Aes128FixedKeyHash(constants.PRG_KEY_LEFT)
+        self._prg_right = Aes128FixedKeyHash(constants.PRG_KEY_RIGHT)
+        self._prg_value = Aes128FixedKeyHash(constants.PRG_KEY_VALUE)
+
+    # -- helpers -----------------------------------------------------------
+
+    def _domain_to_block_index(self, domain_index: int, hierarchy_level: int) -> int:
+        p = self._v.parameters[hierarchy_level]
+        block_index_bits = p.log_domain_size - self._v.hierarchy_to_tree[hierarchy_level]
+        return domain_index & ((1 << block_index_bits) - 1)
+
+    def _compute_value_correction(
+        self, hierarchy_level: int, seeds: List[int], alpha: int, beta, invert: bool
+    ) -> list:
+        """Mirrors DistributedPointFunction::ComputeValueCorrection
+        (distributed_point_function.cc:63-99): hash seeds[i]+j for
+        j < blocks_needed under the value PRG, then form correction shares."""
+        blocks_needed = self._v.blocks_needed[hierarchy_level]
+        inputs = [(seeds[0] + j) & MASK128 for j in range(blocks_needed)]
+        inputs += [(seeds[1] + j) & MASK128 for j in range(blocks_needed)]
+        hashed = self._prg_value.evaluate(inputs)
+        seed_a = b"".join(uint128.to_bytes(h) for h in hashed[:blocks_needed])
+        seed_b = b"".join(uint128.to_bytes(h) for h in hashed[blocks_needed:])
+        index_in_block = self._domain_to_block_index(alpha, hierarchy_level)
+        value_type = self._v.parameters[hierarchy_level].value_type
+        return compute_value_correction(
+            value_type, seed_a, seed_b, index_in_block, beta, invert
+        )
+
+    # -- key generation ----------------------------------------------------
+
+    def generate_keys_incremental(
+        self,
+        alpha: int,
+        betas: Sequence,
+        seeds: Optional[Tuple[int, int]] = None,
+    ) -> Tuple[DpfKey, DpfKey]:
+        """Generates a key pair. `seeds` overrides the CSPRNG (tests only)."""
+        v = self._v
+        if len(betas) != v.num_hierarchy_levels:
+            raise InvalidArgumentError(
+                "`beta` has to have the same size as `parameters` passed at "
+                "construction"
+            )
+        for i, beta in enumerate(betas):
+            v.validate_value(beta, i)
+        last_log_domain_size = v.parameters[-1].log_domain_size
+        if alpha < 0 or (
+            last_log_domain_size < 128 and alpha >= (1 << last_log_domain_size)
+        ):
+            raise InvalidArgumentError(
+                "`alpha` must be smaller than the output domain size"
+            )
+
+        if seeds is None:
+            seeds = (
+                uint128.from_bytes(secrets.token_bytes(16)),
+                uint128.from_bytes(secrets.token_bytes(16)),
+            )
+        seeds = [seeds[0] & MASK128, seeds[1] & MASK128]
+        control_bits = [0, 1]
+        keys = (
+            DpfKey(seed=seeds[0], correction_words=[], party=0),
+            DpfKey(seed=seeds[1], correction_words=[], party=1),
+        )
+
+        for tree_level in range(1, v.tree_levels_needed):
+            self._generate_next(tree_level, alpha, betas, seeds, control_bits, keys)
+
+        last_cw = self._compute_value_correction(
+            v.num_hierarchy_levels - 1, seeds, alpha, betas[-1], bool(control_bits[1])
+        )
+        keys[0].last_level_value_correction = list(last_cw)
+        keys[1].last_level_value_correction = list(last_cw)
+        return keys
+
+    def _generate_next(
+        self,
+        tree_level: int,
+        alpha: int,
+        betas: Sequence,
+        seeds: List[int],
+        control_bits: List[int],
+        keys: Tuple[DpfKey, DpfKey],
+    ) -> None:
+        """One level of correction-word generation (Fig. 11 lines 5-15)."""
+        v = self._v
+        # Value correction for the previous tree level, if it is an output
+        # level ("PRG evaluation optimization", paper Appendix C.2).
+        value_correction: list = []
+        if (tree_level - 1) in v.tree_to_hierarchy:
+            hierarchy_level = v.tree_to_hierarchy[tree_level - 1]
+            shift = (
+                v.parameters[-1].log_domain_size
+                - v.parameters[hierarchy_level].log_domain_size
+            )
+            alpha_prefix = alpha >> shift if shift < 128 else 0
+            value_correction = self._compute_value_correction(
+                hierarchy_level, seeds, alpha_prefix,
+                betas[hierarchy_level], bool(control_bits[1]),
+            )
+
+        # Expand both parties' seeds with both PRGs (line 5).
+        left = self._prg_left.evaluate(seeds)
+        right = self._prg_right.evaluate(seeds)
+        expanded_seeds = [[left[0], left[1]], [right[0], right[1]]]  # [branch][party]
+        expanded_control_bits = [[0, 0], [0, 0]]
+        for branch in range(2):
+            for party in range(2):
+                bit, cleared = _extract_and_clear_lowest_bit(expanded_seeds[branch][party])
+                expanded_control_bits[branch][party] = bit
+                expanded_seeds[branch][party] = cleared
+
+        # Keep/lose branch from the current bit of alpha (lines 6-8).
+        bit_index = v.parameters[-1].log_domain_size - tree_level
+        current_bit = int(bit_index < 128 and (alpha >> bit_index) & 1)
+        keep, lose = current_bit, 1 - current_bit
+
+        # Seed and control-bit correction words (lines 9-10).
+        seed_correction = expanded_seeds[lose][0] ^ expanded_seeds[lose][1]
+        control_correction = [
+            expanded_control_bits[0][0] ^ expanded_control_bits[0][1] ^ current_bit ^ 1,
+            expanded_control_bits[1][0] ^ expanded_control_bits[1][1] ^ current_bit,
+        ]
+
+        # Update seeds with the *previous* level's control bits (line 12; the
+        # corrected seed feeds the next level directly, which is safe because
+        # value correction uses an independent AES key).
+        for party in range(2):
+            new_seed = expanded_seeds[keep][party]
+            if control_bits[party]:
+                new_seed ^= seed_correction
+            seeds[party] = new_seed
+
+        # Update control bits (line 11).
+        for party in range(2):
+            control_bits[party] = expanded_control_bits[keep][party] ^ (
+                control_bits[party] & control_correction[keep]
+            )
+
+        cw = CorrectionWord(
+            seed=seed_correction,
+            control_left=bool(control_correction[0]),
+            control_right=bool(control_correction[1]),
+            value_correction=list(value_correction),
+        )
+        keys[0].correction_words.append(cw)
+        keys[1].correction_words.append(
+            CorrectionWord(
+                seed=cw.seed,
+                control_left=cw.control_left,
+                control_right=cw.control_right,
+                value_correction=list(value_correction),
+            )
+        )

@@ -1,0 +1,80 @@
+"""Host-side 128-bit integer helpers.
+
+The whole framework represents 128-bit AES blocks / DPF seeds in two ways:
+
+* On the host (key generation, proto (de)serialization): Python ``int`` in
+  ``[0, 2**128)`` or numpy arrays of shape ``[..., 16]`` (uint8, little-endian
+  bytes) / ``[..., 4]`` (uint32 limbs, little-endian limb order).
+* On device (JAX): ``uint32[..., 4]`` limb arrays, limb 0 = bits 0..31.
+
+The little-endian layout matches the reference C++ library, which hands the
+in-memory representation of an ``absl::uint128`` (x86, little-endian) directly
+to AES (see /root/reference/dpf/aes_128_fixed_key_hash.cc:38-44,70-73). Keeping
+the same byte order makes keys and hash outputs byte-compatible.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MASK64 = (1 << 64) - 1
+MASK128 = (1 << 128) - 1
+
+
+def make_uint128(high: int, low: int) -> int:
+    """Equivalent of absl::MakeUint128: (high << 64) | low."""
+    return ((high & MASK64) << 64) | (low & MASK64)
+
+
+def high64(x: int) -> int:
+    return (x >> 64) & MASK64
+
+
+def low64(x: int) -> int:
+    return x & MASK64
+
+
+def to_bytes(x: int) -> bytes:
+    """128-bit int -> 16 little-endian bytes (the AES-facing layout)."""
+    return int(x & MASK128).to_bytes(16, "little")
+
+
+def from_bytes(b: bytes) -> int:
+    return int.from_bytes(b, "little")
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """128-bit int -> uint32[4] little-endian limbs."""
+    return np.frombuffer(to_bytes(x), dtype=np.uint32).copy()
+
+
+def from_limbs(limbs: np.ndarray) -> int:
+    limbs = np.asarray(limbs, dtype=np.uint32)
+    assert limbs.shape[-1] == 4, limbs.shape
+    return from_bytes(limbs.tobytes())
+
+
+def array_to_limbs(xs) -> np.ndarray:
+    """Iterable of 128-bit ints -> uint32[N, 4]."""
+    xs = list(xs)
+    out = np.empty((len(xs), 4), dtype=np.uint32)
+    for i, x in enumerate(xs):
+        out[i] = to_limbs(x)
+    return out
+
+
+def limbs_to_array(limbs: np.ndarray) -> list:
+    """uint32[N, 4] -> list of 128-bit Python ints."""
+    limbs = np.ascontiguousarray(np.asarray(limbs, dtype=np.uint32))
+    assert limbs.shape[-1] == 4
+    flat = limbs.reshape(-1, 4)
+    return [from_bytes(flat[i].tobytes()) for i in range(flat.shape[0])]
+
+
+def sigma(x: int) -> int:
+    """The MMO orthomorphism sigma(x) = (high ^ low, high).
+
+    Mirrors /root/reference/dpf/aes_128_fixed_key_hash.cc:63-67.
+    """
+    hi, lo = high64(x), low64(x)
+    return make_uint128(hi ^ lo, hi)

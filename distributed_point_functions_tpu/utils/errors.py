@@ -1,0 +1,23 @@
+"""Error types for the framework.
+
+The reference reports failures through absl::Status codes
+(/root/reference/dpf/status_macros.h). At a Python API edge the idiomatic
+equivalent is exceptions; we keep the same *categories* so tests can assert on
+them the way the reference asserts on status codes.
+"""
+
+
+class DpfError(Exception):
+    """Base class for all framework errors."""
+
+
+class InvalidArgumentError(DpfError, ValueError):
+    """Mirrors absl::InvalidArgumentError."""
+
+
+class FailedPreconditionError(DpfError, RuntimeError):
+    """Mirrors absl::FailedPreconditionError."""
+
+
+class UnimplementedError(DpfError, NotImplementedError):
+    """Mirrors absl::UnimplementedError."""

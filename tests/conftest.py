@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Must run before jax is imported anywhere: forces an 8-device virtual CPU
+platform so multi-chip sharding tests (jax.sharding.Mesh over 8 devices) run
+without TPU hardware, and enables x64 so uint64 outputs are representable.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,75 @@
+"""Golden-value and property tests for the numpy AES oracle.
+
+The pinned constants are the cross-implementation compatibility anchors from
+the reference's test suite (/root/reference/dpf/aes_128_fixed_key_hash_test.cc
+:114-135); matching them proves byte compatibility of the PRG layer.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core import constants, uint128
+from distributed_point_functions_tpu.core.aes_numpy import (
+    Aes128FixedKeyHash,
+    SBOX,
+    encrypt_blocks,
+    expand_key,
+)
+
+KEY0 = uint128.make_uint128(0x0000000000000000, 0x0000000000000000)
+KEY1 = uint128.make_uint128(0x1111111111111111, 0x1111111111111111)
+SEED0 = uint128.make_uint128(0x0123012301230123, 0x0123012301230123)
+SEED1 = uint128.make_uint128(0x4567456745674567, 0x4567456745674567)
+
+
+def test_sbox_spot_values():
+    # Standard AES S-box anchors.
+    assert SBOX[0x00] == 0x63
+    assert SBOX[0x01] == 0x7C
+    assert SBOX[0x53] == 0xED
+    assert SBOX[0xFF] == 0x16
+
+
+def test_fips197_vector():
+    # FIPS-197 Appendix B: AES-128 single block.
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    pt = bytes.fromhex("3243f6a8885a308d313198a2e0370734")
+    ct = encrypt_blocks(
+        np.frombuffer(pt, dtype=np.uint8)[None, :], expand_key(key)
+    ).tobytes()
+    assert ct.hex() == "3925841d02dc09fbdc118597196a0b32"
+
+
+def test_fixed_key_hash_golden_values():
+    out0 = Aes128FixedKeyHash(KEY0).evaluate([SEED0, SEED1])
+    out1 = Aes128FixedKeyHash(KEY1).evaluate([SEED0, SEED1])
+    assert out0 == [
+        uint128.make_uint128(0x73C2DC14812BE4EF, 0xEAC64D09C8ADF8ED),
+        uint128.make_uint128(0xB8F33653A53A8436, 0xAEDF39B62DE91D95),
+    ]
+    assert out1 == [
+        uint128.make_uint128(0x934704AFF58FA233, 0xD3C20D1B9CC18D8F),
+        uint128.make_uint128(0x530098817046D284, 0x43E61D3273A04F7C),
+    ]
+
+
+def test_batched_equals_single():
+    prg = Aes128FixedKeyHash(KEY1)
+    xs = [uint128.make_uint128(i * 7, i * 13 + 1) for i in range(131)]
+    batched = prg.evaluate(xs)
+    singles = [prg.evaluate_one(x) for x in xs]
+    assert batched == singles
+
+
+def test_prg_keys_derived_from_sha256_of_names():
+    for name, value in [
+        ("kPrgKeyLeft", constants.PRG_KEY_LEFT),
+        ("kPrgKeyRight", constants.PRG_KEY_RIGHT),
+        ("kPrgKeyValue", constants.PRG_KEY_VALUE),
+    ]:
+        digest = hashlib.sha256(
+            f"DistributedPointFunction::{name}\n".encode()
+        ).digest()[:16]
+        assert int.from_bytes(digest, "big") == value

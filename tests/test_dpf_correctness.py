@@ -1,0 +1,188 @@
+"""Share-sum correctness property tests for the core DPF engine.
+
+The workhorse acceptance property from the reference test suite
+(/root/reference/dpf/distributed_point_function_test.cc:334-462): evaluating
+*both* keys and summing must give beta at alpha (or a prefix of alpha) and
+zero everywhere else, across value types, hierarchies, and evaluation modes.
+"""
+
+import random
+
+import pytest
+
+from distributed_point_functions_tpu import (
+    DistributedPointFunction,
+    DpfParameters,
+    Int,
+    IntModN,
+    InvalidArgumentError,
+    TupleType,
+    XorWrapper,
+)
+
+RNG = random.Random(0xDF0)
+
+
+def make_dpf(params):
+    return DistributedPointFunction.create_incremental(params)
+
+
+def combine(vt, a, b):
+    return vt.add(a, b)
+
+
+def check_share_sum(vt, shares0, shares1, alpha_index, beta, domain_iter):
+    for x, (a, b) in zip(domain_iter, zip(shares0, shares1)):
+        total = vt.add(a, b)
+        expected = beta if x == alpha_index else vt.zero()
+        assert total == expected, (x, total, expected)
+
+
+@pytest.mark.parametrize("bitsize", [8, 16, 32, 64, 128])
+@pytest.mark.parametrize("log_domain", [0, 1, 5, 10])
+def test_regular_dpf_full_domain(bitsize, log_domain):
+    vt = Int(bitsize)
+    dpf = make_dpf([DpfParameters(log_domain, vt)])
+    alpha = RNG.randrange(1 << log_domain)
+    beta = RNG.randrange(1 << bitsize)
+    k0, k1 = dpf.generate_keys(alpha, beta)
+    ctx0, ctx1 = dpf.create_evaluation_context(k0), dpf.create_evaluation_context(k1)
+    e0, e1 = dpf.evaluate_next([], ctx0), dpf.evaluate_next([], ctx1)
+    assert len(e0) == 1 << log_domain
+    check_share_sum(vt, e0, e1, alpha, beta, range(1 << log_domain))
+
+
+@pytest.mark.parametrize(
+    "vt",
+    [
+        Int(8),
+        Int(128),
+        XorWrapper(64),
+        XorWrapper(128),
+        IntModN(32, 4294967291),  # 2**32 - 5
+        IntModN(64, 18446744073709551557),  # 2**64 - 59
+        TupleType(Int(32), Int(32)),
+        TupleType(Int(8), Int(16), Int(8)),
+        TupleType(Int(64), TupleType(Int(32), Int(32))),
+        TupleType(Int(32), IntModN(32, 4294967291)),
+        TupleType(IntModN(32, 4294967291), IntModN(32, 4294967291)),
+    ],
+    ids=str,
+)
+def test_value_types_full_domain_and_points(vt):
+    log_domain = 7
+    dpf = make_dpf([DpfParameters(log_domain, vt)])
+    alpha = 93
+    beta = random_value(vt)
+    k0, k1 = dpf.generate_keys(alpha, beta)
+    ctx0, ctx1 = dpf.create_evaluation_context(k0), dpf.create_evaluation_context(k1)
+    e0, e1 = dpf.evaluate_next([], ctx0), dpf.evaluate_next([], ctx1)
+    check_share_sum(vt, e0, e1, alpha, beta, range(1 << log_domain))
+
+    points = [RNG.randrange(1 << log_domain) for _ in range(20)] + [alpha]
+    a0 = dpf.evaluate_at(k0, 0, points)
+    a1 = dpf.evaluate_at(k1, 0, points)
+    check_share_sum(vt, a0, a1, alpha, beta, points)
+
+
+def random_value(vt):
+    if isinstance(vt, Int):
+        return RNG.randrange(1 << vt.bitsize)
+    if isinstance(vt, XorWrapper):
+        return RNG.randrange(1 << vt.bitsize)
+    if isinstance(vt, IntModN):
+        return RNG.randrange(vt.modulus)
+    if isinstance(vt, TupleType):
+        return tuple(random_value(e) for e in vt.elements)
+    raise TypeError(vt)
+
+
+@pytest.mark.parametrize("level_step", [1, 2, 3, 5])
+def test_incremental_hierarchy_prefixes(level_step):
+    log_domains = list(range(level_step, 10 + 1, level_step))
+    params = [DpfParameters(ld, Int(64)) for ld in log_domains]
+    dpf = make_dpf(params)
+    alpha = RNG.randrange(1 << log_domains[-1])
+    betas = [RNG.randrange(1 << 64) for _ in params]
+    k0, k1 = dpf.generate_keys_incremental(alpha, betas)
+    ctx0, ctx1 = dpf.create_evaluation_context(k0), dpf.create_evaluation_context(k1)
+
+    vt = Int(64)
+    prefixes = []
+    for level, ld in enumerate(log_domains):
+        e0 = dpf.evaluate_until(level, prefixes, ctx0)
+        e1 = dpf.evaluate_until(level, prefixes, ctx1)
+        alpha_prefix = alpha >> (log_domains[-1] - ld)
+        # Reconstruct absolute indices for the evaluated prefixes.
+        if prefixes:
+            step = ld - log_domains[level - 1]
+            indices = [
+                (p << step) | j for p in prefixes for j in range(1 << step)
+            ]
+        else:
+            indices = list(range(1 << ld))
+        check_share_sum(vt, e0, e1, alpha_prefix, betas[level], indices)
+        # Keep the path containing alpha plus a decoy prefix.
+        decoy = (alpha_prefix + 1) % (1 << ld)
+        prefixes = sorted({alpha_prefix, decoy})
+
+
+def test_evaluate_at_all_hierarchy_levels_with_ctx():
+    params = [DpfParameters(ld, Int(32)) for ld in (4, 8, 12)]
+    dpf = make_dpf(params)
+    alpha = 0xABC
+    betas = [5, 6, 7]
+    k0, k1 = dpf.generate_keys_incremental(alpha, betas)
+    vt = Int(32)
+    # Without a context: each call starts from the key seed.
+    for level, ld in enumerate((4, 8, 12)):
+        alpha_prefix = alpha >> (12 - ld)
+        points = [alpha_prefix, (alpha_prefix + 2) % (1 << ld)]
+        a0 = dpf.evaluate_at(k0, level, points)
+        a1 = dpf.evaluate_at(k1, level, points)
+        check_share_sum(vt, a0, a1, alpha_prefix, betas[level], points)
+    # With a context: partial evaluations are saved and reused per level.
+    ctx0, ctx1 = dpf.create_evaluation_context(k0), dpf.create_evaluation_context(k1)
+    for level, ld in enumerate((4, 8, 12)):
+        alpha_prefix = alpha >> (12 - ld)
+        points = [alpha_prefix, (alpha_prefix + 2) % (1 << ld)]
+        a0 = dpf.evaluate_at(k0, level, points, ctx=ctx0)
+        a1 = dpf.evaluate_at(k1, level, points, ctx=ctx1)
+        check_share_sum(vt, a0, a1, alpha_prefix, betas[level], points)
+        assert ctx0.previous_hierarchy_level == level
+
+
+def test_128_bit_domain_point_eval():
+    vt = Int(64)
+    dpf = make_dpf([DpfParameters(128, vt)])
+    alpha = (1 << 127) + 12345
+    beta = 42
+    k0, k1 = dpf.generate_keys(alpha, beta)
+    points = [alpha, 0, (1 << 128) - 1, alpha ^ 1]
+    a0 = dpf.evaluate_at(k0, 0, points)
+    a1 = dpf.evaluate_at(k1, 0, points)
+    check_share_sum(vt, a0, a1, alpha, beta, points)
+
+
+def test_keygen_validation_errors():
+    dpf = make_dpf([DpfParameters(5, Int(32))])
+    with pytest.raises(InvalidArgumentError, match="smaller than the output domain"):
+        dpf.generate_keys(32, 1)
+    with pytest.raises(InvalidArgumentError, match="too large"):
+        dpf.generate_keys(3, 1 << 32)
+    with pytest.raises(InvalidArgumentError, match="same size as `parameters`"):
+        dpf.generate_keys_incremental(3, [1, 2])
+
+
+def test_context_lifecycle_errors():
+    dpf = make_dpf([DpfParameters(3, Int(32)), DpfParameters(6, Int(32))])
+    k0, _ = dpf.generate_keys_incremental(5, [1, 2])
+    ctx = dpf.create_evaluation_context(k0)
+    with pytest.raises(InvalidArgumentError, match="must be empty"):
+        dpf.evaluate_until(0, [1], ctx)
+    dpf.evaluate_until(0, [], ctx)
+    with pytest.raises(InvalidArgumentError, match="greater than"):
+        dpf.evaluate_until(0, [0], ctx)
+    dpf.evaluate_until(1, [0, 1], ctx)
+    with pytest.raises(InvalidArgumentError, match="fully evaluated"):
+        dpf.evaluate_until(1, [0], ctx)

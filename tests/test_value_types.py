@@ -1,0 +1,112 @@
+"""Value-type algebra, sampling, and security-accounting tests.
+
+Ports the patterns of /root/reference/dpf/{tuple,xor_wrapper,int_mod_n}_test.cc
+including the pinned IntModN sampling worked example
+(int_mod_n_test.cc:158-211), which anchors sampling byte-compatibility.
+"""
+
+import math
+
+import pytest
+
+from distributed_point_functions_tpu import (
+    Int,
+    IntModN,
+    InvalidArgumentError,
+    TupleType,
+    XorWrapper,
+)
+from distributed_point_functions_tpu.core.value_types import (
+    int_mod_n_num_bytes_required,
+    int_mod_n_security_level,
+)
+
+MOD32 = 4294967291  # 2**32 - 5
+SECURITY = 40.0
+
+
+def test_int_group_laws():
+    vt = Int(16)
+    assert vt.add(0xFFFF, 1) == 0
+    assert vt.sub(0, 1) == 0xFFFF
+    assert vt.neg(5) == vt.sub(0, 5)
+    assert vt.elements_per_block() == 8
+
+
+def test_xor_wrapper_group():
+    vt = XorWrapper(64)
+    a, b = 0xDEADBEEF, 0x12345678
+    assert vt.add(a, b) == a ^ b
+    assert vt.sub(a, b) == a ^ b
+    assert vt.neg(a) == a  # -a == a under XOR
+    assert vt.elements_per_block() == 2
+
+
+def test_int_mod_n_group():
+    vt = IntModN(32, MOD32)
+    assert vt.add(MOD32 - 1, 1) == 0
+    assert vt.sub(0, 1) == MOD32 - 1
+    assert vt.elements_per_block() == 1
+
+
+def test_int_mod_n_security_accounting():
+    # GetSecurityLevel = 128 + 3 - (log2 N + log2 n + log2 (n+1)).
+    assert int_mod_n_security_level(1, 1 << 32) == pytest.approx(
+        131 - 32 - math.log2(2)
+    )
+    # Worked example: 5 samples of IntModN<uint32, 2**32-5> need 32 bytes.
+    assert int_mod_n_num_bytes_required(5, 32, MOD32, SECURITY) == 32
+    with pytest.raises(InvalidArgumentError, match="statistical security"):
+        int_mod_n_num_bytes_required(100000, 64, (1 << 64) - 59, 100.0)
+
+
+def test_int_mod_n_sampling_worked_example():
+    # Mirrors IntModNTest.SampleFromBytesWorksInConcreteExample
+    # (int_mod_n_test.cc:158-190).
+    data = b"this is a length 32 test string."
+    vt = TupleType(*[IntModN(32, MOD32)] * 5)
+    samples = vt.from_bytes(data)
+    r = int.from_bytes(b"this is a length", "little")
+    expected = []
+    for chunk in (b" 32 ", b"test", b" str", b"ing."):
+        expected.append(r % MOD32)
+        r //= MOD32
+        r <<= 32
+        r |= int.from_bytes(chunk, "little")
+    expected.append(r % MOD32)
+    assert list(samples) == expected
+
+
+def test_tuple_layout_and_bits_needed():
+    vt = TupleType(Int(32), Int(32))
+    assert vt.total_bit_size() == 64
+    assert vt.elements_per_block() == 2
+    assert vt.bits_needed(SECURITY) == 64
+
+    mixed = TupleType(Int(32), IntModN(32, MOD32))
+    assert mixed.elements_per_block() == 1
+    # 32 bits for the integer + 128 bits (16 bytes) for one IntModN sample.
+    assert mixed.bits_needed(SECURITY) == 32 + 128
+
+
+def test_tuple_direct_from_bytes_little_endian():
+    vt = TupleType(Int(16), Int(32))
+    data = (0x1234).to_bytes(2, "little") + (0xDEADBEEF).to_bytes(4, "little")
+    assert vt.directly_from_bytes(data) == (0x1234, 0xDEADBEEF)
+
+
+def test_validation_errors():
+    with pytest.raises(InvalidArgumentError, match="power of 2"):
+        Int(12).validate()
+    with pytest.raises(InvalidArgumentError, match="positive"):
+        Int(0).validate()
+    with pytest.raises(InvalidArgumentError, match="128"):
+        Int(256).validate()
+    with pytest.raises(InvalidArgumentError):
+        IntModN(32, 1 << 33).validate()
+    with pytest.raises(InvalidArgumentError, match="too large"):
+        Int(8).validate_value(256)
+    with pytest.raises(InvalidArgumentError, match="modulus"):
+        IntModN(32, MOD32).validate_value(MOD32)
+    with pytest.raises(InvalidArgumentError, match="size"):
+        TupleType(Int(8), Int(8)).validate_value((1,))
