@@ -118,18 +118,10 @@ class DistributedPointFunction:
     # ------------------------------------------------------------------
 
     def _domain_to_tree_index(self, domain_index: int, hierarchy_level: int) -> int:
-        bits = (
-            self._validator.parameters[hierarchy_level].log_domain_size
-            - self._validator.hierarchy_to_tree[hierarchy_level]
-        )
-        return domain_index >> bits
+        return self._validator.domain_to_tree_index(domain_index, hierarchy_level)
 
     def _domain_to_block_index(self, domain_index: int, hierarchy_level: int) -> int:
-        bits = (
-            self._validator.parameters[hierarchy_level].log_domain_size
-            - self._validator.hierarchy_to_tree[hierarchy_level]
-        )
-        return domain_index & ((1 << bits) - 1)
+        return self._validator.domain_to_block_index(domain_index, hierarchy_level)
 
     def _evaluate_seeds_arrays(
         self,

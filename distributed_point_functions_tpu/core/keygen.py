@@ -44,9 +44,7 @@ class KeyGenerator:
     # -- helpers -----------------------------------------------------------
 
     def _domain_to_block_index(self, domain_index: int, hierarchy_level: int) -> int:
-        p = self._v.parameters[hierarchy_level]
-        block_index_bits = p.log_domain_size - self._v.hierarchy_to_tree[hierarchy_level]
-        return domain_index & ((1 << block_index_bits) - 1)
+        return self._v.domain_to_block_index(domain_index, hierarchy_level)
 
     def _compute_value_correction(
         self, hierarchy_level: int, seeds: List[int], alpha: int, beta, invert: bool

@@ -125,6 +125,21 @@ class ParameterValidator:
     def num_hierarchy_levels(self) -> int:
         return len(self.parameters)
 
+    def block_index_bits(self, hierarchy_level: int) -> int:
+        """Bits of a domain index below the tree (block packing)."""
+        return (
+            self.parameters[hierarchy_level].log_domain_size
+            - self.hierarchy_to_tree[hierarchy_level]
+        )
+
+    def domain_to_tree_index(self, domain_index: int, hierarchy_level: int) -> int:
+        """Mirrors DomainToTreeIndex (distributed_point_function.cc:206-213)."""
+        return domain_index >> self.block_index_bits(hierarchy_level)
+
+    def domain_to_block_index(self, domain_index: int, hierarchy_level: int) -> int:
+        """Mirrors DomainToBlockIndex (distributed_point_function.cc:215-221)."""
+        return domain_index & ((1 << self.block_index_bits(hierarchy_level)) - 1)
+
     def validate_value(self, value, hierarchy_level: int) -> None:
         self.parameters[hierarchy_level].value_type.validate_value(value)
 

@@ -36,7 +36,7 @@ def low64(x: int) -> int:
 
 def to_bytes(x: int) -> bytes:
     """128-bit int -> 16 little-endian bytes (the AES-facing layout)."""
-    return int(x & MASK128).to_bytes(16, "little")
+    return (int(x) & MASK128).to_bytes(16, "little")
 
 
 def from_bytes(b: bytes) -> int:

@@ -1,0 +1,352 @@
+"""Bitsliced AES-128 fixed-key hash in pure JAX — the TPU PRG primitive.
+
+TPU has no AES instruction and table lookups do not vectorize on the VPU, so
+AES is computed as boolean algebra on *bit-planes*: a batch of N 128-bit
+blocks is transposed into 128 planes of N bits, each plane packed 32
+lanes/word into ``uint32[W]`` (W = N/32). Every AES step is then XOR/AND on
+uint32 vectors, which the VPU executes 8x128 lanes at a time — one vector op
+processes 32 blocks per element. The S-box is the 113-gate Boyar-Peralta
+circuit; ShiftRows is a static byte-plane permutation; MixColumns is a small
+XOR network.
+
+This replaces the reference's two AES paths — OpenSSL EVP
+(/root/reference/dpf/aes_128_fixed_key_hash.cc) and the Highway SIMD
+register implementation with per-lane key selection
+(/root/reference/dpf/internal/aes_128_fixed_key_hash_hwy.h:62-229) — with a
+single data layout that also keeps the DPF level loop (correction XOR,
+control-bit extraction, left/right key choice by path bit) in plane space, so
+an entire tree walk never leaves the packed representation.
+
+Per-lane key selection (the reference's `HashOneWithKeyMask`) costs only two
+extra vector ops per differing round-key bit: round keys are 0/~0 plane
+constants, so ``rk = rk_left ^ (diff & lane_mask)``.
+
+Everything here is differentially tested against the numpy oracle
+(core/aes_numpy.py), which in turn pins the reference's golden hash vectors.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import uint128
+from ..core.aes_numpy import expand_key
+
+# ---------------------------------------------------------------------------
+# Packing: uint32[N, 4] limbs <-> uint32[128, W] bit-planes (N = 32*W)
+# ---------------------------------------------------------------------------
+
+_TSHIFTS = (16, 8, 4, 2, 1)
+_TMASKS = (0x0000FFFF, 0x00FF00FF, 0x0F0F0F0F, 0x33333333, 0x55555555)
+
+
+def _bit_transpose32(a: jnp.ndarray) -> jnp.ndarray:
+    """Transpose 32x32 bit matrices: out[..., j] bit i == in[..., i] bit j.
+
+    Masked-shift butterfly (5 stages); the word-order reversals adapt the
+    classic MSB-column algorithm to LSB-first bit indexing. Self-inverse.
+    """
+    lead = a.shape[:-1]
+    a = a[..., ::-1]
+    for j, m in zip(_TSHIFTS, _TMASKS):
+        mm = jnp.uint32(m)
+        g = a.reshape(lead + (32 // (2 * j), 2, j))
+        a0 = g[..., 0, :]
+        a1 = g[..., 1, :]
+        t = (a0 ^ (a1 >> j)) & mm
+        a0 = a0 ^ t
+        a1 = a1 ^ (t << j)
+        a = jnp.stack([a0, a1], axis=-2).reshape(lead + (32,))
+    return a[..., ::-1]
+
+
+def pack_to_planes(x: jnp.ndarray) -> jnp.ndarray:
+    """uint32[N, 4] blocks -> uint32[128, W] planes; plane b, word w holds bit
+    b of blocks 32w..32w+31 (block 32w+i in bit i). N must be a multiple of 32.
+    """
+    n = x.shape[0]
+    assert n % 32 == 0, n
+    w = n // 32
+    rows = x.reshape(w, 32, 4).transpose(2, 0, 1)  # [limb, W, 32]
+    t = _bit_transpose32(rows)  # [limb, W, 32]: word j holds bit j of rows
+    return t.transpose(0, 2, 1).reshape(128, w)
+
+
+def unpack_from_planes(planes: jnp.ndarray) -> jnp.ndarray:
+    """uint32[128, W] planes -> uint32[32*W, 4] blocks (inverse of pack)."""
+    w = planes.shape[1]
+    t = planes.reshape(4, 32, w).transpose(0, 2, 1)  # [limb, W, 32]
+    rows = _bit_transpose32(t)
+    return rows.transpose(1, 2, 0).reshape(32 * w, 4)
+
+
+def pack_bit_mask(bits: np.ndarray) -> np.ndarray:
+    """Host-side: bool[..., N] -> uint32[..., N//32] lane masks (bit i of word
+    w = element 32w+i), matching the pack_to_planes lane order."""
+    bits = np.asarray(bits, dtype=bool)
+    n = bits.shape[-1]
+    assert n % 32 == 0, n
+    w = bits.reshape(bits.shape[:-1] + (n // 32, 32)).astype(np.uint32)
+    return (w << np.arange(32, dtype=np.uint32)).sum(axis=-1, dtype=np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Boyar-Peralta S-box circuit (113 gates), bit-plane operands
+# ---------------------------------------------------------------------------
+
+
+def _bp_sbox(u0, u1, u2, u3, u4, u5, u6, u7):
+    """Forward AES S-box on 8 bit-planes; u0 is the MSB. Any uint dtype."""
+    y14 = u3 ^ u5
+    y13 = u0 ^ u6
+    y9 = u0 ^ u3
+    y8 = u0 ^ u5
+    t0 = u1 ^ u2
+    y1 = t0 ^ u7
+    y4 = y1 ^ u3
+    y12 = y13 ^ y14
+    y2 = y1 ^ u0
+    y5 = y1 ^ u6
+    y3 = y5 ^ y8
+    t1 = u4 ^ y12
+    y15 = t1 ^ u5
+    y20 = t1 ^ u1
+    y6 = y15 ^ u7
+    y10 = y15 ^ t0
+    y11 = y20 ^ y9
+    y7 = u7 ^ y11
+    y17 = y10 ^ y11
+    y19 = y10 ^ y8
+    y16 = t0 ^ y11
+    y21 = y13 ^ y16
+    y18 = u0 ^ y16
+    t2 = y12 & y15
+    t3 = y3 & y6
+    t4 = t3 ^ t2
+    t5 = y4 & u7
+    t6 = t5 ^ t2
+    t7 = y13 & y16
+    t8 = y5 & y1
+    t9 = t8 ^ t7
+    t10 = y2 & y7
+    t11 = t10 ^ t7
+    t12 = y9 & y11
+    t13 = y14 & y17
+    t14 = t13 ^ t12
+    t15 = y8 & y10
+    t16 = t15 ^ t12
+    t17 = t4 ^ t14
+    t18 = t6 ^ t16
+    t19 = t9 ^ t14
+    t20 = t11 ^ t16
+    t21 = t17 ^ y20
+    t22 = t18 ^ y19
+    t23 = t19 ^ y21
+    t24 = t20 ^ y18
+    t25 = t21 ^ t22
+    t26 = t21 & t23
+    t27 = t24 ^ t26
+    t28 = t25 & t27
+    t29 = t28 ^ t22
+    t30 = t23 ^ t24
+    t31 = t22 ^ t26
+    t32 = t31 & t30
+    t33 = t32 ^ t24
+    t34 = t23 ^ t33
+    t35 = t27 ^ t33
+    t36 = t24 & t35
+    t37 = t36 ^ t34
+    t38 = t27 ^ t36
+    t39 = t29 & t38
+    t40 = t25 ^ t39
+    t41 = t40 ^ t37
+    t42 = t29 ^ t33
+    t43 = t29 ^ t40
+    t44 = t33 ^ t37
+    t45 = t42 ^ t41
+    z0 = t44 & y15
+    z1 = t37 & y6
+    z2 = t33 & u7
+    z3 = t43 & y16
+    z4 = t40 & y1
+    z5 = t29 & y7
+    z6 = t42 & y11
+    z7 = t45 & y17
+    z8 = t41 & y10
+    z9 = t44 & y12
+    z10 = t37 & y3
+    z11 = t33 & y4
+    z12 = t43 & y13
+    z13 = t40 & y5
+    z14 = t29 & y2
+    z15 = t42 & y9
+    z16 = t45 & y14
+    z17 = t41 & y8
+    t46 = z15 ^ z16
+    t47 = z10 ^ z11
+    t48 = z5 ^ z13
+    t49 = z9 ^ z10
+    t50 = z2 ^ z12
+    t51 = z2 ^ z5
+    t52 = z7 ^ z8
+    t53 = z0 ^ z3
+    t54 = z6 ^ z7
+    t55 = z16 ^ z17
+    t56 = z12 ^ t48
+    t57 = t50 ^ t53
+    t58 = z4 ^ t46
+    t59 = z3 ^ t54
+    t60 = t46 ^ t57
+    t61 = z14 ^ t57
+    t62 = t52 ^ t58
+    t63 = t49 ^ t58
+    t64 = z4 ^ t59
+    t65 = t61 ^ t62
+    t66 = z1 ^ t63
+    s0 = t59 ^ t63
+    s6 = ~(t56 ^ t62)
+    s7 = ~(t48 ^ t60)
+    t67 = t64 ^ t65
+    s3 = t53 ^ t66
+    s4 = t51 ^ t66
+    s5 = t47 ^ t65
+    s1 = ~(t64 ^ s3)
+    s2 = ~(t55 ^ t67)
+    return s0, s1, s2, s3, s4, s5, s6, s7
+
+
+def _sub_bytes(state: jnp.ndarray) -> jnp.ndarray:
+    """S-box on state [16, 8, W] (byte-plane, bit index LSB-first)."""
+    u = [state[:, 7 - i, :] for i in range(8)]  # u0 = MSB = bit 7
+    s = _bp_sbox(*u)
+    return jnp.stack([s[7 - k] for k in range(8)], axis=1)
+
+
+# ShiftRows source index for output byte j (column-major state, byte j =
+# row j%4, col j//4): out[row, col] = in[row, (col + row) % 4]. Mirrors the
+# numpy oracle's table (core/aes_numpy.py).
+_SHIFT_ROWS = tuple(
+    (row + 4 * ((col + row) % 4)) for col in range(4) for row in range(4)
+)
+
+
+def _shift_rows(state: jnp.ndarray) -> jnp.ndarray:
+    return state[jnp.array(_SHIFT_ROWS), :, :]
+
+
+def _xtime(a: jnp.ndarray) -> jnp.ndarray:
+    """GF(2^8) doubling on bit-planes [..., 8, W]: x<<1 ^ (0x1B if MSB)."""
+    a7 = a[..., 7, :]
+    return jnp.stack(
+        [
+            a7,
+            a[..., 0, :] ^ a7,
+            a[..., 1, :],
+            a[..., 2, :] ^ a7,
+            a[..., 3, :] ^ a7,
+            a[..., 4, :],
+            a[..., 5, :],
+            a[..., 6, :],
+        ],
+        axis=-2,
+    )
+
+
+def _mix_columns(state: jnp.ndarray) -> jnp.ndarray:
+    w = state.shape[-1]
+    s = state.reshape(4, 4, 8, w)  # [col, row, bit, W]
+    t = s[:, 0] ^ s[:, 1] ^ s[:, 2] ^ s[:, 3]  # [col, 8, W]
+    rows = []
+    for r in range(4):
+        rows.append(s[:, r] ^ t ^ _xtime(s[:, r] ^ s[:, (r + 1) % 4]))
+    return jnp.stack(rows, axis=1).reshape(16, 8, w)
+
+
+# ---------------------------------------------------------------------------
+# Round keys as plane constants
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def round_key_planes(key: int) -> np.ndarray:
+    """AES-128 round keys -> uint32[11, 16, 8] of 0 / 0xFFFFFFFF plane masks."""
+    rks = expand_key(uint128.to_bytes(key))  # uint8[11, 16]
+    bits = (rks[:, :, None] >> np.arange(8, dtype=np.uint8)) & 1
+    return (bits.astype(np.uint32) * np.uint32(0xFFFFFFFF)).astype(np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Core encryption + fixed-key hash, in plane space
+# ---------------------------------------------------------------------------
+
+
+def aes_encrypt_planes(state, rk_base, rk_diff=None, key_mask=None):
+    """AES-128 over bit-planes.
+
+    Args:
+      state: uint32[16, 8, W] byte/bit planes of the plaintext blocks.
+      rk_base: uint32[11, 16, 8] plane-constant round keys (0 / ~0).
+      rk_diff: optional uint32[11, 16, 8]; when given with `key_mask`
+        (uint32[W]), lanes with a set mask bit are encrypted under
+        rk_base ^ rk_diff instead — the reference's per-lane key selection
+        (aes_128_fixed_key_hash_hwy.h:88-107) for free in plane space.
+    Returns: uint32[16, 8, W] ciphertext planes.
+    """
+
+    def ark(s, r):
+        k = rk_base[r][:, :, None]
+        if rk_diff is not None:
+            k = k ^ (rk_diff[r][:, :, None] & key_mask[None, None, :])
+        return s ^ k
+
+    s = ark(state, 0)
+    for r in range(1, 11):
+        s = _sub_bytes(s)
+        s = _shift_rows(s)
+        if r < 10:
+            s = _mix_columns(s)
+        s = ark(s, r)
+    return s
+
+
+def sigma_planes(planes: jnp.ndarray) -> jnp.ndarray:
+    """MMO orthomorphism sigma(x) = (high ^ low, high) on [128, W] planes."""
+    lo, hi = planes[:64], planes[64:]
+    return jnp.concatenate([hi, hi ^ lo], axis=0)
+
+
+def hash_planes(planes, rk_base, rk_diff=None, key_mask=None):
+    """Fixed-key MMO hash H(x) = AES_k(sigma(x)) ^ sigma(x) on [128, W] planes.
+
+    Plane-space equivalent of Aes128FixedKeyHash::Evaluate
+    (/root/reference/dpf/aes_128_fixed_key_hash.cc:47-85); with
+    rk_diff/key_mask it is HashOneWithKeyMask
+    (/root/reference/dpf/internal/aes_128_fixed_key_hash_hwy.h:88-107).
+    """
+    w = planes.shape[1]
+    sig = sigma_planes(planes)
+    enc = aes_encrypt_planes(sig.reshape(16, 8, w), rk_base, rk_diff, key_mask)
+    return enc.reshape(128, w) ^ sig
+
+
+# Convenience block-layout wrappers (pack -> op -> unpack), mostly for tests.
+
+
+@functools.partial(jax.jit, static_argnames=("key",))
+def encrypt_blocks_jax(x: jnp.ndarray, key: int) -> jnp.ndarray:
+    """uint32[N, 4] -> AES-128_key(blocks), N % 32 == 0."""
+    rk = jnp.asarray(round_key_planes(key))
+    planes = pack_to_planes(x)
+    out = aes_encrypt_planes(planes.reshape(16, 8, -1), rk)
+    return unpack_from_planes(out.reshape(128, -1))
+
+
+@functools.partial(jax.jit, static_argnames=("key",))
+def hash_blocks_jax(x: jnp.ndarray, key: int) -> jnp.ndarray:
+    """uint32[N, 4] -> H_key(blocks) (fixed-key MMO hash), N % 32 == 0."""
+    rk = jnp.asarray(round_key_planes(key))
+    return unpack_from_planes(hash_planes(pack_to_planes(x), rk))

@@ -1,0 +1,320 @@
+"""JAX/TPU evaluation backend: the three DPF hot primitives in plane space.
+
+TPU-native re-design of the reference's evaluation kernels:
+
+* ``evaluate_seeds``  — dpf_internal::EvaluateSeeds
+  (/root/reference/dpf/internal/evaluate_prg_hwy.cc:143-506): a
+  ``jax.lax.scan`` over tree levels; per level one masked-key bitsliced AES
+  hash + correction XOR + control-bit extraction, all on uint32 bit-planes.
+* ``expand_seeds``    — DistributedPointFunction::ExpandSeeds
+  (/root/reference/dpf/distributed_point_function.cc:271-349): per level both
+  PRGs are applied to every lane and the lane axis doubles. Children are laid
+  out block-concatenated ([all left | all right]) rather than interleaved —
+  packed lanes make interleaving a bit-shuffle — and the resulting
+  bit-reversal permutation is undone by a single gather at unpack time.
+* ``hash_expanded_seeds`` — HashExpandedSeeds
+  (/root/reference/dpf/distributed_point_function.cc:500-524): value-PRG hash
+  of seed+j for j < blocks_needed.
+
+The class `JaxBackend` exposes these with a numpy boundary (drop-in for
+`NumpyBackend` in core/dpf.py); the `*_planes` functions are the pure device
+path used by the batched evaluators (ops/evaluator.py) which never leave the
+device between levels.
+
+Lane padding: lane counts are padded up to a multiple of 32 (one packed
+word); padded lanes compute garbage independently and are trimmed on unpack.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import constants
+from . import aes_jax
+
+_FULL = np.uint32(0xFFFFFFFF)
+
+
+@functools.lru_cache(maxsize=None)
+def _rk_np(which: str) -> np.ndarray:
+    left = aes_jax.round_key_planes(constants.PRG_KEY_LEFT)
+    if which == "left":
+        return left
+    if which == "right":
+        return aes_jax.round_key_planes(constants.PRG_KEY_RIGHT)
+    if which == "value":
+        return aes_jax.round_key_planes(constants.PRG_KEY_VALUE)
+    if which == "lr_diff":
+        return left ^ aes_jax.round_key_planes(constants.PRG_KEY_RIGHT)
+    raise ValueError(which)
+
+
+def _rk(which: str) -> jnp.ndarray:
+    # jnp conversion happens at the use site: inside a jit trace the numpy
+    # array becomes an embedded constant (caching a jnp array here would
+    # leak tracers through the lru_cache).
+    return jnp.asarray(_rk_np(which))
+
+
+def cw_seed_planes(correction_seeds: np.ndarray) -> np.ndarray:
+    """uint32[L, 4] limb rows -> uint32[L, 128] plane-broadcast masks."""
+    cs = np.asarray(correction_seeds, dtype=np.uint32)
+    bits = (cs[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    return (bits.reshape(cs.shape[0], 128) * _FULL).astype(np.uint32)
+
+
+def control_masks(flags: np.ndarray) -> np.ndarray:
+    """bool[L] -> uint32[L] all-zeros/all-ones lane-broadcast masks."""
+    return np.where(np.asarray(flags, dtype=bool), _FULL, np.uint32(0)).astype(
+        np.uint32
+    )
+
+
+# ---------------------------------------------------------------------------
+# Device cores (pure functions of device arrays; jitted by shape)
+# ---------------------------------------------------------------------------
+
+
+def evaluate_seeds_planes(planes, control, path_masks, cw_planes, ccl, ccr):
+    """Walks every lane down L tree levels along its path — plane space.
+
+    Args:
+      planes: uint32[128, W] packed seeds. control: uint32[W] lane mask.
+      path_masks: uint32[L, W] per-level packed path bits (bit set = right).
+      cw_planes: uint32[L, 128] correction-seed plane masks.
+      ccl, ccr: uint32[L] control-correction masks (0 / ~0).
+    Returns: (uint32[128, W], uint32[W]).
+    """
+    rk_left = _rk("left")
+    rk_diff = _rk("lr_diff")
+
+    def body(carry, xs):
+        p, c = carry
+        path_mask, cw, l, r = xs
+        h = aes_jax.hash_planes(p, rk_left, rk_diff, path_mask)
+        h = h ^ (cw[:, None] & c[None, :])
+        new_control = h[0]
+        h = h.at[0].set(jnp.zeros_like(h[0]))
+        cc = (l & ~path_mask) | (r & path_mask)
+        return (h, new_control ^ (c & cc)), None
+
+    (planes, control), _ = jax.lax.scan(
+        body, (planes, control), (path_masks, cw_planes, ccl, ccr)
+    )
+    return planes, control
+
+
+@jax.jit
+def _evaluate_seeds_blocks_jit(seeds, control, path_masks, cw, ccl, ccr):
+    """pack -> level scan -> unpack fused under one jit."""
+    planes = aes_jax.pack_to_planes(seeds)
+    out_planes, out_control = evaluate_seeds_planes(
+        planes, control, path_masks, cw, ccl, ccr
+    )
+    return aes_jax.unpack_from_planes(out_planes), out_control
+
+
+def expand_one_level(planes, control, cw_plane, ccl_mask, ccr_mask):
+    """One doubling level: every lane hashed under both PRG keys.
+
+    Returns planes/control with the lane axis doubled, children
+    block-concatenated: [left children | right children].
+    """
+    corr = cw_plane[:, None] & control[None, :]
+    hl = aes_jax.hash_planes(planes, _rk("left")) ^ corr
+    hr = aes_jax.hash_planes(planes, _rk("right")) ^ corr
+    new_control = jnp.concatenate(
+        [hl[0] ^ (control & ccl_mask), hr[0] ^ (control & ccr_mask)]
+    )
+    zero = jnp.zeros_like(hl[0])
+    out = jnp.concatenate([hl.at[0].set(zero), hr.at[0].set(zero)], axis=1)
+    return out, new_control
+
+
+_expand_one_level_jit = jax.jit(expand_one_level)
+_pack_jit = jax.jit(aes_jax.pack_to_planes)
+_unpack_jit = jax.jit(aes_jax.unpack_from_planes)
+
+
+def hash_value_planes(planes):
+    """Value-PRG hash of packed seeds (the j=0 block)."""
+    return aes_jax.hash_planes(planes, _rk("value"))
+
+
+@functools.partial(jax.jit, static_argnames=("blocks_needed",))
+def _hash_expanded_blocks_jit(seeds, blocks_needed: int):
+    """Value-PRG hash of seeds[i]+j for all j < blocks_needed, one batch.
+
+    Returns uint32[blocks_needed, N, 4] (block-major so the per-j hashes stay
+    contiguous lanes in plane space).
+    """
+    inputs = jnp.concatenate(
+        [
+            seeds if j == 0 else _add_small_constant(seeds, np.uint32(j))
+            for j in range(blocks_needed)
+        ],
+        axis=0,
+    )
+    hashed = hash_value_planes(aes_jax.pack_to_planes(inputs))
+    return aes_jax.unpack_from_planes(hashed).reshape(
+        blocks_needed, seeds.shape[0], 4
+    )
+
+
+def _add_small_constant(limbs: jnp.ndarray, j) -> jnp.ndarray:
+    """uint128 limb addition of a small scalar j, with carry propagation."""
+    out0 = limbs[:, 0] + jnp.uint32(j)
+    carry = (out0 < limbs[:, 0]).astype(jnp.uint32)
+    out1 = limbs[:, 1] + carry
+    carry = (out1 < limbs[:, 1]).astype(jnp.uint32)
+    out2 = limbs[:, 2] + carry
+    carry = (out2 < limbs[:, 2]).astype(jnp.uint32)
+    out3 = limbs[:, 3] + carry
+    return jnp.stack([out0, out1, out2, out3], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Expansion ordering
+# ---------------------------------------------------------------------------
+
+
+def expansion_output_order(num_parents: int, padded_parents: int, levels: int) -> np.ndarray:
+    """Lane index of leaf (parent p, path v) after `levels` block-concatenated
+    doublings: lane = bitrev(v) * padded_parents + p. Returns int64[N_out]
+    gather indices producing the canonical order out[p * 2^levels + v].
+    """
+    v = np.arange(1 << levels, dtype=np.int64)
+    rev = np.zeros_like(v)
+    for b in range(levels):
+        rev |= ((v >> b) & 1) << (levels - 1 - b)
+    p = np.arange(num_parents, dtype=np.int64)
+    return (rev[None, :] * padded_parents + p[:, None]).reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Numpy-boundary backend (drop-in for core.dpf.NumpyBackend)
+# ---------------------------------------------------------------------------
+
+
+def _pad_lanes(seeds: np.ndarray, control_bits: np.ndarray, multiple: int = 32):
+    n = seeds.shape[0]
+    padded = -(-n // multiple) * multiple
+    if padded != n:
+        seeds = np.concatenate(
+            [seeds, np.zeros((padded - n, 4), dtype=np.uint32)], axis=0
+        )
+        control_bits = np.concatenate(
+            [control_bits, np.zeros(padded - n, dtype=bool)]
+        )
+    return seeds, control_bits, n
+
+
+def _path_bit_masks(paths: np.ndarray, num_levels: int, padded: int) -> np.ndarray:
+    """uint32[N, 4] tree indices -> uint32[L, padded//32] per-level lane masks.
+
+    Level l selects bit (num_levels - 1 - l) of the path, as in the scalar
+    reference (evaluate_prg_hwy.cc:441-449).
+    """
+    n = paths.shape[0]
+    bits = np.zeros((num_levels, padded), dtype=bool)
+    for level in range(num_levels):
+        bit_index = num_levels - 1 - level
+        if bit_index < 128:
+            bits[level, :n] = (paths[:, bit_index // 32] >> (bit_index % 32)) & 1
+    return aes_jax.pack_bit_mask(bits)
+
+
+class JaxBackend:
+    """Evaluation primitives on TPU/JAX (numpy in, numpy out)."""
+
+    name = "jax"
+
+    @staticmethod
+    def evaluate_seeds(
+        seeds: np.ndarray,
+        control_bits: np.ndarray,
+        paths: np.ndarray,
+        correction_seeds: np.ndarray,
+        correction_controls_left: np.ndarray,
+        correction_controls_right: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        num_levels = len(correction_seeds)
+        n = seeds.shape[0]
+        if num_levels == 0 or n == 0:
+            return np.array(seeds, dtype=np.uint32), np.asarray(
+                control_bits, dtype=bool
+            ).copy()
+        seeds_p, control_p, _ = _pad_lanes(
+            np.asarray(seeds, np.uint32), np.asarray(control_bits, bool)
+        )
+        control = jnp.asarray(aes_jax.pack_bit_mask(control_p))
+        path_masks = jnp.asarray(
+            _path_bit_masks(np.asarray(paths, np.uint32), num_levels, seeds_p.shape[0])
+        )
+        cw = jnp.asarray(cw_seed_planes(correction_seeds))
+        ccl = jnp.asarray(control_masks(correction_controls_left))
+        ccr = jnp.asarray(control_masks(correction_controls_right))
+        out_seeds, out_control = _evaluate_seeds_blocks_jit(
+            jnp.asarray(seeds_p), control, path_masks, cw, ccl, ccr
+        )
+        out_bits = _unpack_mask(np.asarray(out_control), n)
+        return np.asarray(out_seeds)[:n], out_bits
+
+    @staticmethod
+    def expand_seeds(
+        seeds: np.ndarray,
+        control_bits: np.ndarray,
+        correction_seeds: np.ndarray,
+        correction_controls_left: np.ndarray,
+        correction_controls_right: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        num_levels = len(correction_seeds)
+        n = seeds.shape[0]
+        if num_levels == 0 or n == 0:
+            return np.array(seeds, dtype=np.uint32), np.asarray(
+                control_bits, dtype=bool
+            ).copy()
+        seeds_p, control_p, _ = _pad_lanes(
+            np.asarray(seeds, np.uint32), np.asarray(control_bits, bool)
+        )
+        padded = seeds_p.shape[0]
+        planes = _pack_jit(jnp.asarray(seeds_p))
+        control = jnp.asarray(aes_jax.pack_bit_mask(control_p))
+        cw = cw_seed_planes(correction_seeds)
+        ccl = control_masks(correction_controls_left)
+        ccr = control_masks(correction_controls_right)
+        for level in range(num_levels):
+            planes, control = _expand_one_level_jit(
+                planes,
+                control,
+                jnp.asarray(cw[level]),
+                jnp.uint32(ccl[level]),
+                jnp.uint32(ccr[level]),
+            )
+        out_seeds = np.asarray(_unpack_jit(planes))
+        out_control = _unpack_mask(np.asarray(control), padded << num_levels)
+        order = expansion_output_order(n, padded, num_levels)
+        return out_seeds[order], out_control[order]
+
+    @staticmethod
+    def hash_expanded_seeds(seeds: np.ndarray, blocks_needed: int) -> np.ndarray:
+        seeds = np.asarray(seeds, dtype=np.uint32)
+        n = seeds.shape[0]
+        if n == 0 or blocks_needed == 0:
+            return np.zeros((n, blocks_needed, 4), dtype=np.uint32)
+        seeds_p, _, _ = _pad_lanes(seeds, np.zeros(n, dtype=bool))
+        hashed = _hash_expanded_blocks_jit(jnp.asarray(seeds_p), blocks_needed)
+        return np.asarray(hashed).transpose(1, 0, 2)[:n]
+
+
+def _unpack_mask(mask_words: np.ndarray, n: int) -> np.ndarray:
+    """uint32[W] lane masks -> bool[n]."""
+    bits = (
+        (mask_words[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(bool)
+    return bits.reshape(-1)[:n]

@@ -1,16 +1,28 @@
 """Test configuration.
 
-Must run before jax is imported anywhere: forces an 8-device virtual CPU
-platform so multi-chip sharding tests (jax.sharding.Mesh over 8 devices) run
-without TPU hardware, and enables x64 so uint64 outputs are representable.
+Tests run on a *virtual 8-device CPU platform* so multi-chip sharding tests
+(jax.sharding.Mesh over 8 devices) run without TPU hardware. Two subtleties of
+this environment:
+
+* ``sitecustomize`` may pre-import jax with ``JAX_PLATFORMS`` pointing at real
+  TPU hardware, so ``os.environ`` changes are too late — the platform must be
+  forced via ``jax.config.update``.
+* Only one process may hold the TPU claim at a time; tests must never touch
+  the TPU backend or they would contend with benchmark runs.
+
+``XLA_FLAGS`` is still read at first backend initialization, so the virtual
+device count is set via the environment before any backend is created.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
