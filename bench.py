@@ -1,0 +1,86 @@
+"""Headline benchmark: full-domain DPF evaluation throughput.
+
+Config (BASELINE.json headline): single-hierarchy DPF, log-domain 20, uint64
+values, 1024-key batch, full-domain evaluation on one TPU chip. Metric is
+evaluations/second = keys x domain points / wall time.
+
+Baseline derivation (BASELINE.md / SURVEY.md §6): the reference's
+single-thread AES-NI full-domain expansion sustains ~40M level-AES ops/s; a
+full-domain expansion of 2^20 leaves costs ~2*2^20 tree-AES + 2^20 value-AES
+≈ 3*2^20 AES, i.e. ~13M leaf evaluations/s/core. vs_baseline is measured
+against that 13e6 evals/s anchor.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "evals/s", "vs_baseline": N}
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_EVALS_PER_SEC = 13e6
+
+LOG_DOMAIN = int(os.environ.get("BENCH_LOG_DOMAIN", 20))
+NUM_KEYS = int(os.environ.get("BENCH_KEYS", 1024))
+KEY_CHUNK = int(os.environ.get("BENCH_KEY_CHUNK", 64))
+
+
+def main() -> None:
+    import jax
+
+    sys.path.insert(0, ".")
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import evaluator
+
+    platform = jax.default_backend()
+    print(f"# platform: {platform}, devices: {len(jax.devices())}", file=sys.stderr)
+
+    dpf = DistributedPointFunction.create(DpfParameters(LOG_DOMAIN, Int(64)))
+    rng = np.random.default_rng(7)
+    print("# generating keys...", file=sys.stderr)
+    t0 = time.time()
+    keys = []
+    for i in range(NUM_KEYS):
+        alpha = int(rng.integers(0, 1 << LOG_DOMAIN))
+        beta = int(rng.integers(1, 1 << 63))
+        ka, _ = dpf.generate_keys(alpha, beta)
+        keys.append(ka)
+    print(f"# keygen: {time.time() - t0:.1f}s for {NUM_KEYS} keys", file=sys.stderr)
+
+    # Warmup/compile on the first chunk.
+    t0 = time.time()
+    evaluator.full_domain_evaluate(dpf, keys[:KEY_CHUNK], key_chunk=KEY_CHUNK)
+    print(f"# warmup (compile + first chunk): {time.time() - t0:.1f}s", file=sys.stderr)
+
+    t0 = time.time()
+    out = evaluator.full_domain_evaluate(dpf, keys, key_chunk=KEY_CHUNK)
+    elapsed = time.time() - t0
+    assert out.shape[0] == NUM_KEYS
+
+    total_evals = NUM_KEYS * (1 << LOG_DOMAIN)
+    evals_per_sec = total_evals / elapsed
+    print(
+        f"# {total_evals} evals in {elapsed:.2f}s on {platform}", file=sys.stderr
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "full-domain DPF evaluations/sec (keys x domain points), "
+                    f"log_domain={LOG_DOMAIN}, {NUM_KEYS}-key batch, uint64"
+                ),
+                "value": round(evals_per_sec),
+                "unit": "evals/s",
+                "vs_baseline": round(evals_per_sec / BASELINE_EVALS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -62,14 +62,14 @@ def _rk(which: str) -> jnp.ndarray:
 
 
 def cw_seed_planes(correction_seeds: np.ndarray) -> np.ndarray:
-    """uint32[L, 4] limb rows -> uint32[L, 128] plane-broadcast masks."""
+    """uint32[..., 4] limb rows -> uint32[..., 128] plane-broadcast masks."""
     cs = np.asarray(correction_seeds, dtype=np.uint32)
-    bits = (cs[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
-    return (bits.reshape(cs.shape[0], 128) * _FULL).astype(np.uint32)
+    bits = (cs[..., :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    return (bits.reshape(cs.shape[:-1] + (128,)) * _FULL).astype(np.uint32)
 
 
 def control_masks(flags: np.ndarray) -> np.ndarray:
-    """bool[L] -> uint32[L] all-zeros/all-ones lane-broadcast masks."""
+    """bool[...] -> uint32[...] all-zeros/all-ones lane-broadcast masks."""
     return np.where(np.asarray(flags, dtype=bool), _FULL, np.uint32(0)).astype(
         np.uint32
     )
@@ -183,17 +183,24 @@ def _add_small_constant(limbs: jnp.ndarray, j) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
-def expansion_output_order(num_parents: int, padded_parents: int, levels: int) -> np.ndarray:
-    """Lane index of leaf (parent p, path v) after `levels` block-concatenated
-    doublings: lane = bitrev(v) * padded_parents + p. Returns int64[N_out]
-    gather indices producing the canonical order out[p * 2^levels + v].
-    """
-    v = np.arange(1 << levels, dtype=np.int64)
-    rev = np.zeros_like(v)
-    for b in range(levels):
-        rev |= ((v >> b) & 1) << (levels - 1 - b)
-    p = np.arange(num_parents, dtype=np.int64)
-    return (rev[None, :] * padded_parents + p[:, None]).reshape(-1)
+@functools.lru_cache(maxsize=None)
+def expansion_output_order(
+    num_parents: int, padded_parents: int, levels: int
+) -> np.ndarray:
+    """int64[num_parents << levels] gather indices restoring leaf order after
+    `levels` block-concatenated doublings of `num_parents` in-order lanes
+    padded to `padded_parents` (padded lanes produce garbage children that
+    are skipped). Computed by carrying each lane's leaf prefix through the
+    concat schedule."""
+    prefix = np.arange(padded_parents, dtype=np.int64)
+    prefix[num_parents:] = -1
+    for _ in range(levels):
+        child = np.where(prefix >= 0, 2 * prefix, -1)
+        prefix = np.concatenate([child, np.where(child >= 0, child + 1, -1)])
+    order = np.empty(num_parents << levels, dtype=np.int64)
+    valid = prefix >= 0
+    order[prefix[valid]] = np.nonzero(valid)[0]
+    return order
 
 
 # ---------------------------------------------------------------------------
@@ -310,6 +317,12 @@ class JaxBackend:
         seeds_p, _, _ = _pad_lanes(seeds, np.zeros(n, dtype=bool))
         hashed = _hash_expanded_blocks_jit(jnp.asarray(seeds_p), blocks_needed)
         return np.asarray(hashed).transpose(1, 0, 2)[:n]
+
+
+def unpack_mask_device(mask_words: jnp.ndarray) -> jnp.ndarray:
+    """uint32[W] lane masks -> uint32[32*W] of 0/1, device-side."""
+    bits = (mask_words[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    return bits.reshape(-1)
 
 
 def _unpack_mask(mask_words: np.ndarray, n: int) -> np.ndarray:

@@ -1,0 +1,523 @@
+"""Batched device-native DPF evaluators — the performance path.
+
+Where core/dpf.py walks the reference's control flow one host value at a
+time (general value types, hierarchies, contexts), this module implements the
+two benchmark-defining bulk operations as single fused jit programs that
+never leave the device:
+
+* ``full_domain_evaluate``  — EvaluateUntil's expansion
+  (/root/reference/dpf/distributed_point_function.cc:271-349,500-524 +
+  value correction at .h:744-836) for a whole *batch of keys*: host
+  pre-expansion to one packed word, then unrolled doubling levels in
+  bit-plane space, value hash, and u32-limb value correction, vmapped over
+  the key axis. Output ordering is restored by one gather computed by
+  simulating the lane layout (see ``_expansion_order``).
+* ``evaluate_at_batch``     — EvaluateAt
+  (/root/reference/dpf/distributed_point_function.h:839-1010) for
+  keys x points: one ``lax.scan`` tree walk over all levels with per-lane
+  key selection, vmapped over keys, sharing one set of evaluation points.
+
+Value correction handles power-of-two integer widths 8..128 (additive and
+XOR groups) with u32-limb arithmetic — no 64-bit emulation needed on TPU.
+IntModN and Tuple outputs go through the host path in core/dpf.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import backend_numpy, uint128
+from ..core.dpf import DistributedPointFunction
+from ..core.keys import DpfKey
+from ..core.value_types import Int, XorWrapper
+from . import aes_jax, backend_jax
+
+# ---------------------------------------------------------------------------
+# Host-side key batch preparation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class KeyBatch:
+    """Correction-word arrays for K same-parameter keys of one party."""
+
+    seeds: np.ndarray  # uint32[K, 4]
+    party: int
+    cw_seeds: np.ndarray  # uint32[K, L, 4]
+    cw_left: np.ndarray  # bool[K, L]
+    cw_right: np.ndarray  # bool[K, L]
+    value_corrections: np.ndarray  # uint32[K, epb, 4] (limbs of each element)
+    num_levels: int
+
+    @classmethod
+    def from_keys(
+        cls, dpf: DistributedPointFunction, keys: Sequence[DpfKey], hierarchy_level: int = -1
+    ) -> "KeyBatch":
+        v = dpf.validator
+        if hierarchy_level < 0:
+            hierarchy_level = v.num_hierarchy_levels - 1
+        stop_level = v.hierarchy_to_tree[hierarchy_level]
+        k = len(keys)
+        party = keys[0].party
+        seeds = np.zeros((k, 4), dtype=np.uint32)
+        cw_seeds = np.zeros((k, stop_level, 4), dtype=np.uint32)
+        cw_left = np.zeros((k, stop_level), dtype=bool)
+        cw_right = np.zeros((k, stop_level), dtype=bool)
+        value_type = v.parameters[hierarchy_level].value_type
+        epb = value_type.elements_per_block()
+        vc = np.zeros((k, epb, 4), dtype=np.uint32)
+        for i, key in enumerate(keys):
+            if key.party != party:
+                raise ValueError("all keys in a batch must belong to one party")
+            v.validate_key(key)
+            seeds[i] = uint128.to_limbs(key.seed)
+            for l in range(stop_level):
+                cw = key.correction_words[l]
+                cw_seeds[i, l] = uint128.to_limbs(cw.seed)
+                cw_left[i, l] = cw.control_left
+                cw_right[i, l] = cw.control_right
+            if hierarchy_level == v.num_hierarchy_levels - 1:
+                corrections = key.last_level_value_correction
+            else:
+                corrections = key.correction_words[stop_level].value_correction
+            for j, c in enumerate(corrections):
+                vc[i, j] = uint128.to_limbs(int(c))
+        return cls(
+            seeds=seeds,
+            party=party,
+            cw_seeds=cw_seeds,
+            cw_left=cw_left,
+            cw_right=cw_right,
+            value_corrections=vc,
+            num_levels=stop_level,
+        )
+
+    def device_cw_arrays(self, from_level: int = 0):
+        """(cw_planes uint32[K,L,128], ccl uint32[K,L], ccr uint32[K,L]) for
+        tree levels >= from_level, vectorized over the key axis."""
+        k = self.seeds.shape[0]
+        if self.num_levels <= from_level:
+            z = np.zeros((k, 0), np.uint32)
+            return np.zeros((k, 0, 128), np.uint32), z, z
+        return (
+            backend_jax.cw_seed_planes(self.cw_seeds[:, from_level:]),
+            backend_jax.control_masks(self.cw_left[:, from_level:]),
+            backend_jax.control_masks(self.cw_right[:, from_level:]),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Value extraction / correction in u32 limbs (device)
+# ---------------------------------------------------------------------------
+
+
+def _split_elements(limbs: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """uint32[..., 4] 128-bit blocks -> uint32[..., epb, limbs_per_element].
+
+    Element j of a block occupies bits [j*bits, (j+1)*bits) of the
+    little-endian uint128, mirroring ConvertBytesToArrayOf
+    (/root/reference/dpf/internal/value_type_helpers.h:506-520).
+    """
+    if bits >= 32:
+        lpe = bits // 32
+        return limbs.reshape(limbs.shape[:-1] + (128 // bits, lpe))
+    per_limb = 32 // bits
+    mask = jnp.uint32((1 << bits) - 1)
+    shifts = jnp.arange(per_limb, dtype=jnp.uint32) * jnp.uint32(bits)
+    vals = (limbs[..., :, None] >> shifts) & mask  # [..., 4, per_limb]
+    return vals.reshape(limbs.shape[:-1] + (128 // bits, 1))
+
+
+def _limb_add(a: jnp.ndarray, b: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Element-wise addition mod 2^bits on uint32[..., lpe] limb arrays."""
+    if bits <= 32:
+        mask = jnp.uint32((1 << bits) - 1) if bits < 32 else jnp.uint32(0xFFFFFFFF)
+        return (a + b) & mask
+    out = []
+    carry = jnp.zeros_like(a[..., 0])
+    for l in range(bits // 32):
+        t = a[..., l] + b[..., l]
+        c1 = (t < a[..., l]).astype(jnp.uint32)
+        s = t + carry
+        c2 = (s < t).astype(jnp.uint32)
+        carry = c1 | c2
+        out.append(s)
+    return jnp.stack(out, axis=-1)
+
+
+def _limb_neg(a: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Two's-complement negation mod 2^bits on uint32[..., lpe] limbs."""
+    if bits <= 32:
+        mask = jnp.uint32((1 << bits) - 1) if bits < 32 else jnp.uint32(0xFFFFFFFF)
+        return (jnp.uint32(0) - a) & mask
+    out = []
+    carry = jnp.uint32(1)  # ~a + 1
+    for l in range(bits // 32):
+        s = (~a[..., l]) + carry
+        carry = jnp.where((s == 0) & (carry == 1), jnp.uint32(1), jnp.uint32(0))
+        out.append(s)
+    return jnp.stack(out, axis=-1)
+
+
+def _correct_values(
+    hashed: jnp.ndarray,  # uint32[..., 4] value-hash blocks
+    control: jnp.ndarray,  # bool/uint32[...] control bits (1 = corrected)
+    corrections: jnp.ndarray,  # uint32[epb, lpe] per-element correction limbs
+    bits: int,
+    party: int,
+    xor_group: bool,
+) -> jnp.ndarray:
+    """value = hash_element (+ correction if control) (negated if party 1).
+
+    Mirrors the correction loop in EvaluateUntil
+    (/root/reference/dpf/distributed_point_function.h:776-808).
+    Returns uint32[..., epb, lpe].
+    """
+    elems = _split_elements(hashed, bits)  # [..., epb, lpe]
+    ctrl = control.astype(jnp.uint32)[..., None, None]
+    if xor_group:
+        return elems ^ (corrections * ctrl)
+    corr = corrections * ctrl  # zero where control unset
+    out = _limb_add(elems, corr, bits)
+    if party == 1:
+        out = _limb_neg(out, bits)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Lane-order bookkeeping for the doubling expansion
+# ---------------------------------------------------------------------------
+
+
+# ---------------------------------------------------------------------------
+# Host pre-expansion (vectorized numpy, per-key correction words)
+# ---------------------------------------------------------------------------
+
+
+def _host_expand(
+    seeds: np.ndarray,  # uint32[K, 4]
+    control: np.ndarray,  # bool[K]
+    batch: KeyBatch,
+    levels: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Expands each key `levels` levels on the host -> ([K, 2^levels, 4],
+    [K, 2^levels]) in leaf order. Cheap: used only to fill the first packed
+    word (32 lanes) before the device takes over."""
+    k = seeds.shape[0]
+    seeds = seeds[:, None, :]  # [K, M, 4]
+    control = control[:, None]
+    for level in range(levels):
+        m = seeds.shape[1]
+        flat = seeds.reshape(k * m, 4)
+        left = backend_numpy._PRG_LEFT.evaluate_limbs(flat).reshape(k, m, 4)
+        right = backend_numpy._PRG_RIGHT.evaluate_limbs(flat).reshape(k, m, 4)
+        corr = np.where(
+            control[:, :, None], batch.cw_seeds[:, level][:, None, :], 0
+        ).astype(np.uint32)
+        left ^= corr
+        right ^= corr
+        # interleave children in leaf order
+        children = np.stack([left, right], axis=2).reshape(k, 2 * m, 4)
+        child_control = (children[:, :, 0] & 1).astype(bool)
+        children[:, :, 0] &= np.uint32(0xFFFFFFFE)
+        cc = np.stack(
+            [
+                control & batch.cw_left[:, level][:, None],
+                control & batch.cw_right[:, level][:, None],
+            ],
+            axis=2,
+        ).reshape(k, 2 * m)
+        control = child_control ^ cc
+        seeds = children
+    return seeds, control
+
+
+# ---------------------------------------------------------------------------
+# Fused device programs
+# ---------------------------------------------------------------------------
+
+
+def _expand_hash_correct(
+    seeds,  # uint32[M, 4] in-order seeds of ONE key (M % 32 == 0)
+    control,  # uint32[M//32] packed control mask
+    cw_planes,  # uint32[L, 128] (device levels only)
+    ccl,  # uint32[L]
+    ccr,  # uint32[L]
+    corrections,  # uint32[epb, lpe]
+    levels: int,
+    bits: int,
+    party: int,
+    xor_group: bool,
+):
+    """Single-key fused program: pack -> `levels` doublings -> value hash ->
+    correction. Returns uint32[M * 2^levels, epb, lpe] in *lane* order (use
+    `_expansion_order` to restore leaf order)."""
+    planes = aes_jax.pack_to_planes(seeds)
+    for level in range(levels):
+        planes, control = backend_jax.expand_one_level(
+            planes, control, cw_planes[level], ccl[level], ccr[level]
+        )
+    hashed = backend_jax.hash_value_planes(planes)
+    blocks = aes_jax.unpack_from_planes(hashed)  # [M<<levels, 4]
+    ctrl_bits = backend_jax.unpack_mask_device(control)
+    return _correct_values(blocks, ctrl_bits, corrections, bits, party, xor_group)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("levels", "bits", "party", "xor_group")
+)
+def _expand_batch_jit(
+    seeds,  # uint32[K, M, 4]
+    control,  # uint32[K, M//32]
+    cw_planes,  # uint32[K, L, 128]
+    ccl,  # uint32[K, L]
+    ccr,  # uint32[K, L]
+    corrections,  # uint32[K, epb, lpe]
+    order,  # int[M << levels] leaf-order gather
+    levels: int,
+    bits: int,
+    party: int,
+    xor_group: bool,
+):
+    fn = functools.partial(
+        _expand_hash_correct,
+        levels=levels,
+        bits=bits,
+        party=party,
+        xor_group=xor_group,
+    )
+    out = jax.vmap(fn)(seeds, control, cw_planes, ccl, ccr, corrections)
+    # [K, lanes, epb, lpe] -> leaf order -> flat element order
+    out = out[:, order]
+    k, n_blocks, epb, lpe = out.shape
+    return out.reshape(k, n_blocks * epb, lpe)
+
+
+def full_domain_evaluate(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    hierarchy_level: int = -1,
+    key_chunk: int = 32,
+    host_levels: Optional[int] = None,
+) -> np.ndarray:
+    """Full-domain evaluation of a key batch on device.
+
+    Returns uint32[K, domain_size, lpe] limb values (lpe = max(bits//32, 1));
+    use `values_to_numpy` for a numpy integer view. Keys are processed in
+    chunks of `key_chunk` to bound HBM use.
+    """
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    bits, xor_group = _value_kind(value_type)
+    batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    stop_level = batch.num_levels
+
+    # Host expands until one packed word (32 lanes) is full.
+    if host_levels is None:
+        host_levels = min(5, stop_level)
+    host_levels = min(host_levels, stop_level)
+    device_levels = stop_level - host_levels
+
+    num_keys = len(keys)
+    outs = []
+    for start in range(0, num_keys, key_chunk):
+        sl = slice(start, start + key_chunk)
+        # Pad the last chunk with key 0 so every chunk compiles to the same
+        # shape; padded rows are trimmed after concatenation.
+        idx = np.arange(start, min(start + key_chunk, num_keys))
+        pad = key_chunk - idx.shape[0] if num_keys > key_chunk else 0
+        if pad:
+            idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
+        kb = KeyBatch(
+            seeds=batch.seeds[idx],
+            party=batch.party,
+            cw_seeds=batch.cw_seeds[idx],
+            cw_left=batch.cw_left[idx],
+            cw_right=batch.cw_right[idx],
+            value_corrections=batch.value_corrections[idx],
+            num_levels=stop_level,
+        )
+        k = kb.seeds.shape[0]
+        control0 = np.full(k, bool(kb.party), dtype=bool)
+        seeds_h, control_h = _host_expand(kb.seeds, control0, kb, host_levels)
+        m = seeds_h.shape[1]
+        seeds_p, control_p = seeds_h, control_h
+        if m < 32:  # pad lanes to one packed word
+            lane_pad = 32 - m
+            seeds_p = np.concatenate(
+                [seeds_h, np.zeros((k, lane_pad, 4), np.uint32)], axis=1
+            )
+            control_p = np.concatenate(
+                [control_h, np.zeros((k, lane_pad), bool)], axis=1
+            )
+        control_mask = aes_jax.pack_bit_mask(control_p)
+        cw_dev, ccl, ccr = kb.device_cw_arrays(host_levels)
+        corrections = _correction_limbs(kb.value_corrections, bits)
+        order_np = backend_jax.expansion_output_order(
+            m, seeds_p.shape[1], device_levels
+        )
+        out = _expand_batch_jit(
+            jnp.asarray(seeds_p),
+            jnp.asarray(control_mask),
+            jnp.asarray(cw_dev),
+            jnp.asarray(ccl),
+            jnp.asarray(ccr),
+            jnp.asarray(corrections),
+            jnp.asarray(order_np),
+            levels=device_levels,
+            bits=bits,
+            party=batch.party,
+            xor_group=xor_group,
+        )
+        out = np.asarray(out)
+        if pad:
+            out = out[: key_chunk - pad]
+        outs.append(out)
+    result = np.concatenate(outs, axis=0)
+    # Trim to the actual domain size (block packing may overshoot).
+    domain = 1 << v.parameters[hierarchy_level].log_domain_size
+    return result[:, :domain]
+
+
+def _value_kind(value_type) -> Tuple[int, bool]:
+    if isinstance(value_type, Int):
+        return value_type.bitsize, False
+    if isinstance(value_type, XorWrapper):
+        return value_type.bitsize, True
+    raise NotImplementedError(
+        f"device evaluator supports Int/XorWrapper outputs, got {value_type}; "
+        "use the host path (DistributedPointFunction.evaluate_*) instead"
+    )
+
+
+def _correction_limbs(vc: np.ndarray, bits: int) -> np.ndarray:
+    """uint32[K, epb, 4] full-block limbs -> uint32[K, epb, lpe]."""
+    if bits >= 32:
+        return vc[:, :, : bits // 32]
+    return vc[:, :, :1] & np.uint32((1 << bits) - 1)
+
+
+def values_to_numpy(values: np.ndarray, bits: int) -> np.ndarray:
+    """uint32[..., lpe] limb values -> numpy uint array (object for 128)."""
+    values = np.asarray(values)
+    if bits <= 32:
+        return values[..., 0].astype(f"uint{max(bits, 8)}" if bits != 32 else "uint32")
+    if bits == 64:
+        return values[..., 0].astype(np.uint64) | (
+            values[..., 1].astype(np.uint64) << np.uint64(32)
+        )
+    out = np.zeros(values.shape[:-1], dtype=object)
+    for l in range(values.shape[-1]):
+        out |= values[..., l].astype(object) << (32 * l)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Batched point evaluation (keys x points)
+# ---------------------------------------------------------------------------
+
+
+def _evaluate_points_one_key(
+    seeds,  # uint32[P, 4] root seed broadcast
+    control,  # uint32[W]
+    path_masks,  # uint32[L, W] (shared across keys)
+    cw_planes,  # uint32[L, 128]
+    ccl,
+    ccr,  # uint32[L]
+    corrections,  # uint32[epb, lpe]
+    block_sel,  # int32[P] block index of each point
+    bits: int,
+    party: int,
+    xor_group: bool,
+):
+    planes = aes_jax.pack_to_planes(seeds)
+    planes, control = backend_jax.evaluate_seeds_planes(
+        planes, control, path_masks, cw_planes, ccl, ccr
+    )
+    hashed = backend_jax.hash_value_planes(planes)
+    blocks = aes_jax.unpack_from_planes(hashed)
+    ctrl_bits = backend_jax.unpack_mask_device(control)
+    values = _correct_values(
+        blocks, ctrl_bits, corrections, bits, party, xor_group
+    )  # [P_pad, epb, lpe]
+    p = block_sel.shape[0]
+    return values[jnp.arange(p), block_sel]  # [P, lpe]
+
+
+@functools.partial(jax.jit, static_argnames=("bits", "party", "xor_group"))
+def _evaluate_points_jit(
+    seeds, control, path_masks, cw_planes, ccl, ccr, corrections, block_sel,
+    bits, party, xor_group,
+):
+    fn = functools.partial(
+        _evaluate_points_one_key, bits=bits, party=party, xor_group=xor_group
+    )
+    return jax.vmap(fn, in_axes=(0, None, None, 0, 0, 0, 0, None))(
+        seeds, control, path_masks, cw_planes, ccl, ccr, corrections, block_sel
+    )
+
+
+def evaluate_at_batch(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    points: Sequence[int],
+    hierarchy_level: int = -1,
+) -> np.ndarray:
+    """Evaluates every key at every point on device.
+
+    Batched-device equivalent of EvaluateAt
+    (/root/reference/dpf/distributed_point_function.h:331-360) — the
+    reference evaluates one key at a time; here keys are vmapped and points
+    are packed lanes. Returns uint32[K, P, lpe] limb values.
+    """
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    bits, xor_group = _value_kind(value_type)
+    batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    num_levels = batch.num_levels
+    k = batch.seeds.shape[0]
+    p = len(points)
+
+    tree_indices = np.array(
+        [v.domain_to_tree_index(int(pt), hierarchy_level) for pt in points],
+        dtype=object,
+    )
+    block_sel = np.array(
+        [v.domain_to_block_index(int(pt), hierarchy_level) for pt in points],
+        dtype=np.int32,
+    )
+    paths = uint128.array_to_limbs([int(t) for t in tree_indices])
+    p_pad = -(-p // 32) * 32
+    path_masks = backend_jax._path_bit_masks(paths, num_levels, p_pad)
+
+    cw_planes, ccl, ccr = batch.device_cw_arrays()
+    corrections = _correction_limbs(batch.value_corrections, bits)
+
+    seeds = np.broadcast_to(batch.seeds[:, None, :], (k, p_pad, 4)).copy()
+    control0 = aes_jax.pack_bit_mask(
+        np.full(p_pad, bool(batch.party), dtype=bool)
+    )
+    out = _evaluate_points_jit(
+        jnp.asarray(seeds),
+        jnp.asarray(control0),
+        jnp.asarray(path_masks),
+        jnp.asarray(cw_planes),
+        jnp.asarray(ccl),
+        jnp.asarray(ccr),
+        jnp.asarray(corrections),
+        jnp.asarray(block_sel),
+        bits=bits,
+        party=batch.party,
+        xor_group=xor_group,
+    )
+    return np.asarray(out)[:, :p]

@@ -1,0 +1,198 @@
+"""Multi-chip sharded DPF evaluation over a jax.sharding.Mesh.
+
+The reference library has no distributed backend at all — its "distribution"
+is protocol-level (two parties hold two keys). On TPU, scale comes from two
+mesh axes (this module is green-field design fixed by BASELINE.json
+config[4], the v5e-8 two-server PIR workload):
+
+* ``keys``   — data parallelism over independent queries/keys. Embarrassingly
+  parallel; no communication (the math has no cross-key terms).
+* ``domain`` — the DPF evaluation tree is split at depth log2(n_domain):
+  device d owns subtree d, *walks* the first log2(n_domain) levels along the
+  path d (one masked-key AES per level), then fully expands only its own
+  2^(levels - log2(n_domain)) leaves. This is the sequence-parallel analog:
+  the long axis (the domain) is sharded, and only a tiny all-gather of the
+  per-device partial inner products crosses the ICI.
+
+The PIR inner product uses the XOR group: with beta = 2^128-1, the two
+servers' responses XOR to DB[alpha] (share_a ^ share_b is beta at alpha and 0
+elsewhere). XOR has no hardware collective, so the [K, limbs] partials ride
+one ``all_gather`` over 'domain' and reduce locally — bytes on the wire:
+n_domain * K * 16.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..core.dpf import DistributedPointFunction
+from ..core.keys import DpfKey
+from ..ops import aes_jax, backend_jax, evaluator
+
+
+def make_mesh(n_key_shards: int, n_domain_shards: int, devices=None) -> Mesh:
+    """A (keys, domain) mesh; n_key_shards * n_domain_shards devices."""
+    if devices is None:
+        devices = jax.devices()
+    n = n_key_shards * n_domain_shards
+    grid = np.asarray(devices[:n]).reshape(n_key_shards, n_domain_shards)
+    return Mesh(grid, axis_names=("keys", "domain"))
+
+
+def _walk_and_expand_one_key(
+    seed,  # uint32[4]
+    cw_planes,  # uint32[L, 128]
+    ccl,  # uint32[L]
+    ccr,  # uint32[L]
+    corrections,  # uint32[epb, lpe]
+    subtree_index,  # int32 traced: which subtree this device owns
+    subtree_levels: int,
+    expand_levels: int,
+    party: int,
+    bits: int,
+    xor_group: bool,
+):
+    """Walks `subtree_levels` down along subtree_index, expands the rest,
+    hashes and corrects. Returns uint32[2^expand_levels * epb, lpe] values of
+    this key restricted to the device's domain slice, in leaf order."""
+    lanes = jnp.zeros((32, 4), jnp.uint32).at[0].set(seed)
+    planes = aes_jax.pack_to_planes(lanes)
+    control = jnp.array([party], dtype=jnp.uint32)  # lane 0 only
+    if subtree_levels:
+        shifts = subtree_levels - 1 - jnp.arange(subtree_levels, dtype=jnp.int32)
+        bits_path = (subtree_index >> shifts) & 1
+        path_masks = (jnp.uint32(0) - bits_path.astype(jnp.uint32))[:, None]
+        planes, control = backend_jax.evaluate_seeds_planes(
+            planes,
+            control,
+            path_masks,
+            cw_planes[:subtree_levels],
+            ccl[:subtree_levels],
+            ccr[:subtree_levels],
+        )
+    for l in range(subtree_levels, subtree_levels + expand_levels):
+        planes, control = backend_jax.expand_one_level(
+            planes, control, cw_planes[l], ccl[l], ccr[l]
+        )
+    hashed = backend_jax.hash_value_planes(planes)
+    blocks = aes_jax.unpack_from_planes(hashed)
+    ctrl = backend_jax.unpack_mask_device(control)
+    values = evaluator._correct_values(
+        blocks, ctrl, corrections, bits, party, xor_group
+    )  # [32 << expand_levels, epb, lpe]
+    order = jnp.asarray(backend_jax.expansion_output_order(1, 32, expand_levels))
+    values = values[order]  # [2^expand_levels, epb, lpe] leaf order
+    n_blocks, epb, lpe = values.shape
+    return values.reshape(n_blocks * epb, lpe)
+
+
+@functools.lru_cache(maxsize=None)
+def build_pir_step(
+    mesh: Mesh,
+    num_levels: int,
+    party: int,
+    bits: int = 128,
+    xor_group: bool = True,
+):
+    """Compiles one server's sharded PIR answer step.
+
+    Returns jitted fn(seeds [K,4], cw_planes [K,L,128], ccl [K,L], ccr [K,L],
+    corrections [K,epb,lpe], db [D,lpe]) -> responses [K, lpe], with K sharded
+    over 'keys', the DB and the evaluation tree sharded over 'domain', and the
+    XOR inner-product reduction crossing shards via all_gather.
+    """
+    n_domain = mesh.shape["domain"]
+    subtree_levels = int(np.log2(n_domain))
+    assert 1 << subtree_levels == n_domain, "domain shards must be a power of 2"
+    expand_levels = num_levels - subtree_levels
+    assert expand_levels >= 0, "domain smaller than the device mesh"
+
+    def device_fn(seeds, cw_planes, ccl, ccr, corrections, db):
+        di = jax.lax.axis_index("domain").astype(jnp.int32)
+        fn = functools.partial(
+            _walk_and_expand_one_key,
+            subtree_levels=subtree_levels,
+            expand_levels=expand_levels,
+            party=party,
+            bits=bits,
+            xor_group=xor_group,
+        )
+        values = jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, None))(
+            seeds, cw_planes, ccl, ccr, corrections, di
+        )  # [Kl, elems_local, lpe]
+        elems_local = db.shape[0]
+        partial = jnp.bitwise_xor.reduce(
+            values[:, :elems_local] & db[None, :, :], axis=1
+        )  # [Kl, lpe]
+        gathered = jax.lax.all_gather(partial, "domain")  # [n_domain, Kl, lpe]
+        return jnp.bitwise_xor.reduce(gathered, axis=0)
+
+    step = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(
+            P("keys"),  # seeds
+            P("keys"),  # cw_planes
+            P("keys"),  # ccl
+            P("keys"),  # ccr
+            P("keys"),  # corrections
+            P("domain"),  # db
+        ),
+        out_specs=P("keys"),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def pir_query_batch(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    db_limbs: np.ndarray,  # uint32[D, lpe]
+    mesh: Mesh,
+) -> np.ndarray:
+    """One server's answers for a batch of PIR queries. Returns uint32[K, lpe].
+
+    Host-side convenience wrapper: prepares correction-word arrays from the
+    keys, shards them over `mesh`, runs the compiled step.
+    """
+    v = dpf.validator
+    hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    bits, xor_group = evaluator._value_kind(value_type)
+    batch = evaluator.KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    # Pad the key axis to a multiple of the 'keys' mesh axis (shard_map
+    # requires even divisibility); padded rows repeat key 0 and are trimmed.
+    n_real = batch.seeds.shape[0]
+    key_shards = mesh.shape["keys"]
+    pad = (-n_real) % key_shards
+    if pad:
+        rep = lambda a: np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
+        batch = evaluator.KeyBatch(
+            seeds=rep(batch.seeds),
+            party=batch.party,
+            cw_seeds=rep(batch.cw_seeds),
+            cw_left=rep(batch.cw_left),
+            cw_right=rep(batch.cw_right),
+            value_corrections=rep(batch.value_corrections),
+            num_levels=batch.num_levels,
+        )
+    cw_planes, ccl, ccr = batch.device_cw_arrays()
+    corrections = evaluator._correction_limbs(batch.value_corrections, bits)
+    step = build_pir_step(
+        mesh, batch.num_levels, batch.party, bits=bits, xor_group=xor_group
+    )
+    out = step(
+        jnp.asarray(batch.seeds),
+        jnp.asarray(cw_planes),
+        jnp.asarray(ccl),
+        jnp.asarray(ccr),
+        jnp.asarray(corrections),
+        jnp.asarray(db_limbs),
+    )
+    return np.asarray(out)[:n_real]

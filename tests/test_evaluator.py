@@ -1,0 +1,117 @@
+"""Tests for the batched device evaluators (ops/evaluator.py) against the
+host path and the share-sum property."""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
+from distributed_point_functions_tpu.ops import evaluator
+
+RNG = np.random.default_rng(0xEA1)
+
+
+def make_keys(dpf, alphas, betas):
+    keys_a, keys_b = [], []
+    for alpha, beta in zip(alphas, betas):
+        ka, kb = dpf.generate_keys(alpha, beta)
+        keys_a.append(ka)
+        keys_b.append(kb)
+    return keys_a, keys_b
+
+
+@pytest.mark.parametrize(
+    "bits,log_domain", [(8, 6), (32, 8), (64, 9), (128, 7)]
+)
+def test_full_domain_share_sum(bits, log_domain):
+    dpf = DistributedPointFunction.create(DpfParameters(log_domain, Int(bits)))
+    domain = 1 << log_domain
+    k = 5
+    alphas = RNG.integers(0, domain, size=k)
+    betas = [int(b) for b in RNG.integers(1, 2 ** min(bits, 63), size=k)]
+    keys_a, keys_b = make_keys(dpf, [int(a) for a in alphas], betas)
+
+    out_a = evaluator.full_domain_evaluate(dpf, keys_a, key_chunk=3)
+    out_b = evaluator.full_domain_evaluate(dpf, keys_b, key_chunk=3)
+    va = evaluator.values_to_numpy(out_a, bits)
+    vb = evaluator.values_to_numpy(out_b, bits)
+    assert va.shape == (k, domain)
+    mod = 1 << bits
+    for i in range(k):
+        total = (va[i].astype(object) + vb[i].astype(object)) % mod
+        expected = np.zeros(domain, dtype=object)
+        expected[alphas[i]] = betas[i]
+        assert (total == expected).all(), f"key {i}"
+
+
+def test_full_domain_matches_host_path():
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    ka, _ = dpf.generate_keys(200, 31337)
+    got = evaluator.values_to_numpy(
+        evaluator.full_domain_evaluate(dpf, [ka]), 64
+    )[0]
+    ctx = dpf.create_evaluation_context(ka)
+    want = np.array(dpf.evaluate_next([], ctx), dtype=np.uint64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_full_domain_xor_group():
+    dpf = DistributedPointFunction.create(DpfParameters(6, XorWrapper(128)))
+    alpha, beta = 33, (1 << 100) | 0xFFEE
+    ka, kb = dpf.generate_keys(alpha, beta)
+    va = evaluator.values_to_numpy(evaluator.full_domain_evaluate(dpf, [ka]), 128)
+    vb = evaluator.values_to_numpy(evaluator.full_domain_evaluate(dpf, [kb]), 128)
+    total = va[0] ^ vb[0]
+    expected = np.zeros(64, dtype=object)
+    expected[alpha] = beta
+    assert (total == expected).all()
+
+
+def test_full_domain_host_levels_split():
+    """Different host/device level splits give identical results."""
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(32)))
+    ka, _ = dpf.generate_keys(777, 99)
+    base = evaluator.full_domain_evaluate(dpf, [ka], host_levels=5)
+    for hl in [0, 2, 9]:
+        other = evaluator.full_domain_evaluate(dpf, [ka], host_levels=hl)
+        np.testing.assert_array_equal(base, other)
+
+
+@pytest.mark.parametrize("bits", [32, 64])
+def test_evaluate_at_batch_matches_host(bits):
+    dpf = DistributedPointFunction.create(DpfParameters(32, Int(bits)))
+    k, p = 3, 40
+    alphas = [int(a) for a in RNG.integers(0, 2**32, size=k)]
+    betas = [int(b) for b in RNG.integers(1, 2 ** min(bits, 63), size=k)]
+    keys_a, keys_b = make_keys(dpf, alphas, betas)
+    points = [int(x) for x in RNG.integers(0, 2**32, size=p)]
+    points[0] = alphas[0]
+    points[1] = alphas[min(1, k - 1)]
+
+    got_a = evaluator.values_to_numpy(
+        evaluator.evaluate_at_batch(dpf, keys_a, points), bits
+    )
+    got_b = evaluator.values_to_numpy(
+        evaluator.evaluate_at_batch(dpf, keys_b, points), bits
+    )
+    mod = 1 << bits
+    for i in range(k):
+        want = dpf.evaluate_at(keys_a[i], 0, points)
+        np.testing.assert_array_equal(
+            got_a[i].astype(object), np.array([w % mod for w in want], dtype=object)
+        )
+        for j, pt in enumerate(points):
+            expected = betas[i] if pt == alphas[i] else 0
+            assert (int(got_a[i][j]) + int(got_b[i][j])) % mod == expected
+
+
+def test_evaluate_at_batch_large_domain_128():
+    dpf = DistributedPointFunction.create(DpfParameters(128, Int(64)))
+    alpha = (1 << 127) | 12345
+    ka, kb = dpf.generate_keys(alpha, 5)
+    points = [alpha, alpha ^ 1, 0, (1 << 128) - 1]
+    va = evaluator.values_to_numpy(evaluator.evaluate_at_batch(dpf, [ka], points), 64)
+    vb = evaluator.values_to_numpy(evaluator.evaluate_at_batch(dpf, [kb], points), 64)
+    total = (va[0].astype(object) + vb[0].astype(object)) % 2**64
+    assert list(total) == [5, 0, 0, 0]

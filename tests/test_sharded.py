@@ -1,0 +1,57 @@
+"""Multi-chip sharded evaluation tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import XorWrapper
+from distributed_point_functions_tpu.parallel import sharded
+
+RNG = np.random.default_rng(0x5AD)
+
+
+@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (4, 2)])
+def test_sharded_pir_reconstructs(mesh_shape):
+    log_domain = 8
+    domain = 1 << log_domain
+    dpf = DistributedPointFunction.create(
+        DpfParameters(log_domain, XorWrapper(128))
+    )
+    db = RNG.integers(0, 2**32, size=(domain, 4), dtype=np.uint32)
+    beta = (1 << 128) - 1
+    mesh = sharded.make_mesh(*mesh_shape)
+
+    targets = [0, domain - 1] + [int(t) for t in RNG.integers(0, domain, size=2)]
+    keys_a, keys_b = [], []
+    for alpha in targets:
+        ka, kb = dpf.generate_keys(alpha, beta)
+        keys_a.append(ka)
+        keys_b.append(kb)
+
+    resp_a = sharded.pir_query_batch(dpf, keys_a, db, mesh)
+    resp_b = sharded.pir_query_batch(dpf, keys_b, db, mesh)
+    recovered = resp_a ^ resp_b
+    for i, alpha in enumerate(targets):
+        np.testing.assert_array_equal(recovered[i], db[alpha], err_msg=f"q{i}")
+
+
+def test_sharded_matches_unsharded():
+    """The sharded expansion equals the single-device evaluator output."""
+    from distributed_point_functions_tpu.ops import evaluator
+
+    log_domain = 7
+    dpf = DistributedPointFunction.create(
+        DpfParameters(log_domain, XorWrapper(128))
+    )
+    ka, _ = dpf.generate_keys(77, (1 << 128) - 1)
+    # Unsharded full-domain values
+    full = evaluator.full_domain_evaluate(dpf, [ka])[0]  # [domain, 4]
+    # Sharded inner product against a one-hot DB recovers each value
+    mesh = sharded.make_mesh(1, 8)
+    domain = 1 << log_domain
+    for probe in [0, 1, 63, 127]:
+        db = np.zeros((domain, 4), dtype=np.uint32)
+        db[probe] = 0xFFFFFFFF
+        resp = sharded.pir_query_batch(dpf, [ka], db, mesh)[0]
+        np.testing.assert_array_equal(resp, full[probe])
