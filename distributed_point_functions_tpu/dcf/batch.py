@@ -1,0 +1,213 @@
+"""Fused batched DCF evaluation on device.
+
+The reference evaluates a DCF by calling EvaluateAt once per domain bit, each
+call re-walking the tree from the root — O(n^2) AES per point
+(/root/reference/dcf/distributed_comparison_function.h:83-107; noted in
+SURVEY.md §3.4). This kernel makes the pass O(n): ONE ``lax.scan`` walks the
+point's root-to-leaf path, and at every output depth captures the current
+seed, value-hashes it, selects the addressed block element, applies that
+hierarchy level's value correction, and mask-accumulates it iff the point's
+bit at that level is 0. vmapped over keys; evaluation points are shared
+across the key batch.
+
+Depth bookkeeping (hierarchy level i -> tree depth t_i = hierarchy_to_tree[i])
+follows the incremental-DPF packing rules (core/params.py); depths that carry
+no output level get a zero accumulate mask and their hash is wasted work —
+at most a few early levels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import uint128
+from ..ops import aes_jax, backend_jax, evaluator
+
+
+def _capture_tables(dcf, xs_padded: np.ndarray, num_points: int):
+    """Host precompute of per-depth capture parameters.
+
+    Returns (acc_mask float? no — uint32[T+1, P], block_sel int32[T+1, P],
+    depth_to_hierarchy list[T+1] with -1 for no capture).
+    """
+    v = dcf.dpf.validator
+    n = dcf.log_domain_size
+    T = v.hierarchy_to_tree[v.num_hierarchy_levels - 1]
+    p_pad = xs_padded.shape[0]
+    acc_mask = np.zeros((T + 1, p_pad), dtype=np.uint32)
+    block_sel = np.zeros((T + 1, p_pad), dtype=np.int32)
+    depth_to_hierarchy = [-1] * (T + 1)
+    for i in range(v.num_hierarchy_levels):
+        d = v.hierarchy_to_tree[i]
+        depth_to_hierarchy[d] = i
+        bits_d = i - d  # block-index bits at this level
+        for j in range(num_points):
+            x = int(xs_padded[j])
+            prefix = x >> (n - i)
+            block_sel[d, j] = prefix & ((1 << bits_d) - 1)
+            bit = (x >> (n - 1 - i)) & 1
+            acc_mask[d, j] = 0 if bit else 1
+    return acc_mask, block_sel, depth_to_hierarchy
+
+
+def _value_corrections_all(dcf, keys, depth_to_hierarchy) -> np.ndarray:
+    """uint32[K, T+1, epb, 4]: per-key value-correction limbs by tree depth."""
+    v = dcf.dpf.validator
+    epb = dcf.value_type.elements_per_block()
+    k = len(keys)
+    T = len(depth_to_hierarchy) - 1
+    vc = np.zeros((k, T + 1, epb, 4), dtype=np.uint32)
+    for ki, key in enumerate(keys):
+        dpf_key = key.key
+        for d, i in enumerate(depth_to_hierarchy):
+            if i < 0:
+                continue
+            if i == v.num_hierarchy_levels - 1:
+                corrections = dpf_key.last_level_value_correction
+            else:
+                corrections = dpf_key.correction_words[d].value_correction
+            for j, c in enumerate(corrections):
+                vc[ki, d, j] = uint128.to_limbs(int(c))
+    return vc
+
+
+def _capture(planes, control, vc_d, block_sel_d, acc_mask_d, bits, xor_group):
+    """Hash + select + correct + mask one depth; returns [P_pad, lpe]."""
+    hashed = backend_jax.hash_value_planes(planes)
+    blocks = aes_jax.unpack_from_planes(hashed)
+    ctrl = backend_jax.unpack_mask_device(control)  # uint32[P_pad] 0/1
+    elems = evaluator._split_elements(blocks, bits)  # [P_pad, epb, lpe]
+    p_pad = elems.shape[0]
+    sel = elems[jnp.arange(p_pad), block_sel_d]  # [P_pad, lpe]
+    corr = vc_d[block_sel_d]  # [P_pad, lpe]
+    gated = corr * ctrl[:, None]
+    if xor_group:
+        value = sel ^ gated
+        return value * acc_mask_d[:, None]  # mask: 0 or 1
+    value = evaluator._limb_add(sel, gated, bits)
+    return value * acc_mask_d[:, None]
+
+
+def _accumulate(acc, value, bits, xor_group):
+    if xor_group:
+        return acc ^ value
+    return evaluator._limb_add(acc, value, bits)
+
+
+def _dcf_walk_one_key(
+    seeds,  # uint32[P_pad, 4] root seed broadcast
+    control,  # uint32[W]
+    path_masks,  # uint32[T, W]
+    cw_planes,  # uint32[T, 128]
+    ccl,  # uint32[T]
+    ccr,  # uint32[T]
+    vc,  # uint32[T+1, epb, lpe]
+    block_sel,  # int32[T+1, P_pad]
+    acc_mask,  # uint32[T+1, P_pad]
+    bits: int,
+    party: int,
+    xor_group: bool,
+):
+    rk_left = backend_jax._rk("left")
+    rk_diff = backend_jax._rk("lr_diff")
+    planes = aes_jax.pack_to_planes(seeds)
+    p_pad = seeds.shape[0]
+    lpe = vc.shape[-1]
+    acc0 = jnp.zeros((p_pad, lpe), dtype=jnp.uint32)
+
+    def body(carry, xs):
+        planes, control, acc = carry
+        path_mask, cw, l, r, vc_d, bs_d, am_d = xs
+        value = _capture(planes, control, vc_d, bs_d, am_d, bits, xor_group)
+        acc = _accumulate(acc, value, bits, xor_group)
+        h = aes_jax.hash_planes(planes, rk_left, rk_diff, path_mask)
+        h = h ^ (cw[:, None] & control[None, :])
+        new_control = h[0]
+        h = h.at[0].set(jnp.zeros_like(h[0]))
+        cc = (l & ~path_mask) | (r & path_mask)
+        return (h, new_control ^ (control & cc), acc), None
+
+    (planes, control, acc), _ = jax.lax.scan(
+        body,
+        (planes, control, acc0),
+        (path_masks, cw_planes, ccl, ccr, vc[:-1], block_sel[:-1], acc_mask[:-1]),
+    )
+    value = _capture(
+        planes, control, vc[-1], block_sel[-1], acc_mask[-1], bits, xor_group
+    )
+    acc = _accumulate(acc, value, bits, xor_group)
+    if party == 1 and not xor_group:
+        acc = evaluator._limb_neg(acc, bits)
+    return acc
+
+
+@functools.partial(jax.jit, static_argnames=("bits", "party", "xor_group"))
+def _dcf_batch_jit(
+    seeds, control, path_masks, cw_planes, ccl, ccr, vc, block_sel, acc_mask,
+    bits, party, xor_group,
+):
+    fn = functools.partial(
+        _dcf_walk_one_key, bits=bits, party=party, xor_group=xor_group
+    )
+    return jax.vmap(fn, in_axes=(0, None, None, 0, 0, 0, 0, None, None))(
+        seeds, control, path_masks, cw_planes, ccl, ccr, vc, block_sel, acc_mask
+    )
+
+
+def batch_evaluate(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
+    """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe]."""
+    v = dcf.dpf.validator
+    n = dcf.log_domain_size
+    bits, xor_group = evaluator._value_kind(dcf.value_type)
+    num_points = len(xs)
+    for x in xs:
+        if x < 0 or (n < 128 and int(x) >= (1 << n)):
+            raise ValueError(f"evaluation point {x} outside the domain")
+    batch = evaluator.KeyBatch.from_keys(dcf.dpf, [k.key for k in keys])
+    T = batch.num_levels
+    k = len(keys)
+
+    p_pad = max(32, -(-num_points // 32) * 32)
+    xs_padded = np.zeros(p_pad, dtype=object)
+    for j, x in enumerate(xs):
+        xs_padded[j] = int(x)
+
+    # Tree path of each point: the final hierarchy level's tree index.
+    last = v.num_hierarchy_levels - 1
+    paths = uint128.array_to_limbs(
+        [v.domain_to_tree_index(int(x) >> 1, last) for x in xs_padded]
+    )
+    path_masks = backend_jax._path_bit_masks(paths, T, p_pad)
+    acc_mask, block_sel, depth_to_hierarchy = _capture_tables(
+        dcf, xs_padded, num_points
+    )
+    vc_full = _value_corrections_all(dcf, keys, depth_to_hierarchy)
+    vc = np.ascontiguousarray(
+        evaluator._correction_limbs(
+            vc_full.reshape(k * (T + 1), -1, 4), bits
+        ).reshape(k, T + 1, -1, max(bits // 32, 1))
+    )
+    cw_planes, ccl, ccr = batch.device_cw_arrays()
+
+    seeds = np.broadcast_to(batch.seeds[:, None, :], (k, p_pad, 4)).copy()
+    control0 = aes_jax.pack_bit_mask(np.full(p_pad, bool(batch.party), dtype=bool))
+    out = _dcf_batch_jit(
+        jnp.asarray(seeds),
+        jnp.asarray(control0),
+        jnp.asarray(path_masks),
+        jnp.asarray(cw_planes),
+        jnp.asarray(ccl),
+        jnp.asarray(ccr),
+        jnp.asarray(vc),
+        jnp.asarray(block_sel),
+        jnp.asarray(acc_mask),
+        bits=bits,
+        party=batch.party,
+        xor_group=xor_group,
+    )
+    return np.asarray(out)[:, :num_points]
