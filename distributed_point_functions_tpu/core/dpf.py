@@ -100,6 +100,14 @@ class DistributedPointFunction:
     def generate_keys(self, alpha: int, beta, seeds=None) -> Tuple[DpfKey, DpfKey]:
         return self.generate_keys_incremental(alpha, [beta], seeds=seeds)
 
+    def generate_keys_batch(self, alphas, betas, seeds=None):
+        """K key pairs at once; one vectorized AES call per tree level.
+
+        `betas` is per hierarchy level, scalar or length-K. See
+        KeyGenerator.generate_keys_batch.
+        """
+        return self._keygen.generate_keys_batch(alphas, betas, seeds=seeds)
+
     def generate_keys_incremental(
         self, alpha: int, betas: Sequence, seeds=None
     ) -> Tuple[DpfKey, DpfKey]:

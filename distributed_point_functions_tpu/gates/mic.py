@@ -25,8 +25,7 @@ whole gate evaluation is a single O(n)-depth scan over a packed lane batch.
 from __future__ import annotations
 
 import dataclasses
-import secrets
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -34,6 +33,7 @@ from ..core.value_types import Int
 from ..dcf.dcf import DcfKey, DistributedComparisonFunction
 from ..ops import evaluator
 from ..utils.errors import InvalidArgumentError
+from .prng import BasicRng, SecurePrng
 
 
 @dataclasses.dataclass
@@ -77,7 +77,19 @@ class MultipleIntervalContainmentGate:
     def dcf(self) -> DistributedComparisonFunction:
         return self._dcf
 
-    def gen(self, r_in: int, r_outs: Sequence[int]) -> Tuple[MicKey, MicKey]:
+    def gen(
+        self,
+        r_in: int,
+        r_outs: Sequence[int],
+        prng: Optional[SecurePrng] = None,
+        dcf_seeds=None,
+    ) -> Tuple[MicKey, MicKey]:
+        """Key pair for masks r_in / r_outs. `prng` supplies the mask-share
+        randomness (SecurePrng analog, prng.h:26-36); `dcf_seeds` optionally
+        pins the inner DCF keygen seeds — together they make `gen` fully
+        deterministic for golden-key tests."""
+        if prng is None:
+            prng = BasicRng()
         n = 1 << self.log_group_size
         if len(r_outs) != len(self.intervals):
             raise InvalidArgumentError(
@@ -94,7 +106,7 @@ class MultipleIntervalContainmentGate:
                 )
 
         gamma = (n - 1 + r_in) % n
-        key_0, key_1 = self._dcf.generate_keys(gamma, 1)
+        key_0, key_1 = self._dcf.generate_keys(gamma, 1, seeds=dcf_seeds)
         shares_0, shares_1 = [], []
         for (p, q), r_out in zip(self.intervals, r_outs):
             q_prime = (q + 1) % n
@@ -108,7 +120,7 @@ class MultipleIntervalContainmentGate:
                 + (1 if alpha_q_prime > q_prime else 0)
                 + (1 if alpha_q == n - 1 else 0)
             ) % n
-            z_0 = int.from_bytes(secrets.token_bytes(16), "little") % n
+            z_0 = prng.rand128() % n
             z_1 = (z - z_0) % n
             shares_0.append(z_0)
             shares_1.append(z_1)

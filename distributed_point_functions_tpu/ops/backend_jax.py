@@ -28,6 +28,7 @@ word); padded lanes compute garbage independently and are trimmed on unpack.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Tuple
 
 import jax
@@ -38,6 +39,31 @@ from ..core import constants
 from . import aes_jax
 
 _FULL = np.uint32(0xFFFFFFFF)
+
+_backend_logged = False
+
+
+def log_backend_once() -> None:
+    """One-time log of the active JAX backend and device kind — the analog of
+    the reference's one-time SIMD-dispatch-mode log at Create
+    (/root/reference/dpf/distributed_point_function.cc:569-571 via
+    internal/get_hwy_mode.cc:30-41). Called from the evaluation entry points
+    so it runs exactly when the first device computation is about to."""
+    global _backend_logged
+    if _backend_logged:
+        return
+    _backend_logged = True
+    log = logging.getLogger("distributed_point_functions_tpu")
+    try:
+        devices = jax.devices()
+        log.info(
+            "DPF evaluation backend: %s, %d device(s), kind: %s",
+            jax.default_backend(),
+            len(devices),
+            devices[0].device_kind if devices else "none",
+        )
+    except Exception as e:  # backend init failure is the caller's problem
+        log.warning("JAX backend unavailable: %r", e)
 
 
 @functools.lru_cache(maxsize=None)
@@ -122,17 +148,24 @@ def _evaluate_seeds_blocks_jit(seeds, control, path_masks, cw, ccl, ccr):
 def expand_one_level(planes, control, cw_plane, ccl_mask, ccr_mask):
     """One doubling level: every lane hashed under both PRG keys.
 
-    Returns planes/control with the lane axis doubled, children
-    block-concatenated: [left children | right children].
+    Implemented as ONE bitsliced AES at doubled width with per-lane key
+    selection (left key for the first half, right for the second) — same
+    arithmetic as hashing twice, but the program traces a single AES circuit,
+    which halves compile time of unrolled expansions. Returns planes/control
+    with the lane axis doubled, children block-concatenated:
+    [left children | right children].
     """
-    corr = cw_plane[:, None] & control[None, :]
-    hl = aes_jax.hash_planes(planes, _rk("left")) ^ corr
-    hr = aes_jax.hash_planes(planes, _rk("right")) ^ corr
-    new_control = jnp.concatenate(
-        [hl[0] ^ (control & ccl_mask), hr[0] ^ (control & ccr_mask)]
+    w = planes.shape[1]
+    both = jnp.concatenate([planes, planes], axis=1)
+    key_mask = jnp.concatenate(
+        [jnp.zeros(w, jnp.uint32), jnp.full(w, _FULL, jnp.uint32)]
     )
-    zero = jnp.zeros_like(hl[0])
-    out = jnp.concatenate([hl.at[0].set(zero), hr.at[0].set(zero)], axis=1)
+    h = aes_jax.hash_planes(both, _rk("left"), _rk("lr_diff"), key_mask)
+    corr = cw_plane[:, None] & control[None, :]
+    h = h ^ jnp.concatenate([corr, corr], axis=1)
+    cc = jnp.concatenate([control & ccl_mask, control & ccr_mask])
+    new_control = h[0] ^ cc
+    out = h.at[0].set(jnp.zeros_like(h[0]))
     return out, new_control
 
 

@@ -269,6 +269,50 @@ def _expand_hash_correct(
     return _correct_values(blocks, ctrl_bits, corrections, bits, party, xor_group)
 
 
+@jax.jit
+def _pack_batch_jit(seeds, control_mask):
+    """uint32[K, M, 4] seeds -> uint32[K, 128, M//32] planes (+ control)."""
+    return jax.vmap(aes_jax.pack_to_planes)(seeds), control_mask
+
+
+@jax.jit
+def _expand_level_batch_jit(planes, control, cw_plane, ccl, ccr):
+    """One doubling level over the whole key batch; one traced AES circuit.
+
+    Dispatched per level from the host (arrays stay on device) so each XLA
+    program stays small — compile time scales with the number of *distinct
+    widths*, not with a single giant unrolled program.
+    """
+    return jax.vmap(backend_jax.expand_one_level)(planes, control, cw_plane, ccl, ccr)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bits", "party", "xor_group", "keep_per_block")
+)
+def _finalize_batch_jit(
+    planes, control, corrections, order, bits, party, xor_group, keep_per_block
+):
+    """Value hash + unpack + correction + leaf-order restore for a key batch.
+
+    `keep_per_block` slices each block to corrected_elements_per_block
+    (1 << (log_domain_size - tree_level)) before flattening, mirroring
+    /root/reference/dpf/distributed_point_function.h:786-808 — blocks carry
+    elements_per_block values but only the first 2^(lds - level) are
+    addressable when an earlier hierarchy level forces the tree deeper.
+    """
+    hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
+    blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
+    ctrl = jax.vmap(backend_jax.unpack_mask_device)(control)
+    fn = functools.partial(
+        _correct_values, bits=bits, party=party, xor_group=xor_group
+    )
+    values = jax.vmap(fn)(blocks, ctrl, corrections)  # [K, lanes, epb, lpe]
+    values = values[:, order]  # leaf order
+    values = values[:, :, :keep_per_block]
+    k, n_blocks, kept, lpe = values.shape
+    return values.reshape(k, n_blocks * kept, lpe)
+
+
 @functools.partial(
     jax.jit, static_argnames=("levels", "bits", "party", "xor_group")
 )
@@ -317,8 +361,15 @@ def full_domain_evaluate(
         hierarchy_level = v.num_hierarchy_levels - 1
     value_type = v.parameters[hierarchy_level].value_type
     bits, xor_group = _value_kind(value_type)
+    backend_jax.log_backend_once()
     batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
     stop_level = batch.num_levels
+    # Only the first 2^(lds - tree_level) elements of each block are
+    # addressable; fewer than elements_per_block when an earlier hierarchy
+    # level forces the tree deeper (distributed_point_function.h:786-808).
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep_per_block = 1 << (lds - stop_level)
+    assert keep_per_block <= value_type.elements_per_block()
 
     # Host expands until one packed word (32 lanes) is full.
     if host_levels is None:
@@ -364,18 +415,25 @@ def full_domain_evaluate(
         order_np = backend_jax.expansion_output_order(
             m, seeds_p.shape[1], device_levels
         )
-        out = _expand_batch_jit(
-            jnp.asarray(seeds_p),
-            jnp.asarray(control_mask),
-            jnp.asarray(cw_dev),
-            jnp.asarray(ccl),
-            jnp.asarray(ccr),
+        planes, control = _pack_batch_jit(
+            jnp.asarray(seeds_p), jnp.asarray(control_mask)
+        )
+        cw_dev = jnp.asarray(cw_dev)
+        ccl = jnp.asarray(ccl)
+        ccr = jnp.asarray(ccr)
+        for level in range(device_levels):
+            planes, control = _expand_level_batch_jit(
+                planes, control, cw_dev[:, level], ccl[:, level], ccr[:, level]
+            )
+        out = _finalize_batch_jit(
+            planes,
+            control,
             jnp.asarray(corrections),
             jnp.asarray(order_np),
-            levels=device_levels,
             bits=bits,
             party=batch.party,
             xor_group=xor_group,
+            keep_per_block=keep_per_block,
         )
         out = np.asarray(out)
         if pad:
@@ -483,6 +541,7 @@ def evaluate_at_batch(
         hierarchy_level = v.num_hierarchy_levels - 1
     value_type = v.parameters[hierarchy_level].value_type
     bits, xor_group = _value_kind(value_type)
+    backend_jax.log_backend_once()
     batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
     num_levels = batch.num_levels
     k = batch.seeds.shape[0]

@@ -1,0 +1,25 @@
+"""Byte-compatible wire format for keys, parameters, and contexts.
+
+See serialization.py for the message codecs (reference schema:
+/root/reference/dpf/distributed_point_function.proto and the dcf/fss_gates
+protos) and wire.py for the proto3 wire-format primitives.
+"""
+
+from .serialization import (  # noqa: F401
+    decode_dpf_parameters,
+    decode_mic_parameters,
+    decode_value,
+    decode_value_type,
+    encode_dpf_parameters,
+    encode_mic_parameters,
+    encode_value,
+    encode_value_type,
+    parse_dcf_key,
+    parse_dpf_key,
+    parse_evaluation_context,
+    parse_mic_key,
+    serialize_dcf_key,
+    serialize_dpf_key,
+    serialize_evaluation_context,
+    serialize_mic_key,
+)

@@ -9,6 +9,42 @@ from distributed_point_functions_tpu.core.params import DpfParameters
 from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
 from distributed_point_functions_tpu.ops import evaluator
 
+
+def test_batched_keygen_matches_sequential():
+    """generate_keys_batch is bit-exact with K sequential generate_keys calls
+    given the same seeds (level-major vectorization changes no math)."""
+    rng = np.random.default_rng(42)
+    params = [DpfParameters(3, Int(128)), DpfParameters(10, Int(32))]
+    dpf = DistributedPointFunction.create_incremental(params)
+    k = 6
+    alphas = [int(a) for a in rng.integers(0, 1 << 10, size=k)]
+    betas = [
+        [int(b) for b in rng.integers(1, 100, size=k)],
+        [int(b) for b in rng.integers(1, 100, size=k)],
+    ]
+    seeds = rng.integers(0, 2**32, size=(k, 2, 4), dtype=np.uint32)
+    ka_batch, kb_batch = dpf.generate_keys_batch(alphas, betas, seeds=seeds)
+    for i in range(k):
+        s = (
+            int.from_bytes(seeds[i, 0].tobytes(), "little"),
+            int.from_bytes(seeds[i, 1].tobytes(), "little"),
+        )
+        ka, kb = dpf.generate_keys_incremental(
+            alphas[i], [betas[0][i], betas[1][i]], seeds=s
+        )
+        assert ka == ka_batch[i]
+        assert kb == kb_batch[i]
+
+
+def test_batched_keygen_broadcast_beta_and_validation():
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    keys_a, keys_b = dpf.generate_keys_batch([1, 2, 3], [5])
+    assert len(keys_a) == len(keys_b) == 3
+    with pytest.raises(Exception, match="same size as `parameters`"):
+        dpf.generate_keys_batch([1], [5, 6])
+    with pytest.raises(Exception, match="smaller than the output domain"):
+        dpf.generate_keys_batch([1 << 9], [5])
+
 RNG = np.random.default_rng(0xEA1)
 
 
@@ -104,6 +140,63 @@ def test_evaluate_at_batch_matches_host(bits):
         for j, pt in enumerate(points):
             expected = betas[i] if pt == alphas[i] else 0
             assert (int(got_a[i][j]) + int(got_b[i][j])) % mod == expected
+
+
+@pytest.mark.parametrize(
+    "params,alpha",
+    [
+        # ADVICE r1 repro: level 0 (Int(128), epb=1) forces tree height 3;
+        # level 1 (Int(32), epb=4) stops at a tree level where only
+        # 2^(lds - level) < epb elements per block are addressable.
+        ([DpfParameters(3, Int(128)), DpfParameters(4, Int(32))], 13),
+        ([DpfParameters(2, Int(64)), DpfParameters(5, Int(8))], 21),
+        ([DpfParameters(4, Int(32)), DpfParameters(8, Int(32)),
+          DpfParameters(12, Int(64))], 3071),
+    ],
+)
+def test_full_domain_incremental_matches_host(params, alpha):
+    """Device full_domain_evaluate == host evaluate_until at EVERY hierarchy
+    level of an incremental DPF (catches partial-block trimming)."""
+    dpf = DistributedPointFunction.create_incremental(params)
+    betas = [int(b) for b in RNG.integers(1, 100, size=len(params))]
+    ka, kb = dpf.generate_keys_incremental(alpha, betas)
+    for level, p in enumerate(params):
+        bits = p.value_type.bitsize
+        got = evaluator.values_to_numpy(
+            evaluator.full_domain_evaluate(dpf, [ka], hierarchy_level=level),
+            bits,
+        )[0]
+        ctx = dpf.create_evaluation_context(ka)
+        want = dpf.evaluate_until(level, [], ctx)
+        np.testing.assert_array_equal(
+            got.astype(object), np.array(want, dtype=object)
+        )
+        # and the share-sum property at this level
+        got_b = evaluator.values_to_numpy(
+            evaluator.full_domain_evaluate(dpf, [kb], hierarchy_level=level),
+            bits,
+        )[0]
+        total = (got.astype(object) + got_b.astype(object)) % (1 << bits)
+        expected = np.zeros(1 << p.log_domain_size, dtype=object)
+        expected[alpha >> (params[-1].log_domain_size - p.log_domain_size)] = betas[level]
+        assert (total == expected).all(), f"level {level}"
+
+
+def test_evaluate_at_batch_incremental_intermediate_level():
+    """evaluate_at_batch at an intermediate hierarchy level == host path."""
+    params = [DpfParameters(3, Int(128)), DpfParameters(4, Int(32))]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(13, [7, 9])
+    for level, bits in [(0, 128), (1, 32)]:
+        points = list(range(1 << params[level].log_domain_size))
+        got = evaluator.values_to_numpy(
+            evaluator.evaluate_at_batch(dpf, [ka], points, hierarchy_level=level),
+            bits,
+        )[0]
+        want = dpf.evaluate_at(ka, level, points)
+        np.testing.assert_array_equal(
+            got.astype(object), np.array(want, dtype=object)
+        )
 
 
 def test_evaluate_at_batch_large_domain_128():

@@ -1,0 +1,351 @@
+"""Wire-format tests: round-trips, byte-for-byte differential against the
+protobuf runtime, and a pinned golden key fixture.
+
+The oracle schema is built programmatically with descriptor_pb2 (same
+messages/field numbers as /root/reference/dpf/distributed_point_function.proto
+and the dcf/fss_gates protos) so the hand-rolled encoder in
+protos/serialization.py is checked against protobuf's canonical C++-style
+serialization without depending on generated code.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.keys import (
+    CorrectionWord,
+    DpfKey,
+    EvaluationContext,
+    PartialEvaluation,
+)
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import (
+    Int,
+    IntModN,
+    TupleType,
+    XorWrapper,
+)
+from distributed_point_functions_tpu.protos import serialization as ser
+from distributed_point_functions_tpu.utils.errors import InvalidArgumentError
+
+# ---------------------------------------------------------------------------
+# Oracle: protobuf runtime with dynamically built descriptors
+# ---------------------------------------------------------------------------
+
+_T = descriptor_pb2.FieldDescriptorProto
+
+
+def _build_oracle():
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "dpf_oracle.proto"
+    fdp.package = "dpf_oracle"
+    fdp.syntax = "proto3"
+
+    def message(name, *fields, oneofs=()):
+        m = fdp.message_type.add()
+        m.name = name
+        for o in oneofs:
+            m.oneof_decl.add().name = o
+        for fname, number, ftype, kw in fields:
+            f = m.field.add()
+            f.name = fname
+            f.number = number
+            f.type = ftype
+            f.label = kw.get("label", _T.LABEL_OPTIONAL)
+            if "type_name" in kw:
+                f.type_name = ".dpf_oracle." + kw["type_name"]
+            if "oneof" in kw:
+                f.oneof_index = kw["oneof"]
+
+    M, REP = _T.TYPE_MESSAGE, {"label": _T.LABEL_REPEATED}
+    message("Block", ("high", 1, _T.TYPE_UINT64, {}), ("low", 2, _T.TYPE_UINT64, {}))
+    message("Integer", ("bitsize", 1, _T.TYPE_INT32, {}))
+    message("TypeTuple", ("elements", 1, M, {**REP, "type_name": "ValueType"}))
+    message(
+        "TypeIntModN",
+        ("base_integer", 1, M, {"type_name": "Integer"}),
+        ("modulus", 2, M, {"type_name": "ValueInteger"}),
+    )
+    message(
+        "ValueType",
+        ("integer", 1, M, {"type_name": "Integer", "oneof": 0}),
+        ("tuple", 2, M, {"type_name": "TypeTuple", "oneof": 0}),
+        ("int_mod_n", 3, M, {"type_name": "TypeIntModN", "oneof": 0}),
+        ("xor_wrapper", 4, M, {"type_name": "Integer", "oneof": 0}),
+        oneofs=("type",),
+    )
+    message(
+        "ValueInteger",
+        ("value_uint64", 1, _T.TYPE_UINT64, {"oneof": 0}),
+        ("value_uint128", 2, M, {"type_name": "Block", "oneof": 0}),
+        oneofs=("value",),
+    )
+    message("ValueTuple", ("elements", 1, M, {**REP, "type_name": "Value"}))
+    message(
+        "Value",
+        ("integer", 1, M, {"type_name": "ValueInteger", "oneof": 0}),
+        ("tuple", 2, M, {"type_name": "ValueTuple", "oneof": 0}),
+        ("int_mod_n", 3, M, {"type_name": "ValueInteger", "oneof": 0}),
+        ("xor_wrapper", 4, M, {"type_name": "ValueInteger", "oneof": 0}),
+        oneofs=("value",),
+    )
+    message(
+        "DpfParameters",
+        ("log_domain_size", 1, _T.TYPE_INT32, {}),
+        ("value_type", 3, M, {"type_name": "ValueType"}),
+        ("security_parameter", 4, _T.TYPE_DOUBLE, {}),
+    )
+    message(
+        "CorrectionWord",
+        ("seed", 1, M, {"type_name": "Block"}),
+        ("control_left", 2, _T.TYPE_BOOL, {}),
+        ("control_right", 3, _T.TYPE_BOOL, {}),
+        ("value_correction", 5, M, {**REP, "type_name": "Value"}),
+    )
+    message(
+        "DpfKey",
+        ("seed", 1, M, {"type_name": "Block"}),
+        ("correction_words", 2, M, {**REP, "type_name": "CorrectionWord"}),
+        ("party", 3, _T.TYPE_INT32, {}),
+        ("last_level_value_correction", 5, M, {**REP, "type_name": "Value"}),
+    )
+    message(
+        "PartialEvaluation",
+        ("prefix", 1, M, {"type_name": "Block"}),
+        ("seed", 2, M, {"type_name": "Block"}),
+        ("control_bit", 3, _T.TYPE_BOOL, {}),
+    )
+    message(
+        "EvaluationContext",
+        ("parameters", 1, M, {**REP, "type_name": "DpfParameters"}),
+        ("key", 2, M, {"type_name": "DpfKey"}),
+        ("previous_hierarchy_level", 3, _T.TYPE_INT32, {}),
+        ("partial_evaluations", 4, M, {**REP, "type_name": "PartialEvaluation"}),
+        ("partial_evaluations_level", 5, _T.TYPE_INT32, {}),
+    )
+
+    pool = descriptor_pool.DescriptorPool()
+    pool.Add(fdp)
+    names = [
+        "Block", "Integer", "TypeTuple", "TypeIntModN", "ValueType",
+        "ValueInteger", "ValueTuple", "Value", "DpfParameters",
+        "CorrectionWord", "DpfKey", "PartialEvaluation", "EvaluationContext",
+    ]
+    return {
+        n: message_factory.GetMessageClass(pool.FindMessageTypeByName(f"dpf_oracle.{n}"))
+        for n in names
+    }
+
+
+ORACLE = _build_oracle()
+
+
+def _o_block(msg, x):
+    msg.high = (x >> 64) & 0xFFFFFFFFFFFFFFFF
+    msg.low = x & 0xFFFFFFFFFFFFFFFF
+
+
+def _o_value_integer(msg, x):
+    if (x >> 64) == 0:
+        msg.value_uint64 = x
+    else:
+        _o_block(msg.value_uint128, x)
+
+
+def _o_value_type(msg, vt):
+    if isinstance(vt, Int):
+        msg.integer.bitsize = vt.bitsize
+    elif isinstance(vt, TupleType):
+        msg.tuple.SetInParent()
+        for e in vt.elements:
+            _o_value_type(msg.tuple.elements.add(), e)
+    elif isinstance(vt, IntModN):
+        msg.int_mod_n.base_integer.bitsize = vt.base_bitsize
+        _o_value_integer(msg.int_mod_n.modulus, vt.modulus)
+    elif isinstance(vt, XorWrapper):
+        msg.xor_wrapper.bitsize = vt.bitsize
+    else:
+        raise AssertionError(vt)
+
+
+def _o_value(msg, vt, v):
+    if isinstance(vt, Int):
+        _o_value_integer(msg.integer, int(v))
+    elif isinstance(vt, TupleType):
+        msg.tuple.SetInParent()
+        for evt, ev in zip(vt.elements, v):
+            _o_value(msg.tuple.elements.add(), evt, ev)
+    elif isinstance(vt, IntModN):
+        _o_value_integer(msg.int_mod_n, int(v))
+    elif isinstance(vt, XorWrapper):
+        _o_value_integer(msg.xor_wrapper, int(v))
+    else:
+        raise AssertionError(vt)
+
+
+def _o_parameters(msg, p: DpfParameters):
+    msg.log_domain_size = p.log_domain_size
+    _o_value_type(msg.value_type, p.value_type)
+    msg.security_parameter = p.security_parameter
+
+
+def _o_key(msg, key: DpfKey, parameters):
+    _o_block(msg.seed, key.seed)
+    type_map = ser._output_level_types(parameters, len(key.correction_words))
+    for i, cw in enumerate(key.correction_words):
+        m = msg.correction_words.add()
+        _o_block(m.seed, cw.seed)
+        m.control_left = cw.control_left
+        m.control_right = cw.control_right
+        vt = type_map.get(i, parameters[-1].value_type)
+        for v in cw.value_correction:
+            _o_value(m.value_correction.add(), vt, v)
+    msg.party = key.party
+    for v in key.last_level_value_correction:
+        _o_value(msg.last_level_value_correction.add(), parameters[-1].value_type, v)
+
+
+# ---------------------------------------------------------------------------
+# Fixtures: deterministic keys across a spread of parameter shapes
+# ---------------------------------------------------------------------------
+
+CASES = [
+    ("int64", [DpfParameters(10, Int(64))], 137, [5]),
+    ("int128", [DpfParameters(5, Int(128))], 30, [(1 << 127) | 99]),
+    ("xor128", [DpfParameters(6, XorWrapper(128))], 63, [(1 << 100) | 7]),
+    (
+        "hierarchy",
+        [DpfParameters(3, Int(128)), DpfParameters(10, Int(32))],
+        999,
+        [12, 34],
+    ),
+    (
+        "tuple_intmodn",
+        [DpfParameters(4, TupleType(Int(32), IntModN(64, (1 << 62) - 57)))],
+        9,
+        [(77, 123456789)],
+    ),
+]
+
+
+def _make_key(params, alpha, betas):
+    dpf = DistributedPointFunction.create_incremental(params)
+    seeds = np.arange(8, dtype=np.uint32).reshape(1, 2, 4) + 1
+    keys_a, keys_b = dpf.generate_keys_batch([alpha], [[b] for b in betas], seeds=seeds)
+    return dpf, keys_a[0], keys_b[0]
+
+
+@pytest.mark.parametrize("name,params,alpha,betas", CASES, ids=[c[0] for c in CASES])
+def test_key_roundtrip_and_oracle_bytes(name, params, alpha, betas):
+    dpf, ka, kb = _make_key(params, alpha, betas)
+    for key in (ka, kb):
+        data = ser.serialize_dpf_key(key, params)
+        # byte-for-byte identical to the protobuf runtime's serialization
+        oracle = ORACLE["DpfKey"]()
+        _o_key(oracle, key, dpf.validator.parameters)
+        assert data == oracle.SerializeToString(deterministic=True), name
+        # round-trip restores the dataclass exactly
+        assert ser.parse_dpf_key(data) == key
+
+
+@pytest.mark.parametrize("name,params,alpha,betas", CASES, ids=[c[0] for c in CASES])
+def test_parameters_roundtrip_and_oracle_bytes(name, params, alpha, betas):
+    dpf = DistributedPointFunction.create_incremental(params)
+    for p in dpf.validator.parameters:
+        data = ser.encode_dpf_parameters(p)
+        oracle = ORACLE["DpfParameters"]()
+        _o_parameters(oracle, p)
+        assert data == oracle.SerializeToString(deterministic=True)
+        got = ser.decode_dpf_parameters(data)
+        assert got.log_domain_size == p.log_domain_size
+        assert got.value_type == p.value_type
+        assert got.security_parameter == p.security_parameter
+
+
+def test_context_roundtrip_with_partial_evaluations():
+    params = [DpfParameters(3, Int(128)), DpfParameters(10, Int(32))]
+    dpf, ka, _ = _make_key(params, 999, [12, 34])
+    ctx = dpf.create_evaluation_context(ka)
+    dpf.evaluate_next([], ctx)  # populate partial evaluations at level 0
+    data = ser.serialize_evaluation_context(ctx)
+
+    oracle = ORACLE["EvaluationContext"]()
+    for p in ctx.parameters:
+        _o_parameters(oracle.parameters.add(), p)
+    _o_key(oracle.key, ctx.key, ctx.parameters)
+    oracle.previous_hierarchy_level = ctx.previous_hierarchy_level
+    for pe in ctx.partial_evaluations:
+        m = oracle.partial_evaluations.add()
+        _o_block(m.prefix, pe.prefix)
+        _o_block(m.seed, pe.seed)
+        m.control_bit = pe.control_bit
+    oracle.partial_evaluations_level = ctx.partial_evaluations_level
+    assert data == oracle.SerializeToString(deterministic=True)
+
+    got = ser.parse_evaluation_context(data)
+    assert got.key == ctx.key
+    assert got.previous_hierarchy_level == ctx.previous_hierarchy_level
+    assert got.partial_evaluations == ctx.partial_evaluations
+    assert got.partial_evaluations_level == ctx.partial_evaluations_level
+    assert [
+        (p.log_domain_size, p.value_type, p.security_parameter)
+        for p in got.parameters
+    ] == [
+        (p.log_domain_size, p.value_type, p.security_parameter)
+        for p in ctx.parameters
+    ]
+    # the deserialized context keeps evaluating where the old one stopped
+    out = dpf.evaluate_next([3], got)
+    want = dpf.evaluate_next([3], ctx)
+    assert out == want
+
+
+def test_fresh_context_negative_level_roundtrip():
+    """previous_hierarchy_level = -1 exercises int32 sign-extension."""
+    params = [DpfParameters(10, Int(64))]
+    dpf, ka, _ = _make_key(params, 137, [5])
+    ctx = dpf.create_evaluation_context(ka)
+    assert ctx.previous_hierarchy_level == -1
+    got = ser.parse_evaluation_context(ser.serialize_evaluation_context(ctx))
+    assert got.previous_hierarchy_level == -1
+
+
+def test_golden_serialized_key():
+    """Pinned fixture: the serialized bytes of a deterministic key must never
+    change (wire-format regression anchor, analog of the reference's
+    proto_validator_test.textproto), and a parsed copy must evaluate to
+    correct shares."""
+    params = [DpfParameters(10, Int(64))]
+    dpf, ka, kb = _make_key(params, 137, [5])
+    data_a = ser.serialize_dpf_key(ka, params)
+    assert hashlib.sha256(data_a).hexdigest() == GOLDEN_KEY_SHA256, (
+        "serialized DpfKey bytes changed — wire format broke"
+    )
+    parsed = ser.parse_dpf_key(data_a)
+    va = dpf.evaluate_at(parsed, 0, [137, 64])
+    vb = dpf.evaluate_at(kb, 0, [137, 64])
+    assert (va[0] + vb[0]) % 2**64 == 5
+    assert (va[1] + vb[1]) % 2**64 == 0
+
+
+GOLDEN_KEY_SHA256 = "66ad81287439b506ad5cf4619e0362366e795c12ce51993788efab5b63e26c0f"
+
+
+def test_value_type_deterministic_encoding():
+    """ValueType bytes are the dispatch key; spot-check stability."""
+    assert ser.encode_value_type(Int(64)).hex() == "0a020840"
+    vt = TupleType(Int(32), XorWrapper(8))
+    rt = ser.decode_value_type(ser.encode_value_type(vt))
+    assert rt == vt
+
+
+def test_errors():
+    with pytest.raises(InvalidArgumentError):
+        ser.parse_dpf_key(b"\x00\x01")  # field number 0
+    with pytest.raises(InvalidArgumentError):
+        ser.decode_value_type(b"")  # no oneof set
+    with pytest.raises(InvalidArgumentError):
+        list(ser.wire.iter_fields(b"\xff"))  # truncated varint
