@@ -179,6 +179,26 @@ def hash_value_planes(planes):
     return aes_jax.hash_planes(planes, _rk("value"))
 
 
+def hash_value_stream(planes, blocks_needed: int):
+    """Value-PRG byte stream of packed seeds: hash(seed + j) for all
+    j < blocks_needed, concatenated little-endian per lane.
+
+    Device analog of HashExpandedSeeds
+    (/root/reference/dpf/distributed_point_function.cc:500-524) feeding the
+    value codec: returns uint32[lanes, 4 * blocks_needed] — the limb stream
+    whose bytes equal the reference's per-seed hash buffer.
+    """
+    if blocks_needed == 1:
+        return aes_jax.unpack_from_planes(hash_value_planes(planes))
+    seeds = aes_jax.unpack_from_planes(planes)
+    parts = []
+    for j in range(blocks_needed):
+        s = seeds if j == 0 else _add_small_constant(seeds, np.uint32(j))
+        h = hash_value_planes(aes_jax.pack_to_planes(s))
+        parts.append(aes_jax.unpack_from_planes(h))
+    return jnp.concatenate(parts, axis=-1)
+
+
 @functools.partial(jax.jit, static_argnames=("blocks_needed",))
 def _hash_expanded_blocks_jit(seeds, blocks_needed: int):
     """Value-PRG hash of seeds[i]+j for all j < blocks_needed, one batch.

@@ -17,9 +17,12 @@ never leave the device:
   keys x points: one ``lax.scan`` tree walk over all levels with per-lane
   key selection, vmapped over keys, sharing one set of evaluation points.
 
-Value correction handles power-of-two integer widths 8..128 (additive and
-XOR groups) with u32-limb arithmetic — no 64-bit emulation needed on TPU.
-IntModN and Tuple outputs go through the host path in core/dpf.py.
+Value correction handles every value type on device: power-of-two integer
+widths 8..128 (additive and XOR groups) on the scalar fast path, and
+IntModN / Tuple outputs through the spec-driven codec (ops/value_codec.py):
+mod-N reduction of the hash block in u32 limbs, struct-of-arrays tuples,
+and the sequential sampling chain for tuples containing IntModN. Tuple
+outputs are returned as a tuple of per-component limb arrays.
 """
 
 from __future__ import annotations
@@ -36,7 +39,7 @@ from ..core import backend_numpy, uint128
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..core.value_types import Int, XorWrapper
-from . import aes_jax, backend_jax
+from . import aes_jax, backend_jax, value_codec
 
 # ---------------------------------------------------------------------------
 # Host-side key batch preparation
@@ -52,8 +55,11 @@ class KeyBatch:
     cw_seeds: np.ndarray  # uint32[K, L, 4]
     cw_left: np.ndarray  # bool[K, L]
     cw_right: np.ndarray  # bool[K, L]
-    value_corrections: np.ndarray  # uint32[K, epb, 4] (limbs of each element)
+    value_corrections: np.ndarray  # uint32[K, epb, 4] (zeros for tuple types)
     num_levels: int
+    # Spec-driven codec form: per component c, uint32[K, epb, lpe_c].
+    spec: Optional[value_codec.ValueSpec] = None
+    codec_corrections: Optional[Tuple[np.ndarray, ...]] = None
 
     @classmethod
     def from_keys(
@@ -71,7 +77,12 @@ class KeyBatch:
         cw_right = np.zeros((k, stop_level), dtype=bool)
         value_type = v.parameters[hierarchy_level].value_type
         epb = value_type.elements_per_block()
+        spec = value_codec.build_spec(value_type, v.blocks_needed[hierarchy_level])
         vc = np.zeros((k, epb, 4), dtype=np.uint32)
+        codec_vc = tuple(
+            np.zeros((k, spec.epb, comp.lpe), dtype=np.uint32)
+            for comp in spec.components
+        )
         for i, key in enumerate(keys):
             if key.party != party:
                 raise ValueError("all keys in a batch must belong to one party")
@@ -86,8 +97,12 @@ class KeyBatch:
                 corrections = key.last_level_value_correction
             else:
                 corrections = key.correction_words[stop_level].value_correction
-            for j, c in enumerate(corrections):
-                vc[i, j] = uint128.to_limbs(int(c))
+            per_comp = value_codec.correction_limbs(spec, corrections)
+            for c, arr in enumerate(per_comp):
+                codec_vc[c][i] = arr
+            if not spec.is_tuple:
+                for j, cval in enumerate(corrections):
+                    vc[i, j] = uint128.to_limbs(int(cval))
         return cls(
             seeds=seeds,
             party=party,
@@ -96,6 +111,8 @@ class KeyBatch:
             cw_right=cw_right,
             value_corrections=vc,
             num_levels=stop_level,
+            spec=spec,
+            codec_corrections=codec_vc,
         )
 
     def device_cw_arrays(self, from_level: int = 0):
@@ -313,6 +330,26 @@ def _finalize_batch_jit(
     return values.reshape(k, n_blocks * kept, lpe)
 
 
+@functools.partial(jax.jit, static_argnames=("spec", "party", "keep_per_block"))
+def _finalize_batch_codec_jit(planes, control, corrections, order, spec, party, keep_per_block):
+    """Spec-driven finalize for IntModN / Tuple outputs (see _finalize_batch_jit
+    for the scalar fast path). Returns a tuple of per-component limb arrays
+    uint32[K, n_blocks * keep_per_block, lpe_c]."""
+
+    def one(p, c, corrs):
+        stream = backend_jax.hash_value_stream(p, spec.blocks_needed)
+        ctrl = backend_jax.unpack_mask_device(c)
+        return value_codec.correct_values(stream, ctrl, corrs, spec, party)
+
+    vals = jax.vmap(one)(planes, control, corrections)
+    outs = []
+    for v in vals:  # [K, lanes, epb, lpe_c]
+        v = v[:, order][:, :, :keep_per_block]
+        k, n_blocks, kept, lpe = v.shape
+        outs.append(v.reshape(k, n_blocks * kept, lpe))
+    return tuple(outs)
+
+
 @functools.partial(
     jax.jit, static_argnames=("levels", "bits", "party", "xor_group")
 )
@@ -352,17 +389,23 @@ def full_domain_evaluate(
 ) -> np.ndarray:
     """Full-domain evaluation of a key batch on device.
 
-    Returns uint32[K, domain_size, lpe] limb values (lpe = max(bits//32, 1));
-    use `values_to_numpy` for a numpy integer view. Keys are processed in
-    chunks of `key_chunk` to bound HBM use.
+    For Int/XorWrapper outputs returns uint32[K, domain_size, lpe] limb
+    values (lpe = max(bits//32, 1)); use `values_to_numpy` for a numpy
+    integer view. For IntModN returns uint32[K, domain_size, lpe] mod-N limb
+    values; for Tuple outputs returns a tuple of such per-component arrays
+    (struct of arrays) — `value_codec.values_to_host` converts either back to
+    host values. Keys are processed in chunks of `key_chunk` to bound HBM use.
     """
     v = dpf.validator
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
     value_type = v.parameters[hierarchy_level].value_type
-    bits, xor_group = _value_kind(value_type)
     backend_jax.log_backend_once()
     batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    spec = batch.spec
+    scalar_fast = spec.is_scalar_direct and spec.blocks_needed == 1
+    if scalar_fast:
+        bits, xor_group = _value_kind(value_type)
     stop_level = batch.num_levels
     # Only the first 2^(lds - tree_level) elements of each block are
     # addressable; fewer than elements_per_block when an earlier hierarchy
@@ -395,6 +438,8 @@ def full_domain_evaluate(
             cw_right=batch.cw_right[idx],
             value_corrections=batch.value_corrections[idx],
             num_levels=stop_level,
+            spec=spec,
+            codec_corrections=tuple(a[idx] for a in batch.codec_corrections),
         )
         k = kb.seeds.shape[0]
         control0 = np.full(k, bool(kb.party), dtype=bool)
@@ -411,7 +456,6 @@ def full_domain_evaluate(
             )
         control_mask = aes_jax.pack_bit_mask(control_p)
         cw_dev, ccl, ccr = kb.device_cw_arrays(host_levels)
-        corrections = _correction_limbs(kb.value_corrections, bits)
         order_np = backend_jax.expansion_output_order(
             m, seeds_p.shape[1], device_levels
         )
@@ -425,24 +469,44 @@ def full_domain_evaluate(
             planes, control = _expand_level_batch_jit(
                 planes, control, cw_dev[:, level], ccl[:, level], ccr[:, level]
             )
-        out = _finalize_batch_jit(
-            planes,
-            control,
-            jnp.asarray(corrections),
-            jnp.asarray(order_np),
-            bits=bits,
-            party=batch.party,
-            xor_group=xor_group,
-            keep_per_block=keep_per_block,
-        )
-        out = np.asarray(out)
-        if pad:
-            out = out[: key_chunk - pad]
-        outs.append(out)
-    result = np.concatenate(outs, axis=0)
-    # Trim to the actual domain size (block packing may overshoot).
+        if scalar_fast:
+            out = _finalize_batch_jit(
+                planes,
+                control,
+                jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
+                jnp.asarray(order_np),
+                bits=bits,
+                party=batch.party,
+                xor_group=xor_group,
+                keep_per_block=keep_per_block,
+            )
+            out = np.asarray(out)
+            if pad:
+                out = out[: key_chunk - pad]
+            outs.append(out)
+        else:
+            out = _finalize_batch_codec_jit(
+                planes,
+                control,
+                tuple(jnp.asarray(a) for a in kb.codec_corrections),
+                jnp.asarray(order_np),
+                spec=spec,
+                party=batch.party,
+                keep_per_block=keep_per_block,
+            )
+            out = tuple(np.asarray(o) for o in out)
+            if pad:
+                out = tuple(o[: key_chunk - pad] for o in out)
+            outs.append(out)
     domain = 1 << v.parameters[hierarchy_level].log_domain_size
-    return result[:, :domain]
+    if scalar_fast:
+        # Trim to the actual domain size (block packing may overshoot).
+        return np.concatenate(outs, axis=0)[:, :domain]
+    merged = tuple(
+        np.concatenate([o[c] for o in outs], axis=0)[:, :domain]
+        for c in range(len(spec.components))
+    )
+    return merged if spec.is_tuple else merged[0]
 
 
 def _value_kind(value_type) -> Tuple[int, bool]:
@@ -523,6 +587,32 @@ def _evaluate_points_jit(
     )
 
 
+def _evaluate_points_one_key_codec(
+    seeds, control, path_masks, cw_planes, ccl, ccr, corrections, block_sel,
+    spec, party,
+):
+    planes = aes_jax.pack_to_planes(seeds)
+    planes, control = backend_jax.evaluate_seeds_planes(
+        planes, control, path_masks, cw_planes, ccl, ccr
+    )
+    stream = backend_jax.hash_value_stream(planes, spec.blocks_needed)
+    ctrl_bits = backend_jax.unpack_mask_device(control)
+    vals = value_codec.correct_values(stream, ctrl_bits, corrections, spec, party)
+    p = block_sel.shape[0]
+    return tuple(v[jnp.arange(p), block_sel] for v in vals)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "party"))
+def _evaluate_points_codec_jit(
+    seeds, control, path_masks, cw_planes, ccl, ccr, corrections, block_sel,
+    spec, party,
+):
+    fn = functools.partial(_evaluate_points_one_key_codec, spec=spec, party=party)
+    return jax.vmap(fn, in_axes=(0, None, None, 0, 0, 0, 0, None))(
+        seeds, control, path_masks, cw_planes, ccl, ccr, corrections, block_sel
+    )
+
+
 def evaluate_at_batch(
     dpf: DistributedPointFunction,
     keys: Sequence[DpfKey],
@@ -534,15 +624,17 @@ def evaluate_at_batch(
     Batched-device equivalent of EvaluateAt
     (/root/reference/dpf/distributed_point_function.h:331-360) — the
     reference evaluates one key at a time; here keys are vmapped and points
-    are packed lanes. Returns uint32[K, P, lpe] limb values.
+    are packed lanes. Returns uint32[K, P, lpe] limb values for scalar
+    outputs, or a tuple of per-component arrays for Tuple outputs.
     """
     v = dpf.validator
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
     value_type = v.parameters[hierarchy_level].value_type
-    bits, xor_group = _value_kind(value_type)
     backend_jax.log_backend_once()
     batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    spec = batch.spec
+    scalar_fast = spec.is_scalar_direct and spec.blocks_needed == 1
     num_levels = batch.num_levels
     k = batch.seeds.shape[0]
     p = len(points)
@@ -560,23 +652,38 @@ def evaluate_at_batch(
     path_masks = backend_jax._path_bit_masks(paths, num_levels, p_pad)
 
     cw_planes, ccl, ccr = batch.device_cw_arrays()
-    corrections = _correction_limbs(batch.value_corrections, bits)
 
     seeds = np.broadcast_to(batch.seeds[:, None, :], (k, p_pad, 4)).copy()
     control0 = aes_jax.pack_bit_mask(
         np.full(p_pad, bool(batch.party), dtype=bool)
     )
-    out = _evaluate_points_jit(
+    if scalar_fast:
+        bits, xor_group = _value_kind(value_type)
+        out = _evaluate_points_jit(
+            jnp.asarray(seeds),
+            jnp.asarray(control0),
+            jnp.asarray(path_masks),
+            jnp.asarray(cw_planes),
+            jnp.asarray(ccl),
+            jnp.asarray(ccr),
+            jnp.asarray(_correction_limbs(batch.value_corrections, bits)),
+            jnp.asarray(block_sel),
+            bits=bits,
+            party=batch.party,
+            xor_group=xor_group,
+        )
+        return np.asarray(out)[:, :p]
+    out = _evaluate_points_codec_jit(
         jnp.asarray(seeds),
         jnp.asarray(control0),
         jnp.asarray(path_masks),
         jnp.asarray(cw_planes),
         jnp.asarray(ccl),
         jnp.asarray(ccr),
-        jnp.asarray(corrections),
+        tuple(jnp.asarray(a) for a in batch.codec_corrections),
         jnp.asarray(block_sel),
-        bits=bits,
+        spec=spec,
         party=batch.party,
-        xor_group=xor_group,
     )
-    return np.asarray(out)[:, :p]
+    out = tuple(np.asarray(o)[:, :p] for o in out)
+    return out if spec.is_tuple else out[0]

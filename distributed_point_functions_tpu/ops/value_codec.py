@@ -1,0 +1,547 @@
+"""Device lowering of the ValueType system.
+
+Maps the host value types (core/value_types.py — the re-implementation of
+/root/reference/dpf/internal/value_type_helpers.h:42-651) onto TPU-friendly
+u32-limb kernels:
+
+* ``Int`` / ``XorWrapper``   — bit-slot extraction + add/xor mod 2^bits.
+* ``IntModN``                — the 128-bit hash block reduced mod N by a
+  bit-serial ``lax.fori_loop`` (TPU has no wide divide; 128 shift/compare/
+  subtract steps on little-endian u32 limbs), then mod-N group ops.
+  Mirrors IntModNImpl::UnsafeSampleFromBytes
+  (/root/reference/dpf/int_mod_n.h:154-177).
+* ``TupleType``              — struct-of-arrays: one limb array per element.
+  Directly-convertible tuples extract each component at its static byte
+  offset; tuples containing IntModN replay the sequential sampling chain
+  (running 128-bit block, divmod by N, refill low bits from the byte
+  stream) with static offsets — vectorized across lanes, sequential only in
+  the (static, small) component count, exactly like the reference's
+  SampleAndUpdateBytes chain
+  (/root/reference/dpf/internal/value_type_helpers.h:341-437).
+
+The public entry points are ``build_spec`` (host: ValueType -> hashable
+``ValueSpec`` usable as a jit static argument), ``correction_limbs`` (host:
+key correction values -> per-component limb arrays) and ``correct_values``
+(device: hashed blocks + control bits + corrections -> per-component limb
+arrays, applying `value += correction if control; value = -value if party 1`
+as in EvaluateUntil, /root/reference/dpf/distributed_point_function.h:776-808).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.value_types import Int, IntModN, TupleType, ValueType, XorWrapper
+
+_U32 = jnp.uint32
+
+
+# ---------------------------------------------------------------------------
+# Specs (hashable; jit static arguments)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentSpec:
+    """One tuple component (or the sole component of a scalar type)."""
+
+    kind: str  # "int" | "xor" | "modn"
+    bits: int  # bitsize (int/xor) or base integer bitsize (modn)
+    modulus: int = 0  # modn only
+    offset_bits: int = 0  # bit offset within one element slot (direct specs)
+
+    @property
+    def lpe(self) -> int:
+        """Output limbs per element for this component."""
+        if self.kind == "modn":
+            return max(((self.modulus - 1).bit_length() + 31) // 32, 1)
+        return max(self.bits // 32, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class ValueSpec:
+    """Device lowering plan for one ValueType."""
+
+    components: Tuple[ComponentSpec, ...]
+    epb: int  # elements per 128-bit block
+    stride_bits: int  # spacing of element slots within the block (direct)
+    blocks_needed: int
+    direct: bool  # True: offset extraction; False: sampling chain
+    is_tuple: bool
+
+    @property
+    def is_scalar_direct(self) -> bool:
+        return self.direct and not self.is_tuple
+
+
+def build_spec(value_type: ValueType, blocks_needed: int) -> ValueSpec:
+    """Lowers a host ValueType to a device ValueSpec."""
+    if isinstance(value_type, (Int, XorWrapper)):
+        kind = "xor" if isinstance(value_type, XorWrapper) else "int"
+        bits = value_type.bitsize
+        return ValueSpec(
+            components=(ComponentSpec(kind, bits),),
+            epb=128 // bits,
+            stride_bits=bits,
+            blocks_needed=blocks_needed,
+            direct=True,
+            is_tuple=False,
+        )
+    if isinstance(value_type, IntModN):
+        return ValueSpec(
+            components=(
+                ComponentSpec("modn", value_type.base_bitsize, value_type.modulus),
+            ),
+            epb=1,
+            stride_bits=0,
+            blocks_needed=blocks_needed,
+            direct=False,
+            is_tuple=False,
+        )
+    if isinstance(value_type, TupleType):
+        comps = []
+        for e in value_type.elements:
+            if isinstance(e, Int):
+                comps.append(("int", e.bitsize, 0))
+            elif isinstance(e, XorWrapper):
+                comps.append(("xor", e.bitsize, 0))
+            elif isinstance(e, IntModN):
+                comps.append(("modn", e.base_bitsize, e.modulus))
+            else:
+                raise NotImplementedError(
+                    f"device codec does not support nested tuples ({e})"
+                )
+        direct = value_type.can_convert_directly()
+        if direct:
+            tbs = value_type.total_bit_size()
+            offset = 0
+            specs = []
+            for kind, bits, mod in comps:
+                specs.append(ComponentSpec(kind, bits, mod, offset))
+                offset += bits
+            epb = 128 // tbs if tbs <= 128 else 1
+            return ValueSpec(
+                components=tuple(specs),
+                epb=epb,
+                stride_bits=tbs,
+                blocks_needed=blocks_needed,
+                direct=True,
+                is_tuple=True,
+            )
+        return ValueSpec(
+            components=tuple(ComponentSpec(k, b, m) for k, b, m in comps),
+            epb=1,
+            stride_bits=0,
+            blocks_needed=blocks_needed,
+            direct=False,
+            is_tuple=True,
+        )
+    raise NotImplementedError(f"no device lowering for value type {value_type}")
+
+
+# ---------------------------------------------------------------------------
+# Host-side correction preparation
+# ---------------------------------------------------------------------------
+
+
+def _int_to_limbs(x: int, n: int) -> np.ndarray:
+    return np.array([(x >> (32 * i)) & 0xFFFFFFFF for i in range(n)], dtype=np.uint32)
+
+
+def correction_limbs(spec: ValueSpec, corrections: Sequence) -> Tuple[np.ndarray, ...]:
+    """Key correction values (epb host values) -> per-component limb arrays.
+
+    Returns, per component c, uint32[epb, lpe_c].
+    """
+    out = []
+    for c, comp in enumerate(spec.components):
+        arr = np.zeros((spec.epb, comp.lpe), dtype=np.uint32)
+        for j, value in enumerate(corrections):
+            v = value[c] if spec.is_tuple else value
+            arr[j] = _int_to_limbs(int(v), comp.lpe)
+        out.append(arr)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Limb arithmetic primitives (static limb counts, unrolled)
+# ---------------------------------------------------------------------------
+
+
+def extract_bits(stream: jnp.ndarray, offset: int, width: int) -> jnp.ndarray:
+    """uint32[..., S] little-endian limb stream -> uint32[..., lpe] value of
+    `width` bits starting at static bit `offset`."""
+    s = stream.shape[-1]
+    lpe = (width + 31) // 32
+    outs = []
+    for l in range(lpe):
+        bitoff = offset + 32 * l
+        limb, sh = bitoff // 32, bitoff % 32
+        lo = stream[..., limb] if limb < s else jnp.zeros_like(stream[..., 0])
+        if sh:
+            lo = lo >> _U32(sh)
+            if limb + 1 < s:
+                lo = lo | (stream[..., limb + 1] << _U32(32 - sh))
+        outs.append(lo)
+    rem = width - 32 * (lpe - 1)
+    if rem < 32:
+        outs[-1] = outs[-1] & _U32((1 << rem) - 1)
+    return jnp.stack(outs, axis=-1)
+
+
+def _shl1(a: jnp.ndarray) -> jnp.ndarray:
+    """Limb-wise left shift by one bit over the last axis."""
+    parts = [a[..., 0] << _U32(1)]
+    for l in range(1, a.shape[-1]):
+        parts.append((a[..., l] << _U32(1)) | (a[..., l - 1] >> _U32(31)))
+    return jnp.stack(parts, axis=-1)
+
+
+def _shl_const(a: jnp.ndarray, k: int, out_limbs: int) -> jnp.ndarray:
+    """a << k truncated to out_limbs limbs; k static."""
+    word, bit = k // 32, k % 32
+    parts = []
+    for l in range(out_limbs):
+        src = l - word
+        lo = a[..., src] if 0 <= src < a.shape[-1] else jnp.zeros_like(a[..., 0])
+        if bit:
+            lo = lo << _U32(bit)
+            if 0 <= src - 1 < a.shape[-1]:
+                lo = lo | (a[..., src - 1] >> _U32(32 - bit))
+        parts.append(lo)
+    return jnp.stack(parts, axis=-1)
+
+
+def _ge_const(a: jnp.ndarray, c: np.ndarray) -> jnp.ndarray:
+    """a >= c (elementwise over leading axes); c: uint32[n] host constant."""
+    n = a.shape[-1]
+    gt = jnp.zeros(a.shape[:-1], dtype=bool)
+    eq = jnp.ones(a.shape[:-1], dtype=bool)
+    for l in range(n - 1, -1, -1):
+        cl = _U32(c[l]) if l < len(c) else _U32(0)
+        gt = gt | (eq & (a[..., l] > cl))
+        eq = eq & (a[..., l] == cl)
+    return gt | eq
+
+
+def _sub_const(a: jnp.ndarray, c: np.ndarray) -> jnp.ndarray:
+    """a - c mod 2^(32n); c: uint32 host constant limbs."""
+    n = a.shape[-1]
+    parts = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=_U32)
+    for l in range(n):
+        cl = _U32(c[l]) if l < len(c) else _U32(0)
+        t = a[..., l] - cl
+        b1 = (t > a[..., l]).astype(_U32)
+        d = t - borrow
+        b2 = (d > t).astype(_U32)
+        parts.append(d)
+        borrow = b1 | b2
+    return jnp.stack(parts, axis=-1)
+
+
+def _rsub_const(c: np.ndarray, a: jnp.ndarray) -> jnp.ndarray:
+    """c - a mod 2^(32n); c: uint32 host constant limbs."""
+    n = a.shape[-1]
+    parts = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=_U32)
+    for l in range(n):
+        cl = _U32(c[l]) if l < len(c) else _U32(0)
+        t = cl - a[..., l]
+        b1 = (t > cl).astype(_U32)
+        d = t - borrow
+        b2 = (d > t).astype(_U32)
+        parts.append(d)
+        borrow = b1 | b2
+    return jnp.stack(parts, axis=-1)
+
+
+def _add_wide(a: jnp.ndarray, b: jnp.ndarray, out_limbs: int) -> jnp.ndarray:
+    """a + b over out_limbs limbs (inputs zero-extended)."""
+    parts = []
+    carry = jnp.zeros(jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1]), dtype=_U32)
+    for l in range(out_limbs):
+        al = a[..., l] if l < a.shape[-1] else jnp.zeros_like(carry)
+        bl = b[..., l] if l < b.shape[-1] else jnp.zeros_like(carry)
+        t = al + bl
+        c1 = (t < al).astype(_U32)
+        s = t + carry
+        c2 = (s < t).astype(_U32)
+        parts.append(s)
+        carry = c1 | c2
+    return jnp.stack(parts, axis=-1)
+
+
+def _mask_low_bits(a: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Keeps the low `bits` bits of a limb array (static)."""
+    n = a.shape[-1]
+    parts = []
+    for l in range(n):
+        lo, hi = 32 * l, 32 * (l + 1)
+        if hi <= bits:
+            parts.append(a[..., l])
+        elif lo >= bits:
+            parts.append(jnp.zeros_like(a[..., l]))
+        else:
+            parts.append(a[..., l] & _U32((1 << (bits - lo)) - 1))
+    return jnp.stack(parts, axis=-1)
+
+
+def _clear_low_bits(a: jnp.ndarray, bits: int) -> jnp.ndarray:
+    n = a.shape[-1]
+    parts = []
+    for l in range(n):
+        lo, hi = 32 * l, 32 * (l + 1)
+        if hi <= bits:
+            parts.append(jnp.zeros_like(a[..., l]))
+        elif lo >= bits:
+            parts.append(a[..., l])
+        else:
+            parts.append(a[..., l] & _U32(~((1 << (bits - lo)) - 1) & 0xFFFFFFFF))
+    return jnp.stack(parts, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Mod-N arithmetic (modulus is a static Python int)
+# ---------------------------------------------------------------------------
+
+
+def divmod_by_const(
+    block: jnp.ndarray, modulus: int, need_quotient: bool
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(block // modulus, block % modulus) for uint32[..., 4] 128-bit blocks.
+
+    Bit-serial restoring division via ``lax.fori_loop`` — 128 iterations of
+    shift/compare/conditional-subtract on u32 limbs; TPU has no 128-bit (or
+    even 64x64) integer divide. The quotient (needed only for the IntModN
+    refill chain, int_mod_n.h:165-170) is collected from the subtract
+    decisions of the same loop.
+
+    Returns (quotient uint32[..., 4], remainder uint32[..., rl]).
+    """
+    nbits = max(modulus.bit_length(), 1)
+    if modulus & (modulus - 1) == 0:
+        # Power of two: plain masking/shifting.
+        shift = nbits - 1  # modulus == 2^shift
+        rl = max((shift + 31) // 32, 1)
+        if shift == 0:
+            return block, jnp.zeros(block.shape[:-1] + (1,), _U32)
+        r = _mask_low_bits(block, shift)[..., :rl]
+        if shift >= 128:
+            q = jnp.zeros_like(block)
+        else:
+            qv = extract_bits(block, shift, 128 - shift)
+            pad = 4 - qv.shape[-1]
+            q = jnp.concatenate(
+                [qv, jnp.zeros(block.shape[:-1] + (pad,), _U32)], axis=-1
+            )
+        return q, r
+    rl = (nbits + 1 + 31) // 32  # remainder register holds values < 2N
+    n_limbs = _int_to_limbs(modulus, rl)
+
+    def body(i, carry):
+        q, r = carry
+        bit_index = _U32(127) - jnp.asarray(i, _U32)
+        limb = jnp.take(block, bit_index // _U32(32), axis=-1)
+        bit = (limb >> (bit_index % _U32(32))) & _U32(1)
+        r = _shl1(r)
+        r = r.at[..., 0].set(r[..., 0] | bit)
+        ge = _ge_const(r, n_limbs)
+        r = jnp.where(ge[..., None], _sub_const(r, n_limbs), r)
+        if need_quotient:
+            q = _shl1(q)
+            q = q.at[..., 0].set(q[..., 0] | ge.astype(_U32))
+        return q, r
+
+    q0 = jnp.zeros(block.shape[:-1] + (4,), _U32)
+    r0 = jnp.zeros(block.shape[:-1] + (rl,), _U32)
+    q, r = jax.lax.fori_loop(0, 128, body, (q0, r0))
+    lpe = max(((modulus - 1).bit_length() + 31) // 32, 1)
+    return q, r[..., :lpe]
+
+
+def modn_add(a: jnp.ndarray, b: jnp.ndarray, modulus: int) -> jnp.ndarray:
+    """(a + b) mod modulus for limb values a, b < modulus."""
+    lpe = a.shape[-1]
+    wide = lpe + 1
+    s = _add_wide(a, b, wide)
+    n_wide = _int_to_limbs(modulus, wide)
+    ge = _ge_const(s, n_wide)
+    s = jnp.where(ge[..., None], _sub_const(s, n_wide), s)
+    return s[..., :lpe]
+
+
+def modn_neg(a: jnp.ndarray, modulus: int) -> jnp.ndarray:
+    """(-a) mod modulus for limb values a < modulus."""
+    n_limbs = _int_to_limbs(modulus, a.shape[-1])
+    nz = jnp.zeros(a.shape[:-1], dtype=bool)
+    for l in range(a.shape[-1]):
+        nz = nz | (a[..., l] != 0)
+    return jnp.where(nz[..., None], _rsub_const(n_limbs, a), jnp.zeros_like(a))
+
+
+# ---------------------------------------------------------------------------
+# Power-of-two group ops (shared with the scalar fast path)
+# ---------------------------------------------------------------------------
+
+
+def limb_add_pow2(a: jnp.ndarray, b: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Elementwise addition mod 2^bits on uint32[..., lpe] limb arrays."""
+    if bits <= 32:
+        mask = _U32((1 << bits) - 1) if bits < 32 else _U32(0xFFFFFFFF)
+        return (a + b) & mask
+    return _add_wide(a, b, bits // 32)
+
+
+def limb_neg_pow2(a: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Two's-complement negation mod 2^bits on uint32[..., lpe] limbs."""
+    if bits <= 32:
+        mask = _U32((1 << bits) - 1) if bits < 32 else _U32(0xFFFFFFFF)
+        return (_U32(0) - a) & mask
+    out = []
+    carry = _U32(1)  # ~a + 1
+    for l in range(bits // 32):
+        s = (~a[..., l]) + carry
+        carry = jnp.where((s == 0) & (carry == 1), _U32(1), _U32(0))
+        out.append(s)
+    return jnp.stack(out, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Sampling (device replay of FromBytes / SampleAndUpdateBytes)
+# ---------------------------------------------------------------------------
+
+
+def _sample_chain(stream: jnp.ndarray, spec: ValueSpec) -> Tuple[jnp.ndarray, ...]:
+    """Non-direct sampling: running 128-bit block + static-offset refills.
+
+    stream: uint32[..., 4*blocks_needed]. Returns per-component limb arrays
+    uint32[..., lpe_c] (one element per block: epb == 1).
+    """
+    block = stream[..., :4]
+    cursor = 16  # bytes; refills start after the first block
+    results = []
+    n = len(spec.components)
+    for i, comp in enumerate(spec.components):
+        update = i + 1 < n  # eval-side FromBytes: update all but the last
+        if comp.kind in ("int", "xor"):
+            lpe = comp.lpe
+            results.append(_mask_low_bits(block[..., :lpe], comp.bits)[..., :lpe])
+            if update:
+                size = comp.bits // 8
+                fresh = extract_bits(stream, 8 * cursor, comp.bits)
+                kept = _clear_low_bits(block, comp.bits)
+                padded = jnp.concatenate(
+                    [fresh, jnp.zeros(block.shape[:-1] + (4 - fresh.shape[-1],), _U32)],
+                    axis=-1,
+                )
+                block = kept | padded
+                cursor += size
+        else:  # modn
+            q, r = divmod_by_const(block, comp.modulus, need_quotient=update)
+            results.append(r)
+            if update:
+                size = comp.bits // 8
+                shifted = (
+                    jnp.zeros_like(block)
+                    if comp.bits >= 128
+                    else _shl_const(q, comp.bits, 4)
+                )
+                fresh = extract_bits(stream, 8 * cursor, comp.bits)
+                padded = jnp.concatenate(
+                    [fresh, jnp.zeros(block.shape[:-1] + (4 - fresh.shape[-1],), _U32)],
+                    axis=-1,
+                )
+                block = shifted | padded
+                cursor += size
+    return tuple(results)
+
+
+# ---------------------------------------------------------------------------
+# Correction (device)
+# ---------------------------------------------------------------------------
+
+
+def correct_values(
+    stream: jnp.ndarray,  # uint32[..., 4*blocks_needed] hashed byte stream
+    control: jnp.ndarray,  # bool/uint32[...] control bits (1 = corrected)
+    corrections: Tuple[jnp.ndarray, ...],  # per component uint32[epb, lpe_c]
+    spec: ValueSpec,
+    party: int,
+) -> Tuple[jnp.ndarray, ...]:
+    """hash -> elements -> += correction if control -> negate if party 1.
+
+    Returns per-component uint32[..., epb, lpe_c] limb arrays (struct of
+    arrays). Mirrors the per-element correction loop in EvaluateUntil
+    (/root/reference/dpf/distributed_point_function.h:776-808).
+    """
+    ctrl = control.astype(_U32)[..., None, None]  # [..., 1, 1]
+    if spec.direct:
+        sampled = []
+        for comp in spec.components:
+            elems = [
+                extract_bits(stream, j * spec.stride_bits + comp.offset_bits, comp.bits)
+                for j in range(spec.epb)
+            ]
+            sampled.append(jnp.stack(elems, axis=-2))  # [..., epb, lpe]
+    else:
+        sampled = [v[..., None, :] for v in _sample_chain(stream, spec)]
+
+    out = []
+    for comp, elems, corr in zip(spec.components, sampled, corrections):
+        c = corr * ctrl  # zero where control unset (corr < group order)
+        if comp.kind == "xor":
+            out.append(elems ^ c)
+        elif comp.kind == "int":
+            v = limb_add_pow2(elems, c, comp.bits)
+            if party == 1:
+                v = limb_neg_pow2(v, comp.bits)
+            out.append(v)
+        else:  # modn
+            v = modn_add(elems, c, comp.modulus)
+            if party == 1:
+                v = modn_neg(v, comp.modulus)
+            out.append(v)
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# Host-side views
+# ---------------------------------------------------------------------------
+
+
+def component_to_numpy(values: np.ndarray, comp: ComponentSpec) -> np.ndarray:
+    """uint32[..., lpe] limb values of one component -> numpy integers
+    (object dtype above 64 bits)."""
+    values = np.asarray(values)
+    lpe = values.shape[-1]
+    if lpe == 1:
+        bits = comp.bits if comp.kind != "modn" else 32
+        if comp.kind != "modn" and bits < 32:
+            return values[..., 0].astype(f"uint{max(bits, 8)}")
+        return values[..., 0]
+    if lpe == 2:
+        return values[..., 0].astype(np.uint64) | (
+            values[..., 1].astype(np.uint64) << np.uint64(32)
+        )
+    out = np.zeros(values.shape[:-1], dtype=object)
+    for l in range(lpe):
+        out |= values[..., l].astype(object) << (32 * l)
+    return out
+
+
+def values_to_host(arrays: Tuple[np.ndarray, ...], spec: ValueSpec) -> list:
+    """Per-component limb arrays [N, lpe_c] -> flat list of host values
+    (ints, or tuples of ints for tuple types) comparable with the host path."""
+    comps = [
+        component_to_numpy(a, c).reshape(-1) for a, c in zip(arrays, spec.components)
+    ]
+    n = comps[0].shape[0]
+    if not spec.is_tuple:
+        return [int(v) for v in comps[0]]
+    return [tuple(int(comps[c][i]) for c in range(len(comps))) for i in range(n)]

@@ -1,0 +1,188 @@
+"""Device value-codec paths (IntModN / Tuple) vs the host path.
+
+Covers BASELINE config 3's value regime (IntModN hierarchies on the device
+evaluators) and the reference's typed-evaluation matrix
+(/root/reference/dpf/distributed_point_function_test.cc:899-1030): mod-N
+reduction, direct tuples (struct of arrays), multi-block value hashes, and
+the sequential sampling chain for tuples of IntModN.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import (
+    Int,
+    IntModN,
+    TupleType,
+    XorWrapper,
+)
+from distributed_point_functions_tpu.ops import evaluator, value_codec
+
+RNG = np.random.default_rng(0xC0DEC)
+import random as _random
+_random.seed(0xC0DEC)
+
+
+def randmod(m):
+    return _random.randrange(m)
+
+MOD64 = (1 << 64) - 59
+MOD32 = (1 << 32) - 5
+MOD80 = (1 << 80) - 65
+
+
+def make_keys(dpf, alphas, betas):
+    keys_a, keys_b = [], []
+    for alpha, beta in zip(alphas, betas):
+        ka, kb = dpf.generate_keys(alpha, beta)
+        keys_a.append(ka)
+        keys_b.append(kb)
+    return keys_a, keys_b
+
+
+def full_domain_host_values(out, spec, num_keys):
+    """Device output -> per-key list of host values."""
+    arrays = out if isinstance(out, tuple) else (out,)
+    per_key = []
+    for i in range(num_keys):
+        per_key.append(
+            value_codec.values_to_host(tuple(a[i] for a in arrays), spec)
+        )
+    return per_key
+
+
+VALUE_CASES = [
+    (IntModN(64, MOD64), lambda: randmod(MOD64)),
+    (IntModN(32, MOD32), lambda: randmod(MOD32)),
+    (
+        TupleType(Int(32), Int(32)),
+        lambda: (randmod(1 << 32), randmod(1 << 32)),
+    ),
+    (
+        TupleType(Int(8), Int(64), XorWrapper(16)),
+        lambda: (
+            int(RNG.integers(0, 1 << 8)),
+            randmod(1 << 64),
+            int(RNG.integers(0, 1 << 16)),
+        ),
+    ),
+    (  # 160-bit tuple: blocks_needed = 2 (the ISRG example shape,
+        # distributed_point_function_benchmark.cc:182-222)
+        TupleType(Int(32), Int(32), Int(32), Int(32), Int(32)),
+        lambda: tuple(int(x) for x in RNG.integers(0, 1 << 32, size=5)),
+    ),
+    (
+        TupleType(IntModN(64, MOD64), IntModN(64, MOD64)),
+        lambda: (randmod(MOD64), randmod(MOD64)),
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "value_type,sample", VALUE_CASES, ids=[str(v) for v, _ in VALUE_CASES]
+)
+def test_full_domain_matches_host(value_type, sample):
+    log_domain = 5
+    dpf = DistributedPointFunction.create(DpfParameters(log_domain, value_type))
+    spec = value_codec.build_spec(
+        value_type, dpf.validator.blocks_needed[0]
+    )
+    k = 3
+    alphas = [int(a) for a in RNG.integers(0, 1 << log_domain, size=k)]
+    betas = [sample() for _ in range(k)]
+    keys_a, keys_b = make_keys(dpf, alphas, betas)
+
+    out_a = evaluator.full_domain_evaluate(dpf, keys_a, key_chunk=2)
+    out_b = evaluator.full_domain_evaluate(dpf, keys_b, key_chunk=2)
+    vals_a = full_domain_host_values(out_a, spec, k)
+    vals_b = full_domain_host_values(out_b, spec, k)
+
+    for i in range(k):
+        # Differential vs host path.
+        ctx = dpf.create_evaluation_context(keys_a[i])
+        host = dpf.evaluate_next([], ctx)
+        assert vals_a[i] == host, f"key {i} device != host"
+        # Share-sum property.
+        for x in range(1 << log_domain):
+            total = value_type.add(vals_a[i][x], vals_b[i][x])
+            expected = betas[i] if x == alphas[i] else value_type.zero()
+            assert total == expected, (i, x)
+
+
+@pytest.mark.parametrize(
+    "value_type,sample",
+    [VALUE_CASES[0], VALUE_CASES[2], VALUE_CASES[5]],
+    ids=[str(VALUE_CASES[i][0]) for i in (0, 2, 5)],
+)
+def test_evaluate_at_batch_matches_host(value_type, sample):
+    log_domain = 16
+    dpf = DistributedPointFunction.create(DpfParameters(log_domain, value_type))
+    spec = value_codec.build_spec(value_type, dpf.validator.blocks_needed[0])
+    k = 2
+    alphas = [int(a) for a in RNG.integers(0, 1 << log_domain, size=k)]
+    betas = [sample() for _ in range(k)]
+    keys_a, keys_b = make_keys(dpf, alphas, betas)
+    points = [int(p) for p in RNG.integers(0, 1 << log_domain, size=37)]
+    points[0] = alphas[0]  # make sure at least one point hits alpha
+
+    out_a = evaluator.evaluate_at_batch(dpf, keys_a, points)
+    out_b = evaluator.evaluate_at_batch(dpf, keys_b, points)
+    vals_a = full_domain_host_values(out_a, spec, k)
+    vals_b = full_domain_host_values(out_b, spec, k)
+
+    for i in range(k):
+        host = dpf.evaluate_at(keys_a[i], 0, points)
+        assert vals_a[i] == host
+        for j, x in enumerate(points):
+            total = value_type.add(vals_a[i][j], vals_b[i][j])
+            expected = betas[i] if x == alphas[i] else value_type.zero()
+            assert total == expected
+
+
+def test_intmodn_hierarchy_config3_shape():
+    """BASELINE config 3 in miniature: multi-level IntModN<u64> hierarchy
+    evaluated on the device path at every hierarchy level."""
+    mod = MOD64
+    vt = IntModN(64, mod)
+    params = [DpfParameters(2 + 2 * i, vt) for i in range(4)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    alpha = 37
+    betas = [randmod(mod) for _ in range(4)]
+    ka, kb = dpf.generate_keys_incremental(alpha, betas)
+
+    for level in range(4):
+        spec = value_codec.build_spec(vt, dpf.validator.blocks_needed[level])
+        out_a = evaluator.full_domain_evaluate(dpf, [ka], hierarchy_level=level)
+        out_b = evaluator.full_domain_evaluate(dpf, [kb], hierarchy_level=level)
+        vals_a = full_domain_host_values(out_a, spec, 1)[0]
+        vals_b = full_domain_host_values(out_b, spec, 1)[0]
+        ctx = dpf.create_evaluation_context(ka)
+        host = dpf.evaluate_until(level, [], ctx)
+        assert vals_a == host, f"hierarchy level {level}"
+        lds = params[level].log_domain_size
+        prefix = alpha >> (params[-1].log_domain_size - lds)
+        for x in range(1 << lds):
+            total = (vals_a[x] + vals_b[x]) % mod
+            assert total == (betas[level] if x == prefix else 0), (level, x)
+
+
+def test_modn_point_eval_large_base():
+    """IntModN over a 128-bit base integer (modulus 2^80-65), point eval."""
+    vt = IntModN(128, MOD80)
+    dpf = DistributedPointFunction.create(DpfParameters(10, vt))
+    spec = value_codec.build_spec(vt, dpf.validator.blocks_needed[0])
+    alpha, beta = 517, randmod(MOD80)
+    ka, kb = dpf.generate_keys(alpha, beta)
+    points = [alpha, 0, 1023, 517, 42]
+    va = full_domain_host_values(
+        evaluator.evaluate_at_batch(dpf, [ka], points), spec, 1
+    )[0]
+    vb = full_domain_host_values(
+        evaluator.evaluate_at_batch(dpf, [kb], points), spec, 1
+    )[0]
+    host = dpf.evaluate_at(ka, 0, points)
+    assert va == host
+    for j, x in enumerate(points):
+        assert (va[j] + vb[j]) % MOD80 == (beta if x == alpha else 0)
