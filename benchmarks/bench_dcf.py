@@ -1,0 +1,67 @@
+"""BASELINE config 4: DistributedComparisonFunction batch evaluation —
+log-domain 24, 512 keys.
+
+The reference evaluates one x per call in O(n^2) AES
+(/root/reference/dcf/distributed_comparison_function_benchmark.cc:24-54 and
+.h:83-107); this framework's fused walk does all levels in one O(n) scan,
+vmapped over keys x points (dcf/batch.py).
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def bench(jax, smoke):
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.dcf.dcf import (
+        DistributedComparisonFunction,
+    )
+    from distributed_point_functions_tpu.dcf import batch as dcf_batch
+
+    log_domain = int(os.environ.get("BENCH_LOG_DOMAIN", 10 if smoke else 24))
+    num_keys = int(os.environ.get("BENCH_KEYS", 8 if smoke else 512))
+    num_points = int(os.environ.get("BENCH_POINTS", 32 if smoke else 512))
+    reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
+
+    dcf = DistributedComparisonFunction.create(log_domain, Int(64))
+    rng = np.random.default_rng(11)
+    with Timer() as tk:
+        keys = [
+            dcf.generate_keys(
+                int(rng.integers(0, 1 << log_domain)),
+                int(rng.integers(1, 1 << 62)),
+            )[0]
+            for _ in range(num_keys)
+        ]
+    log(f"keygen: {tk.elapsed:.2f}s for {num_keys} DCF keys")
+    xs = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_points)]
+
+    with Timer() as warm:
+        out = dcf_batch.batch_evaluate(dcf, keys, xs)
+    assert out.shape[:2] == (num_keys, num_points)
+    log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    with Timer() as t:
+        for _ in range(reps):
+            dcf_batch.batch_evaluate(dcf, keys, xs)
+    evals = num_keys * num_points * reps
+    return {
+        "bench": "dcf_batch",
+        "metric": (
+            f"DCF BatchEvaluate, {num_keys} keys x {num_points} points, "
+            f"log_domain={log_domain}, uint64"
+        ),
+        "value": round(evals / t.elapsed),
+        "unit": "comparisons/s",
+        "config": {
+            "log_domain": log_domain,
+            "num_keys": num_keys,
+            "num_points": num_points,
+        },
+    }
+
+
+if __name__ == "__main__":
+    run_bench("dcf_batch", bench)

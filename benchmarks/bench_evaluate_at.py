@@ -1,0 +1,62 @@
+"""BASELINE config 2: batched EvaluateAt — 1024 keys x 4096 points each,
+log-domain 32, uint64 output.
+
+Methodology of BM_BatchEvaluation
+(/root/reference/dpf/distributed_point_function_benchmark.cc:345-402), which
+loops EvaluateAt over keys one at a time on CPU; here all keys x points run
+as one vmapped device program.
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def bench(jax, smoke):
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import evaluator
+
+    log_domain = int(os.environ.get("BENCH_LOG_DOMAIN", 16 if smoke else 32))
+    num_keys = int(os.environ.get("BENCH_KEYS", 16 if smoke else 1024))
+    num_points = int(os.environ.get("BENCH_POINTS", 256 if smoke else 4096))
+    reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
+
+    dpf = DistributedPointFunction.create(DpfParameters(log_domain, Int(64)))
+    rng = np.random.default_rng(5)
+    alphas = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_keys)]
+    betas = [int(x) for x in rng.integers(1, 1 << 62, size=num_keys)]
+    with Timer() as tk:
+        keys, _ = dpf.generate_keys_batch(alphas, [betas])
+    log(f"keygen: {tk.elapsed:.2f}s for {num_keys} keys")
+    points = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_points)]
+
+    with Timer() as warm:
+        out = evaluator.evaluate_at_batch(dpf, keys, points)
+    assert out.shape[:2] == (num_keys, num_points)
+    log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    with Timer() as t:
+        for _ in range(reps):
+            out = evaluator.evaluate_at_batch(dpf, keys, points)
+    evals = num_keys * num_points * reps
+    return {
+        "bench": "evaluate_at",
+        "metric": (
+            f"batched EvaluateAt, {num_keys} keys x {num_points} points, "
+            f"log_domain={log_domain}, uint64"
+        ),
+        "value": round(evals / t.elapsed),
+        "unit": "point-evals/s",
+        "config": {
+            "log_domain": log_domain,
+            "num_keys": num_keys,
+            "num_points": num_points,
+        },
+    }
+
+
+if __name__ == "__main__":
+    run_bench("evaluate_at", bench)

@@ -1,0 +1,54 @@
+"""BASELINE config 1: single-hierarchy DPF full-domain evaluation.
+
+log-domain 20, XorWrapper<uint128>, 1 key — the shape of
+BM_EvaluateRegularDpf (/root/reference/dpf/distributed_point_function_benchmark.cc:29-82)
+at its largest type. Values are materialized device-resident and XOR-folded
+(see PERF.md for why host transfer is not part of the metric).
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def bench(jax, smoke):
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import XorWrapper
+    from distributed_point_functions_tpu.ops import evaluator
+
+    log_domain = int(os.environ.get("BENCH_LOG_DOMAIN", 12 if smoke else 20))
+    reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
+    dpf = DistributedPointFunction.create(
+        DpfParameters(log_domain, XorWrapper(128))
+    )
+    key, _ = dpf.generate_keys(123, 1 << 100)
+
+    def run():
+        for _, out in evaluator.full_domain_evaluate_chunks(dpf, [key]):
+            fold = jnp.bitwise_xor.reduce(out, axis=1)
+        jax.block_until_ready(fold)
+
+    with Timer() as warm:
+        run()
+    log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    with Timer() as t:
+        for _ in range(reps):
+            run()
+    evals = (1 << log_domain) * reps
+    return {
+        "bench": "full_domain",
+        "metric": f"full-domain eval, log_domain={log_domain}, XorWrapper<u128>, 1 key",
+        "value": round(evals / t.elapsed),
+        "unit": "evals/s",
+        "config": {"log_domain": log_domain, "value_type": "XorWrapper<u128>"},
+        "seconds_per_expansion": t.elapsed / reps,
+    }
+
+
+if __name__ == "__main__":
+    run_bench("full_domain", bench)

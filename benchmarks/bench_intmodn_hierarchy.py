@@ -1,0 +1,79 @@
+"""BASELINE config 3: incremental 8-level hierarchy (heavy-hitters prefix
+tree), IntModN<uint64> output, 256 keys.
+
+Times the device-path expansion at every hierarchy level (the heavy-hitters
+access pattern evaluates each level once, pruning between levels — the
+per-level full expansions measured here are its compute kernel; cf.
+BM_HeavyHitters, /root/reference/dpf/distributed_point_function_benchmark.cc:308-340).
+The deepest level (log-domain 24) dominates; outputs stay device-resident
+(IntModN mod-N reduction runs on device via the value codec).
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+MOD64 = (1 << 64) - 59
+
+
+def bench(jax, smoke):
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import IntModN
+    from distributed_point_functions_tpu.ops import evaluator
+
+    num_keys = int(os.environ.get("BENCH_KEYS", 8 if smoke else 256))
+    max_lds = int(os.environ.get("BENCH_MAX_LOG_DOMAIN", 10 if smoke else 24))
+    key_chunk = int(os.environ.get("BENCH_KEY_CHUNK", 8 if smoke else 16))
+    num_levels = 8
+    step = max(max_lds // num_levels, 1)
+    domains = [step * (i + 1) for i in range(num_levels)]
+
+    vt = IntModN(64, MOD64)
+    params = [DpfParameters(d, vt) for d in domains]
+    dpf = DistributedPointFunction.create_incremental(params)
+    rng = np.random.default_rng(3)
+    alphas = [int(x) for x in rng.integers(0, 1 << domains[-1], size=num_keys)]
+    betas = [
+        [int(x) % MOD64 for x in rng.integers(1, 1 << 63, size=num_keys)]
+        for _ in range(num_levels)
+    ]
+    with Timer() as tk:
+        keys, _ = dpf.generate_keys_batch(alphas, betas)
+    log(f"keygen: {tk.elapsed:.2f}s for {num_keys} keys x {num_levels} levels")
+
+    def run_level(level):
+        for _, out in evaluator.full_domain_evaluate_chunks(
+            dpf, keys, hierarchy_level=level, key_chunk=key_chunk
+        ):
+            fold = jnp.bitwise_xor.reduce(out, axis=1)
+        jax.block_until_ready(fold)
+
+    with Timer() as warm:
+        for level in range(num_levels):
+            run_level(level)
+    log(f"warmup all {num_levels} levels (compile + run): {warm.elapsed:.1f}s")
+
+    with Timer() as t:
+        for level in range(num_levels):
+            run_level(level)
+    evals = num_keys * sum(1 << d for d in domains)
+    return {
+        "bench": "intmodn_hierarchy",
+        "metric": (
+            f"{num_levels}-level IntModN<u64> hierarchy, {num_keys} keys, "
+            f"domains {domains}"
+        ),
+        "value": round(evals / t.elapsed),
+        "unit": "evals/s",
+        "config": {"domains": domains, "num_keys": num_keys, "modulus": MOD64},
+        "seconds_all_levels": t.elapsed,
+    }
+
+
+if __name__ == "__main__":
+    run_bench("intmodn_hierarchy", bench)

@@ -1,0 +1,46 @@
+"""Key-generation throughput (host, batched level-major numpy AES).
+
+Methodology of BM_KeyGeneration
+(/root/reference/dpf/distributed_point_function_benchmark.cc:228-260):
+single-level DPFs across tree depths. Keygen stays on CPU by design
+(SURVEY.md north star) — sequential in depth, vectorized across the batch.
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def bench(jax, smoke):
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+
+    num_keys = int(os.environ.get("BENCH_KEYS", 64 if smoke else 1024))
+    depths = [20, 64, 128]
+    rng = np.random.default_rng(23)
+    per_depth = {}
+    for depth in depths:
+        dpf = DistributedPointFunction.create(DpfParameters(depth, Int(64)))
+        alphas = [
+            int.from_bytes(rng.bytes(16), "little") % (1 << depth)
+            for _ in range(num_keys)
+        ]
+        betas = [int(x) for x in rng.integers(1, 1 << 62, size=num_keys)]
+        with Timer() as t:
+            dpf.generate_keys_batch(alphas, [betas])
+        per_depth[depth] = round(num_keys / t.elapsed)
+        log(f"depth {depth}: {per_depth[depth]} keys/s")
+    return {
+        "bench": "keygen",
+        "metric": f"batched key generation, {num_keys} keys, depth 20",
+        "value": per_depth[20],
+        "unit": "keys/s",
+        "config": {"num_keys": num_keys, "keys_per_s_by_depth": per_depth},
+    }
+
+
+if __name__ == "__main__":
+    run_bench("keygen", bench)

@@ -1,0 +1,81 @@
+"""BASELINE config 5: two-server PIR — full-domain eval + XOR inner-product
+reduction, 2^24-entry database x 64 concurrent queries.
+
+On multi-device platforms the database and evaluation tree shard over the
+'domain' mesh axis and queries over 'keys' (parallel/sharded.py, XLA
+collectives over ICI); on one chip the same program runs on a 1x1 mesh.
+Queries run in chunks sized to HBM.
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def bench(jax, smoke):
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import XorWrapper
+    from distributed_point_functions_tpu.parallel import sharded
+
+    log_domain = int(os.environ.get("BENCH_LOG_DOMAIN", 14 if smoke else 24))
+    num_queries = int(os.environ.get("BENCH_QUERIES", 8 if smoke else 64))
+    key_chunk = int(os.environ.get("BENCH_KEY_CHUNK", 8))
+    n_dev = len(jax.devices())
+    if smoke and n_dev >= 8:
+        mesh = sharded.make_mesh(2, 4)
+    else:
+        mesh = sharded.make_mesh(1, n_dev)
+    log(f"mesh: keys={mesh.shape['keys']} x domain={mesh.shape['domain']}")
+
+    dpf = DistributedPointFunction.create(
+        DpfParameters(log_domain, XorWrapper(128))
+    )
+    rng = np.random.default_rng(17)
+    targets = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_queries)]
+    with Timer() as tk:
+        keys, _ = dpf.generate_keys_batch(targets, [[1] * num_queries])
+    log(f"keygen: {tk.elapsed:.2f}s for {num_queries} queries")
+    db = rng.integers(0, 2**32, size=(1 << log_domain, 4), dtype=np.uint32)
+
+    def run():
+        outs = []
+        for start in range(0, num_queries, key_chunk):
+            outs.append(
+                sharded.pir_query_batch(
+                    dpf, keys[start : start + key_chunk], db, mesh
+                )
+            )
+        return np.concatenate(outs, axis=0)
+
+    with Timer() as warm:
+        out = run()
+    assert out.shape == (num_queries, 4)
+    log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    reps = int(os.environ.get("BENCH_REPS", 2))
+    with Timer() as t:
+        for _ in range(reps):
+            run()
+    queries = num_queries * reps
+    scanned = queries * (1 << log_domain)
+    return {
+        "bench": "pir",
+        "metric": (
+            f"two-server PIR, 2^{log_domain} x 128-bit DB, "
+            f"{num_queries} concurrent queries"
+        ),
+        "value": round(queries / t.elapsed, 2),
+        "unit": "queries/s",
+        "config": {
+            "log_domain": log_domain,
+            "num_queries": num_queries,
+            "mesh": dict(mesh.shape),
+        },
+        "db_bytes_scanned_per_s": round(scanned * 16 / t.elapsed),
+    }
+
+
+if __name__ == "__main__":
+    run_bench("pir", bench)

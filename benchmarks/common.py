@@ -1,0 +1,122 @@
+"""Shared harness for the benchmark suite.
+
+Every bench script prints exactly ONE JSON line to stdout:
+  {"bench": ..., "metric": ..., "value": N, "unit": ..., "platform": ...,
+   "config": {...}, "error": ...?}
+
+Mirrors the robustness contract of the headline bench.py: the default
+backend is probed in a subprocess (killable on hang); on failure the bench
+runs on CPU with a reduced config. Platform forcing happens in-process via
+jax.config (env-var forcing deadlocks under this image's sitecustomize).
+
+Methodology matches the reference's google-benchmark suites
+(/root/reference/dpf/distributed_point_function_benchmark.cc:29-402,
+/root/reference/dcf/distributed_comparison_function_benchmark.cc:24-54):
+time the evaluation loop only; key generation and (for the TPU) program
+compilation are set-up, reported to stderr.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+
+
+def log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
+
+
+def probe_default_backend(timeout: float = PROBE_TIMEOUT):
+    code = "import jax; print(jax.default_backend())"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code], timeout=timeout, capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        log(f"backend probe timed out after {timeout:.0f}s")
+        return None
+    if r.returncode != 0:
+        log(f"backend probe failed rc={r.returncode}: {r.stderr.strip()[-300:]}")
+        return None
+    return r.stdout.strip().splitlines()[-1] if r.stdout.strip() else None
+
+
+def init_jax(platform=None):
+    """Platform selection + persistent compilation cache. Returns jax."""
+    if platform is None:
+        platform = os.environ.get("BENCH_PLATFORM") or probe_default_backend()
+        if platform is None:
+            log("default backend unreachable; using CPU")
+            platform = "cpu"
+    if platform == "cpu":
+        # Virtual 8-device mesh for sharded smoke runs; XLA_FLAGS is read at
+        # first backend init, which hasn't happened yet in this process.
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    if platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        log(f"compilation cache unavailable: {e!r}")
+    return jax
+
+
+def emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def run_bench(name: str, fn) -> None:
+    """Runs fn() -> result dict, emitting exactly one JSON line, always.
+
+    fn receives the initialized jax module and a bool `smoke` (True when on
+    CPU — scripts should shrink their configs).
+    """
+    result = {"bench": name, "value": 0}
+    try:
+        jax = init_jax()
+        platform = jax.default_backend()
+        log(f"platform: {platform}, devices: {jax.devices()}")
+        try:
+            result = fn(jax, platform == "cpu")
+        except Exception:
+            log("bench failed:\n" + traceback.format_exc())
+            if platform != "cpu":
+                log("retrying on CPU smoke config")
+                os.environ["JAX_PLATFORMS"] = "cpu"
+                jax.config.update("jax_platforms", "cpu")
+                result = fn(jax, True)
+            else:
+                raise
+        result.setdefault("bench", name)
+        result["platform"] = jax.default_backend()
+    except Exception as e:
+        result["error"] = f"{type(e).__name__}: {e}"
+    emit(result)
+
+
+class Timer:
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self.t0

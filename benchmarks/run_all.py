@@ -1,0 +1,47 @@
+"""Runs the whole benchmark suite, one subprocess per bench (each owns the
+TPU claim in turn), collecting JSON lines into benchmarks/results.json."""
+
+import json
+import os
+import subprocess
+import sys
+
+BENCHES = [
+    "bench_keygen.py",
+    "bench_full_domain.py",
+    "bench_evaluate_at.py",
+    "bench_intmodn_hierarchy.py",
+    "bench_dcf.py",
+    "bench_pir.py",
+]
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    results = []
+    for script in BENCHES:
+        if os.environ.get("BENCH_ONLY") and script != os.environ["BENCH_ONLY"]:
+            continue
+        print(f"# running {script}", file=sys.stderr, flush=True)
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, script)],
+            cwd=here,
+            capture_output=True,
+            text=True,
+            timeout=float(os.environ.get("BENCH_TIMEOUT", 3600)),
+        )
+        sys.stderr.write(r.stderr)
+        line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+        try:
+            results.append(json.loads(line))
+        except json.JSONDecodeError:
+            results.append({"bench": script, "error": f"bad output: {line[:200]}"})
+        print(line, flush=True)
+    out = os.path.join(here, "results.json")
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(f"# wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

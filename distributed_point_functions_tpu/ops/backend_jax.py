@@ -191,9 +191,9 @@ def hash_value_stream(planes, blocks_needed: int):
     if blocks_needed == 1:
         return aes_jax.unpack_from_planes(hash_value_planes(planes))
     seeds = aes_jax.unpack_from_planes(planes)
-    parts = []
-    for j in range(blocks_needed):
-        s = seeds if j == 0 else _add_small_constant(seeds, np.uint32(j))
+    parts = [aes_jax.unpack_from_planes(hash_value_planes(planes))]
+    for j in range(1, blocks_needed):
+        s = _add_small_constant(seeds, np.uint32(j))
         h = hash_value_planes(aes_jax.pack_to_planes(s))
         parts.append(aes_jax.unpack_from_planes(h))
     return jnp.concatenate(parts, axis=-1)

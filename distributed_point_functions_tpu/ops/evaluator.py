@@ -304,10 +304,12 @@ def _expand_level_batch_jit(planes, control, cw_plane, ccl, ccr):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("bits", "party", "xor_group", "keep_per_block")
+    jax.jit,
+    static_argnames=("bits", "party", "xor_group", "keep_per_block", "reorder"),
 )
 def _finalize_batch_jit(
-    planes, control, corrections, order, bits, party, xor_group, keep_per_block
+    planes, control, corrections, order, bits, party, xor_group, keep_per_block,
+    reorder=True,
 ):
     """Value hash + unpack + correction + leaf-order restore for a key batch.
 
@@ -316,6 +318,11 @@ def _finalize_batch_jit(
     /root/reference/dpf/distributed_point_function.h:786-808 — blocks carry
     elements_per_block values but only the first 2^(lds - level) are
     addressable when an earlier hierarchy level forces the tree deeper.
+
+    `reorder=False` skips the leaf-order gather and returns values in lane
+    (expansion) order — for consumers that pre-permute their data into lane
+    order once (e.g. a PIR database) instead of paying a full-size gather
+    per evaluation.
     """
     hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
     blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
@@ -324,17 +331,24 @@ def _finalize_batch_jit(
         _correct_values, bits=bits, party=party, xor_group=xor_group
     )
     values = jax.vmap(fn)(blocks, ctrl, corrections)  # [K, lanes, epb, lpe]
-    values = values[:, order]  # leaf order
+    if reorder:
+        values = values[:, order]  # leaf order
     values = values[:, :, :keep_per_block]
     k, n_blocks, kept, lpe = values.shape
     return values.reshape(k, n_blocks * kept, lpe)
 
 
-@functools.partial(jax.jit, static_argnames=("spec", "party", "keep_per_block"))
-def _finalize_batch_codec_jit(planes, control, corrections, order, spec, party, keep_per_block):
+@functools.partial(
+    jax.jit, static_argnames=("spec", "party", "keep_per_block", "reorder")
+)
+def _finalize_batch_codec_jit(
+    planes, control, corrections, order, spec, party, keep_per_block,
+    reorder=True,
+):
     """Spec-driven finalize for IntModN / Tuple outputs (see _finalize_batch_jit
     for the scalar fast path). Returns a tuple of per-component limb arrays
-    uint32[K, n_blocks * keep_per_block, lpe_c]."""
+    uint32[K, n_blocks * keep_per_block, lpe_c]. reorder=False keeps lane
+    (expansion) order, as in _finalize_batch_jit."""
 
     def one(p, c, corrs):
         stream = backend_jax.hash_value_stream(p, spec.blocks_needed)
@@ -344,7 +358,9 @@ def _finalize_batch_codec_jit(planes, control, corrections, order, spec, party, 
     vals = jax.vmap(one)(planes, control, corrections)
     outs = []
     for v in vals:  # [K, lanes, epb, lpe_c]
-        v = v[:, order][:, :, :keep_per_block]
+        if reorder:
+            v = v[:, order]
+        v = v[:, :, :keep_per_block]
         k, n_blocks, kept, lpe = v.shape
         outs.append(v.reshape(k, n_blocks * kept, lpe))
     return tuple(outs)
@@ -380,21 +396,27 @@ def _expand_batch_jit(
     return out.reshape(k, n_blocks * epb, lpe)
 
 
-def full_domain_evaluate(
+def full_domain_evaluate_chunks(
     dpf: DistributedPointFunction,
     keys: Sequence[DpfKey],
     hierarchy_level: int = -1,
     key_chunk: int = 32,
     host_levels: Optional[int] = None,
-) -> np.ndarray:
-    """Full-domain evaluation of a key batch on device.
+    leaf_order: bool = True,
+):
+    """Full-domain evaluation, yielding *device-resident* results per chunk.
 
-    For Int/XorWrapper outputs returns uint32[K, domain_size, lpe] limb
-    values (lpe = max(bits//32, 1)); use `values_to_numpy` for a numpy
-    integer view. For IntModN returns uint32[K, domain_size, lpe] mod-N limb
-    values; for Tuple outputs returns a tuple of such per-component arrays
-    (struct of arrays) — `value_codec.values_to_host` converts either back to
-    host values. Keys are processed in chunks of `key_chunk` to bound HBM use.
+    Yields (num_valid_keys, values) where values is a jax uint32 array
+    [key_chunk, domain_size, lpe] (or a tuple of per-component arrays for
+    Tuple outputs); only the first num_valid_keys rows are real keys. Nothing
+    is transferred to the host — on a TPU behind a slow host link, pulling
+    full-domain outputs costs orders of magnitude more than computing them,
+    so on-device consumers (PIR reductions, histogram aggregation) should
+    use this generator and reduce on device.
+
+    leaf_order=False skips the per-evaluation leaf-order gather and yields
+    values in expansion (lane) order: consumers can instead permute their
+    static data once with `lane_order_map` at setup time.
     """
     v = dpf.validator
     if hierarchy_level < 0:
@@ -421,9 +443,7 @@ def full_domain_evaluate(
     device_levels = stop_level - host_levels
 
     num_keys = len(keys)
-    outs = []
     for start in range(0, num_keys, key_chunk):
-        sl = slice(start, start + key_chunk)
         # Pad the last chunk with key 0 so every chunk compiles to the same
         # shape; padded rows are trimmed after concatenation.
         idx = np.arange(start, min(start + key_chunk, num_keys))
@@ -469,6 +489,7 @@ def full_domain_evaluate(
             planes, control = _expand_level_batch_jit(
                 planes, control, cw_dev[:, level], ccl[:, level], ccr[:, level]
             )
+        domain = 1 << v.parameters[hierarchy_level].log_domain_size
         if scalar_fast:
             out = _finalize_batch_jit(
                 planes,
@@ -479,11 +500,12 @@ def full_domain_evaluate(
                 party=batch.party,
                 xor_group=xor_group,
                 keep_per_block=keep_per_block,
+                reorder=leaf_order,
             )
-            out = np.asarray(out)
-            if pad:
-                out = out[: key_chunk - pad]
-            outs.append(out)
+            # Trim to the actual domain size (block packing may overshoot);
+            # only valid in leaf order — lane order keeps padded lanes.
+            if leaf_order:
+                out = out[:, :domain]
         else:
             out = _finalize_batch_codec_jit(
                 planes,
@@ -493,20 +515,86 @@ def full_domain_evaluate(
                 spec=spec,
                 party=batch.party,
                 keep_per_block=keep_per_block,
+                reorder=leaf_order,
             )
-            out = tuple(np.asarray(o) for o in out)
-            if pad:
-                out = tuple(o[: key_chunk - pad] for o in out)
-            outs.append(out)
-    domain = 1 << v.parameters[hierarchy_level].log_domain_size
-    if scalar_fast:
-        # Trim to the actual domain size (block packing may overshoot).
-        return np.concatenate(outs, axis=0)[:, :domain]
-    merged = tuple(
-        np.concatenate([o[c] for o in outs], axis=0)[:, :domain]
-        for c in range(len(spec.components))
-    )
-    return merged if spec.is_tuple else merged[0]
+            if leaf_order:
+                out = tuple(o[:, :domain] for o in out)
+            if not spec.is_tuple:
+                out = out[0]
+        yield key_chunk - pad if pad else min(key_chunk, num_keys - start), out
+
+
+def full_domain_evaluate(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    hierarchy_level: int = -1,
+    key_chunk: int = 32,
+    host_levels: Optional[int] = None,
+) -> np.ndarray:
+    """Full-domain evaluation of a key batch, results on the host.
+
+    For Int/XorWrapper outputs returns uint32[K, domain_size, lpe] limb
+    values (lpe = max(bits//32, 1)); use `values_to_numpy` for a numpy
+    integer view. For IntModN returns uint32[K, domain_size, lpe] mod-N limb
+    values; for Tuple outputs returns a tuple of such per-component arrays
+    (struct of arrays) — `value_codec.values_to_host` converts either back to
+    host values. Keys are processed in chunks of `key_chunk` to bound HBM
+    use. For on-device consumption use `full_domain_evaluate_chunks`.
+    """
+    outs = []
+    is_tuple = None
+    for valid, out in full_domain_evaluate_chunks(
+        dpf, keys, hierarchy_level, key_chunk, host_levels
+    ):
+        if is_tuple is None:
+            is_tuple = isinstance(out, tuple)
+        if is_tuple:
+            outs.append(tuple(np.asarray(o)[:valid] for o in out))
+        else:
+            outs.append(np.asarray(out)[:valid])
+    if is_tuple:
+        return tuple(
+            np.concatenate([o[c] for o in outs], axis=0)
+            for c in range(len(outs[0]))
+        )
+    return np.concatenate(outs, axis=0)
+
+
+def lane_order_map(
+    dpf: DistributedPointFunction,
+    hierarchy_level: int = -1,
+    host_levels: Optional[int] = None,
+) -> np.ndarray:
+    """Maps lane-order output positions to domain indices (-1 = padding).
+
+    For `full_domain_evaluate_chunks(..., leaf_order=False)`: output element
+    at position p is the DPF value at domain index `lane_order_map(...)[p]`.
+    Static data (e.g. a PIR database) can be permuted once with this map at
+    setup time, after which every evaluation skips its full-size leaf-order
+    gather.
+    """
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    stop_level = v.hierarchy_to_tree[hierarchy_level]
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep = 1 << (lds - stop_level)
+    host_levels = min(5 if host_levels is None else host_levels, stop_level)
+    device_levels = stop_level - host_levels
+    m = 1 << host_levels
+    padded = max(m, 32)
+    order = backend_jax.expansion_output_order(m, padded, device_levels)
+    n_lanes = padded << device_levels
+    inv = np.full(n_lanes, -1, dtype=np.int64)
+    inv[order] = np.arange(order.shape[0], dtype=np.int64)
+    out = np.full(n_lanes * keep, -1, dtype=np.int64)
+    for i in range(keep):
+        valid = inv >= 0
+        leaf_elem = inv * keep + i
+        pos = np.arange(n_lanes, dtype=np.int64) * keep + i
+        out[pos[valid]] = leaf_elem[valid]
+    out[out >= (1 << lds)] = -1  # block packing overshoot
+    return out
 
 
 def _value_kind(value_type) -> Tuple[int, bool]:

@@ -1,0 +1,330 @@
+"""Batched hierarchical evaluation with prefix sets — the device analog of
+EvaluateUntil/EvaluateNext over an EvaluationContext.
+
+The host path (core/dpf.py:evaluate_until) replicates the reference's control
+flow one value at a time — fine for small expansions, far too slow for the
+experiments workload (2^20 nonzero prefixes, millions of outputs per level).
+This module is the bulk path: a `BatchedContext` holds, per key batch, the
+previous level's expansion (sorted prefix array + device seeds/control
+bits), and `evaluate_until_batch` advances it:
+
+  1. unique sorted prefixes -> positions into the stored prefix array
+     (vectorized np.searchsorted — replaces the btree walk in
+     ComputePartialEvaluations, /root/reference/dpf/distributed_point_function.cc:351-453),
+  2. doubling expansion of the selected seeds on device
+     (ExpandSeeds, .cc:271-349) across all keys at once,
+  3. value hash + correction through the value codec (HashExpandedSeeds,
+     .cc:500-524 + the correction loop in .h:776-836).
+
+Outputs are leaf-ordered per prefix — for unique sorted `prefixes` this
+equals the reference's output order. The context round-trips to/from the
+wire-format EvaluationContext via to_evaluation_contexts / from the key list
+(checkpoint/resume, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import uint128
+from ..core.dpf import DistributedPointFunction
+from ..core.keys import DpfKey, EvaluationContext, PartialEvaluation
+from ..utils.errors import InvalidArgumentError
+from . import aes_jax, backend_jax, evaluator, value_codec
+
+
+@dataclasses.dataclass
+class BatchedContext:
+    """Evaluation state of K same-parameter keys of one party."""
+
+    dpf: DistributedPointFunction
+    keys: List[DpfKey]
+    previous_hierarchy_level: int = -1
+    # Expansion state at previous_hierarchy_level (None before first call):
+    prefixes: Optional[np.ndarray] = None  # object/uint64[Np] sorted unique
+    seeds: Optional[jnp.ndarray] = None  # uint32[K, Np, 4] leaf-ordered
+    control: Optional[jnp.ndarray] = None  # uint32[K, Np] 0/1
+
+    @classmethod
+    def create(
+        cls, dpf: DistributedPointFunction, keys: Sequence[DpfKey]
+    ) -> "BatchedContext":
+        party = keys[0].party
+        for key in keys:
+            dpf.validator.validate_key(key)
+            if key.party != party:
+                raise InvalidArgumentError(
+                    "all keys in a batch must belong to one party"
+                )
+        return cls(dpf=dpf, keys=list(keys))
+
+    def to_evaluation_contexts(self) -> List[EvaluationContext]:
+        """Serializable per-key EvaluationContexts (checkpoint/resume)."""
+        v = self.dpf.validator
+        out = []
+        seeds_np = None if self.seeds is None else np.asarray(self.seeds)
+        for i, key in enumerate(self.keys):
+            partials = []
+            if self.prefixes is not None:
+                control_bits = np.asarray(self.control[i]).astype(bool)
+                seed_ints = uint128.limbs_to_array(
+                    seeds_np[i][: len(self.prefixes)]
+                )
+                for j, prefix in enumerate(self.prefixes):
+                    partials.append(
+                        PartialEvaluation(
+                            prefix=int(prefix),
+                            seed=int(seed_ints[j]),
+                            control_bit=bool(control_bits[j]),
+                        )
+                    )
+            out.append(
+                EvaluationContext(
+                    parameters=list(v.parameters),
+                    key=key,
+                    previous_hierarchy_level=self.previous_hierarchy_level,
+                    partial_evaluations=partials,
+                    partial_evaluations_level=self.previous_hierarchy_level,
+                )
+            )
+        return out
+
+
+@jax.jit
+def _pack_mask_device(bits: jnp.ndarray) -> jnp.ndarray:
+    """uint32 0/1 [..., n] (n % 32 == 0) -> packed lane masks [..., n // 32]."""
+    b = bits.reshape(bits.shape[:-1] + (-1, 32))
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return jnp.sum(b << shifts, axis=-1, dtype=jnp.uint32)
+
+
+def _as_prefix_array(prefixes: Sequence[int], log_domain: int) -> np.ndarray:
+    """Unique sorted prefix array; uint64 fast path below 64-bit domains."""
+    if log_domain < 64:
+        arr = np.asarray(prefixes, dtype=np.uint64)
+    else:
+        arr = np.array([int(p) for p in prefixes], dtype=object)
+    uniq = np.unique(arr)
+    if uniq.shape[0] != arr.shape[0]:
+        raise InvalidArgumentError(
+            "`prefixes` must be unique for the batched hierarchical path"
+        )
+    return uniq
+
+
+@jax.jit
+def _gather_seeds_jit(seeds, control_unpacked, positions):
+    sel = seeds[:, positions]  # [K, Np_pad, 4]
+    ctrl = control_unpacked[:, positions]
+    return sel, ctrl
+
+
+def evaluate_until_batch(
+    ctx: BatchedContext,
+    hierarchy_level: int,
+    prefixes: Sequence[int] = (),
+    device_output: bool = False,
+) -> Union[np.ndarray, Tuple[np.ndarray, ...], tuple]:
+    """Advances all keys to `hierarchy_level`, expanding under `prefixes`.
+
+    prefixes are domain indices at ctx.previous_hierarchy_level (empty iff
+    first call), unique and treated as sorted. Returns values for the full
+    expansion of every prefix, ordered by sorted prefix then leaf:
+    uint32[K, num_outputs, lpe] limb values (tuple of per-component arrays
+    for Tuple types). device_output=True returns jax arrays without host
+    transfer.
+    """
+    dpf, v = ctx.dpf, ctx.dpf.validator
+    if hierarchy_level <= ctx.previous_hierarchy_level:
+        raise InvalidArgumentError(
+            "`hierarchy_level` must be greater than `ctx.previous_hierarchy_level`"
+        )
+    if hierarchy_level >= v.num_hierarchy_levels:
+        raise InvalidArgumentError(
+            "`hierarchy_level` must be less than the number of hierarchy levels"
+        )
+    if (ctx.previous_hierarchy_level < 0) != (len(prefixes) == 0):
+        raise InvalidArgumentError(
+            "`prefixes` must be empty if and only if this is the first call"
+        )
+    k = len(ctx.keys)
+    value_type = v.parameters[hierarchy_level].value_type
+    spec = value_codec.build_spec(value_type, v.blocks_needed[hierarchy_level])
+    stop_level = v.hierarchy_to_tree[hierarchy_level]
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep_per_block = 1 << (lds - stop_level)
+
+    batch = evaluator.KeyBatch.from_keys(dpf, ctx.keys, hierarchy_level)
+
+    if ctx.previous_hierarchy_level < 0:
+        start_level = 0
+        prev_lds = 0
+        tree_prefixes = None
+        seeds0 = np.broadcast_to(batch.seeds[:, None, :], (k, 1, 4))
+        control0 = np.full((k, 1), bool(batch.party))
+        num_parents = 1
+    else:
+        start_level = v.hierarchy_to_tree[ctx.previous_hierarchy_level]
+        prev_lds = v.parameters[ctx.previous_hierarchy_level].log_domain_size
+        prefix_arr = _as_prefix_array(prefixes, prev_lds)
+        # Domain prefixes -> tree indices at the previous level's tree depth.
+        shift = prev_lds - start_level
+        if shift:
+            tree = np.unique(
+                prefix_arr >> (np.uint64(shift) if prefix_arr.dtype != object else shift)
+            )
+        else:
+            tree = prefix_arr
+        tree_prefixes = tree
+        positions = np.searchsorted(ctx.prefixes, tree)
+        if (positions >= len(ctx.prefixes)) .any() or not (
+            np.asarray(ctx.prefixes)[positions] == tree
+        ).all():
+            raise InvalidArgumentError(
+                "Prefix not present in ctx.partial_evaluations at hierarchy "
+                f"level {hierarchy_level}"
+            )
+        num_parents = len(tree)
+        seeds0, control0 = _gather_seeds_jit(
+            ctx.seeds, ctx.control, jnp.asarray(positions.astype(np.int64))
+        )
+
+    levels = stop_level - start_level
+    # Pad parents to whole packed words (32 lanes each).
+    pad_to = max(32, -(-num_parents // 32) * 32)
+    outs, new_seeds, new_control = _expand_batch(
+        batch, seeds0, control0, start_level, levels, pad_to, spec,
+        keep_per_block,
+    )
+
+    # When the previous level's domain index carries block bits (epb > 1),
+    # distinct prefixes can share one tree index; each selects the slice
+    # [block_index * outputs_per_prefix, ...) of its tree expansion —
+    # mirroring the prefix_map reassembly in EvaluateUntil
+    # (/root/reference/dpf/distributed_point_function.h:822-835).
+    if ctx.previous_hierarchy_level >= 0:
+        shift = prev_lds - start_level
+        if shift:
+            opp = 1 << (lds - prev_lds)  # outputs per prefix
+            etp = 1 << (lds - start_level)  # elements per tree prefix
+            tree_pos = np.searchsorted(tree_prefixes, prefix_arr >> (
+                np.uint64(shift) if prefix_arr.dtype != object else shift
+            ))
+            block_index = (
+                prefix_arr & ((1 << shift) - 1)
+                if prefix_arr.dtype == object
+                else prefix_arr & np.uint64((1 << shift) - 1)
+            )
+            starts = tree_pos.astype(np.int64) * etp + block_index.astype(
+                np.int64
+            ) * opp
+            sel = (
+                starts[:, None] + np.arange(opp, dtype=np.int64)
+            ).reshape(-1)
+            sel_d = jnp.asarray(sel)
+            if isinstance(outs, tuple):
+                outs = tuple(o[:, sel_d] for o in outs)
+            else:
+                outs = outs[:, sel_d]
+
+    # Update context state: new prefixes are (tree_prefix << levels) + leaf,
+    # only when a further hierarchy level exists.
+    if hierarchy_level < v.num_hierarchy_levels - 1:
+        n_new = num_parents << levels
+        if tree_prefixes is None:
+            base = np.zeros(1, dtype=np.uint64 if stop_level < 64 else object)
+            tree_prefixes = base
+        if tree_prefixes.dtype == object or stop_level >= 64:
+            parents = np.array([int(p) for p in tree_prefixes], dtype=object)
+            new_prefixes = np.repeat(parents << levels, 1 << levels) + np.tile(
+                np.arange(1 << levels, dtype=object), num_parents
+            )
+        else:
+            new_prefixes = np.repeat(
+                tree_prefixes.astype(np.uint64) << np.uint64(levels), 1 << levels
+            ) + np.tile(
+                np.arange(1 << levels, dtype=np.uint64), num_parents
+            )
+        ctx.prefixes = new_prefixes
+        ctx.seeds = new_seeds
+        ctx.control = new_control
+    else:
+        ctx.prefixes = None
+        ctx.seeds = None
+        ctx.control = None
+    ctx.previous_hierarchy_level = hierarchy_level
+
+    if device_output:
+        return outs
+    if isinstance(outs, tuple):
+        return tuple(np.asarray(o) for o in outs)
+    return np.asarray(outs)
+
+
+def _expand_batch(
+    batch: evaluator.KeyBatch,
+    seeds0,  # [K, Np, 4] (numpy or jax)
+    control0,  # [K, Np] bools or uint32 0/1
+    start_level: int,
+    levels: int,
+    pad_to: int,
+    spec,
+    keep_per_block: int,
+):
+    """Doubling expansion + finalize; returns (values, seeds, control_mask).
+
+    values: leaf-ordered [K, Np * 2^levels * keep, lpe] (or tuple);
+    seeds/control are the *leaf-ordered* expansion state for context updates.
+    """
+    k = seeds0.shape[0]
+    num_parents = seeds0.shape[1]
+    pad = pad_to - num_parents
+    seeds0 = jnp.asarray(seeds0, dtype=jnp.uint32)
+    control0 = jnp.asarray(control0)
+    if pad:
+        seeds0 = jnp.concatenate(
+            [seeds0, jnp.zeros((k, pad, 4), jnp.uint32)], axis=1
+        )
+        control0 = jnp.concatenate(
+            [control0, jnp.zeros((k, pad), control0.dtype)], axis=1
+        )
+    control_mask = _pack_mask_device(control0.astype(jnp.uint32))
+    planes = jax.vmap(aes_jax.pack_to_planes)(seeds0)
+
+    cw_dev, ccl, ccr = batch.device_cw_arrays(start_level)
+    cw_dev = jnp.asarray(cw_dev[:, :levels])
+    ccl = jnp.asarray(ccl[:, :levels])
+    ccr = jnp.asarray(ccr[:, :levels])
+    for level in range(levels):
+        planes, control_mask = evaluator._expand_level_batch_jit(
+            planes, control_mask, cw_dev[:, level], ccl[:, level], ccr[:, level]
+        )
+    order = backend_jax.expansion_output_order(num_parents, pad_to, levels)
+    outs = evaluator._finalize_batch_codec_jit(
+        planes,
+        control_mask,
+        tuple(jnp.asarray(a) for a in batch.codec_corrections),
+        jnp.asarray(order),
+        spec=spec,
+        party=batch.party,
+        keep_per_block=keep_per_block,
+    )
+    if not spec.is_tuple:
+        outs = outs[0]
+    # Leaf-ordered seeds/control for the context update.
+    new_seeds, new_control = _reorder_state_jit(
+        planes, control_mask, jnp.asarray(order)
+    )
+    return outs, new_seeds, new_control
+
+
+@jax.jit
+def _reorder_state_jit(planes, control_mask, order):
+    seeds = jax.vmap(aes_jax.unpack_from_planes)(planes)[:, order]
+    ctrl = jax.vmap(backend_jax.unpack_mask_device)(control_mask)[:, order]
+    return seeds, ctrl

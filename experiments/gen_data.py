@@ -1,0 +1,64 @@
+"""Generates synthetic sparse-histogram inputs (the reference's CSV fixtures
+at /root/reference/experiments/data/ are git-LFS pointers with no content, so
+the fixtures are regenerated from their documented distributions,
+/root/reference/experiments/README.md:9-14):
+
+1. power law: 90% of nonzeros uniform in the first 10% of the domain
+2. power law: 90% of nonzeros uniform in the first 50% of the domain
+3. uniform
+
+Usage: python gen_data.py [--log_domain_size 32] [--num_nonzeros 1048576]
+       [--out_dir data]
+Writes <bits>_<nonzeros>_<count>_<skew>.csv with one bucket id per line
+(first column), matching the reference's file naming and format
+(synthetic_data_benchmarks.cc:107-133 reads column 0 of each line).
+"""
+
+import argparse
+import os
+import random
+
+
+def sample_unique(num: int, log_domain: int, skew) -> list:
+    """`num` unique bucket ids; skew in {0.1, 0.5, 'uniform'}."""
+    rng = random.Random(f"{log_domain}-{num}-{skew}")
+    domain = 1 << log_domain
+    seen = set()
+    if skew == "uniform":
+        while len(seen) < num:
+            seen.add(rng.randrange(domain))
+    else:
+        hot = max(int(domain * float(skew)), 1)
+        while len(seen) < num:
+            if rng.random() < 0.9:
+                seen.add(rng.randrange(hot))
+            else:
+                seen.add(rng.randrange(domain))
+    return sorted(seen)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log_domain_size", type=int, default=32)
+    ap.add_argument("--num_nonzeros", type=int, default=1 << 20)
+    ap.add_argument("--out_dir", default=os.path.join(os.path.dirname(os.path.abspath(__file__)), "data"))
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+    for skew in ("0.1", "0.5", "uniform"):
+        name = (
+            f"{args.log_domain_size}_{args.num_nonzeros}_{args.num_nonzeros}_"
+            f"{skew}.csv"
+        )
+        path = os.path.join(args.out_dir, name)
+        values = sample_unique(
+            args.num_nonzeros, args.log_domain_size,
+            skew if skew == "uniform" else float(skew),
+        )
+        with open(path, "w") as f:
+            for v in values:
+                f.write(f"{v}\n")
+        print(f"wrote {path} ({len(values)} nonzeros)")
+
+
+if __name__ == "__main__":
+    main()

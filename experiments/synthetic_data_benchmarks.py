@@ -1,0 +1,216 @@
+"""Sparse-histogram DPF workload: hierarchical vs direct evaluation.
+
+Re-implements the reference experiments binary
+(/root/reference/experiments/synthetic_data_benchmarks.cc) against the TPU
+framework:
+
+* reads non-zero bucket ids from a CSV (first column),
+* hierarchical mode: picks prefix bit lengths so no level's full expansion
+  exceeds --max_expansion_factor x nonzeros (ComputeLevelsToEvaluate,
+  synthetic_data_benchmarks.cc:139-165), then runs a hierarchical
+  evaluation through the batched device path (ops/hierarchical.py),
+* direct mode (--only_nonzeros): single-level DPF evaluated at exactly the
+  nonzero indices (RunBatchedSinglePointEvaluation, .cc:196-208) through
+  the batched device point evaluator.
+
+Reports seconds per key per iteration — comparable to the reference's
+tables (experiments/README.md:39-108, the BASELINE.md numbers).
+
+Usage:
+  python gen_data.py                       # once, writes data/*.csv
+  python synthetic_data_benchmarks.py --input data/32_1048576_1048576_0.1.csv
+  python synthetic_data_benchmarks.py --input ... --only_nonzeros
+"""
+
+import argparse
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--input", default="", help="CSV of nonzero bucket ids")
+    ap.add_argument("--log_domain_size", type=int, default=20)
+    ap.add_argument(
+        "--levels_to_evaluate", default="",
+        help="comma-separated log domain sizes for hierarchy levels",
+    )
+    ap.add_argument("--max_expansion_factor", type=int, default=2)
+    ap.add_argument("--num_iterations", type=int, default=20)
+    ap.add_argument("--only_nonzeros", action="store_true")
+    ap.add_argument(
+        "--platform", default=None, help="jax platform override (cpu/tpu)"
+    )
+    return ap.parse_args()
+
+
+def read_nonzeros(path: str, log_domain_size: int) -> np.ndarray:
+    dtype = np.uint64 if log_domain_size < 64 else object
+    values = []
+    with open(path) as f:
+        for line_number, line in enumerate(f):
+            field = line.split(",")[0].strip()
+            if not field:
+                raise ValueError(f"Line {line_number} is empty")
+            values.append(int(field))
+    arr = np.unique(np.array(values, dtype=dtype))
+    print(f"# read {arr.shape[0]} nonzeros from {len(values)} lines", file=sys.stderr)
+    return arr
+
+
+def compute_prefixes(nonzeros: np.ndarray, log_domain_size: int):
+    """prefixes[bits] = unique bit-prefixes of the nonzeros, bits=0..lds.
+
+    Mirrors ComputePrefixes (synthetic_data_benchmarks.cc:84-105).
+    """
+    prefixes = [np.array([], dtype=nonzeros.dtype)]
+    for bits in range(1, log_domain_size + 1):
+        shift = log_domain_size - bits
+        if nonzeros.dtype == object:
+            p = np.unique(np.array([int(x) >> shift for x in nonzeros], dtype=object))
+        else:
+            p = np.unique(nonzeros >> np.uint64(shift))
+        prefixes.append(p)
+    return prefixes
+
+
+def compute_levels_to_evaluate(
+    prefixes, log_domain_size: int, max_expansion_factor: int
+):
+    """Mirrors ComputeLevelsToEvaluate (synthetic_data_benchmarks.cc:139-165)."""
+    num_nonzeros = len(prefixes[-1])
+    assert num_nonzeros > 0
+    levels = [
+        min(
+            log_domain_size,
+            int(math.log2(num_nonzeros) + math.log2(max_expansion_factor)),
+        )
+        - 1
+    ]
+    while levels[-1] < log_domain_size:
+        nonzeros_at_last = len(prefixes[levels[-1] + 1])
+        levels.append(
+            min(
+                log_domain_size,
+                int(
+                    levels[-1]
+                    + math.log2(num_nonzeros)
+                    + math.log2(max_expansion_factor)
+                    - math.log2(nonzeros_at_last)
+                ),
+            )
+        )
+    return levels
+
+
+def main():
+    args = parse_args()
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    try:
+        cache = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import evaluator, hierarchical
+
+    lds = args.log_domain_size
+    if args.input:
+        nonzeros = read_nonzeros(args.input, lds)
+        prefixes = compute_prefixes(nonzeros, lds)
+    else:
+        nonzeros = np.arange(4, dtype=np.uint64)
+        prefixes = compute_prefixes(nonzeros, lds)
+    num_nonzeros = len(prefixes[-1])
+    print(f"# nonzeros: {num_nonzeros}", file=sys.stderr)
+
+    if args.levels_to_evaluate:
+        levels = [int(x) for x in args.levels_to_evaluate.split(",")]
+    elif not args.only_nonzeros and num_nonzeros:
+        levels = compute_levels_to_evaluate(
+            prefixes, lds, args.max_expansion_factor
+        )
+    else:
+        levels = [lds]
+    print(f"# levels to evaluate: {levels}", file=sys.stderr)
+
+    value_bits = 32  # fixed like the reference (element_bitsize = 32)
+    rng = np.random.default_rng(0)
+    alpha = int(rng.integers(0, 1 << min(lds, 63)))
+    if args.only_nonzeros:
+        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(value_bits)))
+        key, _ = dpf.generate_keys(alpha, 1)
+        points = [int(x) for x in nonzeros]
+        t_start = time.perf_counter()
+        for i in range(args.num_iterations):
+            out = evaluator.evaluate_at_batch(dpf, [key], points)
+            if i == 0:
+                print(f"# outputs: {out.shape}", file=sys.stderr)
+        wall = time.perf_counter() - t_start
+    else:
+        params = [DpfParameters(l, Int(value_bits)) for l in levels]
+        dpf = DistributedPointFunction.create_incremental(params)
+        key, _ = dpf.generate_keys_incremental(alpha, [1] * len(levels))
+        prefixes_to_evaluate = [np.array([], dtype=np.uint64)] + [
+            prefixes[levels[i - 1]] for i in range(1, len(levels))
+        ]
+        t_start = time.perf_counter()
+        for i in range(args.num_iterations):
+            ctx = hierarchical.BatchedContext.create(dpf, [key])
+            for level in range(len(levels)):
+                out = hierarchical.evaluate_until_batch(
+                    ctx,
+                    level,
+                    [int(x) for x in prefixes_to_evaluate[level]],
+                    device_output=True,
+                )
+                if i == 0:
+                    n = out[0].shape[1] if isinstance(out, tuple) else out.shape[1]
+                    print(
+                        f"# outputs at level {level} (log_domain {levels[level]}): {n}",
+                        file=sys.stderr,
+                    )
+            import jax as _jax
+
+            _jax.block_until_ready(out)
+        wall = time.perf_counter() - t_start
+    per_iter = wall / args.num_iterations
+    mode = "direct" if args.only_nonzeros else "hierarchical"
+    import json
+
+    print(
+        json.dumps(
+            {
+                "bench": "experiments",
+                "mode": mode,
+                "input": os.path.basename(args.input) if args.input else "none",
+                "log_domain_size": lds,
+                "num_nonzeros": num_nonzeros,
+                "levels": levels,
+                "value": round(per_iter, 4),
+                "unit": "s/key/iteration",
+                "platform": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

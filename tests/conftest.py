@@ -21,8 +21,48 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+# Suppress XLA:CPU AOT-cache feature-set messages: they are emitted at
+# ERROR level (cpu_aot_loader.cc) on EVERY persistent-cache load, so level 2
+# would not silence them — the cost is that other XLA ERROR logs are hidden
+# too. Export TF_CPP_MIN_LOG_LEVEL=0 when diagnosing device-path failures.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the suite's wall time is dominated by XLA
+# compiles of the bitsliced AES programs; repeat runs hit the cache.
+_cache_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:
+    pass
+
+import pytest
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow",
+        action="store_true",
+        default=False,
+        help="also run tests marked slow (heavy parametrizations)",
+    )
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavy compile-bound test; excluded unless --runslow"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow; use --runslow")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -53,7 +53,9 @@ def test_dcf_intmodn():
         assert (a + b) % mod == expected, x
 
 
-@pytest.mark.parametrize("bits", [32, 64])
+@pytest.mark.parametrize(
+    "bits", [64, pytest.param(32, marks=pytest.mark.slow)]
+)
 def test_batch_evaluate_matches_host(bits):
     from distributed_point_functions_tpu.ops import evaluator
 

@@ -1,0 +1,90 @@
+"""Batched hierarchical evaluation (ops/hierarchical.py) vs the host path."""
+
+import random
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, IntModN
+from distributed_point_functions_tpu.ops import hierarchical, value_codec
+from distributed_point_functions_tpu.utils.errors import InvalidArgumentError
+
+random.seed(0x41E)
+
+
+def to_host(out, spec):
+    arrays = out if isinstance(out, tuple) else (out,)
+    return value_codec.values_to_host(tuple(a[0] for a in arrays), spec)
+
+
+def test_matches_host_at_every_level():
+    """Int32 3-level hierarchy incl. a sparse prefix set whose members share
+    tree indices (epb > 1 block selection)."""
+    params = [DpfParameters(d, Int(32)) for d in (3, 6, 10)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(777, [5, 6, 7])
+
+    ctx_h = dpf.create_evaluation_context(ka)
+    h0 = dpf.evaluate_next([], ctx_h)
+    p1 = list(range(8))
+    h1 = dpf.evaluate_next(p1, ctx_h)
+    p2 = sorted(int(x) for x in np.random.default_rng(1).choice(64, 10, replace=False))
+    h2 = dpf.evaluate_next(p2, ctx_h)
+
+    spec = value_codec.build_spec(Int(32), dpf.validator.blocks_needed[0])
+    bc = hierarchical.BatchedContext.create(dpf, [ka, ka])
+    assert to_host(hierarchical.evaluate_until_batch(bc, 0), spec) == h0
+    assert to_host(hierarchical.evaluate_until_batch(bc, 1, p1), spec) == h1
+    out2 = hierarchical.evaluate_until_batch(bc, 2, p2)
+    assert to_host(out2, spec) == h2
+    # second key in the batch got identical results
+    assert value_codec.values_to_host((out2[1],), spec) == h2
+
+
+def test_context_export_resumes_on_host_path():
+    params = [DpfParameters(d, Int(32)) for d in (3, 6)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(40, [1, 2])
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    hierarchical.evaluate_until_batch(bc, 0)
+    ectx = bc.to_evaluation_contexts()[0]
+    # the exported EvaluationContext continues on the host path
+    host = dpf.evaluate_until(1, list(range(8)), ectx)
+    ctx_h = dpf.create_evaluation_context(ka)
+    dpf.evaluate_next([], ctx_h)
+    assert host == dpf.evaluate_next(list(range(8)), ctx_h)
+
+
+def test_intmodn_share_sum():
+    n = (1 << 64) - 59
+    params = [DpfParameters(d, IntModN(64, n)) for d in (4, 9)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    betas = [random.randrange(n), random.randrange(n)]
+    alpha = 300
+    ka, kb = dpf.generate_keys_incremental(alpha, betas)
+    spec = value_codec.build_spec(IntModN(64, n), dpf.validator.blocks_needed[1])
+    ca = hierarchical.BatchedContext.create(dpf, [ka])
+    cb = hierarchical.BatchedContext.create(dpf, [kb])
+    hierarchical.evaluate_until_batch(ca, 0)
+    hierarchical.evaluate_until_batch(cb, 0)
+    pref = list(range(16))
+    va = to_host(hierarchical.evaluate_until_batch(ca, 1, pref), spec)
+    vb = to_host(hierarchical.evaluate_until_batch(cb, 1, pref), spec)
+    for x in range(512):
+        assert (va[x] + vb[x]) % n == (betas[1] if x == alpha else 0), x
+
+
+def test_rejects_bad_prefix_sets():
+    params = [DpfParameters(d, Int(32)) for d in (3, 6)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(0, [1, 2])
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    with pytest.raises(InvalidArgumentError, match="must be empty"):
+        hierarchical.evaluate_until_batch(bc, 0, [1, 2])
+    hierarchical.evaluate_until_batch(bc, 0)
+    with pytest.raises(InvalidArgumentError, match="unique"):
+        hierarchical.evaluate_until_batch(bc, 1, [1, 1, 2])
+    with pytest.raises(InvalidArgumentError, match="greater than"):
+        hierarchical.evaluate_until_batch(bc, 0, [1])

@@ -11,7 +11,14 @@ from distributed_point_functions_tpu.parallel import sharded
 RNG = np.random.default_rng(0x5AD)
 
 
-@pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4), (4, 2)])
+@pytest.mark.parametrize(
+    "mesh_shape",
+    [
+        (2, 4),
+        pytest.param((1, 8), marks=pytest.mark.slow),
+        pytest.param((4, 2), marks=pytest.mark.slow),
+    ],
+)
 def test_sharded_pir_reconstructs(mesh_shape):
     log_domain = 8
     domain = 1 << log_domain

@@ -117,14 +117,14 @@ def test_full_domain_matches_host(value_type, sample):
     ids=[str(VALUE_CASES[i][0]) for i in (0, 2, 5)],
 )
 def test_evaluate_at_batch_matches_host(value_type, sample):
-    log_domain = 16
+    log_domain = 10
     dpf = DistributedPointFunction.create(DpfParameters(log_domain, value_type))
     spec = value_codec.build_spec(value_type, dpf.validator.blocks_needed[0])
     k = 2
     alphas = [int(a) for a in RNG.integers(0, 1 << log_domain, size=k)]
     betas = [sample() for _ in range(k)]
     keys_a, keys_b = make_keys(dpf, alphas, betas)
-    points = [int(p) for p in RNG.integers(0, 1 << log_domain, size=37)]
+    points = [int(p) for p in RNG.integers(0, 1 << log_domain, size=33)]
     points[0] = alphas[0]  # make sure at least one point hits alpha
 
     out_a = evaluator.evaluate_at_batch(dpf, keys_a, points)
@@ -146,13 +146,13 @@ def test_intmodn_hierarchy_config3_shape():
     evaluated on the device path at every hierarchy level."""
     mod = MOD64
     vt = IntModN(64, mod)
-    params = [DpfParameters(2 + 2 * i, vt) for i in range(4)]
+    params = [DpfParameters(2 + 2 * i, vt) for i in range(3)]
     dpf = DistributedPointFunction.create_incremental(params)
     alpha = 37
-    betas = [randmod(mod) for _ in range(4)]
+    betas = [randmod(mod) for _ in range(3)]
     ka, kb = dpf.generate_keys_incremental(alpha, betas)
 
-    for level in range(4):
+    for level in range(3):
         spec = value_codec.build_spec(vt, dpf.validator.blocks_needed[level])
         out_a = evaluator.full_domain_evaluate(dpf, [ka], hierarchy_level=level)
         out_b = evaluator.full_domain_evaluate(dpf, [kb], hierarchy_level=level)
@@ -171,11 +171,11 @@ def test_intmodn_hierarchy_config3_shape():
 def test_modn_point_eval_large_base():
     """IntModN over a 128-bit base integer (modulus 2^80-65), point eval."""
     vt = IntModN(128, MOD80)
-    dpf = DistributedPointFunction.create(DpfParameters(10, vt))
+    dpf = DistributedPointFunction.create(DpfParameters(8, vt))
     spec = value_codec.build_spec(vt, dpf.validator.blocks_needed[0])
-    alpha, beta = 517, randmod(MOD80)
+    alpha, beta = 217, randmod(MOD80)
     ka, kb = dpf.generate_keys(alpha, beta)
-    points = [alpha, 0, 1023, 517, 42]
+    points = [alpha, 0, 255, 217, 42]
     va = full_domain_host_values(
         evaluator.evaluate_at_batch(dpf, [ka], points), spec, 1
     )[0]
