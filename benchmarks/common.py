@@ -99,13 +99,26 @@ def run_bench(name: str, fn) -> None:
             result = fn(jax, platform == "cpu")
         except Exception:
             log("bench failed:\n" + traceback.format_exc())
-            if platform != "cpu":
-                log("retrying on CPU smoke config")
-                os.environ["JAX_PLATFORMS"] = "cpu"
-                jax.config.update("jax_platforms", "cpu")
-                result = fn(jax, True)
-            else:
-                raise
+            if platform != "cpu" and os.environ.get("BENCH_PLATFORM") != "cpu":
+                # Backends cannot be re-selected after initialization in
+                # this process — retry the whole script in a fresh CPU-forced
+                # subprocess (with a timeout, in case the failure was a hang).
+                log("retrying in a CPU-forced subprocess")
+                env = dict(os.environ, BENCH_PLATFORM="cpu")
+                r = subprocess.run(
+                    [sys.executable, sys.argv[0]],
+                    env=env,
+                    capture_output=True,
+                    text=True,
+                    timeout=float(os.environ.get("BENCH_CPU_TIMEOUT", 1800)),
+                )
+                sys.stderr.write(r.stderr)
+                line = (
+                    r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
+                )
+                print(line, flush=True)
+                return
+            raise
         result.setdefault("bench", name)
         result["platform"] = jax.default_backend()
     except Exception as e:

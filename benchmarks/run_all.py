@@ -23,13 +23,19 @@ def main():
         if os.environ.get("BENCH_ONLY") and script != os.environ["BENCH_ONLY"]:
             continue
         print(f"# running {script}", file=sys.stderr, flush=True)
-        r = subprocess.run(
-            [sys.executable, os.path.join(here, script)],
-            cwd=here,
-            capture_output=True,
-            text=True,
-            timeout=float(os.environ.get("BENCH_TIMEOUT", 3600)),
-        )
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.join(here, script)],
+                cwd=here,
+                capture_output=True,
+                text=True,
+                timeout=float(os.environ.get("BENCH_TIMEOUT", 3600)),
+            )
+        except subprocess.TimeoutExpired as e:
+            sys.stderr.write((e.stderr or b"").decode("utf-8", "replace") if isinstance(e.stderr, bytes) else (e.stderr or ""))
+            results.append({"bench": script, "error": "timeout"})
+            print(json.dumps(results[-1]), flush=True)
+            continue
         sys.stderr.write(r.stderr)
         line = r.stdout.strip().splitlines()[-1] if r.stdout.strip() else "{}"
         try:

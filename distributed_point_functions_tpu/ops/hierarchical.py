@@ -175,11 +175,15 @@ def evaluate_until_batch(
         # Domain prefixes -> tree indices at the previous level's tree depth.
         shift = prev_lds - start_level
         if shift:
-            tree = np.unique(
-                prefix_arr >> (np.uint64(shift) if prefix_arr.dtype != object else shift)
+            shifted = prefix_arr >> (
+                np.uint64(shift) if prefix_arr.dtype != object else shift
             )
+            # inverse maps each prefix to its tree position — reused below
+            # for the per-prefix block selection.
+            tree, tree_pos_of_prefix = np.unique(shifted, return_inverse=True)
         else:
             tree = prefix_arr
+            tree_pos_of_prefix = None
         tree_prefixes = tree
         positions = np.searchsorted(ctx.prefixes, tree)
         if (positions >= len(ctx.prefixes)) .any() or not (
@@ -212,15 +216,12 @@ def evaluate_until_batch(
         if shift:
             opp = 1 << (lds - prev_lds)  # outputs per prefix
             etp = 1 << (lds - start_level)  # elements per tree prefix
-            tree_pos = np.searchsorted(tree_prefixes, prefix_arr >> (
-                np.uint64(shift) if prefix_arr.dtype != object else shift
-            ))
             block_index = (
                 prefix_arr & ((1 << shift) - 1)
                 if prefix_arr.dtype == object
                 else prefix_arr & np.uint64((1 << shift) - 1)
             )
-            starts = tree_pos.astype(np.int64) * etp + block_index.astype(
+            starts = tree_pos_of_prefix.astype(np.int64) * etp + block_index.astype(
                 np.int64
             ) * opp
             sel = (

@@ -293,3 +293,155 @@ def pir_query_batch(
         jnp.asarray(db_limbs),
     )
     return np.asarray(out)[:n_real]
+
+
+# ---------------------------------------------------------------------------
+# Sharded full-domain / hierarchical expansion (all value types)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def build_sharded_expand_step(
+    mesh: Mesh,
+    num_levels: int,
+    party: int,
+    spec,  # value_codec.ValueSpec (hashable)
+    keep_per_block: int,
+):
+    """Compiles a domain-sharded full-domain expansion for one key batch.
+
+    Device d walks log2(n_domain) levels to its subtree, expands the rest,
+    hashes and value-corrects through the codec. Returns jitted
+    fn(seeds [K,4], cw_planes [K,L,128], ccl, ccr, corrections pytree) ->
+    values [K, domain_elems, lpe] (tuple of arrays for Tuple specs), with K
+    sharded over 'keys' and the element axis over 'domain'. The analog of
+    sharding the long axis in sequence parallelism: the evaluation tree
+    splits at depth log2(n_domain) and no communication crosses shards at
+    all (outputs stay sharded for the consumer to reduce).
+    """
+    from ..ops import value_codec
+
+    n_domain = mesh.shape["domain"]
+    subtree_levels = int(np.log2(n_domain))
+    assert 1 << subtree_levels == n_domain, "domain shards must be a power of 2"
+    expand_levels = num_levels - subtree_levels
+    assert expand_levels >= 0, "domain smaller than the device mesh"
+
+    def one_key(seed, cw_planes, ccl, ccr, corrections, subtree_index):
+        lanes = jnp.zeros((32, 4), jnp.uint32).at[0].set(seed)
+        planes = aes_jax.pack_to_planes(lanes)
+        control = jnp.array([party], dtype=jnp.uint32)  # lane 0 only
+        if subtree_levels:
+            shifts = subtree_levels - 1 - jnp.arange(subtree_levels, dtype=jnp.int32)
+            bits_path = (subtree_index >> shifts) & 1
+            path_masks = (jnp.uint32(0) - bits_path.astype(jnp.uint32))[:, None]
+            planes, control = backend_jax.evaluate_seeds_planes(
+                planes,
+                control,
+                path_masks,
+                cw_planes[:subtree_levels],
+                ccl[:subtree_levels],
+                ccr[:subtree_levels],
+            )
+        for l in range(subtree_levels, num_levels):
+            planes, control = backend_jax.expand_one_level(
+                planes, control, cw_planes[l], ccl[l], ccr[l]
+            )
+        stream = backend_jax.hash_value_stream(planes, spec.blocks_needed)
+        ctrl = backend_jax.unpack_mask_device(control)
+        vals = value_codec.correct_values(stream, ctrl, corrections, spec, party)
+        order = jnp.asarray(
+            backend_jax.expansion_output_order(1, 32, expand_levels)
+        )
+        outs = []
+        for v in vals:  # [32 << expand_levels, epb, lpe]
+            v = v[order][:, :keep_per_block]  # leaf order, trimmed blocks
+            n_blocks, kept, lpe = v.shape
+            outs.append(v.reshape(n_blocks * kept, lpe))
+        return tuple(outs)
+
+    def device_fn(seeds, cw_planes, ccl, ccr, corrections):
+        di = jax.lax.axis_index("domain").astype(jnp.int32)
+        outs = jax.vmap(
+            lambda s, cw, l, r, c: one_key(s, cw, l, r, c, di),
+        )(seeds, cw_planes, ccl, ccr, corrections)
+        return outs if spec.is_tuple else outs[0]
+
+    out_spec = (
+        tuple(P("keys", "domain") for _ in spec.components)
+        if spec.is_tuple
+        else P("keys", "domain")
+    )
+    step = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(P("keys"), P("keys"), P("keys"), P("keys"),
+                  tuple(P("keys") for _ in spec.components)),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def sharded_full_domain_evaluate(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    mesh: Mesh,
+    hierarchy_level: int = -1,
+):
+    """Full-domain evaluation sharded over a (keys, domain) mesh.
+
+    Returns a *sharded device array* [K, domain, lpe] (tuple of arrays for
+    Tuple outputs) laid out P('keys', 'domain') — downstream on-device
+    consumers (PIR reductions, aggregation) keep it sharded; np.asarray
+    gathers to the host. Supports every value type via the codec, unlike
+    `pir_query_batch` which is specialized to the XOR inner product.
+    """
+    from ..ops import value_codec
+
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    spec = value_codec.build_spec(value_type, v.blocks_needed[hierarchy_level])
+    lds = v.parameters[hierarchy_level].log_domain_size
+    backend_jax.log_backend_once()
+    batch = evaluator.KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    stop_level = batch.num_levels
+    keep_per_block = 1 << (lds - stop_level)
+    n_domain = mesh.shape["domain"]
+    if (1 << stop_level) < n_domain:
+        raise errors.InvalidArgumentError(
+            f"domain tree ({1 << stop_level} leaves) smaller than the "
+            f"'domain' mesh axis ({n_domain})"
+        )
+    n_real = batch.seeds.shape[0]
+    key_shards = mesh.shape["keys"]
+    pad = (-n_real) % key_shards
+    idx = np.concatenate([np.arange(n_real), np.zeros(pad, dtype=np.int64)])
+    step = build_sharded_expand_step(
+        mesh, stop_level, batch.party, spec, keep_per_block
+    )
+    cw_planes, ccl, ccr = evaluator.KeyBatch(
+        seeds=batch.seeds[idx],
+        party=batch.party,
+        cw_seeds=batch.cw_seeds[idx],
+        cw_left=batch.cw_left[idx],
+        cw_right=batch.cw_right[idx],
+        value_corrections=batch.value_corrections[idx],
+        num_levels=stop_level,
+    ).device_cw_arrays()
+    corrections = tuple(jnp.asarray(a[idx]) for a in batch.codec_corrections)
+    out = step(
+        jnp.asarray(batch.seeds[idx]),
+        jnp.asarray(cw_planes),
+        jnp.asarray(ccl),
+        jnp.asarray(ccr),
+        corrections,
+    )
+    # Trim padded keys and block-packing overshoot (host-side views; the
+    # sharded array itself is what on-device consumers keep).
+    domain = 1 << lds
+    if spec.is_tuple:
+        return tuple(o[:n_real, :domain] for o in out)
+    return out[:n_real, :domain]

@@ -109,7 +109,9 @@ def test_full_domain_host_levels_split():
     dpf = DistributedPointFunction.create(DpfParameters(8, Int(32)))
     ka, _ = dpf.generate_keys(200, 99)
     base = evaluator.full_domain_evaluate(dpf, [ka], host_levels=5)
-    for hl in [0, 3]:
+    # hl=9 exceeds the tree depth (stop_level=6 for lds=8/Int32) and
+    # exercises the host_levels clamp.
+    for hl in [0, 3, 9]:
         other = evaluator.full_domain_evaluate(dpf, [ka], host_levels=hl)
         np.testing.assert_array_equal(base, other)
 

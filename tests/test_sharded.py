@@ -62,3 +62,40 @@ def test_sharded_matches_unsharded():
         db[probe] = 0xFFFFFFFF
         resp = sharded.pir_query_batch(dpf, [ka], db, mesh)[0]
         np.testing.assert_array_equal(resp, full[probe])
+
+
+@pytest.mark.parametrize(
+    "mesh_shape",
+    [
+        (2, 4),
+        pytest.param((1, 8), marks=pytest.mark.slow),
+        pytest.param((8, 1), marks=pytest.mark.slow),
+    ],
+)
+def test_sharded_full_domain_matches_unsharded(mesh_shape):
+    """Domain-sharded expansion == the single-device evaluator, for a packed
+    additive type (block trim) and IntModN (codec path)."""
+    from distributed_point_functions_tpu.core.value_types import Int, IntModN
+    from distributed_point_functions_tpu.ops import evaluator
+
+    mesh = sharded.make_mesh(*mesh_shape)
+    dpf = DistributedPointFunction.create(DpfParameters(7, Int(16)))
+    keys = [dpf.generate_keys(i * 11, 5 + i)[0] for i in range(3)]
+    out = np.asarray(sharded.sharded_full_domain_evaluate(dpf, keys, mesh))
+    np.testing.assert_array_equal(out, evaluator.full_domain_evaluate(dpf, keys))
+
+    n = (1 << 32) - 5
+    dm = DistributedPointFunction.create(DpfParameters(6, IntModN(32, n)))
+    keysm = [dm.generate_keys(9, 4242)[0]]
+    outm = np.asarray(sharded.sharded_full_domain_evaluate(dm, keysm, mesh))
+    np.testing.assert_array_equal(outm, evaluator.full_domain_evaluate(dm, keysm))
+
+
+def test_sharded_full_domain_rejects_small_tree():
+    from distributed_point_functions_tpu.core.value_types import Int
+
+    mesh = sharded.make_mesh(1, 8)
+    dpf = DistributedPointFunction.create(DpfParameters(2, Int(128)))
+    key, _ = dpf.generate_keys(1, 5)
+    with pytest.raises(Exception, match="smaller than the 'domain' mesh axis"):
+        sharded.sharded_full_domain_evaluate(dpf, [key], mesh)
