@@ -138,6 +138,13 @@ class Aes128FixedKeyHash:
         n = x.shape[0]
         if n == 0:
             return x.copy()
+        # AES-NI native engine when present (bit-exact; see native/). The
+        # numpy key schedule is byte-identical to the native one
+        # (tests/test_native.py) so it feeds the FFI directly.
+        from .. import native
+
+        if native.available():
+            return native.mmo_hash_limbs(self._round_keys, x)
         # sigma on limbs: out = (hi ^ lo, hi); limbs 0,1 = lo, limbs 2,3 = hi.
         sig = np.empty_like(x)
         sig[:, 0] = x[:, 2]

@@ -1,0 +1,120 @@
+"""ctypes loader for the native AES-NI host engine (dpf_native.cc).
+
+Builds the shared library on first import (g++, cached next to the source;
+rebuilt when the source is newer) and exposes numpy-friendly wrappers. The
+host layer (core/aes_numpy.py) transparently uses it when available; set
+DPF_TPU_NO_NATIVE=1 to force the pure-numpy path (the differential-test
+baseline). All functions are bit-exact with the numpy implementation — the
+golden AES vectors and every share-sum test run identically either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "dpf_native.cc")
+_LIB = os.path.join(_HERE, "libdpf_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-maes", "-mssse3", "-shared", "-fPIC", _SRC, "-o", _LIB,
+    ]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        return r.returncode == 0
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DPF_TPU_NO_NATIVE"):
+            return None
+        try:
+            stale = (not os.path.exists(_LIB)) or (
+                os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build():
+                return None
+            lib = ctypes.CDLL(_LIB)
+            if not lib.dpf_native_available():
+                return None
+            lib.dpf_expand_key.argtypes = [ctypes.c_char_p, ctypes.c_void_p]
+            lib.dpf_mmo_hash.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_size_t,
+            ]
+            lib.dpf_mmo_hash_masked.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+            ]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def expand_key(key_bytes: bytes) -> np.ndarray:
+    """16-byte AES key -> uint8[11, 16] round keys."""
+    lib = _load()
+    assert lib is not None
+    out = np.empty((11, 16), dtype=np.uint8)
+    lib.dpf_expand_key(key_bytes, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def mmo_hash_limbs(round_keys: np.ndarray, in_limbs: np.ndarray) -> np.ndarray:
+    """MMO hash of uint32[N, 4] blocks with uint8[11, 16] round keys."""
+    lib = _load()
+    assert lib is not None
+    x = np.ascontiguousarray(in_limbs, dtype=np.uint32)
+    out = np.empty_like(x)
+    lib.dpf_mmo_hash(
+        np.ascontiguousarray(round_keys).ctypes.data_as(ctypes.c_void_p),
+        x.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        x.shape[0],
+    )
+    return out
+
+
+def mmo_hash_masked_limbs(
+    rks_left: np.ndarray,
+    rks_right: np.ndarray,
+    in_limbs: np.ndarray,
+    mask: np.ndarray,
+) -> np.ndarray:
+    """Per-block key-selected MMO hash (mask != 0 -> right key)."""
+    lib = _load()
+    assert lib is not None
+    x = np.ascontiguousarray(in_limbs, dtype=np.uint32)
+    m = np.ascontiguousarray(mask, dtype=np.uint8)
+    out = np.empty_like(x)
+    lib.dpf_mmo_hash_masked(
+        np.ascontiguousarray(rks_left).ctypes.data_as(ctypes.c_void_p),
+        np.ascontiguousarray(rks_right).ctypes.data_as(ctypes.c_void_p),
+        x.ctypes.data_as(ctypes.c_void_p),
+        m.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        x.shape[0],
+    )
+    return out

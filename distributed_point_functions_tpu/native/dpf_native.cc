@@ -1,0 +1,142 @@
+// Native host engine: AES-NI batch kernels for the CPU side of the
+// framework (key generation, host pre-expansion, the differential-test
+// oracle). The TPU compute path is JAX/XLA (ops/); this library is the
+// native runtime underneath the host layer, playing the role the
+// OpenSSL/Highway kernels play in the reference
+// (/root/reference/dpf/aes_128_fixed_key_hash.cc:27-85,
+//  /root/reference/dpf/internal/aes_128_fixed_key_hash_hwy.h:62-229) —
+// written from scratch against the AES-NI intrinsics, not ported.
+//
+// Build:  g++ -O3 -maes -mssse3 -shared -fPIC dpf_native.cc -o libdpf_native.so
+// ABI: plain C, little-endian 16-byte blocks (the uint32[,4] limb layout).
+
+#include <cstddef>
+#include <cstdint>
+
+#if defined(__AES__) && defined(__SSSE3__)
+#include <wmmintrin.h>
+#include <tmmintrin.h>
+
+namespace {
+
+inline __m128i expand_step(__m128i key, __m128i keygened) {
+  keygened = _mm_shuffle_epi32(keygened, _MM_SHUFFLE(3, 3, 3, 3));
+  key = _mm_xor_si128(key, _mm_slli_si128(key, 4));
+  key = _mm_xor_si128(key, _mm_slli_si128(key, 4));
+  key = _mm_xor_si128(key, _mm_slli_si128(key, 4));
+  return _mm_xor_si128(key, keygened);
+}
+
+// sigma(x): out.lo64 = x.hi64, out.hi64 = x.hi64 ^ x.lo64 — the linear
+// orthomorphism of the MMO construction.
+inline __m128i sigma(__m128i x) {
+  __m128i hi_hi = _mm_shuffle_epi32(x, _MM_SHUFFLE(3, 2, 3, 2));
+  __m128i zero_lo = _mm_slli_si128(x, 8);
+  return _mm_xor_si128(hi_hi, zero_lo);
+}
+
+inline __m128i encrypt(__m128i block, const __m128i* rks) {
+  block = _mm_xor_si128(block, rks[0]);
+  for (int r = 1; r < 10; ++r) block = _mm_aesenc_si128(block, rks[r]);
+  return _mm_aesenclast_si128(block, rks[10]);
+}
+
+inline void load_rks(const uint8_t* bytes, __m128i* rks) {
+  for (int i = 0; i < 11; ++i)
+    rks[i] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(bytes + 16 * i));
+}
+
+}  // namespace
+
+extern "C" {
+
+int dpf_native_available() { return 1; }
+
+// 16-byte key -> 11 x 16-byte round keys.
+void dpf_expand_key(const uint8_t* key, uint8_t* rks_out) {
+  __m128i rks[11];
+  rks[0] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(key));
+  rks[1] = expand_step(rks[0], _mm_aeskeygenassist_si128(rks[0], 0x01));
+  rks[2] = expand_step(rks[1], _mm_aeskeygenassist_si128(rks[1], 0x02));
+  rks[3] = expand_step(rks[2], _mm_aeskeygenassist_si128(rks[2], 0x04));
+  rks[4] = expand_step(rks[3], _mm_aeskeygenassist_si128(rks[3], 0x08));
+  rks[5] = expand_step(rks[4], _mm_aeskeygenassist_si128(rks[4], 0x10));
+  rks[6] = expand_step(rks[5], _mm_aeskeygenassist_si128(rks[5], 0x20));
+  rks[7] = expand_step(rks[6], _mm_aeskeygenassist_si128(rks[6], 0x40));
+  rks[8] = expand_step(rks[7], _mm_aeskeygenassist_si128(rks[7], 0x80));
+  rks[9] = expand_step(rks[8], _mm_aeskeygenassist_si128(rks[8], 0x1B));
+  rks[10] = expand_step(rks[9], _mm_aeskeygenassist_si128(rks[9], 0x36));
+  for (int i = 0; i < 11; ++i)
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(rks_out + 16 * i), rks[i]);
+}
+
+// MMO hash of n blocks: out[i] = AES_k(sigma(in[i])) ^ sigma(in[i]).
+// 8-wide unrolled to keep the AES units' pipelines full (the same reason
+// the reference batches 64 blocks through EVP and pipelines 4 vectors).
+void dpf_mmo_hash(const uint8_t* rks_bytes, const uint8_t* in, uint8_t* out,
+                  size_t n) {
+  __m128i rks[11];
+  load_rks(rks_bytes, rks);
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i s[8];
+    for (int j = 0; j < 8; ++j)
+      s[j] = sigma(_mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(in + 16 * (i + j))));
+    __m128i b[8];
+    for (int j = 0; j < 8; ++j) b[j] = _mm_xor_si128(s[j], rks[0]);
+    for (int r = 1; r < 10; ++r)
+      for (int j = 0; j < 8; ++j) b[j] = _mm_aesenc_si128(b[j], rks[r]);
+    for (int j = 0; j < 8; ++j) {
+      b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rks[10]), s[j]);
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * (i + j)), b[j]);
+    }
+  }
+  for (; i < n; ++i) {
+    __m128i s =
+        sigma(_mm_loadu_si128(reinterpret_cast<const __m128i*>(in + 16 * i)));
+    __m128i e = _mm_xor_si128(encrypt(s, rks), s);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * i), e);
+  }
+}
+
+// Two-key MMO hash with per-block key selection (mask[i] != 0 -> right key):
+// the evaluate-path primitive where each lane walks left or right.
+void dpf_mmo_hash_masked(const uint8_t* rks_left, const uint8_t* rks_right,
+                         const uint8_t* in, const uint8_t* mask, uint8_t* out,
+                         size_t n) {
+  __m128i rl[11], rr[11];
+  load_rks(rks_left, rl);
+  load_rks(rks_right, rr);
+  // Per-block round keys via blend: rk = rl ^ ((rl ^ rr) & m).
+  __m128i rdiff[11];
+  for (int i = 0; i < 11; ++i) rdiff[i] = _mm_xor_si128(rl[i], rr[i]);
+  for (size_t i = 0; i < n; ++i) {
+    __m128i m = _mm_set1_epi8(mask[i] ? static_cast<char>(0xFF) : 0);
+    __m128i s =
+        sigma(_mm_loadu_si128(reinterpret_cast<const __m128i*>(in + 16 * i)));
+    __m128i b = _mm_xor_si128(
+        s, _mm_xor_si128(rl[0], _mm_and_si128(rdiff[0], m)));
+    for (int r = 1; r < 10; ++r)
+      b = _mm_aesenc_si128(
+          b, _mm_xor_si128(rl[r], _mm_and_si128(rdiff[r], m)));
+    b = _mm_aesenclast_si128(
+        b, _mm_xor_si128(rl[10], _mm_and_si128(rdiff[10], m)));
+    b = _mm_xor_si128(b, s);
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * i), b);
+  }
+}
+
+}  // extern "C"
+
+#else  // no AES-NI at compile time
+
+extern "C" {
+int dpf_native_available() { return 0; }
+void dpf_expand_key(const uint8_t*, uint8_t*) {}
+void dpf_mmo_hash(const uint8_t*, const uint8_t*, uint8_t*, size_t) {}
+void dpf_mmo_hash_masked(const uint8_t*, const uint8_t*, const uint8_t*,
+                         const uint8_t*, uint8_t*, size_t) {}
+}
+
+#endif

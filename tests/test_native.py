@@ -1,0 +1,61 @@
+"""Native AES-NI engine vs the pure-numpy oracle (bit-exactness)."""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import native
+from distributed_point_functions_tpu.core import aes_numpy, constants, uint128
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native engine unavailable on this host"
+)
+
+RNG = np.random.default_rng(0xAE5)
+
+
+def _numpy_mmo(h, x):
+    sig = np.empty_like(x)
+    sig[:, 0] = x[:, 2]
+    sig[:, 1] = x[:, 3]
+    sig[:, 2] = x[:, 2] ^ x[:, 0]
+    sig[:, 3] = x[:, 3] ^ x[:, 1]
+    enc = aes_numpy.encrypt_blocks(
+        sig.view(np.uint8).reshape(-1, 16), h._round_keys
+    )
+    return np.ascontiguousarray(enc).view(np.uint32).reshape(-1, 4) ^ sig
+
+
+@pytest.mark.parametrize(
+    "key", [constants.PRG_KEY_LEFT, constants.PRG_KEY_RIGHT, constants.PRG_KEY_VALUE]
+)
+def test_native_matches_numpy(key):
+    h = aes_numpy.Aes128FixedKeyHash(key)
+    x = RNG.integers(0, 2**32, size=(257, 4), dtype=np.uint32)
+    rks = native.expand_key(uint128.to_bytes(key))
+    np.testing.assert_array_equal(
+        native.mmo_hash_limbs(rks, x), _numpy_mmo(h, x)
+    )
+
+
+def test_round_keys_match_numpy_schedule():
+    key = 0x0F0E0D0C0B0A09080706050403020100
+    np.testing.assert_array_equal(
+        native.expand_key(uint128.to_bytes(key)),
+        np.asarray(
+            aes_numpy.expand_key(uint128.to_bytes(key)), dtype=np.uint8
+        ).reshape(11, 16),
+    )
+
+
+def test_masked_hash_selects_per_block():
+    ha = aes_numpy.Aes128FixedKeyHash(constants.PRG_KEY_LEFT)
+    hb = aes_numpy.Aes128FixedKeyHash(constants.PRG_KEY_RIGHT)
+    rka = native.expand_key(uint128.to_bytes(ha.key))
+    rkb = native.expand_key(uint128.to_bytes(hb.key))
+    x = RNG.integers(0, 2**32, size=(100, 4), dtype=np.uint32)
+    mask = RNG.integers(0, 2, size=100).astype(np.uint8)
+    got = native.mmo_hash_masked_limbs(rka, rkb, x, mask)
+    want = np.where(
+        mask[:, None].astype(bool), _numpy_mmo(hb, x), _numpy_mmo(ha, x)
+    )
+    np.testing.assert_array_equal(got, want)
