@@ -138,8 +138,11 @@ def _run(platform: str, log_domain: int, num_keys: int, key_chunk: int) -> dict:
     run_once(keys[:key_chunk], key_chunk, verbose=True)
     _log(f"warmup (compile + first chunk): {time.time() - t0:.1f}s")
 
+    from distributed_point_functions_tpu.utils import profiling
+
     t0 = time.time()
-    folds = run_once(keys, key_chunk)
+    with profiling.trace():  # set DPF_TPU_PROFILE_DIR to capture a trace
+        folds = run_once(keys, key_chunk)
     elapsed = time.time() - t0
 
     total_evals = num_keys * (1 << log_domain)

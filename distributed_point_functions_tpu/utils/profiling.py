@@ -1,0 +1,64 @@
+"""Profiling/tracing utilities — the observability layer the reference
+delegates to external tools (SURVEY.md §5: no in-library tracing; perf work
+lives in google-benchmark). On TPU the equivalent is a jax.profiler trace
+viewable in TensorBoard/Perfetto, plus named trace annotations around the
+framework's phases (keygen, host expansion, device expansion, finalize).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str] = None) -> Iterator[None]:
+    """Captures a jax.profiler trace into `log_dir` (or $DPF_TPU_PROFILE_DIR).
+
+    No-op when neither is set, so call sites can wrap hot paths
+    unconditionally:
+
+        with profiling.trace():
+            evaluator.full_domain_evaluate(...)
+    """
+    log_dir = log_dir or os.environ.get("DPF_TPU_PROFILE_DIR")
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region in the profiler timeline (jax.profiler.TraceAnnotation)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Stopwatch:
+    """Wall-clock phase timing with a one-line report; host-side fallback
+    when no profiler is attached."""
+
+    def __init__(self) -> None:
+        self.phases: list[tuple[str, float]] = []
+        self._t0 = time.perf_counter()
+
+    def lap(self, name: str) -> float:
+        now = time.perf_counter()
+        dt = now - self._t0
+        self.phases.append((name, dt))
+        self._t0 = now
+        return dt
+
+    def report(self) -> str:
+        total = sum(dt for _, dt in self.phases)
+        parts = ", ".join(f"{n}: {dt:.3f}s" for n, dt in self.phases)
+        return f"{parts} (total {total:.3f}s)"
