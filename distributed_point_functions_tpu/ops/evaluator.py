@@ -115,6 +115,24 @@ class KeyBatch:
             codec_corrections=codec_vc,
         )
 
+    def take(self, idx: np.ndarray) -> "KeyBatch":
+        """Row-selects every per-key array (padding/chunking helper)."""
+        return KeyBatch(
+            seeds=self.seeds[idx],
+            party=self.party,
+            cw_seeds=self.cw_seeds[idx],
+            cw_left=self.cw_left[idx],
+            cw_right=self.cw_right[idx],
+            value_corrections=self.value_corrections[idx],
+            num_levels=self.num_levels,
+            spec=self.spec,
+            codec_corrections=(
+                None
+                if self.codec_corrections is None
+                else tuple(a[idx] for a in self.codec_corrections)
+            ),
+        )
+
     def device_cw_arrays(self, from_level: int = 0):
         """(cw_planes uint32[K,L,128], ccl uint32[K,L], ccr uint32[K,L]) for
         tree levels >= from_level, vectorized over the key axis."""
@@ -450,17 +468,7 @@ def full_domain_evaluate_chunks(
         pad = key_chunk - idx.shape[0] if num_keys > key_chunk else 0
         if pad:
             idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
-        kb = KeyBatch(
-            seeds=batch.seeds[idx],
-            party=batch.party,
-            cw_seeds=batch.cw_seeds[idx],
-            cw_left=batch.cw_left[idx],
-            cw_right=batch.cw_right[idx],
-            value_corrections=batch.value_corrections[idx],
-            num_levels=stop_level,
-            spec=spec,
-            codec_corrections=tuple(a[idx] for a in batch.codec_corrections),
-        )
+        kb = batch.take(idx)
         k = kb.seeds.shape[0]
         control0 = np.full(k, bool(kb.party), dtype=bool)
         seeds_h, control_h = _host_expand(kb.seeds, control0, kb, host_levels)

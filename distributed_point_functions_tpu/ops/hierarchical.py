@@ -25,6 +25,7 @@ wire-format EvaluationContext via to_evaluation_contexts / from the key list
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -129,6 +130,7 @@ def evaluate_until_batch(
     hierarchy_level: int,
     prefixes: Sequence[int] = (),
     device_output: bool = False,
+    mesh=None,
 ) -> Union[np.ndarray, Tuple[np.ndarray, ...], tuple]:
     """Advances all keys to `hierarchy_level`, expanding under `prefixes`.
 
@@ -138,6 +140,12 @@ def evaluate_until_batch(
     uint32[K, num_outputs, lpe] limb values (tuple of per-component arrays
     for Tuple types). device_output=True returns jax arrays without host
     transfer.
+
+    With a (keys, domain) `mesh`, the sorted parent-prefix axis shards over
+    'domain' and keys over 'keys' — the domain-sharded EvaluateUntil: each
+    device expands its contiguous slice of the prefix set, and the
+    concatenated per-shard leaf orders form the global output with zero
+    cross-shard communication.
     """
     dpf, v = ctx.dpf, ctx.dpf.validator
     if hierarchy_level <= ctx.previous_hierarchy_level:
@@ -199,12 +207,20 @@ def evaluate_until_batch(
         )
 
     levels = stop_level - start_level
-    # Pad parents to whole packed words (32 lanes each).
-    pad_to = max(32, -(-num_parents // 32) * 32)
-    outs, new_seeds, new_control = _expand_batch(
-        batch, seeds0, control0, start_level, levels, pad_to, spec,
-        keep_per_block,
-    )
+    if mesh is not None:
+        outs, new_seeds, new_control = _expand_batch_sharded(
+            batch,
+            jnp.asarray(seeds0).astype(jnp.uint32),
+            jnp.asarray(control0).astype(jnp.uint32),
+            start_level, levels, spec, keep_per_block, mesh,
+        )
+    else:
+        # Pad parents to whole packed words (32 lanes each).
+        pad_to = max(32, -(-num_parents // 32) * 32)
+        outs, new_seeds, new_control = _expand_batch(
+            batch, seeds0, control0, start_level, levels, pad_to, spec,
+            keep_per_block,
+        )
 
     # When the previous level's domain index carries block bits (epb > 1),
     # distinct prefixes can share one tree index; each selects the slice
@@ -329,3 +345,139 @@ def _reorder_state_jit(planes, control_mask, order):
     seeds = jax.vmap(aes_jax.unpack_from_planes)(planes)[:, order]
     ctrl = jax.vmap(backend_jax.unpack_mask_device)(control_mask)[:, order]
     return seeds, ctrl
+
+
+# ---------------------------------------------------------------------------
+# Domain-sharded expansion (prefix axis sharded over a mesh)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _build_sharded_parent_expand(
+    mesh_key,  # the Mesh (hashable)
+    levels: int,
+    party: int,
+    spec,
+    keep_per_block: int,
+    local_parents: int,
+):
+    """Compiles the sharded analog of _expand_batch: each 'domain' shard owns
+    a contiguous slice of the (padded, sorted) parent prefixes and expands
+    them fully — the concatenation of per-shard leaf orders IS the global
+    leaf order, so no cross-shard communication exists at all."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    mesh = mesh_key
+    order = backend_jax.expansion_output_order(
+        local_parents, local_parents, levels
+    )
+
+    def device_fn(seeds, control, cw_planes, ccl, ccr, corrections):
+        # seeds [Kl, Pl, 4]; control [Kl, Pl]; cw_* [Kl, L, ...] replicated
+        # over 'domain'; corrections pytree [Kl, epb, lpe_c].
+        control_mask = _pack_mask_device(control.astype(jnp.uint32))
+        planes = jax.vmap(aes_jax.pack_to_planes)(seeds)
+        for level in range(levels):
+            planes, control_mask = jax.vmap(backend_jax.expand_one_level)(
+                planes, control_mask, cw_planes[:, level], ccl[:, level],
+                ccr[:, level],
+            )
+        outs = evaluator._finalize_batch_codec_jit.__wrapped__(
+            planes,
+            control_mask,
+            corrections,
+            jnp.asarray(order),
+            spec=spec,
+            party=party,
+            keep_per_block=keep_per_block,
+        )
+        new_seeds, new_control = _reorder_state_jit.__wrapped__(
+            planes, control_mask, jnp.asarray(order)
+        )
+        return outs, new_seeds, new_control
+
+    step = jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(
+            P("keys", "domain"),  # seeds
+            P("keys", "domain"),  # control
+            P("keys"),  # cw_planes
+            P("keys"),  # ccl
+            P("keys"),  # ccr
+            tuple(P("keys") for _ in spec.components),
+        ),
+        out_specs=(
+            tuple(P("keys", "domain") for _ in spec.components),
+            P("keys", "domain"),
+            P("keys", "domain"),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(step)
+
+
+def _expand_batch_sharded(
+    batch: evaluator.KeyBatch,
+    seeds0,
+    control0,
+    start_level: int,
+    levels: int,
+    spec,
+    keep_per_block: int,
+    mesh,
+):
+    """Mesh-sharded counterpart of _expand_batch. Pads the parent axis to a
+    multiple of 32 * n_domain and the key axis to n_keys shards."""
+    k = seeds0.shape[0]
+    num_parents = seeds0.shape[1]
+    n_domain = mesh.shape["domain"]
+    key_shards = mesh.shape["keys"]
+    key_pad = (-k) % key_shards
+    if key_pad:
+        # Repeat key 0 to make the key axis shardable; trimmed below.
+        idx = np.concatenate(
+            [np.arange(k), np.zeros(key_pad, dtype=np.int64)]
+        )
+        seeds0 = seeds0[jnp.asarray(idx)]
+        control0 = control0[jnp.asarray(idx)]
+        batch = batch.take(idx)
+    pad_to = -(-num_parents // (32 * n_domain)) * (32 * n_domain)
+    pad = pad_to - num_parents
+    seeds0 = jnp.asarray(seeds0, dtype=jnp.uint32)
+    control0 = jnp.asarray(control0)
+    kp = seeds0.shape[0]  # key axis after key padding
+    if pad:
+        seeds0 = jnp.concatenate(
+            [seeds0, jnp.zeros((kp, pad, 4), jnp.uint32)], axis=1
+        )
+        control0 = jnp.concatenate(
+            [control0, jnp.zeros((kp, pad), control0.dtype)], axis=1
+        )
+    cw_dev, ccl, ccr = batch.device_cw_arrays(start_level)
+    step = _build_sharded_parent_expand(
+        mesh, levels, batch.party, spec, keep_per_block, pad_to // n_domain
+    )
+    outs, new_seeds, new_control = step(
+        seeds0,
+        control0.astype(jnp.uint32),
+        jnp.asarray(cw_dev[:, :levels]),
+        jnp.asarray(ccl[:, :levels]),
+        jnp.asarray(ccr[:, :levels]),
+        tuple(jnp.asarray(a) for a in batch.codec_corrections),
+    )
+    # Shards own contiguous parent slices and each emits its leaf order, so
+    # the concatenation IS global leaf order: global element base of parent
+    # p is p * etp. Padding lanes are all appended after the real parents,
+    # hence land in the trailing shards — trimming is a plain slice.
+    etp = (1 << levels) * keep_per_block  # elements per parent
+    outs = tuple(o[:k, : num_parents * etp] for o in outs)
+    if not spec.is_tuple:
+        outs = outs[0]
+    blocks_per_parent = 1 << levels
+    return (
+        outs,
+        new_seeds[:k, : num_parents * blocks_per_parent],
+        new_control[:k, : num_parents * blocks_per_parent],
+    )

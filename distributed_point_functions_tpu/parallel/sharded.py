@@ -268,15 +268,8 @@ def pir_query_batch(
     key_shards = mesh.shape["keys"]
     pad = (-n_real) % key_shards
     if pad:
-        rep = lambda a: np.concatenate([a, np.repeat(a[:1], pad, axis=0)])
-        batch = evaluator.KeyBatch(
-            seeds=rep(batch.seeds),
-            party=batch.party,
-            cw_seeds=rep(batch.cw_seeds),
-            cw_left=rep(batch.cw_left),
-            cw_right=rep(batch.cw_right),
-            value_corrections=rep(batch.value_corrections),
-            num_levels=batch.num_levels,
+        batch = batch.take(
+            np.concatenate([np.arange(n_real), np.zeros(pad, dtype=np.int64)])
         )
     cw_planes, ccl, ccr = batch.device_cw_arrays()
     corrections = evaluator._correction_limbs(batch.value_corrections, bits)
@@ -422,18 +415,11 @@ def sharded_full_domain_evaluate(
     step = build_sharded_expand_step(
         mesh, stop_level, batch.party, spec, keep_per_block
     )
-    cw_planes, ccl, ccr = evaluator.KeyBatch(
-        seeds=batch.seeds[idx],
-        party=batch.party,
-        cw_seeds=batch.cw_seeds[idx],
-        cw_left=batch.cw_left[idx],
-        cw_right=batch.cw_right[idx],
-        value_corrections=batch.value_corrections[idx],
-        num_levels=stop_level,
-    ).device_cw_arrays()
-    corrections = tuple(jnp.asarray(a[idx]) for a in batch.codec_corrections)
+    batch = batch.take(idx)
+    cw_planes, ccl, ccr = batch.device_cw_arrays()
+    corrections = tuple(jnp.asarray(a) for a in batch.codec_corrections)
     out = step(
-        jnp.asarray(batch.seeds[idx]),
+        jnp.asarray(batch.seeds),
         jnp.asarray(cw_planes),
         jnp.asarray(ccl),
         jnp.asarray(ccr),

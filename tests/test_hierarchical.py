@@ -88,3 +88,40 @@ def test_rejects_bad_prefix_sets():
         hierarchical.evaluate_until_batch(bc, 1, [1, 1, 2])
     with pytest.raises(InvalidArgumentError, match="greater than"):
         hierarchical.evaluate_until_batch(bc, 0, [1])
+
+
+def test_sharded_evaluate_until_matches_unsharded():
+    """Domain-sharded evaluate_until_batch (mesh=) == the single-device
+    path at every level, and mixed sharded/unsharded steps share state."""
+    from distributed_point_functions_tpu.parallel import sharded
+
+    mesh = sharded.make_mesh(2, 4)
+    params = [DpfParameters(d, Int(32)) for d in (3, 6, 10)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(777, [5, 6, 7])
+    p1 = list(range(8))
+    p2 = sorted(
+        int(x) for x in np.random.default_rng(1).choice(64, 20, replace=False)
+    )
+
+    c0 = hierarchical.BatchedContext.create(dpf, [ka, ka])
+    u = [
+        hierarchical.evaluate_until_batch(c0, 0),
+        hierarchical.evaluate_until_batch(c0, 1, p1),
+        hierarchical.evaluate_until_batch(c0, 2, p2),
+    ]
+    c1 = hierarchical.BatchedContext.create(dpf, [ka, ka])
+    s = [
+        hierarchical.evaluate_until_batch(c1, 0, mesh=mesh),
+        hierarchical.evaluate_until_batch(c1, 1, p1, mesh=mesh),
+        hierarchical.evaluate_until_batch(c1, 2, p2, mesh=mesh),
+    ]
+    for a, b in zip(s, u):
+        np.testing.assert_array_equal(np.asarray(a), b)
+    # an odd key count gets padded over the 'keys' axis and trimmed; the
+    # sharded step's state then feeds an unsharded continuation
+    c2 = hierarchical.BatchedContext.create(dpf, [ka])
+    hierarchical.evaluate_until_batch(c2, 0, mesh=mesh)
+    np.testing.assert_array_equal(
+        hierarchical.evaluate_until_batch(c2, 1, p1), u[1][:1]
+    )

@@ -108,10 +108,19 @@ def test_golden_fixture_round_trips(ctx):
     """The canonical context's wire bytes are pinned; parsing them back
     yields the same context (checkpoint/resume + interchange anchor)."""
     data = serialization.serialize_evaluation_context(ctx)
-    os.makedirs(DATA_DIR, exist_ok=True)
     if not os.path.exists(FIXTURE):
-        with open(FIXTURE, "wb") as f:
-            f.write(data)
+        # Never auto-heal: a lost fixture must fail loudly, or a wire-format
+        # regression would pin itself as the new golden. Regenerate only via
+        # DPF_REGEN_GOLDEN=1 after verifying the format change on purpose.
+        if os.environ.get("DPF_REGEN_GOLDEN") == "1":
+            os.makedirs(DATA_DIR, exist_ok=True)
+            with open(FIXTURE, "wb") as f:
+                f.write(data)
+        else:
+            pytest.fail(
+                f"golden fixture missing: {FIXTURE} (set DPF_REGEN_GOLDEN=1 "
+                "to regenerate intentionally)"
+            )
     with open(FIXTURE, "rb") as f:
         golden = f.read()
     assert data == golden, (
