@@ -28,15 +28,11 @@ def bench(jax, smoke):
 
     dcf = DistributedComparisonFunction.create(log_domain, Int(64))
     rng = np.random.default_rng(11)
+    alphas = [int(a) for a in rng.integers(0, 1 << log_domain, size=num_keys)]
+    betas = [int(b) for b in rng.integers(1, 1 << 62, size=num_keys)]
     with Timer() as tk:
-        keys = [
-            dcf.generate_keys(
-                int(rng.integers(0, 1 << log_domain)),
-                int(rng.integers(1, 1 << 62)),
-            )[0]
-            for _ in range(num_keys)
-        ]
-    log(f"keygen: {tk.elapsed:.2f}s for {num_keys} DCF keys")
+        keys, _ = dcf.generate_keys_batch(alphas, betas)
+    log(f"keygen: {tk.elapsed:.2f}s for {num_keys} DCF keys (batched)")
     xs = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_points)]
 
     with Timer() as warm:

@@ -91,6 +91,45 @@ class DistributedComparisonFunction:
         )
         return DcfKey(key_a), DcfKey(key_b)
 
+    def generate_keys_batch(
+        self, alphas: Sequence[int], betas, seeds=None
+    ) -> Tuple[List[DcfKey], List[DcfKey]]:
+        """K DCF key pairs at once through the level-major batched DPF
+        keygen (one vectorized AES call per tree level across all keys).
+
+        `betas` is one value (broadcast) or a length-K sequence. A value
+        that is itself valid for the output type (e.g. a tuple for a
+        TupleType DCF) is always treated as the broadcast form.
+        """
+        n = self.log_domain_size
+        k = len(alphas)
+        try:
+            self.value_type.validate_value(betas)
+            betas = [betas] * k
+        except Exception:
+            betas = list(betas) if hasattr(betas, "__len__") else [betas] * k
+        if len(betas) != k:
+            raise InvalidArgumentError(
+                "`betas` must be a single value or one per alpha"
+            )
+        zero = self.value_type.zero()
+        for alpha in alphas:
+            if alpha < 0 or (n < 128 and alpha >= (1 << n)):
+                raise InvalidArgumentError(
+                    "`alpha` must be smaller than the output domain size"
+                )
+        per_level = [
+            [
+                betas[j] if (alphas[j] >> (n - i - 1)) & 1 else zero
+                for j in range(k)
+            ]
+            for i in range(n)
+        ]
+        keys_a, keys_b = self._dpf.generate_keys_batch(
+            [a >> 1 for a in alphas], per_level, seeds=seeds
+        )
+        return [DcfKey(x) for x in keys_a], [DcfKey(x) for x in keys_b]
+
     def evaluate(self, key: DcfKey, x: int):
         """Reference-parity single-point evaluation (host, any value type)."""
         n = self.log_domain_size

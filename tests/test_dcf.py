@@ -112,3 +112,31 @@ def test_dcf_rejects_bad_inputs():
     ka, _ = dcf.generate_keys(3, 1)
     with pytest.raises(InvalidArgumentError):
         dcf.evaluate(ka, 16)
+
+
+def test_batched_dcf_keygen_matches_sequential():
+    """generate_keys_batch is bit-exact with sequential generate_keys given
+    the same seeds."""
+    dcf = DistributedComparisonFunction.create(6, Int(32))
+    rng = np.random.default_rng(5)
+    alphas = [int(a) for a in rng.integers(0, 64, size=4)]
+    betas = [int(b) for b in rng.integers(1, 100, size=4)]
+    seeds = rng.integers(0, 2**32, size=(4, 2, 4), dtype=np.uint32)
+    ka_b, kb_b = dcf.generate_keys_batch(alphas, betas, seeds=seeds)
+    for i in range(4):
+        s = (
+            int.from_bytes(seeds[i, 0].tobytes(), "little"),
+            int.from_bytes(seeds[i, 1].tobytes(), "little"),
+        )
+        ka, kb = dcf.generate_keys(alphas[i], betas[i], seeds=s)
+        assert ka == ka_b[i] and kb == kb_b[i]
+    from distributed_point_functions_tpu.utils.errors import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError, match="single value or one per alpha"):
+        dcf.generate_keys_batch([1, 2], [3, 4, 5])
+    # a tuple beta that is itself a valid value broadcasts
+    from distributed_point_functions_tpu.core.value_types import TupleType
+
+    dcf_t = DistributedComparisonFunction.create(4, TupleType(Int(32), Int(32)))
+    ka, kb = dcf_t.generate_keys_batch([5, 6], (7, 9))
+    assert len(ka) == 2
