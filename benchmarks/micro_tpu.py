@@ -114,6 +114,36 @@ def main():
         f"compile {compile_s:.1f}s, {blocks/dt/1e6:.1f} M child blocks/s"
     )
 
+    # --- 4b. Pallas expansion kernel vs the XLA bitslice ---------------------
+    # (the measured Pallas-vs-XLA decision of PERF.md / SURVEY §7 step 3)
+    try:
+        from distributed_point_functions_tpu.ops import aes_pallas
+
+        w = 8192
+        planes = jnp.asarray(
+            rng.integers(0, 2**32, size=(128, w), dtype=np.uint32)
+        )
+        control = jnp.asarray(rng.integers(0, 2**32, size=(w,), dtype=np.uint32))
+        cwp = jnp.asarray(rng.integers(0, 2**32, size=(128,), dtype=np.uint32))
+        ccl = jnp.uint32(0xFFFFFFFF)
+        ccr = jnp.uint32(0)
+        xla_fn = jax.jit(backend_jax.expand_one_level)
+        dt_xla, _ = timeit(xla_fn, planes, control, cwp, ccl, ccr, n=5)
+        interp = jax.default_backend() != "tpu"
+        pallas_fn = lambda *a: aes_pallas.expand_one_level_pallas(
+            *a, interpret=interp
+        )
+        dt_pal, _ = timeit(pallas_fn, planes, control, cwp, ccl, ccr, n=5)
+        blocks = 2 * 32 * w
+        print(
+            f"expand_one_level W={w}: XLA {dt_xla*1e3:.2f} ms "
+            f"({blocks/dt_xla/1e6:.0f} M blk/s) vs Pallas {dt_pal*1e3:.2f} ms "
+            f"({blocks/dt_pal/1e6:.0f} M blk/s)"
+            + (" [interpreter]" if interp else "")
+        )
+    except Exception as e:
+        print(f"pallas comparison failed: {type(e).__name__}: {e}")
+
     # --- 5. device->host transfer bandwidth ----------------------------------
     big = jnp.asarray(rng.integers(0, 2**32, size=(64, 1 << 19, 2), dtype=np.uint32))
     jax.block_until_ready(big)

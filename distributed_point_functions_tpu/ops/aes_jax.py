@@ -235,7 +235,10 @@ _SHIFT_ROWS = tuple(
 
 
 def _shift_rows(state: jnp.ndarray) -> jnp.ndarray:
-    return state[jnp.array(_SHIFT_ROWS), :, :]
+    # Unrolled static gather (not a fancy-index with a constant array) so
+    # the same circuit traces inside Pallas kernels, which reject captured
+    # array constants; XLA folds both forms identically.
+    return jnp.stack([state[i] for i in _SHIFT_ROWS], axis=0)
 
 
 def _xtime(a: jnp.ndarray) -> jnp.ndarray:

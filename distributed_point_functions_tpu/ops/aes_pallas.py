@@ -1,0 +1,103 @@
+"""Pallas TPU kernel for the doubling-expansion hot op.
+
+The XLA bitslice (ops/aes_jax.py + backend_jax.expand_one_level) already
+saturates the chip far beyond the workload's AES demand (PERF.md), so this
+kernel exists to *prove the decision with a measurement*, not because
+profiles demanded it: `benchmarks/micro_tpu.py` times both paths
+on hardware. The kernel fuses one tree level — per-lane dual-key bitsliced
+AES, correction XOR, control-bit extraction — with all 128 bit-planes
+resident in VMEM and a grid over (child, lane-block):
+
+    grid = (2, W // block_w)
+    out[128, 2W] = [left children | right children]  (expand_one_level's
+    block-concatenated layout, same unpack permutation applies)
+
+The AES circuit itself is the same jnp boolean algebra as the XLA path
+(aes_jax.hash_planes) traced inside the kernel — one implementation, two
+schedulers. Tested for bit-equality against expand_one_level in
+interpreter mode (CPU) and compiled (TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import aes_jax, backend_jax
+
+
+def _expand_kernel(
+    planes_ref,  # uint32[128, bw]
+    control_ref,  # uint32[1, bw]
+    cw_ref,  # uint32[128, 1]
+    cc_ref,  # uint32[1, 2]: (ccl, ccr)
+    rk_ref,  # uint32[22, 128]: [rk_base | rk_diff], 16*8 planes per round
+    out_planes_ref,  # uint32[128, bw]
+    out_control_ref,  # uint32[1, bw]
+):
+    child = pl.program_id(0)  # 0 = left key, 1 = right key
+    p = planes_ref[:, :]
+    c = control_ref[0, :]
+    w = p.shape[1]
+    key_mask = jnp.broadcast_to(
+        jnp.where(child == 0, jnp.uint32(0), jnp.uint32(0xFFFFFFFF)), (w,)
+    )
+    rks = rk_ref[:, :].reshape(22, 16, 8)
+    h = aes_jax.hash_planes(p, rks[:11], rks[11:], key_mask)
+    h = h ^ (cw_ref[:, 0][:, None] & c[None, :])
+    cc = jnp.where(child == 0, cc_ref[0, 0], cc_ref[0, 1])
+    new_control = h[0] ^ (c & cc)
+    h = h.at[0].set(jnp.zeros_like(h[0]))
+    out_planes_ref[:, :] = h
+    out_control_ref[0, :] = new_control
+
+
+@functools.partial(jax.jit, static_argnames=("block_w", "interpret"))
+def expand_one_level_pallas(
+    planes: jnp.ndarray,  # uint32[128, W]
+    control: jnp.ndarray,  # uint32[W]
+    cw_plane: jnp.ndarray,  # uint32[128]
+    ccl_mask: jnp.ndarray,  # uint32 scalar mask
+    ccr_mask: jnp.ndarray,  # uint32 scalar mask
+    block_w: int = 512,
+    interpret: bool = False,
+):
+    """Pallas twin of backend_jax.expand_one_level (same outputs/layout)."""
+    w = planes.shape[1]
+    bw = min(block_w, w)
+    assert w % bw == 0, (w, bw)
+    rks = np.concatenate(
+        [backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff")]
+    ).reshape(22, 128)
+    grid = (2, w // bw)
+    out_planes, out_control = pl.pallas_call(
+        _expand_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((128, 2 * w), jnp.uint32),
+            jax.ShapeDtypeStruct((1, 2 * w), jnp.uint32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((128, bw), lambda i, j: (0, j)),
+            pl.BlockSpec((1, bw), lambda i, j: (0, j)),
+            pl.BlockSpec((128, 1), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 2), lambda i, j: (0, 0)),
+            pl.BlockSpec((22, 128), lambda i, j: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((128, bw), lambda i, j: (0, i * (w // bw) + j)),
+            pl.BlockSpec((1, bw), lambda i, j: (0, i * (w // bw) + j)),
+        ),
+        interpret=interpret,
+    )(
+        planes,
+        control[None, :],
+        cw_plane[:, None],
+        jnp.stack([ccl_mask, ccr_mask]).astype(jnp.uint32)[None, :],
+        jnp.asarray(rks),
+    )
+    return out_planes, out_control[0]

@@ -1,0 +1,26 @@
+"""Pallas expansion kernel vs the XLA bitslice (interpreter mode on CPU)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from distributed_point_functions_tpu.ops import aes_pallas, backend_jax
+
+RNG = np.random.default_rng(0xBA11A5)
+
+
+@pytest.mark.parametrize("w,bw", [(32, 32), (64, 32), (128, 128)])
+def test_pallas_expand_matches_xla(w, bw):
+    planes = jnp.asarray(RNG.integers(0, 2**32, size=(128, w), dtype=np.uint32))
+    control = jnp.asarray(RNG.integers(0, 2**32, size=(w,), dtype=np.uint32))
+    cw = jnp.asarray(RNG.integers(0, 2**32, size=(128,), dtype=np.uint32))
+    for ccl, ccr in [(0xFFFFFFFF, 0), (0, 0xFFFFFFFF), (0, 0)]:
+        want_p, want_c = backend_jax.expand_one_level(
+            planes, control, cw, jnp.uint32(ccl), jnp.uint32(ccr)
+        )
+        got_p, got_c = aes_pallas.expand_one_level_pallas(
+            planes, control, cw, jnp.uint32(ccl), jnp.uint32(ccr),
+            block_w=bw, interpret=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got_p), np.asarray(want_p))
+        np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))

@@ -186,3 +186,56 @@ def test_context_lifecycle_errors():
     dpf.evaluate_until(1, [0, 1], ctx)
     with pytest.raises(InvalidArgumentError, match="fully evaluated"):
         dpf.evaluate_until(1, [0], ctx)
+
+
+def test_maximum_output_domain_129_levels():
+    """The reference's MaximumOutputDomainSize suite: a 129-level hierarchy
+    with log domains 0..128, alpha spanning the full 128 bits, evaluated at
+    a sample of levels via prefixes around alpha
+    (/root/reference/dpf/distributed_point_function_test.cc:879-897)."""
+    params = [DpfParameters(i, Int(64)) for i in range(129)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    alpha = (23 << 64) | 42
+    beta = 1234567
+    ka, kb = dpf.generate_keys_incremental(alpha, [beta] * 129)
+
+    ctx_a = dpf.create_evaluation_context(ka)
+    ctx_b = dpf.create_evaluation_context(kb)
+    previous = -1
+    levels = list(range(0, 129, 7)) + [128]  # level_step 7, as the suite does
+    for level in levels:
+        if previous < 0:
+            prefixes = []
+        else:
+            prev_lds = params[previous].log_domain_size
+            prefix = alpha >> (128 - prev_lds)
+            # alpha's prefix plus a couple of cold neighbours
+            prefixes = sorted(
+                {prefix, prefix ^ 1 if prev_lds > 0 else prefix, 0}
+            )
+        va = dpf.evaluate_until(level, prefixes, ctx_a)
+        vb = dpf.evaluate_until(level, prefixes, ctx_b)
+        lds = params[level].log_domain_size
+        alpha_prefix = alpha >> (128 - lds) if lds < 128 else alpha
+        outputs_per_prefix = (
+            len(va) // max(len(prefixes), 1) if prefixes else len(va)
+        )
+        # reconstruct and locate the nonzero
+        hits = 0
+        for j, (a, b) in enumerate(zip(va, vb)):
+            total = (a + b) % (1 << 64)
+            if prefixes:
+                p = prefixes[j // outputs_per_prefix]
+                idx = (p << (lds - params[previous].log_domain_size)) + (
+                    j % outputs_per_prefix
+                )
+            else:
+                idx = j
+            if idx == alpha_prefix:
+                assert total == beta, (level, idx)
+                hits += 1
+            else:
+                assert total == 0, (level, idx)
+        # alpha's prefix must have been covered at every evaluated level
+        assert hits == 1, level
+        previous = level
