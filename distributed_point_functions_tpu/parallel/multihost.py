@@ -1,0 +1,120 @@
+"""Multi-host (DCN) scaling: key batches across hosts, ICI within each.
+
+The reference has no communication backend at all — its two "parties" are
+organizational, and SURVEY.md §2 fixes the green-field design: the DPF math
+has *no cross-key terms*, so the key/query batch is embarrassingly parallel
+across hosts. The right multi-host shape is therefore NOT one global
+shard_map (which would force every input through cross-process array
+construction for zero benefit): each host runs the single-host sharded
+paths (parallel/sharded.py) over its OWN chips — a local (keys, domain)
+mesh whose 'domain' collectives ride ICI by construction — on its OWN
+contiguous slice of the key batch. DCN carries only the application-level
+key scatter and the tiny [K_local, lpe] response gather.
+
+Usage on every host of a pod/cluster:
+
+    from distributed_point_functions_tpu.parallel import multihost, sharded
+    multihost.initialize()                       # jax.distributed handshake
+    mesh = multihost.local_mesh()                # this host's chips
+    lo, hi = multihost.local_key_slice(num_keys) # this host's key range
+    out = sharded.pir_query_batch(dpf, keys[lo:hi], db, mesh)
+    # gather responses across hosts at the application layer, e.g.
+    # jax.experimental.multihost_utils.process_allgather(out)
+
+The same program runs unchanged in a single process (initialize is then a
+no-op and the slice is the whole batch).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Tuple
+
+from ..utils.errors import InvalidArgumentError
+from . import sharded
+
+_log = logging.getLogger("distributed_point_functions_tpu")
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """jax.distributed handshake.
+
+    With explicit arguments (or JAX_COORDINATOR / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID), initializes exactly as told and propagates failures.
+    With none, attempts jax.distributed's own cluster auto-detection (cloud
+    TPU pods need no arguments); environments with no detectable cluster
+    (laptops, CI, single chips) log and continue as a single process.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except Exception as e:
+        if explicit:
+            raise
+        _log.info("no distributed cluster detected (%s); single process", e)
+
+
+def local_mesh(
+    n_key_shards: Optional[int] = None,
+    n_domain_shards: Optional[int] = None,
+):
+    """A (keys, domain) mesh over THIS host's chips only.
+
+    Domain collectives stay on the host's ICI by construction. Defaults to
+    all local devices on the domain axis (n_key_shards=1).
+    """
+    import jax
+
+    devices = jax.local_devices()
+    n_local = len(devices)
+    for name, v in (("n_key_shards", n_key_shards), ("n_domain_shards", n_domain_shards)):
+        if v is not None and v < 1:
+            raise InvalidArgumentError(f"`{name}` must be positive, got {v}")
+    if n_key_shards is None and n_domain_shards is None:
+        n_key_shards, n_domain_shards = 1, n_local
+    elif n_key_shards is None:
+        n_key_shards = n_local // n_domain_shards
+    elif n_domain_shards is None:
+        n_domain_shards = n_local // n_key_shards
+    if n_key_shards * n_domain_shards != n_local:
+        raise InvalidArgumentError(
+            f"mesh {n_key_shards} x {n_domain_shards} does not match the "
+            f"local device count ({n_local})"
+        )
+    return sharded.make_mesh(n_key_shards, n_domain_shards, devices=devices)
+
+
+def local_key_slice(num_keys: int) -> Tuple[int, int]:
+    """This process's contiguous [start, stop) range of a global key batch.
+
+    Keys are data-parallel across hosts; each host generates/loads only its
+    own slice. The remainder spreads over the first hosts.
+    """
+    import jax
+
+    n_proc = jax.process_count()
+    pid = jax.process_index()
+    base, extra = divmod(num_keys, n_proc)
+    start = pid * base + min(pid, extra)
+    stop = start + base + (1 if pid < extra else 0)
+    return start, stop

@@ -99,3 +99,25 @@ def test_sharded_full_domain_rejects_small_tree():
     key, _ = dpf.generate_keys(1, 5)
     with pytest.raises(Exception, match="smaller than the 'domain' mesh axis"):
         sharded.sharded_full_domain_evaluate(dpf, [key], mesh)
+
+
+def test_multihost_single_process_degenerates():
+    """multihost helpers work unchanged in a single-process run."""
+    from distributed_point_functions_tpu.parallel import multihost
+
+    multihost.initialize()  # no detectable cluster -> single process
+    mesh = multihost.local_mesh(n_domain_shards=4)
+    assert mesh.shape["domain"] == 4
+    assert mesh.shape["keys"] == 2  # 8 virtual devices / 4
+    assert multihost.local_key_slice(10) == (0, 10)
+    with pytest.raises(Exception, match="does not match"):
+        multihost.local_mesh(n_key_shards=3, n_domain_shards=3)
+
+    # the local mesh drives the sharded paths end to end
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import evaluator
+
+    dpf = DistributedPointFunction.create(DpfParameters(6, Int(32)))
+    key, _ = dpf.generate_keys(5, 9)
+    out = np.asarray(sharded.sharded_full_domain_evaluate(dpf, [key], mesh))
+    np.testing.assert_array_equal(out, evaluator.full_domain_evaluate(dpf, [key]))
