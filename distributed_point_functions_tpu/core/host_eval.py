@@ -1,0 +1,157 @@
+"""Vectorized host full-domain evaluation on the native AES engine.
+
+The reference's evaluation runs on CPU with AES-NI; this module is that
+engine's counterpart for hosts without an accelerator (and the bench's CPU
+fallback): the whole doubling expansion and value correction as batched
+numpy over the native AES library (native/dpf_native.cc) — no Python
+per-element loops, no XLA. The TPU path (ops/evaluator.py) remains the
+flagship; results are bit-identical.
+
+Scope: scalar Int/XorWrapper value types (the benchmark configs); other
+types evaluate through ops/evaluator.py or the host reference path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..utils.errors import InvalidArgumentError
+from . import backend_numpy
+from .dpf import DistributedPointFunction
+from .keys import DpfKey
+from .value_types import Int, XorWrapper
+
+
+def _split_elements_np(blocks: np.ndarray, bits: int) -> np.ndarray:
+    """uint32[..., 4] -> uint32/uint64[..., epb] little-endian elements."""
+    if bits == 128:
+        return blocks[..., None, :]  # [..., 1, 4] limbs (caller handles)
+    if bits == 64:
+        v = blocks.view(np.uint64) if blocks.flags["C_CONTIGUOUS"] else np.ascontiguousarray(blocks).view(np.uint64)
+        return v.reshape(blocks.shape[:-1] + (2,))
+    if bits == 32:
+        return blocks
+    per_limb = 32 // bits
+    mask = np.uint32((1 << bits) - 1)
+    shifts = (np.arange(per_limb, dtype=np.uint32) * np.uint32(bits))
+    vals = (blocks[..., :, None] >> shifts) & mask
+    return vals.reshape(blocks.shape[:-1] + (128 // bits,))
+
+
+def full_domain_evaluate_host(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    hierarchy_level: int = -1,
+    key_chunk: int = 32,
+) -> np.ndarray:
+    """Full-domain evaluation of a key batch, entirely on the host.
+
+    Returns uint64[K, domain] for Int/XorWrapper up to 64 bits and
+    uint32[K, domain, 4] limb rows for 128-bit types. Bit-identical to
+    ops/evaluator.full_domain_evaluate.
+    """
+    from ..ops import evaluator  # KeyBatch reuse (host-side preparation)
+
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    if not isinstance(value_type, (Int, XorWrapper)):
+        raise InvalidArgumentError(
+            "full_domain_evaluate_host supports Int/XorWrapper outputs; use "
+            "ops/evaluator or the host reference path for other types"
+        )
+    bits = value_type.bitsize
+    xor_group = isinstance(value_type, XorWrapper)
+    lds = v.parameters[hierarchy_level].log_domain_size
+    domain = 1 << lds
+
+    batch = evaluator.KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    stop_level = batch.num_levels
+    keep_per_block = 1 << (lds - stop_level)
+    num_keys = len(keys)
+    out = (
+        np.empty((num_keys, domain), dtype=np.uint64)
+        if bits <= 64
+        else np.empty((num_keys, domain, 4), dtype=np.uint32)
+    )
+    vc = batch.value_corrections  # uint32[K, epb, 4]
+
+    for start in range(0, num_keys, key_chunk):
+        idx = np.arange(start, min(start + key_chunk, num_keys))
+        kb = batch.take(idx)
+        k = idx.shape[0]
+        control0 = np.full(k, bool(kb.party), dtype=bool)
+        # Vectorized doubling expansion, all levels on the host AES engine.
+        seeds, control = evaluator._host_expand(
+            kb.seeds, control0, kb, stop_level
+        )  # [k, 2^stop, 4], [k, 2^stop]
+        n_blocks = seeds.shape[1]
+        hashed = backend_numpy._PRG_VALUE.evaluate_limbs(
+            seeds.reshape(k * n_blocks, 4)
+        ).reshape(k, n_blocks, 4)
+
+        if bits == 128:
+            corr = vc[idx][:, None, :, :]  # [k, 1, epb, 4]
+            elems = hashed[:, :, None, :]  # [k, blocks, 1, 4]
+            ctrl = control[:, :, None, None]
+            if xor_group:
+                vals = elems ^ np.where(ctrl, corr, np.uint32(0))
+            else:
+                c = np.where(ctrl, corr, np.uint32(0))
+                vals = _add128(elems, c)
+                if kb.party == 1:
+                    vals = _neg128(vals)
+            vals = vals[:, :, :keep_per_block].reshape(k, -1, 4)[:, :domain]
+            out[idx] = vals
+            continue
+
+        elems = _split_elements_np(hashed, bits)  # [k, blocks, epb]
+        epb = elems.shape[-1]
+        # Corrections are stored one 128-bit limb row per element.
+        cw = vc[idx]  # [k, epb, 4]
+        if bits <= 32:
+            corr = (cw[:, :, 0] & np.uint32((1 << bits) - 1)).reshape(k, 1, epb)
+        else:  # 64
+            corr = (
+                cw[:, :, 0].astype(np.uint64)
+                | (cw[:, :, 1].astype(np.uint64) << np.uint64(32))
+            ).reshape(k, 1, epb)
+        ctrl = np.broadcast_to(control[:, :, None], elems.shape)
+        edt = elems.dtype
+        corr_b = np.broadcast_to(corr.astype(edt), elems.shape)
+        # In-place masked group op on the hash buffer view — one pass, no
+        # temporary correction array.
+        vals = np.ascontiguousarray(elems)
+        op = np.bitwise_xor if xor_group else np.add
+        op(vals, corr_b, where=ctrl, out=vals)
+        if bits < 32:
+            vals &= edt.type((1 << bits) - 1)
+        if kb.party == 1 and not xor_group:
+            np.negative(vals.view(np.int64 if edt == np.uint64 else np.int32), out=vals.view(np.int64 if edt == np.uint64 else np.int32))
+            if bits < edt.itemsize * 8:
+                vals &= edt.type((1 << bits) - 1)
+        vals = vals[:, :, :keep_per_block].reshape(k, -1)[:, :domain]
+        out[idx] = vals.astype(np.uint64, copy=False)
+    return out
+
+
+def _add128(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Limb-wise 128-bit addition on uint32[..., 4]."""
+    out = np.empty(np.broadcast_shapes(a.shape, b.shape), dtype=np.uint32)
+    carry = np.zeros(out.shape[:-1], dtype=np.uint64)
+    for l in range(4):
+        t = a[..., l].astype(np.uint64) + b[..., l].astype(np.uint64) + carry
+        out[..., l] = t.astype(np.uint32)
+        carry = t >> np.uint64(32)
+    return out
+
+
+def _neg128(a: np.ndarray) -> np.ndarray:
+    """Two's-complement negation on uint32[..., 4]."""
+    inv = ~a
+    one = np.zeros_like(a)
+    one[..., 0] = 1
+    return _add128(inv, one)

@@ -242,8 +242,11 @@ def _host_expand(
     levels: int,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Expands each key `levels` levels on the host -> ([K, 2^levels, 4],
-    [K, 2^levels]) in leaf order. Cheap: used only to fill the first packed
-    word (32 lanes) before the device takes over."""
+    [K, 2^levels]) in leaf order, vectorized numpy over the native AES
+    engine. Two consumers with very different envelopes: the device
+    evaluators fill only the first packed word (5 levels) before the TPU
+    takes over, while core/host_eval.py drives ALL levels through it for
+    the CPU-only engine."""
     k = seeds.shape[0]
     seeds = seeds[:, None, :]  # [K, M, 4]
     control = control[:, None]
@@ -714,14 +717,17 @@ def evaluate_at_batch(
     keys: Sequence[DpfKey],
     points: Sequence[int],
     hierarchy_level: int = -1,
-) -> np.ndarray:
+    device_output: bool = False,
+):
     """Evaluates every key at every point on device.
 
     Batched-device equivalent of EvaluateAt
     (/root/reference/dpf/distributed_point_function.h:331-360) — the
     reference evaluates one key at a time; here keys are vmapped and points
     are packed lanes. Returns uint32[K, P, lpe] limb values for scalar
-    outputs, or a tuple of per-component arrays for Tuple outputs.
+    outputs, or a tuple of per-component arrays for Tuple outputs — numpy
+    by default, device-resident jax arrays with device_output=True (for
+    on-device consumers; see PERF.md on the host-link cost).
     """
     v = dpf.validator
     if hierarchy_level < 0:
@@ -768,7 +774,7 @@ def evaluate_at_batch(
             party=batch.party,
             xor_group=xor_group,
         )
-        return np.asarray(out)[:, :p]
+        return out[:, :p] if device_output else np.asarray(out)[:, :p]
     out = _evaluate_points_codec_jit(
         jnp.asarray(seeds),
         jnp.asarray(control0),
@@ -781,5 +787,7 @@ def evaluate_at_batch(
         spec=spec,
         party=batch.party,
     )
-    out = tuple(np.asarray(o)[:, :p] for o in out)
+    out = tuple(
+        (o[:, :p] if device_output else np.asarray(o)[:, :p]) for o in out
+    )
     return out if spec.is_tuple else out[0]

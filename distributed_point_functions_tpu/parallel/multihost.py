@@ -69,7 +69,14 @@ def initialize(
             process_id=process_id,
         )
     except Exception as e:
-        if explicit:
+        cluster_markers = (
+            "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "MEGASCALE_COORDINATOR_ADDRESS",
+            "CLOUD_TPU_TASK_ID", "SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE",
+        )
+        if explicit or any(m in os.environ for m in cluster_markers):
+            # A detected-but-broken cluster must fail loudly: proceeding
+            # single-process would silently duplicate the whole key batch
+            # on every host.
             raise
         _log.info("no distributed cluster detected (%s); single process", e)
 

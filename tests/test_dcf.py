@@ -90,10 +90,10 @@ def test_batch_evaluate_matches_host(bits):
 def test_batch_evaluate_xor_group():
     from distributed_point_functions_tpu.ops import evaluator
 
-    dcf = DistributedComparisonFunction.create(8, XorWrapper(128))
-    alpha, beta = 200, (1 << 127) | 0xABC
+    dcf = DistributedComparisonFunction.create(6, XorWrapper(128))
+    alpha, beta = 40, (1 << 127) | 0xABC
     ka, kb = dcf.generate_keys(alpha, beta)
-    xs = list(range(0, 256, 17)) + [199, 200, 201]
+    xs = list(range(0, 64, 7)) + [39, 40, 41]
     va = evaluator.values_to_numpy(dcf.batch_evaluate([ka], xs), 128)
     vb = evaluator.values_to_numpy(dcf.batch_evaluate([kb], xs), 128)
     for j, x in enumerate(xs):

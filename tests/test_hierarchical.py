@@ -90,9 +90,10 @@ def test_rejects_bad_prefix_sets():
         hierarchical.evaluate_until_batch(bc, 0, [1])
 
 
+@pytest.mark.slow
 def test_sharded_evaluate_until_matches_unsharded():
     """Domain-sharded evaluate_until_batch (mesh=) == the single-device
-    path at every level, and mixed sharded/unsharded steps share state."""
+    path at every level, incl. a sparse level with shared tree indices."""
     from distributed_point_functions_tpu.parallel import sharded
 
     mesh = sharded.make_mesh(2, 4)
@@ -118,10 +119,25 @@ def test_sharded_evaluate_until_matches_unsharded():
     ]
     for a, b in zip(s, u):
         np.testing.assert_array_equal(np.asarray(a), b)
-    # an odd key count gets padded over the 'keys' axis and trimmed; the
-    # sharded step's state then feeds an unsharded continuation
-    c2 = hierarchical.BatchedContext.create(dpf, [ka])
-    hierarchical.evaluate_until_batch(c2, 0, mesh=mesh)
+
+
+def test_sharded_evaluate_until_small_and_mixed_state():
+    """Default-suite slice of the sharded hierarchical path: one sharded
+    step (odd key count -> 'keys' padding) whose state feeds an unsharded
+    continuation."""
+    from distributed_point_functions_tpu.parallel import sharded
+
+    mesh = sharded.make_mesh(2, 4)
+    params = [DpfParameters(d, Int(32)) for d in (3, 6)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(40, [1, 2])
+    p1 = list(range(8))
+    c0 = hierarchical.BatchedContext.create(dpf, [ka])
+    u0 = hierarchical.evaluate_until_batch(c0, 0)
+    u1 = hierarchical.evaluate_until_batch(c0, 1, p1)
+    c1 = hierarchical.BatchedContext.create(dpf, [ka])
+    s0 = hierarchical.evaluate_until_batch(c1, 0, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(s0), u0)
     np.testing.assert_array_equal(
-        hierarchical.evaluate_until_batch(c2, 1, p1), u[1][:1]
+        hierarchical.evaluate_until_batch(c1, 1, p1), u1
     )

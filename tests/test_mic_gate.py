@@ -40,14 +40,14 @@ def test_mic_gate_share_sum(log_group_size):
 
 
 def test_mic_gate_batch_eval_matches_host():
-    log_group_size = 8
+    log_group_size = 6
     n = 1 << log_group_size
-    intervals = [(10, 20), (0, 255), (100, 100)]
+    intervals = [(5, 12), (0, 63), (30, 30)]
     gate = MultipleIntervalContainmentGate.create(log_group_size, intervals)
-    r_in = 77
+    r_in = 17
     r_outs = [5, 6, 7]
     k0, k1 = gate.gen(r_in, r_outs)
-    xs = [0, 9, 10, 20, 21, 100, 255, 128]
+    xs = [0, 4, 5, 12, 13, 30, 63, 32]
     b0 = gate.batch_eval(k0, xs)
     b1 = gate.batch_eval(k1, xs)
     for xi, x in enumerate(xs):
@@ -74,3 +74,44 @@ def test_mic_gate_validation():
     k0, _ = gate.gen(0, [0])
     with pytest.raises(InvalidArgumentError):
         gate.eval(k0, 64)
+
+
+def test_mic_gate_gen_deterministic_golden():
+    """gen() with an injected CounterRng + fixed DCF seeds is fully
+    deterministic — the mockable-randomness contract of SecurePrng
+    (/root/reference/dcf/fss_gates/prng/prng.h:26-36) — and the pinned key
+    fingerprint guards the gate's keygen algebra."""
+    import hashlib
+
+    from distributed_point_functions_tpu.gates.prng import CounterRng
+    from distributed_point_functions_tpu.protos import serialization
+
+    gate = MultipleIntervalContainmentGate.create(8, [(10, 20), (0, 255)])
+    seeds = (0x1111111122222222, 0x3333333344444444)
+
+    def make():
+        return gate.gen(77, [5, 6], prng=CounterRng(seed=b"mic-golden"),
+                        dcf_seeds=seeds)
+
+    k0_a, k1_a = make()
+    k0_b, k1_b = make()
+    assert k0_a == k0_b and k1_a == k1_b, "gen must be deterministic"
+    blob = serialization.serialize_mic_key(
+        k0_a, gate.dcf.dpf.validator.parameters
+    )
+    digest = hashlib.sha256(blob).hexdigest()
+    # Pinned fingerprint: changes only if the keygen algebra or the wire
+    # format changes — both must be deliberate (regenerate the constant
+    # with the printed value after verifying the change).
+    assert digest == (
+        "6bab7a421613563e9e9102569e05c2394839b5757669ad396dcc62bf19cc80ff"
+    ), digest
+    # shares still reconstruct
+    n = 1 << 8
+    for x in [0, 10, 21, 87]:
+        e0 = gate.eval(k0_a, x)
+        e1 = gate.eval(k1_a, x)
+        x_real = (x - 77) % n
+        want = plaintext_mic(x_real, [(10, 20), (0, 255)])
+        for i in range(2):
+            assert (e0[i] + e1[i] - [5, 6][i]) % n == want[i]

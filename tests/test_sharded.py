@@ -12,14 +12,15 @@ RNG = np.random.default_rng(0x5AD)
 
 
 @pytest.mark.parametrize(
-    "mesh_shape",
+    "mesh_shape,mode",
     [
-        (2, 4),
-        pytest.param((1, 8), marks=pytest.mark.slow),
-        pytest.param((4, 2), marks=pytest.mark.slow),
+        ((2, 4), "walk"),  # single traced AES circuit: compiles in seconds
+        pytest.param((2, 4), "expand", marks=pytest.mark.slow),
+        pytest.param((1, 8), "walk", marks=pytest.mark.slow),
+        pytest.param((4, 2), "walk", marks=pytest.mark.slow),
     ],
 )
-def test_sharded_pir_reconstructs(mesh_shape):
+def test_sharded_pir_reconstructs(mesh_shape, mode):
     log_domain = 8
     domain = 1 << log_domain
     dpf = DistributedPointFunction.create(
@@ -36,8 +37,8 @@ def test_sharded_pir_reconstructs(mesh_shape):
         keys_a.append(ka)
         keys_b.append(kb)
 
-    resp_a = sharded.pir_query_batch(dpf, keys_a, db, mesh)
-    resp_b = sharded.pir_query_batch(dpf, keys_b, db, mesh)
+    resp_a = sharded.pir_query_batch(dpf, keys_a, db, mesh, mode=mode)
+    resp_b = sharded.pir_query_batch(dpf, keys_b, db, mesh, mode=mode)
     recovered = resp_a ^ resp_b
     for i, alpha in enumerate(targets):
         np.testing.assert_array_equal(recovered[i], db[alpha], err_msg=f"q{i}")
@@ -84,6 +85,13 @@ def test_sharded_full_domain_matches_unsharded(mesh_shape):
     out = np.asarray(sharded.sharded_full_domain_evaluate(dpf, keys, mesh))
     np.testing.assert_array_equal(out, evaluator.full_domain_evaluate(dpf, keys))
 
+
+@pytest.mark.slow
+def test_sharded_full_domain_intmodn():
+    from distributed_point_functions_tpu.core.value_types import IntModN
+    from distributed_point_functions_tpu.ops import evaluator
+
+    mesh = sharded.make_mesh(2, 4)
     n = (1 << 32) - 5
     dm = DistributedPointFunction.create(DpfParameters(6, IntModN(32, n)))
     keysm = [dm.generate_keys(9, 4242)[0]]

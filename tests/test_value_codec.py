@@ -113,7 +113,11 @@ def test_full_domain_matches_host(value_type, sample):
 
 @pytest.mark.parametrize(
     "value_type,sample",
-    [VALUE_CASES[0], VALUE_CASES[2], VALUE_CASES[5]],
+    [
+        VALUE_CASES[0],
+        VALUE_CASES[2],
+        pytest.param(*VALUE_CASES[5], marks=pytest.mark.slow),
+    ],
     ids=[str(VALUE_CASES[i][0]) for i in (0, 2, 5)],
 )
 def test_evaluate_at_batch_matches_host(value_type, sample):
@@ -141,18 +145,21 @@ def test_evaluate_at_batch_matches_host(value_type, sample):
             assert total == expected
 
 
-def test_intmodn_hierarchy_config3_shape():
+@pytest.mark.parametrize(
+    "num_levels", [2, pytest.param(3, marks=pytest.mark.slow)]
+)
+def test_intmodn_hierarchy_config3_shape(num_levels):
     """BASELINE config 3 in miniature: multi-level IntModN<u64> hierarchy
     evaluated on the device path at every hierarchy level."""
     mod = MOD64
     vt = IntModN(64, mod)
-    params = [DpfParameters(2 + 2 * i, vt) for i in range(3)]
+    params = [DpfParameters(2 + 3 * i, vt) for i in range(num_levels)]
     dpf = DistributedPointFunction.create_incremental(params)
-    alpha = 37
-    betas = [randmod(mod) for _ in range(3)]
+    alpha = 19
+    betas = [randmod(mod) for _ in range(num_levels)]
     ka, kb = dpf.generate_keys_incremental(alpha, betas)
 
-    for level in range(3):
+    for level in range(num_levels):
         spec = value_codec.build_spec(vt, dpf.validator.blocks_needed[level])
         out_a = evaluator.full_domain_evaluate(dpf, [ka], hierarchy_level=level)
         out_b = evaluator.full_domain_evaluate(dpf, [kb], hierarchy_level=level)
