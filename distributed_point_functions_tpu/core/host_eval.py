@@ -25,9 +25,9 @@ from .value_types import Int, XorWrapper
 
 
 def _split_elements_np(blocks: np.ndarray, bits: int) -> np.ndarray:
-    """uint32[..., 4] -> uint32/uint64[..., epb] little-endian elements."""
-    if bits == 128:
-        return blocks[..., None, :]  # [..., 1, 4] limbs (caller handles)
+    """uint32[..., 4] -> uint32/uint64[..., epb] elements; bits <= 64 only
+    (the 128-bit case keeps limb rows and is handled by the caller)."""
+    assert bits <= 64, bits
     if bits == 64:
         v = blocks.view(np.uint64) if blocks.flags["C_CONTIGUOUS"] else np.ascontiguousarray(blocks).view(np.uint64)
         return v.reshape(blocks.shape[:-1] + (2,))
@@ -79,16 +79,37 @@ def full_domain_evaluate_host(
     )
     vc = batch.value_corrections  # uint32[K, epb, 4]
 
+    from .. import native
+
+    use_native_tree = native.available()
+    if use_native_tree:
+        rkl = np.asarray(backend_numpy._PRG_LEFT._round_keys, dtype=np.uint8)
+        rkr = np.asarray(backend_numpy._PRG_RIGHT._round_keys, dtype=np.uint8)
+
     for start in range(0, num_keys, key_chunk):
         idx = np.arange(start, min(start + key_chunk, num_keys))
         kb = batch.take(idx)
         k = idx.shape[0]
-        control0 = np.full(k, bool(kb.party), dtype=bool)
-        # Vectorized doubling expansion, all levels on the host AES engine.
-        seeds, control = evaluator._host_expand(
-            kb.seeds, control0, kb, stop_level
-        )  # [k, 2^stop, 4], [k, 2^stop]
-        n_blocks = seeds.shape[1]
+        if use_native_tree:
+            # Whole tree per key in one native call (no per-level numpy
+            # interleave passes): ~10x the vectorized-numpy expansion.
+            n_blocks = 1 << stop_level
+            seeds = np.empty((k, n_blocks, 4), dtype=np.uint32)
+            control = np.empty((k, n_blocks), dtype=bool)
+            for j in range(k):
+                s, c = native.expand_tree(
+                    rkl, rkr, kb.seeds[j], kb.cw_seeds[j], kb.cw_left[j],
+                    kb.cw_right[j], kb.party, stop_level,
+                )
+                seeds[j] = s
+                control[j] = c.astype(bool)
+        else:
+            control0 = np.full(k, bool(kb.party), dtype=bool)
+            # Vectorized doubling expansion on the numpy oracle.
+            seeds, control = evaluator._host_expand(
+                kb.seeds, control0, kb, stop_level
+            )  # [k, 2^stop, 4], [k, 2^stop]
+            n_blocks = seeds.shape[1]
         hashed = backend_numpy._PRG_VALUE.evaluate_limbs(
             seeds.reshape(k * n_blocks, 4)
         ).reshape(k, n_blocks, 4)

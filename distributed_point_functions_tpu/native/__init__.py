@@ -118,3 +118,47 @@ def mmo_hash_masked_limbs(
         x.shape[0],
     )
     return out
+
+
+def expand_tree(
+    rks_left: np.ndarray,
+    rks_right: np.ndarray,
+    seed_limbs: np.ndarray,  # uint32[4]
+    cw_seed_limbs: np.ndarray,  # uint32[L, 4]
+    cw_left: np.ndarray,  # bool/uint8[L]
+    cw_right: np.ndarray,  # bool/uint8[L]
+    party: int,
+    levels: int,
+):
+    """Full doubling expansion of one key in native code.
+
+    Returns (seeds uint32[2^levels, 4], control uint8[2^levels]) in leaf
+    order — bit-identical to the numpy oracle's level-by-level expansion.
+    """
+    lib = _load()
+    assert lib is not None
+    n = 1 << levels
+    out_seeds = np.empty((n, 4), dtype=np.uint32)
+    out_control = np.empty(n, dtype=np.uint8)
+    scratch = np.empty((n, 4), dtype=np.uint32)
+    if not hasattr(lib, "_expand_tree_typed"):
+        lib.dpf_expand_tree.argtypes = [ctypes.c_void_p] * 6 + [
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib._expand_tree_typed = True
+    ptr = lambda a: np.ascontiguousarray(a).ctypes.data_as(ctypes.c_void_p)
+    lib.dpf_expand_tree(
+        ptr(rks_left),
+        ptr(rks_right),
+        ptr(np.ascontiguousarray(seed_limbs, dtype=np.uint32)),
+        ptr(np.ascontiguousarray(cw_seed_limbs, dtype=np.uint32)),
+        ptr(np.ascontiguousarray(cw_left, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(cw_right, dtype=np.uint8)),
+        int(party),
+        int(levels),
+        out_seeds.ctypes.data_as(ctypes.c_void_p),
+        out_control.ctypes.data_as(ctypes.c_void_p),
+        scratch.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out_seeds, out_control

@@ -127,6 +127,84 @@ void dpf_mmo_hash_masked(const uint8_t* rks_left, const uint8_t* rks_right,
   }
 }
 
+// Full doubling expansion of one key, all levels in native code: seeds/
+// control ping-pong between two buffers; per level every parent hashes
+// under both PRG keys (left child then right child, leaf order), XORs the
+// correction seed where the parent's control bit is set, extracts and
+// corrects the child control bits. The per-level layout matches the
+// framework's host oracle (core/backend_numpy.py) bit for bit.
+//
+//   rks_left/right: 11x16-byte round keys of the two PRG keys
+//   seed0:          16-byte root seed
+//   cw_seeds:       levels x 16 bytes of correction seeds
+//   cw_left/right:  levels bytes (0/1) of control corrections
+//   party:          0/1 (initial control bit)
+//   out_seeds:      (1 << levels) * 16 bytes, leaf order
+//   out_control:    (1 << levels) bytes (0/1)
+//   scratch:        (1 << levels) * 16 bytes working buffer
+void dpf_expand_tree(const uint8_t* rks_left, const uint8_t* rks_right,
+                     const uint8_t* seed0, const uint8_t* cw_seeds,
+                     const uint8_t* cw_left, const uint8_t* cw_right,
+                     int party, int levels, uint8_t* out_seeds,
+                     uint8_t* out_control, uint8_t* scratch) {
+  __m128i rl[11], rr[11];
+  load_rks(rks_left, rl);
+  load_rks(rks_right, rr);
+  const __m128i low_bit = _mm_set_epi64x(0, 1);
+
+  uint8_t* cur = scratch;
+  uint8_t* nxt = out_seeds;
+  // Control bits ping-pong in the out_control buffer's two halves is not
+  // possible (it is only 2^levels bytes); keep a parallel scratch tail of
+  // the seed buffers: control byte i of level l lives in cur_ctl[i].
+  uint8_t* cur_ctl = out_control;          // reused across levels
+  for (int i = 0; i < 16; ++i) cur[i] = seed0[i];
+  cur_ctl[0] = static_cast<uint8_t>(party & 1);
+
+  for (int level = 0; level < levels; ++level) {
+    const size_t parents = static_cast<size_t>(1) << level;
+    const __m128i cw =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(cw_seeds + 16 * level));
+    const uint8_t ccl = cw_left[level], ccr = cw_right[level];
+    // Walk parents in reverse so children can be written into the same
+    // control buffer without clobbering unread parents (child indices
+    // 2i, 2i+1 are >= i).
+    for (size_t i = parents; i-- > 0;) {
+      const __m128i s =
+          sigma(_mm_loadu_si128(reinterpret_cast<const __m128i*>(cur + 16 * i)));
+      const uint8_t t = cur_ctl[i];
+      const __m128i corr = t ? cw : _mm_setzero_si128();
+      __m128i bl = _mm_xor_si128(s, rl[0]);
+      __m128i br = _mm_xor_si128(s, rr[0]);
+      for (int r = 1; r < 10; ++r) {
+        bl = _mm_aesenc_si128(bl, rl[r]);
+        br = _mm_aesenc_si128(br, rr[r]);
+      }
+      bl = _mm_xor_si128(_mm_aesenclast_si128(bl, rl[10]), s);
+      br = _mm_xor_si128(_mm_aesenclast_si128(br, rr[10]), s);
+      bl = _mm_xor_si128(bl, corr);
+      br = _mm_xor_si128(br, corr);
+      uint8_t ctl_l = static_cast<uint8_t>(
+          (_mm_cvtsi128_si64(bl) & 1) ^ (t & ccl));
+      uint8_t ctl_r = static_cast<uint8_t>(
+          (_mm_cvtsi128_si64(br) & 1) ^ (t & ccr));
+      bl = _mm_andnot_si128(low_bit, bl);
+      br = _mm_andnot_si128(low_bit, br);
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i)), bl);
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i + 1)), br);
+      cur_ctl[2 * i] = ctl_l;
+      cur_ctl[2 * i + 1] = ctl_r;
+    }
+    uint8_t* t = cur;
+    cur = nxt;
+    nxt = t;
+  }
+  if (cur != out_seeds) {
+    const size_t bytes = (static_cast<size_t>(1) << levels) * 16;
+    for (size_t i = 0; i < bytes; ++i) out_seeds[i] = cur[i];
+  }
+}
+
 }  // extern "C"
 
 #else  // no AES-NI at compile time
@@ -137,6 +215,9 @@ void dpf_expand_key(const uint8_t*, uint8_t*) {}
 void dpf_mmo_hash(const uint8_t*, const uint8_t*, uint8_t*, size_t) {}
 void dpf_mmo_hash_masked(const uint8_t*, const uint8_t*, const uint8_t*,
                          const uint8_t*, uint8_t*, size_t) {}
+void dpf_expand_tree(const uint8_t*, const uint8_t*, const uint8_t*,
+                     const uint8_t*, const uint8_t*, const uint8_t*, int, int,
+                     uint8_t*, uint8_t*, uint8_t*) {}
 }
 
 #endif

@@ -69,16 +69,31 @@ def initialize(
             process_id=process_id,
         )
     except Exception as e:
-        cluster_markers = (
-            "TPU_WORKER_HOSTNAMES", "TPU_WORKER_ID", "MEGASCALE_COORDINATOR_ADDRESS",
-            "CLOUD_TPU_TASK_ID", "SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE",
-        )
-        if explicit or any(m in os.environ for m in cluster_markers):
-            # A detected-but-broken cluster must fail loudly: proceeding
-            # single-process would silently duplicate the whole key batch
-            # on every host.
+        if explicit or _multi_host_markers_present():
+            # A detected-but-broken multi-host cluster must fail loudly:
+            # proceeding single-process would silently duplicate the whole
+            # key batch on every host.
             raise
         _log.info("no distributed cluster detected (%s); single process", e)
+
+
+def _multi_host_markers_present() -> bool:
+    """True only when the environment indicates MORE THAN ONE host/rank —
+    single-node SLURM/mpirun/TPU-VM runs (value 1 / one hostname) may
+    safely degrade to single-process."""
+    def _gt1(name):
+        try:
+            return int(os.environ[name]) > 1
+        except (KeyError, ValueError):
+            return False
+
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return (
+        _gt1("SLURM_JOB_NUM_NODES")
+        or _gt1("OMPI_COMM_WORLD_SIZE")
+        or len([h for h in hosts.split(",") if h]) > 1
+        or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+    )
 
 
 def local_mesh(
