@@ -26,6 +26,17 @@ _PRG_RIGHT = Aes128FixedKeyHash(constants.PRG_KEY_RIGHT)
 _PRG_VALUE = Aes128FixedKeyHash(constants.PRG_KEY_VALUE)
 
 
+def _native_prg():
+    """Returns the native module iff the AES-NI engine is loadable, else
+    None. The three hot primitives below run entirely inside the native
+    library when it is present (one FFI call per walk/expansion instead of
+    one per AES batch); DPF_TPU_NO_NATIVE=1 keeps them on the pure-numpy
+    oracle, which is the differential baseline (tests/test_native.py)."""
+    from .. import native
+
+    return native if native.available() else None
+
+
 def get_bit(limbs: np.ndarray, bit_index: int) -> np.ndarray:
     """bool[N]: bit `bit_index` of each uint128 in uint32[N, 4]."""
     return ((limbs[:, bit_index // 32] >> np.uint32(bit_index % 32)) & 1).astype(bool)
@@ -52,6 +63,37 @@ def evaluate_seeds(
       correction_controls_{left,right}: bool[L].
     Returns: (uint32[N, 4] seeds, bool[N] control bits).
     """
+    native = _native_prg()
+    if native is not None and len(seeds):
+        return native.evaluate_seeds(
+            _PRG_LEFT._round_keys,
+            _PRG_RIGHT._round_keys,
+            seeds,
+            control_bits,
+            paths,
+            correction_seeds,
+            correction_controls_left,
+            correction_controls_right,
+        )
+    return _evaluate_seeds_numpy(
+        seeds,
+        control_bits,
+        paths,
+        correction_seeds,
+        correction_controls_left,
+        correction_controls_right,
+    )
+
+
+def _evaluate_seeds_numpy(
+    seeds,
+    control_bits,
+    paths,
+    correction_seeds,
+    correction_controls_left,
+    correction_controls_right,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized-numpy walk (the native kernel's differential oracle)."""
     seeds = np.array(seeds, dtype=np.uint32)
     control = np.asarray(control_bits, dtype=bool).copy()
     num_levels = len(correction_seeds)
@@ -91,6 +133,35 @@ def expand_seeds(
     both PRGs, applies the seed/control corrections, and interleaves children
     as [left_0, right_0, left_1, right_1, ...].
     """
+    native = _native_prg()
+    if native is not None and len(seeds):
+        return native.expand_forest(
+            _PRG_LEFT._round_keys,
+            _PRG_RIGHT._round_keys,
+            seeds,
+            control_bits,
+            correction_seeds,
+            correction_controls_left,
+            correction_controls_right,
+            len(correction_seeds),
+        )
+    return _expand_seeds_numpy(
+        seeds,
+        control_bits,
+        correction_seeds,
+        correction_controls_left,
+        correction_controls_right,
+    )
+
+
+def _expand_seeds_numpy(
+    seeds,
+    control_bits,
+    correction_seeds,
+    correction_controls_left,
+    correction_controls_right,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized-numpy doubling expansion (the native kernel's oracle)."""
     seeds = np.array(seeds, dtype=np.uint32)
     control = np.asarray(control_bits, dtype=bool).copy()
     num_levels = len(correction_seeds)
@@ -124,6 +195,15 @@ def hash_expanded_seeds(seeds: np.ndarray, blocks_needed: int) -> np.ndarray:
     Semantics of DistributedPointFunction::HashExpandedSeeds
     (distributed_point_function.cc:500-524). Returns uint32[N, blocks_needed, 4].
     """
+    seeds = np.asarray(seeds, dtype=np.uint32)
+    native = _native_prg()
+    if native is not None and seeds.shape[0] and blocks_needed:
+        return native.value_hash(_PRG_VALUE._round_keys, seeds, blocks_needed)
+    return _hash_expanded_seeds_numpy(seeds, blocks_needed)
+
+
+def _hash_expanded_seeds_numpy(seeds: np.ndarray, blocks_needed: int) -> np.ndarray:
+    """Numpy value-PRG hash (the native kernel's differential oracle)."""
     seeds = np.asarray(seeds, dtype=np.uint32)
     n = seeds.shape[0]
     inputs = np.repeat(seeds[:, None, :], blocks_needed, axis=1)  # [N, bn, 4]

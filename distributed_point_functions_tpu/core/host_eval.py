@@ -113,49 +113,191 @@ def full_domain_evaluate_host(
         hashed = backend_numpy._PRG_VALUE.evaluate_limbs(
             seeds.reshape(k * n_blocks, 4)
         ).reshape(k, n_blocks, 4)
+        vals = correct_scalar_blocks(
+            hashed, control, vc[idx], bits, xor_group, kb.party, keep_per_block
+        )
+        out[idx] = vals[:, :domain]
+    return out
 
-        if bits == 128:
-            corr = vc[idx][:, None, :, :]  # [k, 1, epb, 4]
-            elems = hashed[:, :, None, :]  # [k, blocks, 1, 4]
-            ctrl = control[:, :, None, None]
-            if xor_group:
-                vals = elems ^ np.where(ctrl, corr, np.uint32(0))
-            else:
-                c = np.where(ctrl, corr, np.uint32(0))
-                vals = _add128(elems, c)
-                if kb.party == 1:
-                    vals = _neg128(vals)
-            vals = vals[:, :, :keep_per_block].reshape(k, -1, 4)[:, :domain]
-            out[idx] = vals
-            continue
 
-        elems = _split_elements_np(hashed, bits)  # [k, blocks, epb]
-        epb = elems.shape[-1]
-        # Corrections are stored one 128-bit limb row per element.
-        cw = vc[idx]  # [k, epb, 4]
-        if bits <= 32:
-            corr = (cw[:, :, 0] & np.uint32((1 << bits) - 1)).reshape(k, 1, epb)
-        else:  # 64
-            corr = (
-                cw[:, :, 0].astype(np.uint64)
-                | (cw[:, :, 1].astype(np.uint64) << np.uint64(32))
-            ).reshape(k, 1, epb)
-        ctrl = np.broadcast_to(control[:, :, None], elems.shape)
-        edt = elems.dtype
-        corr_b = np.broadcast_to(corr.astype(edt), elems.shape)
-        # In-place masked group op on the hash buffer view — one pass, no
-        # temporary correction array.
-        vals = np.ascontiguousarray(elems)
-        op = np.bitwise_xor if xor_group else np.add
-        op(vals, corr_b, where=ctrl, out=vals)
-        if bits < 32:
+def correct_scalar_blocks(
+    hashed: np.ndarray,  # uint32[k, n, 4] value-hash blocks
+    control: np.ndarray,  # bool[k, n]
+    vc: np.ndarray,  # uint32[k, epb, 4] value corrections (one limb row/elem)
+    bits: int,
+    xor_group: bool,
+    party: int,
+    keep_per_block: int,
+) -> np.ndarray:
+    """Vectorized value correction + party negation over hash blocks.
+
+    The correction loop of EvaluateUntil
+    (/root/reference/dpf/distributed_point_function.h:776-808): split each
+    block into elements, apply the group op where the control bit is set,
+    negate for party 1, and keep the first `keep_per_block` elements per
+    block. Returns the native element width — uint32[k, n * keep_per_block]
+    for bits <= 32, uint64[...] for bits == 64, uint32[k, ..., 4] limb rows
+    for bits == 128 (a uint64 up-cast here would add a full-size copy to
+    every bulk path for nothing).
+    """
+    k = hashed.shape[0]
+    if bits == 128:
+        corr = vc[:, None, :, :]  # [k, 1, epb, 4]
+        elems = hashed[:, :, None, :]  # [k, blocks, 1, 4]
+        ctrl = control[:, :, None, None]
+        if xor_group:
+            vals = elems ^ np.where(ctrl, corr, np.uint32(0))
+        else:
+            c = np.where(ctrl, corr, np.uint32(0))
+            vals = _add128(elems, c)
+            if party == 1:
+                vals = _neg128(vals)
+        return vals[:, :, :keep_per_block].reshape(k, -1, 4)
+
+    elems = _split_elements_np(hashed, bits)  # [k, blocks, epb]
+    if bits <= 32:
+        corr = (vc[:, :, 0] & np.uint32((1 << bits) - 1))[:, None, :]
+    else:  # 64
+        corr = (
+            vc[:, :, 0].astype(np.uint64)
+            | (vc[:, :, 1].astype(np.uint64) << np.uint64(32))
+        )[:, None, :]
+    ctrl = np.broadcast_to(control[:, :, None], elems.shape)
+    edt = elems.dtype
+    corr_b = np.broadcast_to(corr.astype(edt), elems.shape)
+    # In-place masked group op on the hash buffer view — one pass, no
+    # temporary correction array.
+    vals = np.ascontiguousarray(elems)
+    op = np.bitwise_xor if xor_group else np.add
+    op(vals, corr_b, where=ctrl, out=vals)
+    if bits < 32:
+        vals &= edt.type((1 << bits) - 1)
+    if party == 1 and not xor_group:
+        sview = vals.view(np.int64 if edt == np.uint64 else np.int32)
+        np.negative(sview, out=sview)
+        if bits < edt.itemsize * 8:
             vals &= edt.type((1 << bits) - 1)
-        if kb.party == 1 and not xor_group:
-            np.negative(vals.view(np.int64 if edt == np.uint64 else np.int32), out=vals.view(np.int64 if edt == np.uint64 else np.int32))
-            if bits < edt.itemsize * 8:
-                vals &= edt.type((1 << bits) - 1)
-        vals = vals[:, :, :keep_per_block].reshape(k, -1)[:, :domain]
-        out[idx] = vals.astype(np.uint64, copy=False)
+    return vals[:, :, :keep_per_block].reshape(k, -1)
+
+
+def _points_to_limb_arrays(points, lds: int, log2_epb: int):
+    """points -> (paths uint32[P, 4] of tree indices, block_idx int64[P]).
+
+    Vectorized uint64 fast path when tree indices fit 64 bits; python-int
+    limb split otherwise (DomainToTreeIndex/DomainToBlockIndex,
+    /root/reference/dpf/distributed_point_function.cc:206-221).
+    """
+    from . import uint128
+
+    num = len(points)
+    paths = np.zeros((num, 4), dtype=np.uint32)
+    if isinstance(points, np.ndarray) and points.dtype == uint128.U128:
+        block = uint128.u128_and_low(points, log2_epb).astype(np.int64)
+        paths = uint128.u128_to_limb_rows(uint128.u128_rshift(points, log2_epb))
+        return paths, block
+    if lds - log2_epb <= 64 and lds <= 64:
+        arr = np.asarray(points, dtype=np.uint64)
+        tree = arr >> np.uint64(log2_epb)
+        block = (arr & np.uint64((1 << log2_epb) - 1)).astype(np.int64)
+        paths[:, 0] = (tree & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        paths[:, 1] = (tree >> np.uint64(32)).astype(np.uint32)
+        return paths, block
+    block = np.empty(num, dtype=np.int64)
+    mask = (1 << log2_epb) - 1
+    for i, p in enumerate(points):
+        p = int(p)
+        block[i] = p & mask
+        t = p >> log2_epb
+        paths[i, 0] = t & 0xFFFFFFFF
+        paths[i, 1] = (t >> 32) & 0xFFFFFFFF
+        paths[i, 2] = (t >> 64) & 0xFFFFFFFF
+        paths[i, 3] = (t >> 96) & 0xFFFFFFFF
+    return paths, block
+
+
+def evaluate_at_host(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    points,
+    hierarchy_level: int = -1,
+) -> np.ndarray:
+    """Batched EvaluateAt of K keys x P points, entirely on the host.
+
+    The vectorized native-engine analog of EvaluateAtImpl
+    (/root/reference/dpf/distributed_point_function.h:839-1010) for scalar
+    Int/XorWrapper outputs: one native tree walk per key over all points,
+    one value-hash pass, vectorized correction. Returns uint64[K, P]
+    (uint32[K, P, 4] limb rows for 128-bit types). Bit-identical to
+    dpf.evaluate_at / ops.evaluator.evaluate_at_batch.
+    """
+    from ..ops import evaluator
+
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    if not isinstance(value_type, (Int, XorWrapper)):
+        raise InvalidArgumentError(
+            "evaluate_at_host supports Int/XorWrapper outputs; use "
+            "dpf.evaluate_at or ops/evaluator for other types"
+        )
+    bits = value_type.bitsize
+    xor_group = isinstance(value_type, XorWrapper)
+    lds = v.parameters[hierarchy_level].log_domain_size
+    epb = value_type.elements_per_block()
+    log2_epb = epb.bit_length() - 1
+    blocks_needed = v.blocks_needed[hierarchy_level]
+
+    batch = evaluator.KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    num_keys = len(keys)
+    num_points = len(points)
+    paths, block_idx = _points_to_limb_arrays(points, lds, log2_epb)
+
+    out = (
+        np.empty((num_keys, num_points), dtype=np.uint64)
+        if bits <= 64
+        else np.empty((num_keys, num_points, 4), dtype=np.uint32)
+    )
+    ctl0 = np.full(num_points, bool(batch.party), dtype=bool)
+    for j in range(num_keys):
+        seeds0 = np.broadcast_to(batch.seeds[j], (num_points, 4))
+        seeds, control = backend_numpy.evaluate_seeds(
+            seeds0,
+            ctl0,
+            paths,
+            batch.cw_seeds[j],
+            batch.cw_left[j],
+            batch.cw_right[j],
+        )
+        hashed = backend_numpy.hash_expanded_seeds(seeds, blocks_needed)
+        vc = batch.value_corrections[j : j + 1]  # [1, epb, 4]
+        if bits == 128:
+            vals = correct_scalar_blocks(
+                hashed[None, :, 0, :], control[None, :], vc, bits, xor_group,
+                batch.party, 1,
+            )
+            out[j] = vals[0]
+            continue
+        # Split the hash block into elements and keep only each point's
+        # block_index element, correcting with that element's correction.
+        elems = _split_elements_np(hashed[:, 0, :], bits)  # [P, epb]
+        sel = np.take_along_axis(elems, block_idx[:, None], axis=1)[:, 0]
+        if bits <= 32:
+            corr_e = vc[0, :, 0] & np.uint32((1 << bits) - 1)
+        else:
+            corr_e = vc[0, :, 0].astype(np.uint64) | (
+                vc[0, :, 1].astype(np.uint64) << np.uint64(32)
+            )
+        corr = corr_e[block_idx].astype(sel.dtype)
+        op = np.bitwise_xor if xor_group else np.add
+        vals = np.where(control, op(sel, corr), sel)
+        if bits < 32:
+            vals &= vals.dtype.type((1 << bits) - 1)
+        if batch.party == 1 and not xor_group:
+            vals = (-vals.astype(np.int64)).astype(np.uint64)
+            if bits < 64:
+                vals &= np.uint64((1 << bits) - 1)
+        out[j] = vals.astype(np.uint64, copy=False)
     return out
 
 

@@ -78,3 +78,162 @@ def sigma(x: int) -> int:
     """
     hi, lo = high64(x), low64(x)
     return make_uint128(hi ^ lo, hi)
+
+
+# ---------------------------------------------------------------------------
+# Vectorized uint128 arrays (structured hi/lo dtype)
+# ---------------------------------------------------------------------------
+#
+# Bulk host paths (hierarchical prefix bookkeeping over 2^64..2^128 domains,
+# 128-bit point batches) need millions of 128-bit values with numpy-speed
+# compare/sort/searchsorted/shift — python-int object arrays are 30-100x too
+# slow there. U128 is a two-field structured dtype ordered (hi, lo), so
+# numpy's lexicographic structured comparison IS the numeric order and
+# np.unique / np.sort / np.searchsorted work unchanged.
+
+U128 = np.dtype([("hi", "<u8"), ("lo", "<u8")])
+
+
+def u128_array(xs) -> np.ndarray:
+    """Iterable of Python ints (or uint64 array) -> U128[N]."""
+    if isinstance(xs, np.ndarray) and xs.dtype == U128:
+        return xs
+    if isinstance(xs, np.ndarray) and xs.dtype != object:
+        out = np.zeros(xs.shape[0], dtype=U128)
+        out["lo"] = xs.astype(np.uint64)
+        return out
+    xs = [int(x) for x in xs]
+    out = np.empty(len(xs), dtype=U128)
+    out["hi"] = np.array([x >> 64 for x in xs], dtype=np.uint64)
+    out["lo"] = np.array([x & MASK64 for x in xs], dtype=np.uint64)
+    return out
+
+
+def u128_to_ints(a: np.ndarray) -> list:
+    """U128[N] -> list of Python ints."""
+    hi = a["hi"].tolist()
+    lo = a["lo"].tolist()
+    return [(h << 64) | l for h, l in zip(hi, lo)]
+
+
+def u128_rshift(a: np.ndarray, k: int) -> np.ndarray:
+    out = np.empty(a.shape, dtype=U128)
+    if k == 0:
+        out["hi"], out["lo"] = a["hi"], a["lo"]
+    elif k >= 128:
+        out["hi"] = 0
+        out["lo"] = 0
+    elif k >= 64:
+        out["hi"] = 0
+        out["lo"] = a["hi"] >> np.uint64(k - 64)
+    else:
+        out["lo"] = (a["lo"] >> np.uint64(k)) | (a["hi"] << np.uint64(64 - k))
+        out["hi"] = a["hi"] >> np.uint64(k)
+    return out
+
+
+def u128_lshift(a: np.ndarray, k: int) -> np.ndarray:
+    out = np.empty(a.shape, dtype=U128)
+    if k == 0:
+        out["hi"], out["lo"] = a["hi"], a["lo"]
+    elif k >= 128:
+        out["hi"] = 0
+        out["lo"] = 0
+    elif k >= 64:
+        out["hi"] = a["lo"] << np.uint64(k - 64)
+        out["lo"] = 0
+    else:
+        out["hi"] = (a["hi"] << np.uint64(k)) | (a["lo"] >> np.uint64(64 - k))
+        out["lo"] = a["lo"] << np.uint64(k)
+    return out
+
+
+def u128_add_u64(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """U128[N] + uint64[N] (element-wise, mod 2^128)."""
+    out = np.empty(a.shape, dtype=U128)
+    b = np.asarray(b, dtype=np.uint64)
+    out["lo"] = a["lo"] + b
+    out["hi"] = a["hi"] + (out["lo"] < b)
+    return out
+
+
+def u128_and_low(a: np.ndarray, k: int) -> np.ndarray:
+    """a & ((1 << k) - 1) as uint64 (requires k <= 64)."""
+    assert k <= 64, k
+    if k == 64:
+        return a["lo"].copy()
+    return a["lo"] & np.uint64((1 << k) - 1)
+
+
+def u128_to_limb_rows(a: np.ndarray) -> np.ndarray:
+    """U128[N] -> uint32[N, 4] little-endian limb rows (the AES layout)."""
+    out = np.empty((a.shape[0], 4), dtype=np.uint32)
+    out[:, 0] = (a["lo"] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out[:, 1] = (a["lo"] >> np.uint64(32)).astype(np.uint32)
+    out[:, 2] = (a["hi"] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out[:, 3] = (a["hi"] >> np.uint64(32)).astype(np.uint32)
+    return out
+
+
+def u128_gt(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Element-wise a > b for U128 arrays (numpy has no void-dtype ufunc
+    loop for ordered comparisons; ==/!=, sort, unique and searchsorted all
+    work natively on the structured dtype)."""
+    return (a["hi"] > b["hi"]) | ((a["hi"] == b["hi"]) & (a["lo"] > b["lo"]))
+
+
+def u128_searchsorted(haystack: np.ndarray, needles: np.ndarray) -> np.ndarray:
+    """np.searchsorted(haystack, needles, 'left') for U128 arrays.
+
+    numpy's structured-dtype searchsorted goes through python-level void
+    comparisons (~20x slower than a uint64 search — it dominated the 2^128
+    hierarchical profile). This splits into two uint64 phases: binary search
+    on the hi words, then rank-by-lo within each equal-hi run, vectorized
+    either as a bounded scan (short runs: the deep-level case, runs of 1-2)
+    or per-run uint64 searchsorted (few long runs: the just-past-64-bit
+    case). Both arrays must be sorted; needles additionally sorted so runs
+    are contiguous.
+    """
+    n_hay, n_needle = haystack.shape[0], needles.shape[0]
+    out = np.zeros(n_needle, dtype=np.int64)
+    if n_hay == 0 or n_needle == 0:
+        return out
+    hay_hi, hay_lo = haystack["hi"], haystack["lo"]
+    ndl_hi, ndl_lo = needles["hi"], needles["lo"]
+    if not hay_hi.any() and not ndl_hi.any():
+        return np.searchsorted(hay_lo, ndl_lo).astype(np.int64)
+    left = np.searchsorted(hay_hi, ndl_hi, "left").astype(np.int64)
+    right = np.searchsorted(hay_hi, ndl_hi, "right").astype(np.int64)
+    runlen = right - left
+    maxrun = int(runlen.max())
+    pos = left
+    short = runlen <= 8
+    if short.any():
+        # Bounded linear scan: advance past haystack entries with smaller lo.
+        idx = np.flatnonzero(short)
+        p = pos[idx]
+        r = right[idx]
+        lo = ndl_lo[idx]
+        # Up to `runlen` advances: a needle greater than every run entry
+        # must land at the run's right edge.
+        for _ in range(min(maxrun, 8)):
+            at = np.minimum(p, n_hay - 1)
+            step = (p < r) & (hay_lo[at] < lo)
+            if not step.any():
+                break
+            p += step
+        pos[idx] = p
+    if not short.all():
+        # Long runs: one uint64 searchsorted per distinct needle-hi run.
+        sel = np.flatnonzero(~short)
+        starts = sel[np.r_[True, ndl_hi[sel][1:] != ndl_hi[sel][:-1]]]
+        for s in starts:
+            e = s
+            while e < n_needle and ndl_hi[e] == ndl_hi[s]:
+                e += 1
+            seg = np.arange(s, e)
+            seg = seg[~short[seg]]
+            if seg.size:
+                lo_run = hay_lo[left[s] : right[s]]
+                pos[seg] = left[s] + np.searchsorted(lo_run, ndl_lo[seg])
+    return pos

@@ -63,6 +63,17 @@ def _load():
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
             ]
+            lib.dpf_evaluate_seeds.argtypes = [ctypes.c_void_p] * 8 + [
+                ctypes.c_size_t, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.dpf_expand_forest.argtypes = [ctypes.c_void_p] * 7 + [
+                ctypes.c_size_t, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ]
+            lib.dpf_value_hash.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t,
+                ctypes.c_int, ctypes.c_void_p,
+            ]
             _lib = lib
         except Exception:
             _lib = None
@@ -116,6 +127,107 @@ def mmo_hash_masked_limbs(
         m.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p),
         x.shape[0],
+    )
+    return out
+
+
+def evaluate_seeds(
+    rks_left: np.ndarray,
+    rks_right: np.ndarray,
+    seeds: np.ndarray,  # uint32[N, 4]
+    control: np.ndarray,  # bool/uint8[N]
+    paths: np.ndarray,  # uint32[N, 4]
+    cw_seed_limbs: np.ndarray,  # uint32[L, 4]
+    cw_left: np.ndarray,  # bool/uint8[L]
+    cw_right: np.ndarray,  # bool/uint8[L]
+):
+    """Native batched point-evaluation walk (EvaluateSeeds).
+
+    Returns (uint32[N, 4] seeds, bool[N] control) — bit-identical to
+    core/backend_numpy.evaluate_seeds.
+    """
+    lib = _load()
+    assert lib is not None
+    x = np.ascontiguousarray(seeds, dtype=np.uint32)
+    n = x.shape[0]
+    levels = len(cw_seed_limbs)
+    out_seeds = np.empty_like(x)
+    out_control = np.empty(n, dtype=np.uint8)
+    ptr = lambda a: np.ascontiguousarray(a).ctypes.data_as(ctypes.c_void_p)
+    lib.dpf_evaluate_seeds(
+        ptr(rks_left),
+        ptr(rks_right),
+        x.ctypes.data_as(ctypes.c_void_p),
+        ptr(np.ascontiguousarray(control, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(paths, dtype=np.uint32)),
+        ptr(np.ascontiguousarray(cw_seed_limbs, dtype=np.uint32)),
+        ptr(np.ascontiguousarray(cw_left, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(cw_right, dtype=np.uint8)),
+        n,
+        levels,
+        out_seeds.ctypes.data_as(ctypes.c_void_p),
+        out_control.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out_seeds, out_control.astype(bool)
+
+
+def expand_forest(
+    rks_left: np.ndarray,
+    rks_right: np.ndarray,
+    seeds: np.ndarray,  # uint32[N, 4] roots
+    control: np.ndarray,  # bool/uint8[N]
+    cw_seed_limbs: np.ndarray,  # uint32[L, 4]
+    cw_left: np.ndarray,
+    cw_right: np.ndarray,
+    levels: int,
+):
+    """Doubling expansion of N roots by `levels` levels (ExpandSeeds).
+
+    Returns (uint32[N << levels, 4], bool[N << levels]) in the interleaved
+    per-level child order — bit-identical to backend_numpy.expand_seeds.
+    """
+    lib = _load()
+    assert lib is not None
+    x = np.ascontiguousarray(seeds, dtype=np.uint32)
+    n = x.shape[0]
+    total = n << levels
+    out_seeds = np.empty((total, 4), dtype=np.uint32)
+    out_control = np.empty(total, dtype=np.uint8)
+    scratch = np.empty((total, 4), dtype=np.uint32)
+    ptr = lambda a: np.ascontiguousarray(a).ctypes.data_as(ctypes.c_void_p)
+    lib.dpf_expand_forest(
+        ptr(rks_left),
+        ptr(rks_right),
+        x.ctypes.data_as(ctypes.c_void_p),
+        ptr(np.ascontiguousarray(control, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(cw_seed_limbs, dtype=np.uint32)),
+        ptr(np.ascontiguousarray(cw_left, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(cw_right, dtype=np.uint8)),
+        n,
+        int(levels),
+        out_seeds.ctypes.data_as(ctypes.c_void_p),
+        out_control.ctypes.data_as(ctypes.c_void_p),
+        scratch.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out_seeds, out_control.astype(bool)
+
+
+def value_hash(round_keys: np.ndarray, in_limbs: np.ndarray, blocks_needed: int):
+    """MMO hash of in[i] + j for j < blocks_needed (HashExpandedSeeds).
+
+    Returns uint32[N, blocks_needed, 4].
+    """
+    lib = _load()
+    assert lib is not None
+    x = np.ascontiguousarray(in_limbs, dtype=np.uint32)
+    n = x.shape[0]
+    out = np.empty((n, blocks_needed, 4), dtype=np.uint32)
+    lib.dpf_value_hash(
+        np.ascontiguousarray(round_keys).ctypes.data_as(ctypes.c_void_p),
+        x.ctypes.data_as(ctypes.c_void_p),
+        n,
+        int(blocks_needed),
+        out.ctypes.data_as(ctypes.c_void_p),
     )
     return out
 

@@ -205,6 +205,257 @@ void dpf_expand_tree(const uint8_t* rks_left, const uint8_t* rks_right,
   }
 }
 
+// Batched point-evaluation walk: n seeds descend `levels` tree levels, each
+// along its own 128-bit path (the EvaluateAt hot loop,
+// /root/reference/dpf/internal/evaluate_prg_hwy.cc:205-304). Per level the
+// PRG key is selected by the path bit (rk = rl ^ (rdiff & mask), the same
+// per-lane blend the reference does in Highway registers), the correction
+// seed is XORed where the control bit is set, and the new control bit is
+// extracted from the seed LSB and corrected. Seeds stay in registers across
+// all levels, 8 lanes pipelined to keep the AES units full.
+//
+//   seeds/paths: n x 16 bytes; ctl: n bytes (0/1), updated in place in the
+//   output buffers; cw_seeds: levels x 16; cw_left/right: levels bytes.
+//   Path bit for level l is bit (levels - 1 - l) of the path (bits >= 128
+//   read as 0).
+void dpf_evaluate_seeds(const uint8_t* rks_left, const uint8_t* rks_right,
+                        const uint8_t* seeds_in, const uint8_t* ctl_in,
+                        const uint8_t* paths, const uint8_t* cw_seeds,
+                        const uint8_t* cw_left, const uint8_t* cw_right,
+                        size_t n, int levels, uint8_t* seeds_out,
+                        uint8_t* ctl_out) {
+  __m128i rl[11], rdiff[11];
+  load_rks(rks_left, rl);
+  {
+    __m128i rr[11];
+    load_rks(rks_right, rr);
+    for (int i = 0; i < 11; ++i) rdiff[i] = _mm_xor_si128(rl[i], rr[i]);
+  }
+  const __m128i low_bit = _mm_set_epi64x(0, 1);
+
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i s[8];
+    uint64_t path_lo[8], path_hi[8];
+    uint8_t t[8];
+    for (int j = 0; j < 8; ++j) {
+      s[j] = _mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(seeds_in + 16 * (i + j)));
+      const uint64_t* p =
+          reinterpret_cast<const uint64_t*>(paths + 16 * (i + j));
+      path_lo[j] = p[0];
+      path_hi[j] = p[1];
+      t[j] = ctl_in[i + j];
+    }
+    for (int level = 0; level < levels; ++level) {
+      const int bit_index = levels - 1 - level;
+      const __m128i cw = _mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(cw_seeds + 16 * level));
+      const uint8_t ccl = cw_left[level], ccr = cw_right[level];
+      __m128i m[8], sg[8], b[8];
+      uint8_t bit[8];
+      for (int j = 0; j < 8; ++j) {
+        bit[j] =
+            (bit_index >= 128)
+                ? 0
+                : static_cast<uint8_t>(
+                      ((bit_index < 64 ? path_lo[j] : path_hi[j]) >>
+                       (bit_index & 63)) &
+                      1);
+        m[j] = _mm_set1_epi8(bit[j] ? static_cast<char>(0xFF) : 0);
+        sg[j] = sigma(s[j]);
+        b[j] = _mm_xor_si128(
+            sg[j], _mm_xor_si128(rl[0], _mm_and_si128(rdiff[0], m[j])));
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int j = 0; j < 8; ++j)
+          b[j] = _mm_aesenc_si128(
+              b[j], _mm_xor_si128(rl[r], _mm_and_si128(rdiff[r], m[j])));
+      for (int j = 0; j < 8; ++j) {
+        b[j] = _mm_xor_si128(
+            _mm_aesenclast_si128(
+                b[j], _mm_xor_si128(rl[10], _mm_and_si128(rdiff[10], m[j]))),
+            sg[j]);
+        if (t[j]) b[j] = _mm_xor_si128(b[j], cw);
+        uint8_t nt = static_cast<uint8_t>(_mm_cvtsi128_si64(b[j]) & 1);
+        t[j] = static_cast<uint8_t>(nt ^ (t[j] & (bit[j] ? ccr : ccl)));
+        s[j] = _mm_andnot_si128(low_bit, b[j]);
+      }
+    }
+    for (int j = 0; j < 8; ++j) {
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(seeds_out + 16 * (i + j)),
+                       s[j]);
+      ctl_out[i + j] = t[j];
+    }
+  }
+  for (; i < n; ++i) {  // scalar tail
+    __m128i s =
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(seeds_in + 16 * i));
+    const uint64_t* p = reinterpret_cast<const uint64_t*>(paths + 16 * i);
+    uint8_t t = ctl_in[i];
+    for (int level = 0; level < levels; ++level) {
+      const int bit_index = levels - 1 - level;
+      const uint8_t bit =
+          (bit_index >= 128)
+              ? 0
+              : static_cast<uint8_t>(
+                    ((bit_index < 64 ? p[0] : p[1]) >> (bit_index & 63)) & 1);
+      const __m128i m = _mm_set1_epi8(bit ? static_cast<char>(0xFF) : 0);
+      const __m128i sg = sigma(s);
+      __m128i b = _mm_xor_si128(
+          sg, _mm_xor_si128(rl[0], _mm_and_si128(rdiff[0], m)));
+      for (int r = 1; r < 10; ++r)
+        b = _mm_aesenc_si128(
+            b, _mm_xor_si128(rl[r], _mm_and_si128(rdiff[r], m)));
+      b = _mm_xor_si128(
+          _mm_aesenclast_si128(
+              b, _mm_xor_si128(rl[10], _mm_and_si128(rdiff[10], m))),
+          sg);
+      if (t)
+        b = _mm_xor_si128(b, _mm_loadu_si128(reinterpret_cast<const __m128i*>(
+                                 cw_seeds + 16 * level)));
+      uint8_t nt = static_cast<uint8_t>(_mm_cvtsi128_si64(b) & 1);
+      t = static_cast<uint8_t>(nt ^ (t & (bit ? cw_right[level] : cw_left[level])));
+      s = _mm_andnot_si128(low_bit, b);
+    }
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(seeds_out + 16 * i), s);
+    ctl_out[i] = t;
+  }
+}
+
+// Doubling expansion of a *forest*: n root seeds expand `levels` levels to
+// n << levels leaves (root j's leaves land contiguously at
+// [j << levels, (j+1) << levels)), sharing one set of correction words —
+// the ExpandSeeds hot loop (distributed_point_function.cc:271-349) for a
+// batch of prefix seeds inside one key. Children of node i go to 2i and
+// 2i+1, so the per-level layout is bit-identical to the host oracle's
+// interleaved [l0, r0, l1, r1, ...]. 4 parents (8 AES streams) pipelined.
+void dpf_expand_forest(const uint8_t* rks_left, const uint8_t* rks_right,
+                       const uint8_t* seeds0, const uint8_t* ctl0,
+                       const uint8_t* cw_seeds, const uint8_t* cw_left,
+                       const uint8_t* cw_right, size_t n, int levels,
+                       uint8_t* out_seeds, uint8_t* out_control,
+                       uint8_t* scratch) {
+  __m128i rl[11], rr[11];
+  load_rks(rks_left, rl);
+  load_rks(rks_right, rr);
+  const __m128i low_bit = _mm_set_epi64x(0, 1);
+
+  // Ping-pong so the final level lands in out_seeds.
+  uint8_t* cur = (levels % 2 == 0) ? out_seeds : scratch;
+  uint8_t* nxt = (levels % 2 == 0) ? scratch : out_seeds;
+  for (size_t i = 0; i < 16 * n; ++i) cur[i] = seeds0[i];
+  uint8_t* ctl = out_control;  // reused across levels (children >= parent)
+  for (size_t i = 0; i < n; ++i) ctl[i] = ctl0[i];
+
+  for (int level = 0; level < levels; ++level) {
+    const size_t parents = n << level;
+    const __m128i cw = _mm_loadu_si128(
+        reinterpret_cast<const __m128i*>(cw_seeds + 16 * level));
+    const uint8_t ccl = cw_left[level], ccr = cw_right[level];
+    // Reverse walk so children can share the control buffer with parents.
+    size_t i = parents;
+    while (i >= 4) {
+      i -= 4;
+      __m128i sg[4], bl[4], br[4];
+      uint8_t t[4];
+      for (int j = 0; j < 4; ++j) {
+        sg[j] = sigma(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(cur + 16 * (i + j))));
+        t[j] = ctl[i + j];
+        bl[j] = _mm_xor_si128(sg[j], rl[0]);
+        br[j] = _mm_xor_si128(sg[j], rr[0]);
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int j = 0; j < 4; ++j) {
+          bl[j] = _mm_aesenc_si128(bl[j], rl[r]);
+          br[j] = _mm_aesenc_si128(br[j], rr[r]);
+        }
+      for (int j = 0; j < 4; ++j) {
+        const __m128i corr = t[j] ? cw : _mm_setzero_si128();
+        bl[j] = _mm_xor_si128(
+            _mm_xor_si128(_mm_aesenclast_si128(bl[j], rl[10]), sg[j]), corr);
+        br[j] = _mm_xor_si128(
+            _mm_xor_si128(_mm_aesenclast_si128(br[j], rr[10]), sg[j]), corr);
+        const size_t c = 2 * (i + j);
+        uint8_t ctl_l =
+            static_cast<uint8_t>((_mm_cvtsi128_si64(bl[j]) & 1) ^ (t[j] & ccl));
+        uint8_t ctl_r =
+            static_cast<uint8_t>((_mm_cvtsi128_si64(br[j]) & 1) ^ (t[j] & ccr));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * c),
+                         _mm_andnot_si128(low_bit, bl[j]));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (c + 1)),
+                         _mm_andnot_si128(low_bit, br[j]));
+        ctl[c] = ctl_l;
+        ctl[c + 1] = ctl_r;
+      }
+    }
+    while (i-- > 0) {
+      const __m128i sg = sigma(
+          _mm_loadu_si128(reinterpret_cast<const __m128i*>(cur + 16 * i)));
+      const uint8_t t = ctl[i];
+      const __m128i corr = t ? cw : _mm_setzero_si128();
+      __m128i bl = _mm_xor_si128(sg, rl[0]);
+      __m128i br = _mm_xor_si128(sg, rr[0]);
+      for (int r = 1; r < 10; ++r) {
+        bl = _mm_aesenc_si128(bl, rl[r]);
+        br = _mm_aesenc_si128(br, rr[r]);
+      }
+      bl = _mm_xor_si128(
+          _mm_xor_si128(_mm_aesenclast_si128(bl, rl[10]), sg), corr);
+      br = _mm_xor_si128(
+          _mm_xor_si128(_mm_aesenclast_si128(br, rr[10]), sg), corr);
+      uint8_t ctl_l = static_cast<uint8_t>((_mm_cvtsi128_si64(bl) & 1) ^ (t & ccl));
+      uint8_t ctl_r = static_cast<uint8_t>((_mm_cvtsi128_si64(br) & 1) ^ (t & ccr));
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i)),
+                       _mm_andnot_si128(low_bit, bl));
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i + 1)),
+                       _mm_andnot_si128(low_bit, br));
+      ctl[2 * i] = ctl_l;
+      ctl[2 * i + 1] = ctl_r;
+    }
+    uint8_t* tmp = cur;
+    cur = nxt;
+    nxt = tmp;
+  }
+}
+
+// Value-PRG hash with block offsets: out[i*bn + j] = MMO(in[i] + j) for
+// j < bn (HashExpandedSeeds, distributed_point_function.cc:500-524) — the
+// uint128 + j addition and the hash in one native pass.
+void dpf_value_hash(const uint8_t* rks_bytes, const uint8_t* in, size_t n,
+                    int blocks_needed, uint8_t* out) {
+  __m128i rks[11];
+  load_rks(rks_bytes, rks);
+  const size_t total = n * static_cast<size_t>(blocks_needed);
+  size_t w = 0;  // flat output index
+  __m128i s[8];
+  size_t done = 0;
+  while (done < total) {
+    int lanes = 0;
+    for (; lanes < 8 && done + lanes < total; ++lanes) {
+      const size_t flat = done + lanes;
+      const size_t i = flat / blocks_needed;
+      const uint64_t j = static_cast<uint64_t>(flat % blocks_needed);
+      const uint64_t* p = reinterpret_cast<const uint64_t*>(in + 16 * i);
+      uint64_t lo = p[0] + j;
+      uint64_t hi = p[1] + (lo < p[0] ? 1 : 0);
+      s[lanes] = sigma(_mm_set_epi64x(static_cast<long long>(hi),
+                                      static_cast<long long>(lo)));
+    }
+    __m128i b[8];
+    for (int j = 0; j < lanes; ++j) b[j] = _mm_xor_si128(s[j], rks[0]);
+    for (int r = 1; r < 10; ++r)
+      for (int j = 0; j < lanes; ++j) b[j] = _mm_aesenc_si128(b[j], rks[r]);
+    for (int j = 0; j < lanes; ++j) {
+      b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rks[10]), s[j]);
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * w), b[j]);
+      ++w;
+    }
+    done += lanes;
+  }
+}
+
 }  // extern "C"
 
 #else  // no AES-NI at compile time
@@ -218,6 +469,15 @@ void dpf_mmo_hash_masked(const uint8_t*, const uint8_t*, const uint8_t*,
 void dpf_expand_tree(const uint8_t*, const uint8_t*, const uint8_t*,
                      const uint8_t*, const uint8_t*, const uint8_t*, int, int,
                      uint8_t*, uint8_t*, uint8_t*) {}
+void dpf_evaluate_seeds(const uint8_t*, const uint8_t*, const uint8_t*,
+                        const uint8_t*, const uint8_t*, const uint8_t*,
+                        const uint8_t*, const uint8_t*, size_t, int, uint8_t*,
+                        uint8_t*) {}
+void dpf_expand_forest(const uint8_t*, const uint8_t*, const uint8_t*,
+                       const uint8_t*, const uint8_t*, const uint8_t*,
+                       const uint8_t*, size_t, int, uint8_t*, uint8_t*,
+                       uint8_t*) {}
+void dpf_value_hash(const uint8_t*, const uint8_t*, size_t, int, uint8_t*) {}
 }
 
 #endif

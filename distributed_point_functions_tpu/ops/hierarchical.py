@@ -46,10 +46,28 @@ class BatchedContext:
     dpf: DistributedPointFunction
     keys: List[DpfKey]
     previous_hierarchy_level: int = -1
-    # Expansion state at previous_hierarchy_level (None before first call):
-    prefixes: Optional[np.ndarray] = None  # object/uint64[Np] sorted unique
-    seeds: Optional[jnp.ndarray] = None  # uint32[K, Np, 4] leaf-ordered
-    control: Optional[jnp.ndarray] = None  # uint32[K, Np] 0/1
+    # Expansion state at previous_hierarchy_level (None before first call).
+    # The stored prefix set is always "every parent's full child block", so
+    # it is represented implicitly: sorted parent tree indices + the number
+    # of levels each was expanded. Child prefix (p << child_levels) + leaf
+    # lives at row position(p) * 2^child_levels + leaf of seeds/control —
+    # positions are arithmetic, no materialized 2^L-times-larger array.
+    parent_tree: Optional[np.ndarray] = None  # uint64/U128[Np] sorted unique
+    child_levels: int = 0
+    seeds: Optional[jnp.ndarray] = None  # uint32[K, Np << L, 4] leaf-ordered
+    control: Optional[jnp.ndarray] = None  # uint32[K, Np << L] 0/1
+
+    def _child_prefixes(self) -> Optional[list]:
+        """Materialized child tree indices (python ints) — serialization."""
+        if self.parent_tree is None:
+            return None
+        parents = (
+            uint128.u128_to_ints(self.parent_tree)
+            if self.parent_tree.dtype == uint128.U128
+            else [int(p) for p in self.parent_tree]
+        )
+        n = 1 << self.child_levels
+        return [(p << self.child_levels) + leaf for p in parents for leaf in range(n)]
 
     @classmethod
     def create(
@@ -69,14 +87,16 @@ class BatchedContext:
         v = self.dpf.validator
         out = []
         seeds_np = None if self.seeds is None else np.asarray(self.seeds)
+        prefix_ints_all = self._child_prefixes()
         for i, key in enumerate(self.keys):
             partials = []
-            if self.prefixes is not None:
+            prefix_ints = prefix_ints_all
+            if prefix_ints is not None:
                 control_bits = np.asarray(self.control[i]).astype(bool)
                 seed_ints = uint128.limbs_to_array(
-                    seeds_np[i][: len(self.prefixes)]
+                    seeds_np[i][: len(prefix_ints)]
                 )
-                for j, prefix in enumerate(self.prefixes):
+                for j, prefix in enumerate(prefix_ints):
                     partials.append(
                         PartialEvaluation(
                             prefix=int(prefix),
@@ -105,11 +125,24 @@ def _pack_mask_device(bits: jnp.ndarray) -> jnp.ndarray:
 
 
 def _as_prefix_array(prefixes: Sequence[int], log_domain: int) -> np.ndarray:
-    """Unique sorted prefix array; uint64 fast path below 64-bit domains."""
+    """Unique sorted prefix array; uint64 below 64-bit domains, vectorized
+    U128 (hi/lo structured, numerically ordered) at and above — python-int
+    object arrays are 30-100x too slow for 2^20-prefix bookkeeping."""
     if log_domain < 64:
-        arr = np.asarray(prefixes, dtype=np.uint64)
+        if isinstance(prefixes, np.ndarray) and prefixes.dtype == uint128.U128:
+            arr = prefixes["lo"].copy()  # hi is zero below 64-bit domains
+        else:
+            arr = np.asarray(prefixes, dtype=np.uint64)
     else:
-        arr = np.array([int(p) for p in prefixes], dtype=object)
+        arr = uint128.u128_array(prefixes)
+    # Already-strictly-sorted input (the common bulk case: callers pass the
+    # previous level's np.unique output) skips the O(n log n) sort.
+    sorted_strict = (
+        uint128.u128_gt(arr[1:], arr[:-1]) if arr.dtype == uint128.U128
+        else arr[1:] > arr[:-1]
+    )
+    if arr.shape[0] and bool(np.all(sorted_strict)):
+        return arr
     uniq = np.unique(arr)
     if uniq.shape[0] != arr.shape[0]:
         raise InvalidArgumentError(
@@ -131,6 +164,7 @@ def evaluate_until_batch(
     prefixes: Sequence[int] = (),
     device_output: bool = False,
     mesh=None,
+    engine: str = "device",
 ) -> Union[np.ndarray, Tuple[np.ndarray, ...], tuple]:
     """Advances all keys to `hierarchy_level`, expanding under `prefixes`.
 
@@ -146,6 +180,12 @@ def evaluate_until_batch(
     device expands its contiguous slice of the prefix set, and the
     concatenated per-shard leaf orders form the global output with zero
     cross-shard communication.
+
+    engine="host" runs the expansion on the native AES-NI host engine
+    (core/host_eval.py) instead of the device — scalar Int/XorWrapper types
+    only, and outputs come back host-format at the native element width:
+    uint32[K, num_outputs] for bits <= 32, uint64[...] for 64-bit types,
+    uint32[K, num_outputs, 4] limb rows for 128-bit types.
     """
     dpf, v = ctx.dpf, ctx.dpf.validator
     if hierarchy_level <= ctx.previous_hierarchy_level:
@@ -183,31 +223,74 @@ def evaluate_until_batch(
         # Domain prefixes -> tree indices at the previous level's tree depth.
         shift = prev_lds - start_level
         if shift:
-            shifted = prefix_arr >> (
-                np.uint64(shift) if prefix_arr.dtype != object else shift
-            )
+            if prefix_arr.dtype == uint128.U128:
+                shifted = uint128.u128_rshift(prefix_arr, shift)
+            else:
+                shifted = prefix_arr >> np.uint64(shift)
             # inverse maps each prefix to its tree position — reused below
-            # for the per-prefix block selection.
-            tree, tree_pos_of_prefix = np.unique(shifted, return_inverse=True)
+            # for the per-prefix block selection. `shifted` is sorted
+            # (prefix_arr is), so unique is a linear neighbor-compare.
+            if shifted.shape[0]:
+                is_new = np.empty(shifted.shape[0], dtype=bool)
+                is_new[0] = True
+                is_new[1:] = shifted[1:] != shifted[:-1]
+                tree = shifted[is_new]
+                tree_pos_of_prefix = np.cumsum(is_new) - 1
+            else:
+                tree, tree_pos_of_prefix = np.unique(shifted, return_inverse=True)
         else:
             tree = prefix_arr
             tree_pos_of_prefix = None
         tree_prefixes = tree
-        positions = np.searchsorted(ctx.prefixes, tree)
-        if (positions >= len(ctx.prefixes)) .any() or not (
-            np.asarray(ctx.prefixes)[positions] == tree
-        ).all():
+        # Stored state holds full child blocks of ctx.parent_tree: row of
+        # child c is pos(c >> L) * 2^L + (c & (2^L - 1)) — one search over
+        # the 2^L-times-smaller parent array instead of the child set.
+        L = ctx.child_levels
+        if tree.dtype == uint128.U128:
+            tp = uint128.u128_rshift(tree, L)
+            leaf = uint128.u128_and_low(tree, min(L, 64)).astype(np.int64)
+            if ctx.parent_tree.dtype == uint128.U128:
+                ppos = uint128.u128_searchsorted(ctx.parent_tree, tp)
+                found = ctx.parent_tree[np.minimum(ppos, len(ctx.parent_tree) - 1)] == tp
+            else:
+                # uint64 parents, U128 tree: hi must be zero or the prefix
+                # cannot be present (low-word equality alone would alias).
+                tp64 = tp["lo"]
+                ppos = np.searchsorted(ctx.parent_tree, tp64).astype(np.int64)
+                found = (
+                    ctx.parent_tree[np.minimum(ppos, len(ctx.parent_tree) - 1)]
+                    == tp64
+                ) & (tp["hi"] == 0)
+        else:
+            tp = tree >> np.uint64(L)
+            leaf = (tree & np.uint64((1 << L) - 1)).astype(np.int64)
+            ppos = np.searchsorted(ctx.parent_tree, tp).astype(np.int64)
+            found = (
+                ctx.parent_tree[np.minimum(ppos, len(ctx.parent_tree) - 1)] == tp
+            )
+        if (ppos >= len(ctx.parent_tree)).any() or not found.all():
             raise InvalidArgumentError(
                 "Prefix not present in ctx.partial_evaluations at hierarchy "
                 f"level {hierarchy_level}"
             )
+        positions = ppos * (1 << L) + leaf
         num_parents = len(tree)
-        seeds0, control0 = _gather_seeds_jit(
-            ctx.seeds, ctx.control, jnp.asarray(positions.astype(np.int64))
-        )
+        if engine == "host":
+            pos = positions.astype(np.int64)
+            seeds0 = np.asarray(ctx.seeds)[:, pos]
+            control0 = np.asarray(ctx.control)[:, pos]
+        else:
+            seeds0, control0 = _gather_seeds_jit(
+                ctx.seeds, ctx.control, jnp.asarray(positions.astype(np.int64))
+            )
 
     levels = stop_level - start_level
-    if mesh is not None:
+    if engine == "host":
+        outs, new_seeds, new_control = _expand_batch_host(
+            batch, np.asarray(seeds0), np.asarray(control0), start_level,
+            levels, keep_per_block, value_type,
+        )
+    elif mesh is not None:
         outs, new_seeds, new_control = _expand_batch_sharded(
             batch,
             jnp.asarray(seeds0).astype(jnp.uint32),
@@ -233,8 +316,8 @@ def evaluate_until_batch(
             opp = 1 << (lds - prev_lds)  # outputs per prefix
             etp = 1 << (lds - start_level)  # elements per tree prefix
             block_index = (
-                prefix_arr & ((1 << shift) - 1)
-                if prefix_arr.dtype == object
+                uint128.u128_and_low(prefix_arr, shift)
+                if prefix_arr.dtype == uint128.U128
                 else prefix_arr & np.uint64((1 << shift) - 1)
             )
             starts = tree_pos_of_prefix.astype(np.int64) * etp + block_index.astype(
@@ -243,7 +326,7 @@ def evaluate_until_batch(
             sel = (
                 starts[:, None] + np.arange(opp, dtype=np.int64)
             ).reshape(-1)
-            sel_d = jnp.asarray(sel)
+            sel_d = sel if engine == "host" else jnp.asarray(sel)
             if isinstance(outs, tuple):
                 outs = tuple(o[:, sel_d] for o in outs)
             else:
@@ -252,26 +335,15 @@ def evaluate_until_batch(
     # Update context state: new prefixes are (tree_prefix << levels) + leaf,
     # only when a further hierarchy level exists.
     if hierarchy_level < v.num_hierarchy_levels - 1:
-        n_new = num_parents << levels
         if tree_prefixes is None:
-            base = np.zeros(1, dtype=np.uint64 if stop_level < 64 else object)
-            tree_prefixes = base
-        if tree_prefixes.dtype == object or stop_level >= 64:
-            parents = np.array([int(p) for p in tree_prefixes], dtype=object)
-            new_prefixes = np.repeat(parents << levels, 1 << levels) + np.tile(
-                np.arange(1 << levels, dtype=object), num_parents
-            )
-        else:
-            new_prefixes = np.repeat(
-                tree_prefixes.astype(np.uint64) << np.uint64(levels), 1 << levels
-            ) + np.tile(
-                np.arange(1 << levels, dtype=np.uint64), num_parents
-            )
-        ctx.prefixes = new_prefixes
+            tree_prefixes = np.zeros(1, dtype=np.uint64)
+        ctx.parent_tree = tree_prefixes
+        ctx.child_levels = levels
         ctx.seeds = new_seeds
         ctx.control = new_control
     else:
-        ctx.prefixes = None
+        ctx.parent_tree = None
+        ctx.child_levels = 0
         ctx.seeds = None
         ctx.control = None
     ctx.previous_hierarchy_level = hierarchy_level
@@ -281,6 +353,59 @@ def evaluate_until_batch(
     if isinstance(outs, tuple):
         return tuple(np.asarray(o) for o in outs)
     return np.asarray(outs)
+
+
+def _expand_batch_host(
+    batch: evaluator.KeyBatch,
+    seeds0: np.ndarray,  # uint32[K, Np, 4]
+    control0: np.ndarray,  # bool[K, Np]
+    start_level: int,
+    levels: int,
+    keep_per_block: int,
+    value_type,
+):
+    """Host-engine counterpart of _expand_batch: the doubling expansion runs
+    in the native AES-NI library (one call per key), value hash + correction
+    vectorized in numpy (core/host_eval.correct_scalar_blocks). Scalar
+    Int/XorWrapper only; outputs are host format (uint64 / uint32 limb rows)
+    in the same leaf order as the device path."""
+    from ..core import backend_numpy, host_eval
+    from ..core.value_types import Int, XorWrapper
+
+    if not isinstance(value_type, (Int, XorWrapper)):
+        raise InvalidArgumentError(
+            "engine='host' supports Int/XorWrapper outputs; use the device "
+            "engine for other value types"
+        )
+    bits = value_type.bitsize
+    xor_group = isinstance(value_type, XorWrapper)
+    k, num_parents = seeds0.shape[0], seeds0.shape[1]
+    n_out = num_parents << levels
+    new_seeds = np.empty((k, n_out, 4), dtype=np.uint32)
+    new_control = np.empty((k, n_out), dtype=bool)
+    for j in range(k):
+        s, c = backend_numpy.expand_seeds(
+            seeds0[j],
+            control0[j].astype(bool),
+            batch.cw_seeds[j, start_level : start_level + levels],
+            batch.cw_left[j, start_level : start_level + levels],
+            batch.cw_right[j, start_level : start_level + levels],
+        )
+        new_seeds[j] = s
+        new_control[j] = c
+    hashed = backend_numpy.hash_expanded_seeds(
+        new_seeds.reshape(k * n_out, 4), 1
+    ).reshape(k, n_out, 4)
+    outs = host_eval.correct_scalar_blocks(
+        hashed,
+        new_control,
+        batch.value_corrections,
+        bits,
+        xor_group,
+        batch.party,
+        keep_per_block,
+    )
+    return outs, new_seeds, new_control
 
 
 def _expand_batch(

@@ -87,12 +87,25 @@ def _multi_host_markers_present() -> bool:
         except (KeyError, ValueError):
             return False
 
+    def _gt0(name):
+        try:
+            return int(os.environ[name]) > 0
+        except (KeyError, ValueError):
+            return False
+
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     return (
         _gt1("SLURM_JOB_NUM_NODES")
         or _gt1("OMPI_COMM_WORLD_SIZE")
         or len([h for h in hosts.split(",") if h]) > 1
         or "MEGASCALE_COORDINATOR_ADDRESS" in os.environ
+        # A nonzero worker/task rank can only come from a multi-worker pod,
+        # even when the hostname list is absent or truncated. (Rank 0 is
+        # indistinguishable from a single-host TPU VM — which also sets
+        # TPU_WORKER_ID=0 — so worker 0 of a hostname-less broken pod still
+        # degrades; raising there would break every single-host box.)
+        or _gt0("TPU_WORKER_ID")
+        or _gt0("CLOUD_TPU_TASK_ID")
     )
 
 

@@ -47,11 +47,20 @@ def parse_args():
     ap.add_argument(
         "--platform", default=None, help="jax platform override (cpu/tpu)"
     )
+    ap.add_argument(
+        "--engine",
+        default="auto",
+        choices=("auto", "device", "host"),
+        help="evaluation engine: the XLA device path, the native AES-NI "
+        "host engine, or auto (host when the backend is cpu — on a CPU the "
+        "honest engine is AES-NI, not the TPU bitslice program; PERF.md)",
+    )
     return ap.parse_args()
 
 
 def read_nonzeros(path: str, log_domain_size: int) -> np.ndarray:
-    dtype = np.uint64 if log_domain_size < 64 else object
+    from distributed_point_functions_tpu.core import uint128
+
     values = []
     with open(path) as f:
         for line_number, line in enumerate(f):
@@ -59,7 +68,12 @@ def read_nonzeros(path: str, log_domain_size: int) -> np.ndarray:
             if not field:
                 raise ValueError(f"Line {line_number} is empty")
             values.append(int(field))
-    arr = np.unique(np.array(values, dtype=dtype))
+    if log_domain_size < 64:
+        arr = np.unique(np.array(values, dtype=np.uint64))
+    else:
+        # Vectorized hi/lo uint128 arrays — python-int object arrays make
+        # the 2^128-domain bookkeeping the bottleneck (core/uint128.py).
+        arr = np.unique(uint128.u128_array(values))
     print(f"# read {arr.shape[0]} nonzeros from {len(values)} lines", file=sys.stderr)
     return arr
 
@@ -69,11 +83,13 @@ def compute_prefixes(nonzeros: np.ndarray, log_domain_size: int):
 
     Mirrors ComputePrefixes (synthetic_data_benchmarks.cc:84-105).
     """
+    from distributed_point_functions_tpu.core import uint128
+
     prefixes = [np.array([], dtype=nonzeros.dtype)]
     for bits in range(1, log_domain_size + 1):
         shift = log_domain_size - bits
-        if nonzeros.dtype == object:
-            p = np.unique(np.array([int(x) >> shift for x in nonzeros], dtype=object))
+        if nonzeros.dtype == uint128.U128:
+            p = np.unique(uint128.u128_rshift(nonzeros, shift))
         else:
             p = np.unique(nonzeros >> np.uint64(shift))
         prefixes.append(p)
@@ -131,6 +147,11 @@ def main():
     from distributed_point_functions_tpu.core.value_types import Int
     from distributed_point_functions_tpu.ops import evaluator, hierarchical
 
+    engine = args.engine
+    if engine == "auto":
+        engine = "host" if jax.default_backend() == "cpu" else "device"
+    print(f"# engine: {engine}", file=sys.stderr)
+
     lds = args.log_domain_size
     if args.input:
         nonzeros = read_nonzeros(args.input, lds)
@@ -157,10 +178,24 @@ def main():
     if args.only_nonzeros:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(value_bits)))
         key, _ = dpf.generate_keys(alpha, 1)
-        points = [int(x) for x in nonzeros]
+        from distributed_point_functions_tpu.core import uint128
+
+        # The host engine consumes U128/uint64 arrays directly; the device
+        # batch evaluator takes python ints per point.
+        if engine == "host":
+            points = nonzeros
+        elif nonzeros.dtype == uint128.U128:
+            points = uint128.u128_to_ints(nonzeros)
+        else:
+            points = [int(x) for x in nonzeros]
         t_start = time.perf_counter()
         for i in range(args.num_iterations):
-            out = evaluator.evaluate_at_batch(dpf, [key], points)
+            if engine == "host":
+                from distributed_point_functions_tpu.core import host_eval
+
+                out = host_eval.evaluate_at_host(dpf, [key], points)
+            else:
+                out = evaluator.evaluate_at_batch(dpf, [key], points)
             if i == 0:
                 print(f"# outputs: {out.shape}", file=sys.stderr)
         wall = time.perf_counter() - t_start
@@ -178,8 +213,9 @@ def main():
                 out = hierarchical.evaluate_until_batch(
                     ctx,
                     level,
-                    [int(x) for x in prefixes_to_evaluate[level]],
+                    prefixes_to_evaluate[level],
                     device_output=True,
+                    engine=engine,
                 )
                 if i == 0:
                     n = out[0].shape[1] if isinstance(out, tuple) else out.shape[1]
@@ -187,9 +223,10 @@ def main():
                         f"# outputs at level {level} (log_domain {levels[level]}): {n}",
                         file=sys.stderr,
                     )
-            import jax as _jax
+            if engine != "host":
+                import jax as _jax
 
-            _jax.block_until_ready(out)
+                _jax.block_until_ready(out)
         wall = time.perf_counter() - t_start
     per_iter = wall / args.num_iterations
     mode = "direct" if args.only_nonzeros else "hierarchical"

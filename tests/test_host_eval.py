@@ -54,3 +54,77 @@ def test_host_engine_rejects_non_scalar_types():
     key, _ = dpf.generate_keys(1, 5)
     with pytest.raises(InvalidArgumentError, match="Int/XorWrapper"):
         host_eval.full_domain_evaluate_host(dpf, [key])
+
+
+@pytest.mark.parametrize("vt", [Int(8), Int(32), Int(64), Int(128), XorWrapper(128)],
+                         ids=str)
+def test_evaluate_at_host_matches_reference_path(vt):
+    dpf = DistributedPointFunction.create(DpfParameters(9, vt))
+    alpha, beta = 137, 21
+    for key in dpf.generate_keys(alpha, beta):
+        pts = [int(x) for x in RNG.integers(0, 512, size=33)] + [alpha]
+        got = host_eval.evaluate_at_host(dpf, [key], pts)
+        ref = dpf.evaluate_at(key, 0, pts)
+        if vt.bitsize == 128:
+            from distributed_point_functions_tpu.core import uint128
+
+            np.testing.assert_array_equal(
+                got[0], np.array([uint128.to_limbs(int(r)) for r in ref])
+            )
+        else:
+            np.testing.assert_array_equal(
+                got[0], np.array([int(r) for r in ref], dtype=np.uint64)
+            )
+
+
+def test_evaluate_at_host_128bit_domain_share_sum():
+    dpf = DistributedPointFunction.create(DpfParameters(128, Int(64)))
+    alpha = (1 << 127) + 12345
+    ka, kb = dpf.generate_keys(alpha, 7)
+    pts = [alpha, alpha + 1, 3, (1 << 128) - 1]
+    total = (
+        host_eval.evaluate_at_host(dpf, [ka], pts)
+        + host_eval.evaluate_at_host(dpf, [kb], pts)
+    )[0]
+    np.testing.assert_array_equal(total, [7, 0, 0, 0])
+
+
+def test_evaluate_at_host_rejects_non_scalar_types():
+    dpf = DistributedPointFunction.create(
+        DpfParameters(4, IntModN(32, (1 << 32) - 5))
+    )
+    key, _ = dpf.generate_keys(1, 5)
+    with pytest.raises(InvalidArgumentError, match="Int/XorWrapper"):
+        host_eval.evaluate_at_host(dpf, [key], [0, 1])
+
+
+@pytest.mark.parametrize("vt", [Int(32), Int(128), XorWrapper(64)], ids=str)
+def test_hierarchical_host_engine_matches_device(vt):
+    from distributed_point_functions_tpu.ops import hierarchical
+
+    lds_list = [3, 6, 9] if vt.bitsize == 32 else [2, 5]
+    params = [DpfParameters(l, vt) for l in lds_list]
+    dpf = DistributedPointFunction.create_incremental(params)
+    keys = []
+    for a in (5, 2):
+        ka, _ = dpf.generate_keys_incremental(a, [3] * len(lds_list))
+        keys.append(ka)
+    ctx_d = hierarchical.BatchedContext.create(dpf, keys)
+    ctx_h = hierarchical.BatchedContext.create(dpf, keys)
+    prefixes = []
+    for level in range(len(lds_list)):
+        out_d = np.asarray(hierarchical.evaluate_until_batch(ctx_d, level, prefixes))
+        out_h = hierarchical.evaluate_until_batch(
+            ctx_h, level, prefixes, engine="host"
+        )
+        if vt.bitsize == 128:
+            np.testing.assert_array_equal(out_h, out_d)
+        else:
+            d64 = out_d[..., 0].astype(np.uint64)
+            if out_d.shape[-1] > 1:
+                d64 |= out_d[..., 1].astype(np.uint64) << np.uint64(32)
+            np.testing.assert_array_equal(out_h, d64)
+        if level + 1 < len(lds_list):
+            lds = lds_list[level]
+            n = out_h.shape[1]
+            prefixes = sorted({0, 1, n - 1, 5 % n, 2 % n})

@@ -59,3 +59,56 @@ def test_masked_hash_selects_per_block():
         mask[:, None].astype(bool), _numpy_mmo(hb, x), _numpy_mmo(ha, x)
     )
     np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("n,levels", [(1, 1), (8, 5), (17, 127), (100, 128), (3, 0)])
+def test_evaluate_seeds_walk_matches_numpy(n, levels):
+    from distributed_point_functions_tpu.core import backend_numpy as bn
+
+    rng = np.random.default_rng(n * 1000 + levels)
+    seeds = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+    ctl = rng.integers(0, 2, size=n).astype(bool)
+    paths = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+    cw = rng.integers(0, 2**32, size=(levels, 4), dtype=np.uint32)
+    ccl = rng.integers(0, 2, size=levels).astype(bool)
+    ccr = rng.integers(0, 2, size=levels).astype(bool)
+    want_s, want_c = bn._evaluate_seeds_numpy(seeds, ctl, paths, cw, ccl, ccr)
+    got_s, got_c = native.evaluate_seeds(
+        bn._PRG_LEFT._round_keys, bn._PRG_RIGHT._round_keys,
+        seeds, ctl, paths, cw, ccl, ccr,
+    )
+    np.testing.assert_array_equal(got_s, want_s)
+    np.testing.assert_array_equal(got_c, want_c)
+
+
+@pytest.mark.parametrize("n,levels", [(1, 1), (2, 6), (5, 3), (9, 0), (16, 8)])
+def test_expand_forest_matches_numpy(n, levels):
+    from distributed_point_functions_tpu.core import backend_numpy as bn
+
+    rng = np.random.default_rng(n * 100 + levels)
+    seeds = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+    ctl = rng.integers(0, 2, size=n).astype(bool)
+    cw = rng.integers(0, 2**32, size=(levels, 4), dtype=np.uint32)
+    ccl = rng.integers(0, 2, size=levels).astype(bool)
+    ccr = rng.integers(0, 2, size=levels).astype(bool)
+    want_s, want_c = bn._expand_seeds_numpy(seeds, ctl, cw, ccl, ccr)
+    got_s, got_c = native.expand_forest(
+        bn._PRG_LEFT._round_keys, bn._PRG_RIGHT._round_keys,
+        seeds, ctl, cw, ccl, ccr, levels,
+    )
+    np.testing.assert_array_equal(got_s, want_s)
+    np.testing.assert_array_equal(got_c, want_c)
+
+
+@pytest.mark.parametrize("n,blocks", [(1, 1), (7, 2), (33, 5), (8, 1)])
+def test_value_hash_matches_numpy(n, blocks):
+    from distributed_point_functions_tpu.core import backend_numpy as bn
+
+    rng = np.random.default_rng(n * 10 + blocks)
+    seeds = rng.integers(0, 2**32, size=(n, 4), dtype=np.uint32)
+    # Exercise the carry chain: + j overflows limb 0, then limb 1, into hi.
+    seeds[::2, 0] = np.uint32(0xFFFFFFFF)
+    seeds[::2, 1] = np.uint32(0xFFFFFFFF)
+    want = bn._hash_expanded_seeds_numpy(seeds, blocks)
+    got = native.value_hash(bn._PRG_VALUE._round_keys, seeds, blocks)
+    np.testing.assert_array_equal(got, want)

@@ -110,3 +110,73 @@ def test_validation_errors():
         IntModN(32, MOD32).validate_value(MOD32)
     with pytest.raises(InvalidArgumentError, match="size"):
         TupleType(Int(8), Int(8)).validate_value((1,))
+
+
+import numpy as np
+
+
+class TestU128VectorOps:
+    """Vectorized uint128 arrays (core/uint128.py U128 dtype)."""
+
+    def test_roundtrip_shift_add_mask(self):
+        from distributed_point_functions_tpu.core import uint128 as u
+
+        xs = [0, 1, (1 << 128) - 1, (1 << 77) + 12345, 1 << 64]
+        a = u.u128_array(xs)
+        assert u.u128_to_ints(a) == xs
+        for k in (0, 13, 64, 100, 128):
+            assert u.u128_to_ints(u.u128_rshift(a, k)) == [x >> k for x in xs]
+            assert u.u128_to_ints(u.u128_lshift(a, k)) == [
+                (x << k) & u.MASK128 for x in xs
+            ]
+        b = np.array([3, 9, 1, 2, 5], dtype=np.uint64)
+        assert u.u128_to_ints(u.u128_add_u64(a, b)) == [
+            (x + int(c)) & u.MASK128 for x, c in zip(xs, b)
+        ]
+        assert list(u.u128_and_low(a, 10)) == [x & 1023 for x in xs]
+        np.testing.assert_array_equal(
+            u.u128_to_limb_rows(a), np.stack([u.to_limbs(x) for x in xs])
+        )
+        # Structured (hi, lo) ordering IS numeric ordering.
+        assert np.all(np.sort(a) == u.u128_array(sorted(xs)))
+
+    def test_searchsorted_matches_bisect(self):
+        import bisect
+
+        from distributed_point_functions_tpu.core import uint128 as u
+
+        rng = np.random.default_rng(11)
+        for trial in range(25):
+            n = int(rng.integers(1, 300))
+            hb = int(rng.integers(0, 6))
+            lb = int(rng.integers(1, 41))
+            vals = sorted(
+                {
+                    (int(h) << 64) | int(l)
+                    for h, l in zip(
+                        rng.integers(0, 1 << hb, n), rng.integers(0, 1 << lb, n)
+                    )
+                }
+            )
+            hay = u.u128_array(vals)
+            q = sorted(
+                {
+                    (int(h) << 64) | int(l)
+                    for h, l in zip(
+                        rng.integers(0, 1 << hb, 60),
+                        rng.integers(0, (1 << lb) + 9, 60),
+                    )
+                }
+            )
+            got = u.u128_searchsorted(hay, u.u128_array(q))
+            np.testing.assert_array_equal(
+                got, [bisect.bisect_left(vals, x) for x in q], err_msg=str(trial)
+            )
+
+    def test_searchsorted_past_run_end(self):
+        # A needle greater than every equal-hi run entry lands at the run's
+        # right edge (regression: the bounded scan was one advance short).
+        from distributed_point_functions_tpu.core import uint128 as u
+
+        hay = u.u128_array([(1 << 64) | 0, (1 << 64) | 1])
+        assert u.u128_searchsorted(hay, u.u128_array([(1 << 64) | 5]))[0] == 2
