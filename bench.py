@@ -158,7 +158,11 @@ def _run(platform: str, log_domain: int, num_keys: int, key_chunk: int) -> dict:
             if verbose:
                 jax.block_until_ready(folds[-1])
                 _log(f"chunk {len(folds)} done ({time.time() - t0:.1f}s)")
-        jax.block_until_ready(folds)
+        # Pull every fold to the host ([chunk, lpe] each — tiny): the timed
+        # quantity must include real execution. block_until_ready alone has
+        # proven unreliable through this image's TPU tunnel (PERF.md:
+        # "Trust, but verify").
+        folds = [np.asarray(f) for f in folds]
         assert total_valid == len(key_subset), (total_valid, len(key_subset))
         return folds
 
@@ -176,7 +180,40 @@ def _run(platform: str, log_domain: int, num_keys: int, key_chunk: int) -> dict:
     total_evals = num_keys * (1 << log_domain)
     evals_per_sec = total_evals / elapsed
     _log(f"{total_evals} evals in {elapsed:.2f}s on {backend} (device-resident)")
-    return _result(log_domain, num_keys, evals_per_sec, backend)
+
+    # Verify the device outputs against the native host oracle on a sample
+    # of keys — the whole number is worthless if the chip (or the tunnel
+    # runtime) mis-executed the program, and that HAS been observed on this
+    # image (upper-lane corruption at 64-key multi-level batches, PERF.md).
+    from distributed_point_functions_tpu.core.host_eval import (
+        full_domain_evaluate_host,
+    )
+
+    fold_rows = np.concatenate(folds, axis=0)[:num_keys]
+    sample = list(range(0, num_keys, max(1, num_keys // 8)))[:8]
+    host_vals = full_domain_evaluate_host(dpf, [keys[i] for i in sample])
+    host_folds = np.bitwise_xor.reduce(host_vals, axis=1)
+    got = fold_rows[sample]
+    got64 = got[:, 0].astype(np.uint64) | (got[:, 1].astype(np.uint64) << np.uint64(32))
+    n_ok = int((got64 == host_folds).sum())
+    verified = n_ok == len(sample)
+    _log(f"device-vs-host verification: {n_ok}/{len(sample)} sampled keys match")
+    result = _result(log_domain, num_keys, evals_per_sec, backend)
+    result["verified_keys"] = f"{n_ok}/{len(sample)}"
+    if not verified:
+        result["error"] = (
+            "device outputs FAILED host-oracle verification on sampled keys; "
+            "the evals/s figure measures a miscomputing program — falling "
+            "back to the CPU host engine for an honest number"
+        )
+        _log(result["error"])
+        fallback = _run_cpu_host_engine(
+            CPU_LOG_DOMAIN, CPU_NUM_KEYS, min(key_chunk, CPU_NUM_KEYS)
+        )
+        fallback["device_unverified_evals_per_sec"] = round(evals_per_sec)
+        fallback["device_verified_keys"] = f"{n_ok}/{len(sample)}"
+        return fallback
+    return result
 
 
 def _run_cpu_host_engine(log_domain: int, num_keys: int, key_chunk: int) -> dict:

@@ -40,12 +40,33 @@ def bench(jax, smoke):
     log(f"keygen: {tk.elapsed:.2f}s for {num_queries} queries")
     db = rng.integers(0, 2**32, size=(1 << log_domain, 4), dtype=np.uint32)
 
+    single_chip = mesh.shape["keys"] == 1 and mesh.shape["domain"] == 1
+    # The DB is the server's static state: permute/upload once at setup
+    # (prepare_pir_database) — per-query upload would measure the host
+    # link, not the query engine.
+    import jax.numpy as jnp
+
+    with Timer() as tdb:
+        db_dev = (
+            sharded.prepare_pir_database(dpf, db)
+            if single_chip
+            else jnp.asarray(db)
+        )
+        jax.block_until_ready(db_dev.lane_db if single_chip else db_dev)
+    log(f"db setup (permute + upload): {tdb.elapsed:.1f}s")
+
     def run():
+        if single_chip:
+            # One device: the chunked per-level path (headline execution
+            # shape, DB pre-permuted to lane order) — no shard_map needed.
+            return sharded.pir_query_batch_chunked(
+                dpf, keys, db_dev, key_chunk=key_chunk
+            )
         outs = []
         for start in range(0, num_queries, key_chunk):
             outs.append(
                 sharded.pir_query_batch(
-                    dpf, keys[start : start + key_chunk], db, mesh
+                    dpf, keys[start : start + key_chunk], db_dev, mesh
                 )
             )
         return np.concatenate(outs, axis=0)

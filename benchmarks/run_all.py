@@ -13,6 +13,7 @@ BENCHES = [
     "bench_intmodn_hierarchy.py",
     "bench_dcf.py",
     "bench_pir.py",
+    "bench_heavy_hitters.py",
 ]
 
 

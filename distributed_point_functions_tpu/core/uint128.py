@@ -204,36 +204,17 @@ def u128_searchsorted(haystack: np.ndarray, needles: np.ndarray) -> np.ndarray:
         return np.searchsorted(hay_lo, ndl_lo).astype(np.int64)
     left = np.searchsorted(hay_hi, ndl_hi, "left").astype(np.int64)
     right = np.searchsorted(hay_hi, ndl_hi, "right").astype(np.int64)
-    runlen = right - left
-    maxrun = int(runlen.max())
-    pos = left
-    short = runlen <= 8
-    if short.any():
-        # Bounded linear scan: advance past haystack entries with smaller lo.
-        idx = np.flatnonzero(short)
-        p = pos[idx]
-        r = right[idx]
-        lo = ndl_lo[idx]
-        # Up to `runlen` advances: a needle greater than every run entry
-        # must land at the run's right edge.
-        for _ in range(min(maxrun, 8)):
-            at = np.minimum(p, n_hay - 1)
-            step = (p < r) & (hay_lo[at] < lo)
-            if not step.any():
-                break
-            p += step
-        pos[idx] = p
-    if not short.all():
-        # Long runs: one uint64 searchsorted per distinct needle-hi run.
-        sel = np.flatnonzero(~short)
-        starts = sel[np.r_[True, ndl_hi[sel][1:] != ndl_hi[sel][:-1]]]
-        for s in starts:
-            e = s
-            while e < n_needle and ndl_hi[e] == ndl_hi[s]:
-                e += 1
-            seg = np.arange(s, e)
-            seg = seg[~short[seg]]
-            if seg.size:
-                lo_run = hay_lo[left[s] : right[s]]
-                pos[seg] = left[s] + np.searchsorted(lo_run, ndl_lo[seg])
-    return pos
+    # Rank-by-lo within each equal-hi run: one vectorized binary search per
+    # needle over its own [left, right) window — ceil(log2(max run)) passes
+    # over the whole needle array, no per-run Python loops in any regime.
+    lo_b, hi_b = left, right.copy()
+    while True:
+        active = lo_b < hi_b
+        if not active.any():
+            break
+        mid = (lo_b + hi_b) >> 1
+        at = np.minimum(mid, n_hay - 1)
+        go_right = active & (hay_lo[at] < ndl_lo)
+        lo_b = np.where(go_right, mid + 1, lo_b)
+        hi_b = np.where(active & ~go_right, mid, hi_b)
+    return lo_b

@@ -130,7 +130,11 @@ def _as_prefix_array(prefixes: Sequence[int], log_domain: int) -> np.ndarray:
     object arrays are 30-100x too slow for 2^20-prefix bookkeeping."""
     if log_domain < 64:
         if isinstance(prefixes, np.ndarray) and prefixes.dtype == uint128.U128:
-            arr = prefixes["lo"].copy()  # hi is zero below 64-bit domains
+            if prefixes["hi"].any():
+                raise InvalidArgumentError(
+                    f"Prefix out of range for a {log_domain}-bit domain"
+                )
+            arr = prefixes["lo"].copy()
         else:
             arr = np.asarray(prefixes, dtype=np.uint64)
     else:
@@ -199,6 +203,18 @@ def evaluate_until_batch(
     if (ctx.previous_hierarchy_level < 0) != (len(prefixes) == 0):
         raise InvalidArgumentError(
             "`prefixes` must be empty if and only if this is the first call"
+        )
+    prev_lds_guard = (
+        0
+        if ctx.previous_hierarchy_level < 0
+        else v.parameters[ctx.previous_hierarchy_level].log_domain_size
+    )
+    if v.parameters[hierarchy_level].log_domain_size - prev_lds_guard > 62:
+        # Same bound as EvaluateUntil
+        # (/root/reference/dpf/distributed_point_function.h:692-696).
+        raise InvalidArgumentError(
+            "Output size would be larger than 2**62. Please evaluate fewer "
+            "hierarchy levels at once."
         )
     k = len(ctx.keys)
     value_type = v.parameters[hierarchy_level].value_type

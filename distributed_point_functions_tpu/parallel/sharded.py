@@ -115,25 +115,43 @@ def _walk_and_expand_one_key(
     bits: int,
     xor_group: bool,
 ):
-    """Walks `subtree_levels` down along subtree_index, expands the rest,
-    hashes and corrects. Returns uint32[2^expand_levels * epb, lpe] values of
-    this key restricted to the device's domain slice, in leaf order."""
-    lanes = jnp.zeros((32, 4), jnp.uint32).at[0].set(seed)
-    planes = aes_jax.pack_to_planes(lanes)
-    control = jnp.array([party], dtype=jnp.uint32)  # lane 0 only
-    if subtree_levels:
-        shifts = subtree_levels - 1 - jnp.arange(subtree_levels, dtype=jnp.int32)
-        bits_path = (subtree_index >> shifts) & 1
-        path_masks = (jnp.uint32(0) - bits_path.astype(jnp.uint32))[:, None]
+    """Walks down to the device's subtree, expands the rest, hashes and
+    corrects. Returns uint32[2^expand_levels * epb, lpe] values of this key
+    restricted to the device's domain slice, in leaf order.
+
+    The walk descends to the 32 (= one packed lane word) subtree nodes at
+    depth subtree_levels + min(5, expand_levels), one per lane, so the
+    doubling expansion starts with every lane real — expanding a single
+    root from a 32-lane word instead costs 32x the AES work and 32x the
+    plane memory (the difference between ~1 GB and ~32 GB of temporaries
+    per 8 queries at a 2^24 domain)."""
+    lane_levels = min(5, expand_levels)
+    n_lane = 1 << lane_levels
+    walk_levels = subtree_levels + lane_levels
+    seeds = jnp.broadcast_to(seed[None, :], (32, 4))
+    planes = aes_jax.pack_to_planes(seeds)
+    control = jnp.full(1, 0xFFFFFFFF if party else 0, jnp.uint32)
+    if walk_levels:
+        # Lane l follows the path to subtree node subtree_index * n_lane +
+        # (l mod n_lane) at depth walk_levels (lanes >= n_lane duplicate
+        # lane l mod n_lane; expansion_output_order dedups below).
+        node = subtree_index.astype(jnp.uint32) * jnp.uint32(n_lane) + (
+            jnp.arange(32, dtype=jnp.uint32) % jnp.uint32(n_lane)
+        )
+        shifts = (walk_levels - 1 - jnp.arange(walk_levels, dtype=jnp.uint32))[
+            :, None
+        ]
+        bits_path = ((node[None, :] >> shifts) & 1).astype(bool)
+        path_masks = _pack_bits_device(bits_path)  # [walk_levels, 1]
         planes, control = backend_jax.evaluate_seeds_planes(
             planes,
             control,
             path_masks,
-            cw_planes[:subtree_levels],
-            ccl[:subtree_levels],
-            ccr[:subtree_levels],
+            cw_planes[:walk_levels],
+            ccl[:walk_levels],
+            ccr[:walk_levels],
         )
-    for l in range(subtree_levels, subtree_levels + expand_levels):
+    for l in range(walk_levels, subtree_levels + expand_levels):
         planes, control = backend_jax.expand_one_level(
             planes, control, cw_planes[l], ccl[l], ccr[l]
         )
@@ -142,8 +160,10 @@ def _walk_and_expand_one_key(
     ctrl = backend_jax.unpack_mask_device(control)
     values = evaluator._correct_values(
         blocks, ctrl, corrections, bits, party, xor_group
-    )  # [32 << expand_levels, epb, lpe]
-    order = jnp.asarray(backend_jax.expansion_output_order(1, 32, expand_levels))
+    )  # [32 << (expand_levels - lane_levels), epb, lpe]
+    order = jnp.asarray(
+        backend_jax.expansion_output_order(n_lane, 32, expand_levels - lane_levels)
+    )
     values = values[order]  # [2^expand_levels, epb, lpe] leaf order
     n_blocks, epb, lpe = values.shape
     return values.reshape(n_blocks * epb, lpe)
@@ -157,6 +177,7 @@ def build_pir_step(
     bits: int = 128,
     xor_group: bool = True,
     mode: str = "expand",
+    slab_levels: int = 0,
 ):
     """Compiles one server's sharded PIR answer step.
 
@@ -169,6 +190,13 @@ def build_pir_step(
     work, one traced AES circuit per level. mode="walk" walks every leaf path
     with one `lax.scan` — ~num_levels/2 x the AES work but a near-constant
     trace size, for compile-time-bound settings (tests, CPU dryrun).
+
+    slab_levels > 0 (expand mode) bounds HBM: each device processes its
+    domain slice in 2^slab_levels slabs inside a `lax.fori_loop`, walking
+    slab_levels extra levels and XOR-accumulating the partial inner product
+    per slab — memory drops 2^slab_levels x for slab_levels extra AES walks
+    per slab (a 2^24-domain query on one v5e chip needs ~32 GB of plane
+    temporaries unslabbed; 8 slabs fit comfortably).
     """
     if mode not in ("expand", "walk"):
         raise errors.InvalidArgumentError(
@@ -180,9 +208,14 @@ def build_pir_step(
     expand_levels = num_levels - subtree_levels
     assert expand_levels >= 0, "domain smaller than the device mesh"
     leaves_per_shard = 1 << expand_levels
+    if slab_levels and mode != "expand":
+        raise errors.InvalidArgumentError("slab_levels requires mode='expand'")
+    if slab_levels > expand_levels:
+        slab_levels = expand_levels
 
     def device_fn(seeds, cw_planes, ccl, ccr, corrections, db):
         di = jax.lax.axis_index("domain").astype(jnp.int32)
+        elems_local = db.shape[0]
         if mode == "walk":
             fn = functools.partial(
                 _walk_leaves_one_key,
@@ -196,22 +229,42 @@ def build_pir_step(
             values = jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, None))(
                 seeds, cw_planes, ccl, ccr, corrections, base
             )  # [Kl, elems_local, lpe]
+            partial = jnp.bitwise_xor.reduce(
+                values[:, :elems_local] & db[None, :, :], axis=1
+            )  # [Kl, lpe]
         else:
+            n_slabs = 1 << slab_levels
+            elems_slab = elems_local // n_slabs
             fn = functools.partial(
                 _walk_and_expand_one_key,
-                subtree_levels=subtree_levels,
-                expand_levels=expand_levels,
+                subtree_levels=subtree_levels + slab_levels,
+                expand_levels=expand_levels - slab_levels,
                 party=party,
                 bits=bits,
                 xor_group=xor_group,
             )
-            values = jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, None))(
-                seeds, cw_planes, ccl, ccr, corrections, di
-            )  # [Kl, elems_local, lpe]
-        elems_local = db.shape[0]
-        partial = jnp.bitwise_xor.reduce(
-            values[:, :elems_local] & db[None, :, :], axis=1
-        )  # [Kl, lpe]
+
+            def slab_partial(j):
+                sub = di * n_slabs + j.astype(jnp.int32)
+                values = jax.vmap(fn, in_axes=(0, 0, 0, 0, 0, None))(
+                    seeds, cw_planes, ccl, ccr, corrections, sub
+                )  # [Kl, elems_slab, lpe]
+                dbj = jax.lax.dynamic_slice_in_dim(
+                    db, j.astype(jnp.int32) * elems_slab, elems_slab
+                )
+                return jnp.bitwise_xor.reduce(
+                    values[:, :elems_slab] & dbj[None, :, :], axis=1
+                )  # [Kl, lpe]
+
+            if n_slabs == 1:
+                partial = slab_partial(jnp.int32(0))
+            else:
+                partial = jax.lax.fori_loop(
+                    0,
+                    n_slabs,
+                    lambda j, acc: acc ^ slab_partial(jnp.int32(j)),
+                    jnp.zeros((seeds.shape[0], db.shape[1]), jnp.uint32),
+                )
         gathered = jax.lax.all_gather(partial, "domain")  # [n_domain, Kl, lpe]
         return jnp.bitwise_xor.reduce(gathered, axis=0)
 
@@ -238,18 +291,29 @@ def pir_query_batch(
     db_limbs: np.ndarray,  # uint32[D, lpe]
     mesh: Mesh,
     mode: str = "expand",
+    slab_levels=None,
 ) -> np.ndarray:
     """One server's answers for a batch of PIR queries. Returns uint32[K, lpe].
 
     Host-side convenience wrapper: prepares correction-word arrays from the
-    keys, shards them over `mesh`, runs the compiled step.
+    keys, shards them over `mesh`, runs the compiled step. slab_levels=None
+    picks the smallest slab count that keeps each device's expansion
+    temporaries under ~DPF_TPU_PIR_SLAB_BUDGET bytes (default 2 GB).
     """
+    import math
+    import os
     v = dpf.validator
     hierarchy_level = v.num_hierarchy_levels - 1
     value_type = v.parameters[hierarchy_level].value_type
     bits, xor_group = evaluator._value_kind(value_type)
     domain = 1 << v.parameters[hierarchy_level].log_domain_size
-    db_limbs = np.asarray(db_limbs)
+    if isinstance(db_limbs, PreparedPirDatabase):
+        raise errors.InvalidArgumentError(
+            "pir_query_batch wants the natural-order DB; PreparedPirDatabase "
+            "is lane-ordered and only pir_query_batch_chunked consumes it"
+        )
+    if not isinstance(db_limbs, jax.Array):  # keep device-resident DBs put
+        db_limbs = np.asarray(db_limbs)
     if db_limbs.shape[0] != domain:
         raise errors.InvalidArgumentError(
             f"db has {db_limbs.shape[0]} rows; the DPF domain has {domain} "
@@ -273,9 +337,22 @@ def pir_query_batch(
         )
     cw_planes, ccl, ccr = batch.device_cw_arrays()
     corrections = evaluator._correction_limbs(batch.value_corrections, bits)
+    if slab_levels is None:
+        slab_levels = 0
+        if mode == "expand":
+            n_domain = mesh.shape["domain"]
+            expand_levels = batch.num_levels - int(np.log2(n_domain))
+            keys_local = -(-batch.seeds.shape[0] // mesh.shape["keys"])
+            # ~16 B/leaf of plane state, ~4x for fusion temporaries.
+            est = keys_local * (1 << max(expand_levels, 0)) * 16 * 4
+            budget = int(os.environ.get("DPF_TPU_PIR_SLAB_BUDGET", 2 << 30))
+            if est > budget:
+                slab_levels = min(
+                    max(expand_levels, 0), math.ceil(math.log2(est / budget))
+                )
     step = build_pir_step(
         mesh, batch.num_levels, batch.party, bits=bits, xor_group=xor_group,
-        mode=mode,
+        mode=mode, slab_levels=int(slab_levels),
     )
     out = step(
         jnp.asarray(batch.seeds),
@@ -286,6 +363,105 @@ def pir_query_batch(
         jnp.asarray(db_limbs),
     )
     return np.asarray(out)[:n_real]
+
+
+@jax.jit
+def _pir_fold_jit(values, db_lane):
+    """XOR inner product of lane-order values against a lane-order DB."""
+    return jnp.bitwise_xor.reduce(values & db_lane[None, :, :], axis=1)
+
+
+class PreparedPirDatabase:
+    """Lane-order, device-resident PIR database (prepare_pir_database).
+
+    A distinct type on purpose: for epb=1 value types the lane-ordered
+    array has exactly `domain` rows, so a bare device array would pass
+    `pir_query_batch`'s shape check and silently produce XOR inner
+    products against a permuted DB."""
+
+    __slots__ = ("lane_db",)
+
+    def __init__(self, lane_db):
+        self.lane_db = lane_db
+
+
+def prepare_pir_database(
+    dpf: DistributedPointFunction,
+    db_limbs: np.ndarray,  # uint32[D, lpe]
+    host_levels=None,
+) -> "PreparedPirDatabase":
+    """Permutes a PIR database into the expansion's lane order and uploads
+    it to the device ONCE. A PIR server's DB is static: re-uploading it per
+    query batch would put the host link (megabytes/s through this image's
+    tunnel) on the query path — prepare at setup, query forever after.
+    Returns the PreparedPirDatabase `pir_query_batch_chunked` consumes."""
+    from ..ops import evaluator as ev
+
+    v = dpf.validator
+    hierarchy_level = v.num_hierarchy_levels - 1
+    domain = 1 << v.parameters[hierarchy_level].log_domain_size
+    db_limbs = np.asarray(db_limbs)
+    if db_limbs.shape[0] != domain:
+        raise errors.InvalidArgumentError(
+            f"db has {db_limbs.shape[0]} rows; the DPF domain has {domain} "
+            "elements — they must match exactly"
+        )
+    m = ev.lane_order_map(dpf, hierarchy_level, host_levels)
+    db_lane = np.zeros((m.shape[0], db_limbs.shape[1]), dtype=np.uint32)
+    valid = m >= 0
+    db_lane[valid] = db_limbs[m[valid]]
+    return PreparedPirDatabase(jnp.asarray(db_lane))
+
+
+def pir_query_batch_chunked(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    db_limbs: np.ndarray,  # uint32[D, lpe]
+    key_chunk: int = 64,
+    host_levels=None,
+) -> np.ndarray:
+    """Single-device PIR answers via the chunked per-level evaluator.
+
+    The headline-bench execution shape (ops/evaluator.full_domain_evaluate_
+    chunks: host-driven per-level dispatch, small XLA programs) applied to
+    the PIR inner product: the database is permuted ONCE into the
+    expansion's lane order (`lane_order_map`, so no per-query leaf-order
+    gather exists at all), and each key chunk folds against it on device.
+    On one v5e chip this runs the 2^24 x 64-query BASELINE config ~60x
+    faster than the monolithic walk+expand shard_map program, whose 20+
+    unrolled AES levels in a single program spill (PERF.md). For multi-chip
+    domain sharding use `pir_query_batch`.
+
+    `db_limbs` may be a host uint32[D, lpe] array (permuted + uploaded on
+    every call — fine for tests, wrong for serving) or the device array
+    returned by `prepare_pir_database` (upload once, query many).
+    """
+    from ..ops import evaluator as ev
+
+    if isinstance(db_limbs, PreparedPirDatabase):
+        db_dev = db_limbs.lane_db
+    elif isinstance(db_limbs, jax.Array):
+        raise errors.InvalidArgumentError(
+            "pass the PreparedPirDatabase from prepare_pir_database (or a "
+            "host array); a bare device array's row order is ambiguous"
+        )
+    else:
+        db_dev = prepare_pir_database(dpf, db_limbs, host_levels).lane_db
+    outs = []
+    for n_valid, vals in ev.full_domain_evaluate_chunks(
+        dpf,
+        keys,
+        key_chunk=key_chunk,
+        host_levels=host_levels,
+        leaf_order=False,
+    ):
+        outs.append(np.asarray(_pir_fold_jit(vals, db_dev))[:n_valid])
+        # Free the chunk's [chunk, domain, lpe] values NOW: at large domains
+        # a live extra chunk (plus the expansion temporaries of the next one)
+        # pushes past HBM and the runtime starts evicting buffers across the
+        # host link — the difference between 0.1 s and 5 s per chunk.
+        vals.delete()
+    return np.concatenate(outs, axis=0)
 
 
 # ---------------------------------------------------------------------------
@@ -321,22 +497,32 @@ def build_sharded_expand_step(
     assert expand_levels >= 0, "domain smaller than the device mesh"
 
     def one_key(seed, cw_planes, ccl, ccr, corrections, subtree_index):
-        lanes = jnp.zeros((32, 4), jnp.uint32).at[0].set(seed)
-        planes = aes_jax.pack_to_planes(lanes)
-        control = jnp.array([party], dtype=jnp.uint32)  # lane 0 only
-        if subtree_levels:
-            shifts = subtree_levels - 1 - jnp.arange(subtree_levels, dtype=jnp.int32)
-            bits_path = (subtree_index >> shifts) & 1
-            path_masks = (jnp.uint32(0) - bits_path.astype(jnp.uint32))[:, None]
+        # Walk to the 32 subtree nodes at depth subtree_levels + lane_levels
+        # (one per packed lane) so the doubling expansion starts with every
+        # lane real — see _walk_and_expand_one_key for why.
+        lane_levels = min(5, expand_levels)
+        n_lane = 1 << lane_levels
+        walk_levels = subtree_levels + lane_levels
+        seeds = jnp.broadcast_to(seed[None, :], (32, 4))
+        planes = aes_jax.pack_to_planes(seeds)
+        control = jnp.full(1, 0xFFFFFFFF if party else 0, jnp.uint32)
+        if walk_levels:
+            node = subtree_index.astype(jnp.uint32) * jnp.uint32(n_lane) + (
+                jnp.arange(32, dtype=jnp.uint32) % jnp.uint32(n_lane)
+            )
+            shifts = (
+                walk_levels - 1 - jnp.arange(walk_levels, dtype=jnp.uint32)
+            )[:, None]
+            bits_path = ((node[None, :] >> shifts) & 1).astype(bool)
             planes, control = backend_jax.evaluate_seeds_planes(
                 planes,
                 control,
-                path_masks,
-                cw_planes[:subtree_levels],
-                ccl[:subtree_levels],
-                ccr[:subtree_levels],
+                _pack_bits_device(bits_path),
+                cw_planes[:walk_levels],
+                ccl[:walk_levels],
+                ccr[:walk_levels],
             )
-        for l in range(subtree_levels, num_levels):
+        for l in range(walk_levels, num_levels):
             planes, control = backend_jax.expand_one_level(
                 planes, control, cw_planes[l], ccl[l], ccr[l]
             )
@@ -344,10 +530,12 @@ def build_sharded_expand_step(
         ctrl = backend_jax.unpack_mask_device(control)
         vals = value_codec.correct_values(stream, ctrl, corrections, spec, party)
         order = jnp.asarray(
-            backend_jax.expansion_output_order(1, 32, expand_levels)
+            backend_jax.expansion_output_order(
+                n_lane, 32, expand_levels - lane_levels
+            )
         )
         outs = []
-        for v in vals:  # [32 << expand_levels, epb, lpe]
+        for v in vals:  # [32 << (expand_levels - lane_levels), epb, lpe]
             v = v[order][:, :keep_per_block]  # leaf order, trimmed blocks
             n_blocks, kept, lpe = v.shape
             outs.append(v.reshape(n_blocks * kept, lpe))
