@@ -105,6 +105,7 @@ def bench(jax, smoke):
             "num_nonzeros": num_nonzeros,
             "engine": engine,
         },
+        **({"platform": "cpu"} if engine == "host" else {}),
     }
 
 

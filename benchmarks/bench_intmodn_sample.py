@@ -1,0 +1,76 @@
+"""IntModN statistical sampling throughput.
+
+Mirrors BM_Sample (/root/reference/dpf/int_mod_n_benchmark.cc:28-46):
+IntModN<uint32, 2^32-5> with the security-padded leftover-entropy chain,
+security parameter 40 + log2(n), ONE sample per block (the reference's
+BM_Sample draws 5 chained samples per call, so its per-call figures are
+~5x one-sample figures — compare rates per sample, not per call).
+Measures both engines:
+
+* host: the python host sampler (core/value_types.IntModN.sample_and_update
+  — the wire-exact path used by keygen/value correction on the host),
+* device: the vectorized codec chain (ops/value_codec._sample_chain) over a
+  batch of blocks on the default backend.
+"""
+
+import os
+import secrets
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+MOD = (1 << 32) - 5
+
+
+def bench(jax, smoke):
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.core.value_types import IntModN
+    from distributed_point_functions_tpu.ops import value_codec
+
+    n_blocks = int(os.environ.get("BENCH_SAMPLE_BLOCKS", 1 << (10 if smoke else 16)))
+    vt = IntModN(32, MOD)
+
+    # Host sampler: one block + chained bytes per call, one sample out.
+    sec = 40 + np.log2(n_blocks)
+    bytes_needed = vt.bits_needed(sec) // 8
+    blocks = [secrets.token_bytes(bytes_needed) for _ in range(256)]
+    with Timer() as th:
+        for b in blocks:
+            block = int.from_bytes(b[:16], "little")
+            vt.sample_and_update(True, block, b[16:])
+    host_rate = 256 / th.elapsed  # samples/s
+    log(f"host sampler: {host_rate:.0f} blocks/s")
+
+    # Device chain: the codec consumes a hash stream [lanes, 4*bn] and emits
+    # mod-N values per lane; blocks_needed from the security accounting.
+    bn = -(-vt.bits_needed(sec) // 128)
+    spec = value_codec.build_spec(vt, blocks_needed=bn)
+    rng = np.random.default_rng(5)
+    stream = jnp.asarray(
+        rng.integers(0, 2**32, size=(n_blocks, 4 * spec.blocks_needed), dtype=np.uint32)
+    )
+    fn = jax.jit(lambda s: value_codec._sample_chain(s, spec))
+    jax.block_until_ready(fn(stream))
+    reps = int(os.environ.get("BENCH_REPS", 10))
+    with Timer() as t:
+        for _ in range(reps):
+            out = fn(stream)
+            out = [np.asarray(o) for o in out]  # host pull: honest timing
+    rate = reps * n_blocks / t.elapsed
+    return {
+        "bench": "intmodn_sample",
+        "metric": (
+            f"IntModN<u32, 2^32-5> sampling, {n_blocks} blocks "
+            f"(device codec chain, 1 sample/block; host sampler "
+            f"{host_rate:.0f} samples/s)"
+        ),
+        "value": round(rate),
+        "unit": "samples/s",
+        "config": {"modulus": MOD, "n_blocks": n_blocks},
+    }
+
+
+if __name__ == "__main__":
+    run_bench("intmodn_sample", bench)

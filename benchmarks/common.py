@@ -120,7 +120,10 @@ def run_bench(name: str, fn) -> None:
                 return
             raise
         result.setdefault("bench", name)
-        result["platform"] = jax.default_backend()
+        # A bench that runs on an engine other than the default backend
+        # (e.g. the native host engine while a TPU is attached) sets its
+        # own platform; only fill it in when absent.
+        result.setdefault("platform", jax.default_backend())
     except Exception as e:
         result["error"] = f"{type(e).__name__}: {e}"
     emit(result)

@@ -14,6 +14,7 @@ BENCHES = [
     "bench_dcf.py",
     "bench_pir.py",
     "bench_heavy_hitters.py",
+    "bench_intmodn_sample.py",
 ]
 
 

@@ -159,33 +159,41 @@ def _dcf_batch_jit(
     )
 
 
-def batch_evaluate(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
-    """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe]."""
+def _prep_points(dcf, keys: Sequence, xs: Sequence[int], p_pad: int):
+    """Shared host precompute for the batched evaluators: point validation,
+    correction-word batch, per-point tree paths, capture tables."""
     v = dcf.dpf.validator
     n = dcf.log_domain_size
-    bits, xor_group = evaluator._value_kind(dcf.value_type)
     num_points = len(xs)
     for x in xs:
         if x < 0 or (n < 128 and int(x) >= (1 << n)):
             raise ValueError(f"evaluation point {x} outside the domain")
     batch = evaluator.KeyBatch.from_keys(dcf.dpf, [k.key for k in keys])
-    T = batch.num_levels
-    k = len(keys)
-
-    p_pad = max(32, -(-num_points // 32) * 32)
     xs_padded = np.zeros(p_pad, dtype=object)
     for j, x in enumerate(xs):
         xs_padded[j] = int(x)
-
     # Tree path of each point: the final hierarchy level's tree index.
     last = v.num_hierarchy_levels - 1
     paths = uint128.array_to_limbs(
         [v.domain_to_tree_index(int(x) >> 1, last) for x in xs_padded]
     )
-    path_masks = backend_jax._path_bit_masks(paths, T, p_pad)
     acc_mask, block_sel, depth_to_hierarchy = _capture_tables(
         dcf, xs_padded, num_points
     )
+    return batch, paths, acc_mask, block_sel, depth_to_hierarchy
+
+
+def batch_evaluate(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
+    """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe]."""
+    bits, xor_group = evaluator._value_kind(dcf.value_type)
+    num_points = len(xs)
+    k = len(keys)
+    p_pad = max(32, -(-num_points // 32) * 32)
+    batch, paths, acc_mask, block_sel, depth_to_hierarchy = _prep_points(
+        dcf, keys, xs, p_pad
+    )
+    T = batch.num_levels
+    path_masks = backend_jax._path_bit_masks(paths, T, p_pad)
     vc_full = _value_corrections_all(dcf, keys, depth_to_hierarchy)
     vc = np.ascontiguousarray(
         evaluator._correction_limbs(
@@ -211,3 +219,57 @@ def batch_evaluate(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
         xor_group=xor_group,
     )
     return np.asarray(out)[:, :num_points]
+
+
+def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
+    """Host-engine fused batched DCF evaluation (native AES-NI).
+
+    The same O(n) one-walk-per-point pass as `batch_evaluate`, executed by
+    native/dpf_native.cc:dpf_dcf_evaluate_u64 — one FFI call per key.
+    Additive Int outputs up to 64 bits (the benchmark configs); use
+    `batch_evaluate` for XOR groups / 128-bit values. Returns uint64[K, P]
+    shares, bit-identical to the device path.
+    """
+    from .. import native
+    from ..core import backend_numpy
+
+    bits, xor_group = evaluator._value_kind(dcf.value_type)
+    if xor_group or bits > 64:
+        raise ValueError(
+            "batch_evaluate_host supports additive Int values up to 64 bits; "
+            "use batch_evaluate for XOR groups and 128-bit values"
+        )
+    if not native.available():
+        raise RuntimeError("native AES-NI engine unavailable on this host")
+    num_points = len(xs)
+    k = len(keys)
+    batch, paths, acc_mask, block_sel, depth_to_hierarchy = _prep_points(
+        dcf, keys, xs, num_points
+    )
+    capture = np.array([i >= 0 for i in depth_to_hierarchy], dtype=np.uint8)
+    vc_limbs = _value_corrections_all(dcf, keys, depth_to_hierarchy)
+    # uint64 view of the per-element corrections (low two limbs).
+    vc64 = (
+        vc_limbs[..., 0].astype(np.uint64)
+        | (vc_limbs[..., 1].astype(np.uint64) << np.uint64(32))
+    )  # [K, T+1, epb]
+    rkl = np.asarray(backend_numpy._PRG_LEFT._round_keys)
+    rkr = np.asarray(backend_numpy._PRG_RIGHT._round_keys)
+    rkv = np.asarray(backend_numpy._PRG_VALUE._round_keys)
+    out = np.empty((k, num_points), dtype=np.uint64)
+    for j in range(k):
+        out[j] = native.dcf_evaluate_u64(
+            rkl, rkr, rkv,
+            batch.seeds[j],
+            batch.party,
+            batch.cw_seeds[j],
+            batch.cw_left[j],
+            batch.cw_right[j],
+            vc64[j],
+            capture,
+            acc_mask[:, :num_points].astype(np.uint8),
+            block_sel[:, :num_points],
+            paths,
+            bits,
+        )
+    return out

@@ -420,6 +420,122 @@ void dpf_expand_forest(const uint8_t* rks_left, const uint8_t* rks_right,
   }
 }
 
+// Fused batched DCF evaluation for one key, <= 64-bit additive outputs
+// (the O(n) root-to-leaf pass of dcf/batch.py on the host): each point
+// walks the incremental DPF's tree once; at every capturing depth d the
+// current seed is value-hashed, the addressed element extracted, the value
+// correction applied under the control bit, party-negated, and accumulated
+// into the point's sum iff acc_mask says the point's bit at that level is
+// 0 (f(x) = sum of prefix shares where bit_i(x) = 0,
+// /root/reference/dcf/distributed_comparison_function.h:83-107 — but one
+// walk total instead of one per bit). 4 points pipelined; value hash and
+// walk AES interleave in the same registers.
+//
+//   vc:        (T+1) * epb uint64 value corrections (by depth, element)
+//   capture:   (T+1) bytes, 1 if a hierarchy level outputs at this depth
+//   acc_mask:  (T+1) x P bytes (1 = accumulate)
+//   block_sel: (T+1) x P int32 element index within the block
+//   paths:     P x 16 bytes (tree index at the final depth)
+//   out:       P uint64 accumulated shares
+void dpf_dcf_evaluate_u64(
+    const uint8_t* rks_left, const uint8_t* rks_right, const uint8_t* rks_value,
+    const uint8_t* seed0, int party, const uint8_t* cw_seeds,
+    const uint8_t* cw_left, const uint8_t* cw_right, const uint64_t* vc,
+    const uint8_t* capture, const uint8_t* acc_mask, const int32_t* block_sel,
+    const uint8_t* paths, int value_bits, int epb, int levels /* T */,
+    size_t n_points, uint64_t* out) {
+  __m128i rl[11], rdiff[11], rv[11];
+  load_rks(rks_left, rl);
+  {
+    __m128i rr[11];
+    load_rks(rks_right, rr);
+    for (int i = 0; i < 11; ++i) rdiff[i] = _mm_xor_si128(rl[i], rr[i]);
+  }
+  load_rks(rks_value, rv);
+  const __m128i low_bit = _mm_set_epi64x(0, 1);
+  const uint64_t value_mask =
+      value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
+  const size_t stride = n_points;  // row stride of acc_mask / block_sel
+
+  for (size_t i0 = 0; i0 < n_points; i0 += 4) {
+    const int lanes =
+        static_cast<int>(n_points - i0 < 4 ? n_points - i0 : 4);
+    __m128i s[4];
+    uint64_t path_lo[4] = {0}, path_hi[4] = {0}, acc[4] = {0, 0, 0, 0};
+    uint8_t t[4] = {0};
+    for (int j = 0; j < lanes; ++j) {
+      s[j] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(seed0));
+      const uint64_t* p =
+          reinterpret_cast<const uint64_t*>(paths + 16 * (i0 + j));
+      path_lo[j] = p[0];
+      path_hi[j] = p[1];
+      t[j] = static_cast<uint8_t>(party & 1);
+    }
+    for (int depth = 0; depth <= levels; ++depth) {
+      if (capture[depth]) {
+        // Value hash of the current seeds (one block: values <= 64 bits),
+        // element select, correction under control bit, party negation,
+        // masked accumulate.
+        __m128i b[4], sg[4];
+        for (int j = 0; j < lanes; ++j) {
+          sg[j] = sigma(s[j]);
+          b[j] = _mm_xor_si128(sg[j], rv[0]);
+        }
+        for (int r = 1; r < 10; ++r)
+          for (int j = 0; j < lanes; ++j) b[j] = _mm_aesenc_si128(b[j], rv[r]);
+        for (int j = 0; j < lanes; ++j) {
+          b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rv[10]), sg[j]);
+          uint64_t blk[2];
+          _mm_storeu_si128(reinterpret_cast<__m128i*>(blk), b[j]);
+          const int32_t sel = block_sel[depth * stride + i0 + j];
+          const int bit_off = static_cast<int>(sel) * value_bits;
+          uint64_t v = blk[bit_off >> 6] >> (bit_off & 63);
+          if ((bit_off & 63) != 0 && value_bits > 64 - (bit_off & 63))
+            v |= blk[(bit_off >> 6) + 1] << (64 - (bit_off & 63));
+          v &= value_mask;
+          if (t[j])
+            v = (v + vc[static_cast<size_t>(depth) * epb + sel]) & value_mask;
+          if (party) v = (0 - v) & value_mask;
+          if (acc_mask[depth * stride + i0 + j])
+            acc[j] = (acc[j] + v) & value_mask;
+        }
+      }
+      if (depth == levels) break;
+      // Walk one level: select the child along the point's path bit.
+      const int bit_index = levels - 1 - depth;
+      const __m128i cw = _mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(cw_seeds + 16 * depth));
+      const uint8_t ccl = cw_left[depth], ccr = cw_right[depth];
+      __m128i m[4], sg[4], b[4];
+      uint8_t bit[4];
+      for (int j = 0; j < lanes; ++j) {
+        bit[j] = static_cast<uint8_t>(
+            ((bit_index < 64 ? path_lo[j] : path_hi[j]) >> (bit_index & 63)) &
+            1);
+        m[j] = _mm_set1_epi8(bit[j] ? static_cast<char>(0xFF) : 0);
+        sg[j] = sigma(s[j]);
+        b[j] = _mm_xor_si128(
+            sg[j], _mm_xor_si128(rl[0], _mm_and_si128(rdiff[0], m[j])));
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int j = 0; j < lanes; ++j)
+          b[j] = _mm_aesenc_si128(
+              b[j], _mm_xor_si128(rl[r], _mm_and_si128(rdiff[r], m[j])));
+      for (int j = 0; j < lanes; ++j) {
+        b[j] = _mm_xor_si128(
+            _mm_aesenclast_si128(
+                b[j], _mm_xor_si128(rl[10], _mm_and_si128(rdiff[10], m[j]))),
+            sg[j]);
+        if (t[j]) b[j] = _mm_xor_si128(b[j], cw);
+        uint8_t nt = static_cast<uint8_t>(_mm_cvtsi128_si64(b[j]) & 1);
+        t[j] = static_cast<uint8_t>(nt ^ (t[j] & (bit[j] ? ccr : ccl)));
+        s[j] = _mm_andnot_si128(low_bit, b[j]);
+      }
+    }
+    for (int j = 0; j < lanes; ++j) out[i0 + j] = acc[j];
+  }
+}
+
 // Value-PRG hash with block offsets: out[i*bn + j] = MMO(in[i] + j) for
 // j < bn (HashExpandedSeeds, distributed_point_function.cc:500-524) — the
 // uint128 + j addition and the hash in one native pass.
@@ -478,6 +594,11 @@ void dpf_expand_forest(const uint8_t*, const uint8_t*, const uint8_t*,
                        const uint8_t*, size_t, int, uint8_t*, uint8_t*,
                        uint8_t*) {}
 void dpf_value_hash(const uint8_t*, const uint8_t*, size_t, int, uint8_t*) {}
+void dpf_dcf_evaluate_u64(const uint8_t*, const uint8_t*, const uint8_t*,
+                          const uint8_t*, int, const uint8_t*, const uint8_t*,
+                          const uint8_t*, const uint64_t*, const uint8_t*,
+                          const uint8_t*, const int32_t*, const uint8_t*, int,
+                          int, int, size_t, uint64_t*) {}
 }
 
 #endif

@@ -140,3 +140,53 @@ def test_batched_dcf_keygen_matches_sequential():
     dcf_t = DistributedComparisonFunction.create(4, TupleType(Int(32), Int(32)))
     ka, kb = dcf_t.generate_keys_batch([5, 6], (7, 9))
     assert len(ka) == 2
+
+
+def test_batch_evaluate_host_matches_device():
+    import numpy as np
+    import pytest
+
+    from distributed_point_functions_tpu import native
+    from distributed_point_functions_tpu.dcf import batch as dcf_batch
+    from distributed_point_functions_tpu.dcf.dcf import (
+        DistributedComparisonFunction,
+    )
+    from distributed_point_functions_tpu.core.value_types import Int
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    rng = np.random.default_rng(0x0DCF)
+    for vt in (Int(16), Int(64)):
+        dcf = DistributedComparisonFunction.create(9, vt)
+        alphas = [7, 300, 511]
+        keys = []
+        for a in alphas:
+            ka, kb = dcf.generate_keys(a, 5)
+            keys.extend([ka, kb])
+        xs = [int(x) for x in rng.integers(0, 512, size=25)] + [0, 511]
+        for key in keys:
+            host = dcf_batch.batch_evaluate_host(dcf, [key], xs)[0]
+            dev = np.asarray(dcf_batch.batch_evaluate(dcf, [key], xs))[0]
+            dev64 = dev[..., 0].astype(np.uint64)
+            if dev.shape[-1] > 1:
+                dev64 |= dev[..., 1].astype(np.uint64) << np.uint64(32)
+            mask = np.uint64((1 << vt.bitsize) - 1)
+            np.testing.assert_array_equal(host & mask, dev64 & mask)
+
+
+def test_batch_evaluate_host_rejects_unsupported():
+    import pytest
+
+    from distributed_point_functions_tpu import native
+    from distributed_point_functions_tpu.dcf import batch as dcf_batch
+    from distributed_point_functions_tpu.dcf.dcf import (
+        DistributedComparisonFunction,
+    )
+    from distributed_point_functions_tpu.core.value_types import XorWrapper
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    dcf = DistributedComparisonFunction.create(4, XorWrapper(64))
+    ka, _ = dcf.generate_keys(3, 1)
+    with pytest.raises(ValueError, match="additive Int"):
+        dcf_batch.batch_evaluate_host(dcf, [ka], [0])

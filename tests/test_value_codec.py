@@ -175,6 +175,7 @@ def test_intmodn_hierarchy_config3_shape(num_levels):
             assert total == (betas[level] if x == prefix else 0), (level, x)
 
 
+@pytest.mark.slow
 def test_modn_point_eval_large_base():
     """IntModN over a 128-bit base integer (modulus 2^80-65), point eval."""
     vt = IntModN(128, MOD80)
