@@ -1,0 +1,94 @@
+"""Device-correctness checker: the default JAX backend vs the host oracle.
+
+Runs the batched full-domain evaluator at several (keys, domain) shapes and
+compares per-key XOR folds against the native host engine, printing one
+verdict line per shape and exiting nonzero on any mismatch. This is the
+standalone form of the verification bench.py performs before reporting —
+written after on-chip checks found this image's TPU tunnel corrupting the
+upper 16 lanes of every packed word in 64-key multi-level programs while
+the identical program is bit-exact on XLA:CPU (PERF.md "Platform
+findings"). Run it whenever the platform changes:
+
+    python tools/check_device.py            # default backend
+    JAX_PLATFORMS=cpu python tools/check_device.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+
+    # Under this image's sitecustomize, jax may already be imported with
+    # the platform pointing at TPU hardware; the env var alone is too late
+    # (same pitfall as tests/conftest.py) — force the platform in-process.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.host_eval import (
+        full_domain_evaluate_host,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import evaluator
+
+    try:
+        cache = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+    rng = np.random.default_rng(7)
+    failures = 0
+    # Default shapes = the headline program family (64-key chunks), the
+    # shape observed corrupting on the axon tunnel. Each extra shape costs
+    # a full compile of its program family — override via CHECK_SHAPES,
+    # e.g. CHECK_SHAPES="1x12,8x12,64x20".
+    shapes = [
+        tuple(int(v) for v in s.split("x"))
+        for s in os.environ.get("CHECK_SHAPES", "64x20").split(",")
+    ]
+    for num_keys, lds in shapes:
+        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
+        betas = [[int(x) for x in rng.integers(1, 1000, size=num_keys)]]
+        keys, _ = dpf.generate_keys_batch(alphas, betas)
+        host = full_domain_evaluate_host(dpf, keys)
+        want = np.bitwise_xor.reduce(host, axis=1)
+        folds = []
+        for valid, out in evaluator.full_domain_evaluate_chunks(
+            dpf, keys, key_chunk=num_keys
+        ):
+            folds.append(np.asarray(jnp.bitwise_xor.reduce(out, axis=1))[:valid])
+        got = np.concatenate(folds, axis=0)
+        got64 = got[:, 0].astype(np.uint64) | (
+            got[:, 1].astype(np.uint64) << np.uint64(32)
+        )
+        bad = int((got64 != want).sum())
+        status = "OK" if bad == 0 else f"MISMATCH ({bad}/{num_keys} keys)"
+        print(f"keys={num_keys:4d} log_domain={lds:3d}: {status}")
+        failures += bad
+    if failures:
+        print(
+            "DEVICE OUTPUT IS WRONG on this backend — do not trust its "
+            "performance numbers (PERF.md 'Platform findings')."
+        )
+        return 1
+    print("all shapes verified against the host oracle")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
