@@ -28,7 +28,8 @@ _tried = False
 
 def _build() -> bool:
     cmd = [
-        "g++", "-O3", "-maes", "-mssse3", "-shared", "-fPIC", _SRC, "-o", _LIB,
+        "g++", "-O3", "-maes", "-mssse3", "-pthread", "-shared", "-fPIC",
+        _SRC, "-o", _LIB,
     ]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)

@@ -7,17 +7,61 @@
 //  /root/reference/dpf/internal/aes_128_fixed_key_hash_hwy.h:62-229) —
 // written from scratch against the AES-NI intrinsics, not ported.
 //
-// Build:  g++ -O3 -maes -mssse3 -shared -fPIC dpf_native.cc -o libdpf_native.so
+// Build:  g++ -O3 -maes -mssse3 -pthread -shared -fPIC dpf_native.cc -o libdpf_native.so
 // ABI: plain C, little-endian 16-byte blocks (the uint32[,4] limb layout).
 
 #include <cstddef>
 #include <cstdint>
+#include <cstdlib>
+#include <memory>
+#include <thread>
+#include <vector>
 
 #if defined(__AES__) && defined(__SSSE3__)
 #include <wmmintrin.h>
 #include <tmmintrin.h>
 
 namespace {
+
+// Host-side worker threads for the batch kernels. The reference library is
+// single-threaded by design; every cross-implementation number in this
+// repo was measured with the default of 1. DPF_TPU_THREADS=N opts in,
+// DPF_TPU_THREADS=0 uses all hardware threads. Outputs are bit-identical
+// at any thread count (work splits are by disjoint index ranges).
+int num_threads() {
+  static int n = [] {
+    const char* env = std::getenv("DPF_TPU_THREADS");
+    if (env == nullptr || *env == '\0') return 1;
+    char* end = nullptr;
+    long v = std::strtol(env, &end, 10);
+    if (end == env || *end != '\0') return 1;  // non-numeric: stay at 1
+    if (v == 0) v = static_cast<long>(std::thread::hardware_concurrency());
+    return v < 1 ? 1 : static_cast<int>(v);
+  }();
+  return n;
+}
+
+// Runs fn(begin, end) over [0, total) split into `threads` contiguous
+// ranges aligned to `align` (so SIMD groups never straddle a boundary).
+template <typename Fn>
+void parallel_ranges(size_t total, size_t align, const Fn& fn) {
+  const int t = num_threads();
+  if (t <= 1 || total <= align * 2) {
+    fn(static_cast<size_t>(0), total);
+    return;
+  }
+  const size_t groups = (total + align - 1) / align;
+  const size_t per = (groups + t - 1) / t;
+  std::vector<std::thread> workers;
+  for (int i = 0; i < t; ++i) {
+    const size_t a = static_cast<size_t>(i) * per * align;
+    if (a >= total) break;
+    size_t b = a + per * align;
+    if (b > total) b = total;
+    workers.emplace_back([&fn, a, b] { fn(a, b); });
+  }
+  for (auto& w : workers) w.join();
+}
 
 inline __m128i expand_step(__m128i key, __m128i keygened) {
   keygened = _mm_shuffle_epi32(keygened, _MM_SHUFFLE(3, 3, 3, 3));
@@ -77,8 +121,9 @@ void dpf_mmo_hash(const uint8_t* rks_bytes, const uint8_t* in, uint8_t* out,
                   size_t n) {
   __m128i rks[11];
   load_rks(rks_bytes, rks);
-  size_t i = 0;
-  for (; i + 8 <= n; i += 8) {
+  parallel_ranges(n, 8, [&](size_t begin, size_t end) {
+  size_t i = begin;
+  for (; i + 8 <= end; i += 8) {
     __m128i s[8];
     for (int j = 0; j < 8; ++j)
       s[j] = sigma(_mm_loadu_si128(
@@ -92,12 +137,13 @@ void dpf_mmo_hash(const uint8_t* rks_bytes, const uint8_t* in, uint8_t* out,
       _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * (i + j)), b[j]);
     }
   }
-  for (; i < n; ++i) {
+  for (; i < end; ++i) {
     __m128i s =
         sigma(_mm_loadu_si128(reinterpret_cast<const __m128i*>(in + 16 * i)));
     __m128i e = _mm_xor_si128(encrypt(s, rks), s);
     _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * i), e);
   }
+  });
 }
 
 // Two-key MMO hash with per-block key selection (mask[i] != 0 -> right key):
@@ -142,67 +188,21 @@ void dpf_mmo_hash_masked(const uint8_t* rks_left, const uint8_t* rks_right,
 //   out_seeds:      (1 << levels) * 16 bytes, leaf order
 //   out_control:    (1 << levels) bytes (0/1)
 //   scratch:        (1 << levels) * 16 bytes working buffer
+void dpf_expand_forest(const uint8_t*, const uint8_t*, const uint8_t*,
+                       const uint8_t*, const uint8_t*, const uint8_t*,
+                       const uint8_t*, size_t, int, uint8_t*, uint8_t*,
+                       uint8_t*);  // forward declaration (defined below)
+
+// Full doubling expansion of one key: the n=1 case of dpf_expand_forest
+// (4-wide pipelined, worker threads at wide levels).
 void dpf_expand_tree(const uint8_t* rks_left, const uint8_t* rks_right,
                      const uint8_t* seed0, const uint8_t* cw_seeds,
                      const uint8_t* cw_left, const uint8_t* cw_right,
                      int party, int levels, uint8_t* out_seeds,
                      uint8_t* out_control, uint8_t* scratch) {
-  __m128i rl[11], rr[11];
-  load_rks(rks_left, rl);
-  load_rks(rks_right, rr);
-  const __m128i low_bit = _mm_set_epi64x(0, 1);
-
-  uint8_t* cur = scratch;
-  uint8_t* nxt = out_seeds;
-  // Control bits ping-pong in the out_control buffer's two halves is not
-  // possible (it is only 2^levels bytes); keep a parallel scratch tail of
-  // the seed buffers: control byte i of level l lives in cur_ctl[i].
-  uint8_t* cur_ctl = out_control;          // reused across levels
-  for (int i = 0; i < 16; ++i) cur[i] = seed0[i];
-  cur_ctl[0] = static_cast<uint8_t>(party & 1);
-
-  for (int level = 0; level < levels; ++level) {
-    const size_t parents = static_cast<size_t>(1) << level;
-    const __m128i cw =
-        _mm_loadu_si128(reinterpret_cast<const __m128i*>(cw_seeds + 16 * level));
-    const uint8_t ccl = cw_left[level], ccr = cw_right[level];
-    // Walk parents in reverse so children can be written into the same
-    // control buffer without clobbering unread parents (child indices
-    // 2i, 2i+1 are >= i).
-    for (size_t i = parents; i-- > 0;) {
-      const __m128i s =
-          sigma(_mm_loadu_si128(reinterpret_cast<const __m128i*>(cur + 16 * i)));
-      const uint8_t t = cur_ctl[i];
-      const __m128i corr = t ? cw : _mm_setzero_si128();
-      __m128i bl = _mm_xor_si128(s, rl[0]);
-      __m128i br = _mm_xor_si128(s, rr[0]);
-      for (int r = 1; r < 10; ++r) {
-        bl = _mm_aesenc_si128(bl, rl[r]);
-        br = _mm_aesenc_si128(br, rr[r]);
-      }
-      bl = _mm_xor_si128(_mm_aesenclast_si128(bl, rl[10]), s);
-      br = _mm_xor_si128(_mm_aesenclast_si128(br, rr[10]), s);
-      bl = _mm_xor_si128(bl, corr);
-      br = _mm_xor_si128(br, corr);
-      uint8_t ctl_l = static_cast<uint8_t>(
-          (_mm_cvtsi128_si64(bl) & 1) ^ (t & ccl));
-      uint8_t ctl_r = static_cast<uint8_t>(
-          (_mm_cvtsi128_si64(br) & 1) ^ (t & ccr));
-      bl = _mm_andnot_si128(low_bit, bl);
-      br = _mm_andnot_si128(low_bit, br);
-      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i)), bl);
-      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i + 1)), br);
-      cur_ctl[2 * i] = ctl_l;
-      cur_ctl[2 * i + 1] = ctl_r;
-    }
-    uint8_t* t = cur;
-    cur = nxt;
-    nxt = t;
-  }
-  if (cur != out_seeds) {
-    const size_t bytes = (static_cast<size_t>(1) << levels) * 16;
-    for (size_t i = 0; i < bytes; ++i) out_seeds[i] = cur[i];
-  }
+  const uint8_t ctl0 = static_cast<uint8_t>(party & 1);
+  dpf_expand_forest(rks_left, rks_right, seed0, &ctl0, cw_seeds, cw_left,
+                    cw_right, 1, levels, out_seeds, out_control, scratch);
 }
 
 // Batched point-evaluation walk: n seeds descend `levels` tree levels, each
@@ -233,8 +233,9 @@ void dpf_evaluate_seeds(const uint8_t* rks_left, const uint8_t* rks_right,
   }
   const __m128i low_bit = _mm_set_epi64x(0, 1);
 
-  size_t i = 0;
-  for (; i + 8 <= n; i += 8) {
+  parallel_ranges(n, 8, [&](size_t begin, size_t end) {
+  size_t i = begin;
+  for (; i + 8 <= end; i += 8) {
     __m128i s[8];
     uint64_t path_lo[8], path_hi[8];
     uint8_t t[8];
@@ -288,7 +289,7 @@ void dpf_evaluate_seeds(const uint8_t* rks_left, const uint8_t* rks_right,
       ctl_out[i + j] = t[j];
     }
   }
-  for (; i < n; ++i) {  // scalar tail
+  for (; i < end; ++i) {  // scalar tail
     __m128i s =
         _mm_loadu_si128(reinterpret_cast<const __m128i*>(seeds_in + 16 * i));
     const uint64_t* p = reinterpret_cast<const uint64_t*>(paths + 16 * i);
@@ -321,6 +322,7 @@ void dpf_evaluate_seeds(const uint8_t* rks_left, const uint8_t* rks_right,
     _mm_storeu_si128(reinterpret_cast<__m128i*>(seeds_out + 16 * i), s);
     ctl_out[i] = t;
   }
+  });
 }
 
 // Doubling expansion of a *forest*: n root seeds expand `levels` levels to
@@ -341,82 +343,94 @@ void dpf_expand_forest(const uint8_t* rks_left, const uint8_t* rks_right,
   load_rks(rks_right, rr);
   const __m128i low_bit = _mm_set_epi64x(0, 1);
 
-  // Ping-pong so the final level lands in out_seeds.
+  // Seeds ping-pong between scratch and out_seeds so the final level lands
+  // in out_seeds; control bits ping-pong between out_control and an
+  // internal scratch (dual buffers keep every parent read disjoint from
+  // every child write, which lets levels split across worker threads — the
+  // old single-buffer reverse-walk trick serializes).
   uint8_t* cur = (levels % 2 == 0) ? out_seeds : scratch;
   uint8_t* nxt = (levels % 2 == 0) ? scratch : out_seeds;
+  // The scratch only ever holds an intermediate level (the final level's
+  // parity lands in out_control), so half the output size suffices;
+  // new[] leaves it uninitialized — no memset of up-to-gigabyte buffers.
+  const size_t scratch_ctl_size =
+      levels > 0 ? (n << (levels - 1)) : n;
+  std::unique_ptr<uint8_t[]> ctl_scratch(new uint8_t[scratch_ctl_size]);
+  uint8_t* ctl_cur = (levels % 2 == 0) ? out_control : ctl_scratch.get();
+  uint8_t* ctl_nxt = (levels % 2 == 0) ? ctl_scratch.get() : out_control;
   for (size_t i = 0; i < 16 * n; ++i) cur[i] = seeds0[i];
-  uint8_t* ctl = out_control;  // reused across levels (children >= parent)
-  for (size_t i = 0; i < n; ++i) ctl[i] = ctl0[i];
+  for (size_t i = 0; i < n; ++i) ctl_cur[i] = ctl0[i];
 
   for (int level = 0; level < levels; ++level) {
     const size_t parents = n << level;
     const __m128i cw = _mm_loadu_si128(
         reinterpret_cast<const __m128i*>(cw_seeds + 16 * level));
     const uint8_t ccl = cw_left[level], ccr = cw_right[level];
-    // Reverse walk so children can share the control buffer with parents.
-    size_t i = parents;
-    while (i >= 4) {
-      i -= 4;
-      __m128i sg[4], bl[4], br[4];
-      uint8_t t[4];
-      for (int j = 0; j < 4; ++j) {
-        sg[j] = sigma(_mm_loadu_si128(
-            reinterpret_cast<const __m128i*>(cur + 16 * (i + j))));
-        t[j] = ctl[i + j];
-        bl[j] = _mm_xor_si128(sg[j], rl[0]);
-        br[j] = _mm_xor_si128(sg[j], rr[0]);
-      }
-      for (int r = 1; r < 10; ++r)
+    parallel_ranges(parents, 4, [&](size_t a, size_t bnd) {
+      size_t i = a;
+      for (; i + 4 <= bnd; i += 4) {
+        __m128i sg[4], bl[4], br[4];
+        uint8_t t[4];
         for (int j = 0; j < 4; ++j) {
-          bl[j] = _mm_aesenc_si128(bl[j], rl[r]);
-          br[j] = _mm_aesenc_si128(br[j], rr[r]);
+          sg[j] = sigma(_mm_loadu_si128(
+              reinterpret_cast<const __m128i*>(cur + 16 * (i + j))));
+          t[j] = ctl_cur[i + j];
+          bl[j] = _mm_xor_si128(sg[j], rl[0]);
+          br[j] = _mm_xor_si128(sg[j], rr[0]);
         }
-      for (int j = 0; j < 4; ++j) {
-        const __m128i corr = t[j] ? cw : _mm_setzero_si128();
-        bl[j] = _mm_xor_si128(
-            _mm_xor_si128(_mm_aesenclast_si128(bl[j], rl[10]), sg[j]), corr);
-        br[j] = _mm_xor_si128(
-            _mm_xor_si128(_mm_aesenclast_si128(br[j], rr[10]), sg[j]), corr);
-        const size_t c = 2 * (i + j);
-        uint8_t ctl_l =
-            static_cast<uint8_t>((_mm_cvtsi128_si64(bl[j]) & 1) ^ (t[j] & ccl));
-        uint8_t ctl_r =
-            static_cast<uint8_t>((_mm_cvtsi128_si64(br[j]) & 1) ^ (t[j] & ccr));
-        _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * c),
-                         _mm_andnot_si128(low_bit, bl[j]));
-        _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (c + 1)),
-                         _mm_andnot_si128(low_bit, br[j]));
-        ctl[c] = ctl_l;
-        ctl[c + 1] = ctl_r;
+        for (int r = 1; r < 10; ++r)
+          for (int j = 0; j < 4; ++j) {
+            bl[j] = _mm_aesenc_si128(bl[j], rl[r]);
+            br[j] = _mm_aesenc_si128(br[j], rr[r]);
+          }
+        for (int j = 0; j < 4; ++j) {
+          const __m128i corr = t[j] ? cw : _mm_setzero_si128();
+          bl[j] = _mm_xor_si128(
+              _mm_xor_si128(_mm_aesenclast_si128(bl[j], rl[10]), sg[j]), corr);
+          br[j] = _mm_xor_si128(
+              _mm_xor_si128(_mm_aesenclast_si128(br[j], rr[10]), sg[j]), corr);
+          const size_t c = 2 * (i + j);
+          ctl_nxt[c] = static_cast<uint8_t>((_mm_cvtsi128_si64(bl[j]) & 1) ^
+                                            (t[j] & ccl));
+          ctl_nxt[c + 1] = static_cast<uint8_t>(
+              (_mm_cvtsi128_si64(br[j]) & 1) ^ (t[j] & ccr));
+          _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * c),
+                           _mm_andnot_si128(low_bit, bl[j]));
+          _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (c + 1)),
+                           _mm_andnot_si128(low_bit, br[j]));
+        }
       }
-    }
-    while (i-- > 0) {
-      const __m128i sg = sigma(
-          _mm_loadu_si128(reinterpret_cast<const __m128i*>(cur + 16 * i)));
-      const uint8_t t = ctl[i];
-      const __m128i corr = t ? cw : _mm_setzero_si128();
-      __m128i bl = _mm_xor_si128(sg, rl[0]);
-      __m128i br = _mm_xor_si128(sg, rr[0]);
-      for (int r = 1; r < 10; ++r) {
-        bl = _mm_aesenc_si128(bl, rl[r]);
-        br = _mm_aesenc_si128(br, rr[r]);
+      for (; i < bnd; ++i) {
+        const __m128i sg = sigma(
+            _mm_loadu_si128(reinterpret_cast<const __m128i*>(cur + 16 * i)));
+        const uint8_t t = ctl_cur[i];
+        const __m128i corr = t ? cw : _mm_setzero_si128();
+        __m128i bl = _mm_xor_si128(sg, rl[0]);
+        __m128i br = _mm_xor_si128(sg, rr[0]);
+        for (int r = 1; r < 10; ++r) {
+          bl = _mm_aesenc_si128(bl, rl[r]);
+          br = _mm_aesenc_si128(br, rr[r]);
+        }
+        bl = _mm_xor_si128(
+            _mm_xor_si128(_mm_aesenclast_si128(bl, rl[10]), sg), corr);
+        br = _mm_xor_si128(
+            _mm_xor_si128(_mm_aesenclast_si128(br, rr[10]), sg), corr);
+        ctl_nxt[2 * i] =
+            static_cast<uint8_t>((_mm_cvtsi128_si64(bl) & 1) ^ (t & ccl));
+        ctl_nxt[2 * i + 1] =
+            static_cast<uint8_t>((_mm_cvtsi128_si64(br) & 1) ^ (t & ccr));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i)),
+                         _mm_andnot_si128(low_bit, bl));
+        _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i + 1)),
+                         _mm_andnot_si128(low_bit, br));
       }
-      bl = _mm_xor_si128(
-          _mm_xor_si128(_mm_aesenclast_si128(bl, rl[10]), sg), corr);
-      br = _mm_xor_si128(
-          _mm_xor_si128(_mm_aesenclast_si128(br, rr[10]), sg), corr);
-      uint8_t ctl_l = static_cast<uint8_t>((_mm_cvtsi128_si64(bl) & 1) ^ (t & ccl));
-      uint8_t ctl_r = static_cast<uint8_t>((_mm_cvtsi128_si64(br) & 1) ^ (t & ccr));
-      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i)),
-                       _mm_andnot_si128(low_bit, bl));
-      _mm_storeu_si128(reinterpret_cast<__m128i*>(nxt + 16 * (2 * i + 1)),
-                       _mm_andnot_si128(low_bit, br));
-      ctl[2 * i] = ctl_l;
-      ctl[2 * i + 1] = ctl_r;
-    }
+    });
     uint8_t* tmp = cur;
     cur = nxt;
     nxt = tmp;
+    uint8_t* ctmp = ctl_cur;
+    ctl_cur = ctl_nxt;
+    ctl_nxt = ctmp;
   }
 }
 
@@ -457,9 +471,10 @@ void dpf_dcf_evaluate_u64(
       value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
   const size_t stride = n_points;  // row stride of acc_mask / block_sel
 
-  for (size_t i0 = 0; i0 < n_points; i0 += 4) {
+  parallel_ranges(n_points, 4, [&](size_t begin, size_t end) {
+  for (size_t i0 = begin; i0 < end; i0 += 4) {
     const int lanes =
-        static_cast<int>(n_points - i0 < 4 ? n_points - i0 : 4);
+        static_cast<int>(end - i0 < 4 ? end - i0 : 4);
     __m128i s[4];
     uint64_t path_lo[4] = {0}, path_hi[4] = {0}, acc[4] = {0, 0, 0, 0};
     uint8_t t[4] = {0};
@@ -534,6 +549,7 @@ void dpf_dcf_evaluate_u64(
     }
     for (int j = 0; j < lanes; ++j) out[i0 + j] = acc[j];
   }
+  });
 }
 
 // Value-PRG hash with block offsets: out[i*bn + j] = MMO(in[i] + j) for
@@ -544,32 +560,33 @@ void dpf_value_hash(const uint8_t* rks_bytes, const uint8_t* in, size_t n,
   __m128i rks[11];
   load_rks(rks_bytes, rks);
   const size_t total = n * static_cast<size_t>(blocks_needed);
-  size_t w = 0;  // flat output index
-  __m128i s[8];
-  size_t done = 0;
-  while (done < total) {
-    int lanes = 0;
-    for (; lanes < 8 && done + lanes < total; ++lanes) {
-      const size_t flat = done + lanes;
-      const size_t i = flat / blocks_needed;
-      const uint64_t j = static_cast<uint64_t>(flat % blocks_needed);
-      const uint64_t* p = reinterpret_cast<const uint64_t*>(in + 16 * i);
-      uint64_t lo = p[0] + j;
-      uint64_t hi = p[1] + (lo < p[0] ? 1 : 0);
-      s[lanes] = sigma(_mm_set_epi64x(static_cast<long long>(hi),
-                                      static_cast<long long>(lo)));
+  parallel_ranges(total, 8, [&](size_t begin, size_t end) {
+    __m128i s[8];
+    size_t done = begin;
+    while (done < end) {
+      int lanes = 0;
+      for (; lanes < 8 && done + lanes < end; ++lanes) {
+        const size_t flat = done + lanes;
+        const size_t i = flat / blocks_needed;
+        const uint64_t j = static_cast<uint64_t>(flat % blocks_needed);
+        const uint64_t* p = reinterpret_cast<const uint64_t*>(in + 16 * i);
+        uint64_t lo = p[0] + j;
+        uint64_t hi = p[1] + (lo < p[0] ? 1 : 0);
+        s[lanes] = sigma(_mm_set_epi64x(static_cast<long long>(hi),
+                                        static_cast<long long>(lo)));
+      }
+      __m128i b[8];
+      for (int j = 0; j < lanes; ++j) b[j] = _mm_xor_si128(s[j], rks[0]);
+      for (int r = 1; r < 10; ++r)
+        for (int j = 0; j < lanes; ++j) b[j] = _mm_aesenc_si128(b[j], rks[r]);
+      for (int j = 0; j < lanes; ++j) {
+        b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rks[10]), s[j]);
+        _mm_storeu_si128(
+            reinterpret_cast<__m128i*>(out + 16 * (done + j)), b[j]);
+      }
+      done += lanes;
     }
-    __m128i b[8];
-    for (int j = 0; j < lanes; ++j) b[j] = _mm_xor_si128(s[j], rks[0]);
-    for (int r = 1; r < 10; ++r)
-      for (int j = 0; j < lanes; ++j) b[j] = _mm_aesenc_si128(b[j], rks[r]);
-    for (int j = 0; j < lanes; ++j) {
-      b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rks[10]), s[j]);
-      _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * w), b[j]);
-      ++w;
-    }
-    done += lanes;
-  }
+  });
 }
 
 }  // extern "C"

@@ -1,5 +1,7 @@
 """Native AES-NI engine vs the pure-numpy oracle (bit-exactness)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -112,3 +114,43 @@ def test_value_hash_matches_numpy(n, blocks):
     want = bn._hash_expanded_seeds_numpy(seeds, blocks)
     got = native.value_hash(bn._PRG_VALUE._round_keys, seeds, blocks)
     np.testing.assert_array_equal(got, want)
+
+
+def test_thread_count_bit_exactness():
+    """DPF_TPU_THREADS must not change any output bit (ranges are disjoint;
+    the env var is read once per process, so compare across subprocesses)."""
+    import hashlib
+    import subprocess
+    import sys
+
+    code = (
+        "import os, sys, hashlib\n"
+        "import numpy as np\n"
+        f"sys.path.insert(0, {repr(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))})\n"
+        "from distributed_point_functions_tpu import native\n"
+        "from distributed_point_functions_tpu.core import backend_numpy as bn\n"
+        "rng = np.random.default_rng(42)\n"
+        "rkl, rkr = bn._PRG_LEFT._round_keys, bn._PRG_RIGHT._round_keys\n"
+        "seeds = rng.integers(0, 2**32, size=(4097, 4), dtype=np.uint32)\n"
+        "ctl = rng.integers(0, 2, size=4097).astype(bool)\n"
+        "paths = rng.integers(0, 2**32, size=(4097, 4), dtype=np.uint32)\n"
+        "cw = rng.integers(0, 2**32, size=(20, 4), dtype=np.uint32)\n"
+        "ccl = rng.integers(0, 2, size=20).astype(bool)\n"
+        "ccr = rng.integers(0, 2, size=20).astype(bool)\n"
+        "s, c = native.evaluate_seeds(rkl, rkr, seeds, ctl, paths, cw, ccl, ccr)\n"
+        "h = hashlib.sha256(s.tobytes() + c.tobytes())\n"
+        "fs, fc = native.expand_forest(rkl, rkr, seeds[:5], ctl[:5], cw[:10], ccl[:10], ccr[:10], 10)\n"
+        "h.update(fs.tobytes() + fc.tobytes())\n"
+        "h.update(native.value_hash(bn._PRG_VALUE._round_keys, seeds[:999], 3).tobytes())\n"
+        "print(h.hexdigest())\n"
+    )
+    digests = set()
+    for t in ("1", "4"):
+        env = dict(os.environ, DPF_TPU_THREADS=t)
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=env, capture_output=True,
+            text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr[-500:]
+        digests.add(r.stdout.strip().splitlines()[-1])
+    assert len(digests) == 1, digests
