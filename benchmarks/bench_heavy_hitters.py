@@ -60,8 +60,8 @@ def bench(jax, smoke):
     num_nonzeros = int(os.environ.get("BENCH_HH_NONZEROS", 10000))
     # Default to the native host engine on every platform: at 10k prefixes
     # x 1 key the workload is ~128 dispatches of ~1 MB expansions, and the
-    # TPU path is dispatch-bound (measured 11.45 s/key on v5e vs 0.22 s/key
-    # host — the framework provides both engines; the device one wins at
+    # TPU path is dispatch-bound (measured 11.45 s/key on v5e vs ~0.22-0.26
+    # s/key host — the framework provides both engines; the device wins at
     # bulk batch sizes, not here). BENCH_HH_ENGINE=device overrides.
     engine = os.environ.get("BENCH_HH_ENGINE", "host")
 
@@ -92,6 +92,28 @@ def bench(jax, smoke):
     log(f"warmup (compile + run): {warm.elapsed:.1f}s")
     with Timer() as t:
         run_once()
+
+    # The reference sweeps Range(16, 128); on the cheap host engine emit
+    # the whole sweep so regenerated results keep it (device sweeps would
+    # compile ~levels programs — single level only there).
+    sweep = {}
+    if engine == "host" and not smoke and "BENCH_HH_LEVELS" not in os.environ:
+        for lv in (16, 32, 64):
+            p_lv = [DpfParameters(i + 1, Int(64)) for i in range(lv)]
+            d_lv = DistributedPointFunction.create_incremental(p_lv)
+            k_lv, _ = d_lv.generate_keys_incremental(42 % (1 << lv), [23] * lv)
+            pre = _uniform_prefixes(lv, num_nonzeros, np.random.default_rng(7))
+            with Timer() as ts:
+                c = hierarchical.BatchedContext.create(d_lv, [k_lv])
+                for level in range(lv):
+                    hierarchical.evaluate_until_batch(
+                        c, level, () if level == 0 else pre[level - 1],
+                        device_output=True, engine="host",
+                    )
+            sweep[str(lv)] = round(ts.elapsed, 4)
+        sweep[str(num_levels)] = round(t.elapsed, 4)
+        log(f"level sweep: {sweep}")
+
     return {
         "bench": "heavy_hitters",
         "metric": (
@@ -104,6 +126,7 @@ def bench(jax, smoke):
             "num_levels": num_levels,
             "num_nonzeros": num_nonzeros,
             "engine": engine,
+            **({"seconds_by_levels": sweep} if sweep else {}),
         },
         **({"platform": "cpu"} if engine == "host" else {}),
     }
