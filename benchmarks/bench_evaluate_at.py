@@ -35,12 +35,13 @@ def bench(jax, smoke):
     points = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_points)]
 
     def run():
-        # device-resident outputs + tiny fold (PERF.md: the host link is
-        # orders of magnitude slower than the evaluation itself)
+        # device-resident outputs + tiny fold PULLED to the host — block_
+        # until_ready alone is not trustworthy timing through this image's
+        # tunnel (PERF.md "Platform findings").
         out = evaluator.evaluate_at_batch(dpf, keys, points, device_output=True)
         import jax.numpy as jnp
 
-        return jax.block_until_ready(jnp.bitwise_xor.reduce(out, axis=1))
+        return np.asarray(jnp.bitwise_xor.reduce(out, axis=1))
 
     with Timer() as warm:
         fold = run()
@@ -50,6 +51,24 @@ def bench(jax, smoke):
         for _ in range(reps):
             run()
     evals = num_keys * num_points * reps
+
+    # Secondary: the native host engine on the same workload, for the
+    # engine-choice record (PERF.md) — the device wins this shape.
+    host_rate = None
+    from distributed_point_functions_tpu import native
+
+    if native.available():
+        from distributed_point_functions_tpu.core.host_eval import (
+            evaluate_at_host,
+        )
+
+        pts_arr = np.asarray(points, dtype=np.uint64)
+        evaluate_at_host(dpf, keys, pts_arr)  # warm (dlopen, KeyBatch prep)
+        with Timer() as th:
+            for _ in range(reps):
+                evaluate_at_host(dpf, keys, pts_arr)
+        host_rate = round(num_keys * num_points * reps / th.elapsed)
+        log(f"host engine: {host_rate} point-evals/s")
     return {
         "bench": "evaluate_at",
         "metric": (
@@ -62,6 +81,11 @@ def bench(jax, smoke):
             "log_domain": log_domain,
             "num_keys": num_keys,
             "num_points": num_points,
+            **(
+                {"host_engine_point_evals_per_s": host_rate}
+                if host_rate
+                else {}
+            ),
         },
     }
 

@@ -65,21 +65,21 @@ def bench(jax, smoke):
     # bulk batch sizes, not here). BENCH_HH_ENGINE=device overrides.
     engine = os.environ.get("BENCH_HH_ENGINE", "host")
 
-    params = [DpfParameters(i + 1, Int(64)) for i in range(num_levels)]
-    dpf = DistributedPointFunction.create_incremental(params)
-    key, _ = dpf.generate_keys_incremental(42, [23] * num_levels)
-    rng = np.random.default_rng(7)
-    prefixes = _uniform_prefixes(num_levels, num_nonzeros, rng)
-    log(f"{num_levels} levels, {len(prefixes[-1])} unique nonzeros, engine={engine}")
+    def make_workload(lv):
+        p_lv = [DpfParameters(i + 1, Int(64)) for i in range(lv)]
+        d_lv = DistributedPointFunction.create_incremental(p_lv)
+        k_lv, _ = d_lv.generate_keys_incremental(42 % (1 << lv), [23] * lv)
+        pre = _uniform_prefixes(lv, num_nonzeros, np.random.default_rng(7))
+        return d_lv, k_lv, pre
 
-    def run_once():
-        ctx = hierarchical.BatchedContext.create(dpf, [key])
+    def run_once(d_lv, k_lv, pre, lv):
+        ctx = hierarchical.BatchedContext.create(d_lv, [k_lv])
         out = None
-        for level in range(num_levels):
+        for level in range(lv):
             out = hierarchical.evaluate_until_batch(
                 ctx,
                 level,
-                () if level == 0 else prefixes[level - 1],
+                () if level == 0 else pre[level - 1],
                 device_output=True,
                 engine=engine,
             )
@@ -87,29 +87,25 @@ def bench(jax, smoke):
             jax.block_until_ready(out)
         return out
 
+    dpf, key, prefixes = make_workload(num_levels)
+    log(f"{num_levels} levels, {len(prefixes[-1])} unique nonzeros, engine={engine}")
     with Timer() as warm:
-        run_once()
+        run_once(dpf, key, prefixes, num_levels)
     log(f"warmup (compile + run): {warm.elapsed:.1f}s")
     with Timer() as t:
-        run_once()
+        run_once(dpf, key, prefixes, num_levels)
 
     # The reference sweeps Range(16, 128); on the cheap host engine emit
     # the whole sweep so regenerated results keep it (device sweeps would
-    # compile ~levels programs — single level only there).
+    # compile ~levels programs — single level only there). Every entry is
+    # a warmed second run, same as the headline number.
     sweep = {}
     if engine == "host" and not smoke and "BENCH_HH_LEVELS" not in os.environ:
         for lv in (16, 32, 64):
-            p_lv = [DpfParameters(i + 1, Int(64)) for i in range(lv)]
-            d_lv = DistributedPointFunction.create_incremental(p_lv)
-            k_lv, _ = d_lv.generate_keys_incremental(42 % (1 << lv), [23] * lv)
-            pre = _uniform_prefixes(lv, num_nonzeros, np.random.default_rng(7))
+            w = make_workload(lv)
+            run_once(*w, lv)
             with Timer() as ts:
-                c = hierarchical.BatchedContext.create(d_lv, [k_lv])
-                for level in range(lv):
-                    hierarchical.evaluate_until_batch(
-                        c, level, () if level == 0 else pre[level - 1],
-                        device_output=True, engine="host",
-                    )
+                run_once(*w, lv)
             sweep[str(lv)] = round(ts.elapsed, 4)
         sweep[str(num_levels)] = round(t.elapsed, 4)
         log(f"level sweep: {sweep}")
