@@ -224,21 +224,18 @@ def batch_evaluate(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
 def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
     """Host-engine fused batched DCF evaluation (native AES-NI).
 
-    The same O(n) one-walk-per-point pass as `batch_evaluate`, executed by
-    native/dpf_native.cc:dpf_dcf_evaluate_u64 — one FFI call per key.
-    Additive Int outputs up to 64 bits (the benchmark configs); use
-    `batch_evaluate` for XOR groups / 128-bit values. Returns uint64[K, P]
-    shares, bit-identical to the device path.
+    The same O(n) one-walk-per-point pass as `batch_evaluate`, executed in
+    native/dpf_native.cc — one FFI call per key. Covers every scalar group
+    the DCF supports: additive Int up to 64 bits on the packed u64 kernel
+    (`dpf_dcf_evaluate_u64`), 128-bit and XOR-group values on the two-word
+    kernel (`dpf_dcf_evaluate_wide`). Returns uint64[K, P] shares for
+    bits <= 64, uint64[K, P, 2] (lo, hi) for 128-bit values — bit-identical
+    to the device path.
     """
     from .. import native
     from ..core import backend_numpy
 
     bits, xor_group = evaluator._value_kind(dcf.value_type)
-    if xor_group or bits > 64:
-        raise ValueError(
-            "batch_evaluate_host supports additive Int values up to 64 bits; "
-            "use batch_evaluate for XOR groups and 128-bit values"
-        )
     if not native.available():
         raise RuntimeError("native AES-NI engine unavailable on this host")
     num_points = len(xs)
@@ -248,28 +245,42 @@ def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
     )
     capture = np.array([i >= 0 for i in depth_to_hierarchy], dtype=np.uint8)
     vc_limbs = _value_corrections_all(dcf, keys, depth_to_hierarchy)
-    # uint64 view of the per-element corrections (low two limbs).
-    vc64 = (
-        vc_limbs[..., 0].astype(np.uint64)
-        | (vc_limbs[..., 1].astype(np.uint64) << np.uint64(32))
-    )  # [K, T+1, epb]
     rkl = np.asarray(backend_numpy._PRG_LEFT._round_keys)
     rkr = np.asarray(backend_numpy._PRG_RIGHT._round_keys)
     rkv = np.asarray(backend_numpy._PRG_VALUE._round_keys)
-    out = np.empty((k, num_points), dtype=np.uint64)
+    am = acc_mask[:, :num_points].astype(np.uint8)
+    bs = block_sel[:, :num_points]
+    if not xor_group and bits <= 64:
+        # uint64 view of the per-element corrections (low two limbs).
+        vc64 = (
+            vc_limbs[..., 0].astype(np.uint64)
+            | (vc_limbs[..., 1].astype(np.uint64) << np.uint64(32))
+        )  # [K, T+1, epb]
+        out = np.empty((k, num_points), dtype=np.uint64)
+        for j in range(k):
+            out[j] = native.dcf_evaluate_u64(
+                rkl, rkr, rkv,
+                batch.seeds[j], batch.party,
+                batch.cw_seeds[j], batch.cw_left[j], batch.cw_right[j],
+                vc64[j], capture, am, bs, paths, bits,
+            )
+        return out
+    # Wide kernel: (lo, hi) uint64 pairs.
+    vc_wide = np.stack(
+        [
+            vc_limbs[..., 0].astype(np.uint64)
+            | (vc_limbs[..., 1].astype(np.uint64) << np.uint64(32)),
+            vc_limbs[..., 2].astype(np.uint64)
+            | (vc_limbs[..., 3].astype(np.uint64) << np.uint64(32)),
+        ],
+        axis=-1,
+    )  # [K, T+1, epb, 2]
+    out = np.empty((k, num_points, 2), dtype=np.uint64)
     for j in range(k):
-        out[j] = native.dcf_evaluate_u64(
+        out[j] = native.dcf_evaluate_wide(
             rkl, rkr, rkv,
-            batch.seeds[j],
-            batch.party,
-            batch.cw_seeds[j],
-            batch.cw_left[j],
-            batch.cw_right[j],
-            vc64[j],
-            capture,
-            acc_mask[:, :num_points].astype(np.uint8),
-            block_sel[:, :num_points],
-            paths,
-            bits,
+            batch.seeds[j], batch.party,
+            batch.cw_seeds[j], batch.cw_left[j], batch.cw_right[j],
+            vc_wide[j], capture, am, bs, paths, bits, xor_group,
         )
-    return out
+    return out if bits > 64 else out[..., 0]

@@ -81,6 +81,12 @@ def _load():
                 ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_size_t,
                 ctypes.c_void_p,
             ]
+            lib.dpf_dcf_evaluate_wide.argtypes = [ctypes.c_void_p] * 4 + [
+                ctypes.c_int,
+            ] + [ctypes.c_void_p] * 8 + [
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_size_t, ctypes.c_void_p,
+            ]
             _lib = lib
         except Exception:
             _lib = None
@@ -326,6 +332,59 @@ def dcf_evaluate_u64(
         ptr(np.ascontiguousarray(block_sel, dtype=np.int32)),
         ptr(np.ascontiguousarray(paths, dtype=np.uint32)),
         int(value_bits),
+        int(vc.shape[1]),
+        levels,
+        n,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
+
+
+def dcf_evaluate_wide(
+    rks_left: np.ndarray,
+    rks_right: np.ndarray,
+    rks_value: np.ndarray,
+    seed_limbs: np.ndarray,  # uint32[4]
+    party: int,
+    cw_seed_limbs: np.ndarray,  # uint32[T, 4]
+    cw_left: np.ndarray,  # bool/uint8[T]
+    cw_right: np.ndarray,  # bool/uint8[T]
+    vc: np.ndarray,  # uint64[T+1, epb, 2] value corrections (lo, hi)
+    capture: np.ndarray,  # bool/uint8[T+1]
+    acc_mask: np.ndarray,  # uint8[T+1, P]
+    block_sel: np.ndarray,  # int32[T+1, P]
+    paths: np.ndarray,  # uint32[P, 4] tree indices
+    value_bits: int,
+    is_xor: bool,
+) -> np.ndarray:
+    """Fused batched DCF evaluation of one key — every scalar group.
+
+    Generalization of `dcf_evaluate_u64` to 128-bit values and XOR groups;
+    values travel as (lo, hi) uint64 pairs. Returns uint64[P, 2] shares.
+    """
+    lib = _load()
+    assert lib is not None
+    vc = np.ascontiguousarray(vc, dtype=np.uint64)
+    levels = len(cw_seed_limbs)
+    n = paths.shape[0]
+    out = np.empty((n, 2), dtype=np.uint64)
+    ptr = lambda a: np.ascontiguousarray(a).ctypes.data_as(ctypes.c_void_p)
+    lib.dpf_dcf_evaluate_wide(
+        ptr(rks_left),
+        ptr(rks_right),
+        ptr(rks_value),
+        ptr(np.ascontiguousarray(seed_limbs, dtype=np.uint32)),
+        int(party),
+        ptr(np.ascontiguousarray(cw_seed_limbs, dtype=np.uint32)),
+        ptr(np.ascontiguousarray(cw_left, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(cw_right, dtype=np.uint8)),
+        vc.ctypes.data_as(ctypes.c_void_p),
+        ptr(np.ascontiguousarray(capture, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(acc_mask, dtype=np.uint8)),
+        ptr(np.ascontiguousarray(block_sel, dtype=np.int32)),
+        ptr(np.ascontiguousarray(paths, dtype=np.uint32)),
+        int(value_bits),
+        1 if is_xor else 0,
         int(vc.shape[1]),
         levels,
         n,

@@ -552,6 +552,143 @@ void dpf_dcf_evaluate_u64(
   });
 }
 
+// Generalization of dpf_dcf_evaluate_u64 to every scalar group the DCF
+// supports: additive Int up to 128 bits (two-word carry arithmetic) and
+// XOR groups of any width (accumulate = XOR, no party negation). Values
+// and corrections travel as (lo, hi) uint64 pairs; out is uint64[P, 2].
+// Same walk/capture structure and pipelining as the u64 kernel.
+void dpf_dcf_evaluate_wide(
+    const uint8_t* rks_left, const uint8_t* rks_right, const uint8_t* rks_value,
+    const uint8_t* seed0, int party, const uint8_t* cw_seeds,
+    const uint8_t* cw_left, const uint8_t* cw_right, const uint64_t* vc,
+    const uint8_t* capture, const uint8_t* acc_mask, const int32_t* block_sel,
+    const uint8_t* paths, int value_bits, int is_xor, int epb,
+    int levels /* T */, size_t n_points, uint64_t* out) {
+  __m128i rl[11], rdiff[11], rv[11];
+  load_rks(rks_left, rl);
+  {
+    __m128i rr[11];
+    load_rks(rks_right, rr);
+    for (int i = 0; i < 11; ++i) rdiff[i] = _mm_xor_si128(rl[i], rr[i]);
+  }
+  load_rks(rks_value, rv);
+  const __m128i low_bit = _mm_set_epi64x(0, 1);
+  const uint64_t lo_mask =
+      value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
+  const uint64_t hi_mask =
+      value_bits >= 128
+          ? ~0ULL
+          : (value_bits > 64 ? ((1ULL << (value_bits - 64)) - 1) : 0);
+  const size_t stride = n_points;  // row stride of acc_mask / block_sel
+
+  parallel_ranges(n_points, 4, [&](size_t begin, size_t end) {
+  for (size_t i0 = begin; i0 < end; i0 += 4) {
+    const int lanes = static_cast<int>(end - i0 < 4 ? end - i0 : 4);
+    __m128i s[4];
+    uint64_t path_lo[4] = {0}, path_hi[4] = {0};
+    uint64_t acc_lo[4] = {0, 0, 0, 0}, acc_hi[4] = {0, 0, 0, 0};
+    uint8_t t[4] = {0};
+    for (int j = 0; j < lanes; ++j) {
+      s[j] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(seed0));
+      const uint64_t* p =
+          reinterpret_cast<const uint64_t*>(paths + 16 * (i0 + j));
+      path_lo[j] = p[0];
+      path_hi[j] = p[1];
+      t[j] = static_cast<uint8_t>(party & 1);
+    }
+    for (int depth = 0; depth <= levels; ++depth) {
+      if (capture[depth]) {
+        __m128i b[4], sg[4];
+        for (int j = 0; j < lanes; ++j) {
+          sg[j] = sigma(s[j]);
+          b[j] = _mm_xor_si128(sg[j], rv[0]);
+        }
+        for (int r = 1; r < 10; ++r)
+          for (int j = 0; j < lanes; ++j) b[j] = _mm_aesenc_si128(b[j], rv[r]);
+        for (int j = 0; j < lanes; ++j) {
+          b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rv[10]), sg[j]);
+          uint64_t blk[2];
+          _mm_storeu_si128(reinterpret_cast<__m128i*>(blk), b[j]);
+          const int32_t sel = block_sel[depth * stride + i0 + j];
+          const int bit_off = static_cast<int>(sel) * value_bits;
+          // Element (lo, hi) starting at bit_off; value_bits <= 128 and
+          // elements never straddle the block boundary.
+          uint64_t v_lo = blk[bit_off >> 6] >> (bit_off & 63);
+          uint64_t v_hi = 0;
+          if ((bit_off & 63) != 0 && value_bits > 64 - (bit_off & 63))
+            v_lo |= blk[(bit_off >> 6) + 1] << (64 - (bit_off & 63));
+          if (value_bits > 64) v_hi = blk[1] >> (bit_off & 63);
+          v_lo &= lo_mask;
+          v_hi &= hi_mask;
+          const uint64_t* c = vc + (static_cast<size_t>(depth) * epb + sel) * 2;
+          if (is_xor) {
+            if (t[j]) {
+              v_lo ^= c[0];
+              v_hi ^= c[1];
+            }
+            if (acc_mask[depth * stride + i0 + j]) {
+              acc_lo[j] ^= v_lo;
+              acc_hi[j] ^= v_hi;
+            }
+          } else {
+            if (t[j]) {
+              const uint64_t s_lo = v_lo + c[0];
+              v_hi = (v_hi + c[1] + (s_lo < v_lo ? 1 : 0)) & hi_mask;
+              v_lo = s_lo & lo_mask;
+            }
+            if (party) {
+              const uint64_t n_lo = (0 - v_lo) & lo_mask;
+              v_hi = ((0 - v_hi) - (v_lo != 0 ? 1 : 0)) & hi_mask;
+              v_lo = n_lo;
+            }
+            if (acc_mask[depth * stride + i0 + j]) {
+              const uint64_t s_lo = acc_lo[j] + v_lo;
+              acc_hi[j] =
+                  (acc_hi[j] + v_hi + (s_lo < acc_lo[j] ? 1 : 0)) & hi_mask;
+              acc_lo[j] = s_lo & lo_mask;
+            }
+          }
+        }
+      }
+      if (depth == levels) break;
+      const int bit_index = levels - 1 - depth;
+      const __m128i cw = _mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(cw_seeds + 16 * depth));
+      const uint8_t ccl = cw_left[depth], ccr = cw_right[depth];
+      __m128i m[4], sg[4], b[4];
+      uint8_t bit[4];
+      for (int j = 0; j < lanes; ++j) {
+        bit[j] = static_cast<uint8_t>(
+            ((bit_index < 64 ? path_lo[j] : path_hi[j]) >> (bit_index & 63)) &
+            1);
+        m[j] = _mm_set1_epi8(bit[j] ? static_cast<char>(0xFF) : 0);
+        sg[j] = sigma(s[j]);
+        b[j] = _mm_xor_si128(
+            sg[j], _mm_xor_si128(rl[0], _mm_and_si128(rdiff[0], m[j])));
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int j = 0; j < lanes; ++j)
+          b[j] = _mm_aesenc_si128(
+              b[j], _mm_xor_si128(rl[r], _mm_and_si128(rdiff[r], m[j])));
+      for (int j = 0; j < lanes; ++j) {
+        b[j] = _mm_xor_si128(
+            _mm_aesenclast_si128(
+                b[j], _mm_xor_si128(rl[10], _mm_and_si128(rdiff[10], m[j]))),
+            sg[j]);
+        if (t[j]) b[j] = _mm_xor_si128(b[j], cw);
+        uint8_t nt = static_cast<uint8_t>(_mm_cvtsi128_si64(b[j]) & 1);
+        t[j] = static_cast<uint8_t>(nt ^ (t[j] & (bit[j] ? ccr : ccl)));
+        s[j] = _mm_andnot_si128(low_bit, b[j]);
+      }
+    }
+    for (int j = 0; j < lanes; ++j) {
+      out[(i0 + j) * 2] = acc_lo[j];
+      out[(i0 + j) * 2 + 1] = acc_hi[j];
+    }
+  }
+  });
+}
+
 // Value-PRG hash with block offsets: out[i*bn + j] = MMO(in[i] + j) for
 // j < bn (HashExpandedSeeds, distributed_point_function.cc:500-524) — the
 // uint128 + j addition and the hash in one native pass.

@@ -174,7 +174,10 @@ def test_batch_evaluate_host_matches_device():
             np.testing.assert_array_equal(host & mask, dev64 & mask)
 
 
-def test_batch_evaluate_host_rejects_unsupported():
+def test_batch_evaluate_host_wide_groups():
+    """The wide native kernel (XOR groups, 128-bit values) vs the device
+    path and the share-sum property."""
+    import numpy as np
     import pytest
 
     from distributed_point_functions_tpu import native
@@ -182,11 +185,45 @@ def test_batch_evaluate_host_rejects_unsupported():
     from distributed_point_functions_tpu.dcf.dcf import (
         DistributedComparisonFunction,
     )
-    from distributed_point_functions_tpu.core.value_types import XorWrapper
+    from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
 
     if not native.available():
         pytest.skip("native engine unavailable")
-    dcf = DistributedComparisonFunction.create(4, XorWrapper(64))
-    ka, _ = dcf.generate_keys(3, 1)
-    with pytest.raises(ValueError, match="additive Int"):
-        dcf_batch.batch_evaluate_host(dcf, [ka], [0])
+
+    def to_int(limbs_or_wide):
+        a = np.asarray(limbs_or_wide)
+        if a.dtype == np.uint64 and a.ndim == 1:  # packed u64 values
+            return a.astype(object)
+        if a.dtype == np.uint64:  # wide (lo, hi) pairs
+            return a[..., 0].astype(object) | (
+                a[..., 1].astype(object) << 64
+            )
+        out = np.zeros(a.shape[:-1], dtype=object)
+        for l in range(a.shape[-1]):
+            out |= a[..., l].astype(object) << (32 * l)
+        return out
+
+    rng = np.random.default_rng(0x1DCF)
+    cases = [
+        (XorWrapper(16), 0xABCD),
+        (XorWrapper(64), (1 << 64) - 3),
+        (XorWrapper(128), (1 << 128) - 1),
+        (Int(128), (1 << 100) + 17),
+    ]
+    for vt, beta in cases:
+        dcf = DistributedComparisonFunction.create(8, vt)
+        alpha = 113
+        ka, kb = dcf.generate_keys(alpha, beta)
+        xs = [int(x) for x in rng.integers(0, 256, size=17)] + [0, alpha, 255]
+        got_a = to_int(dcf_batch.batch_evaluate_host(dcf, [ka], xs)[0])
+        got_b = to_int(dcf_batch.batch_evaluate_host(dcf, [kb], xs)[0])
+        dev_a = to_int(dcf_batch.batch_evaluate(dcf, [ka], xs)[0])
+        np.testing.assert_array_equal(got_a, dev_a)
+        bits = vt.bitsize
+        for j, x in enumerate(xs):
+            if isinstance(vt, XorWrapper):
+                total = int(got_a[j]) ^ int(got_b[j])
+            else:
+                total = (int(got_a[j]) + int(got_b[j])) % (1 << bits)
+            want = beta if x < alpha else 0
+            assert total == want, (vt, x)

@@ -1,0 +1,257 @@
+"""Headline-path strategy shootout on the current default backend.
+
+Times + host-verifies several single-chip execution strategies for the
+headline workload (full-domain 2^20, uint64, 64-key chunks) so the choice of
+program shape is a measurement, not a guess (PERF.md "Platform findings"):
+
+* perlevel       — the shipping path: host-driven per-level dispatch
+                   (ops/evaluator.full_domain_evaluate_chunks, leaf_order
+                   False) + device XOR fold per chunk.
+* walk           — ONE program per chunk: every leaf lane walks its own
+                   root-to-leaf path via the `lax.scan` of
+                   evaluate_seeds_planes (num_levels x full-width AES =
+                   ~num_levels/2 x the doubling's AES work, but no per-level
+                   dispatch, no leaf-order gather — lane i IS domain leaf i).
+* fused          — the unrolled doubling expansion in one jit program (the
+                   shape that returned corrupted upper lanes through the axon
+                   TPU tunnel; kept here as the canary).
+* fused_barrier  — same, with jax.lax.optimization_barrier between levels to
+                   suppress cross-level fusion (probe: is the corruption a
+                   fusion-pass bug?).
+
+Each strategy is timed end-to-end over NUM_KEYS keys in KEY_CHUNK-key chunks
+with every chunk's XOR fold pulled to the host, then verified against the
+native host engine. Usage:
+
+    python tools/tpu_variants.py [walk perlevel fused_barrier fused]
+    BENCH_KEYS=256 python tools/tpu_variants.py walk
+"""
+
+import functools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+NUM_KEYS = int(os.environ.get("BENCH_KEYS", 256))
+KEY_CHUNK = int(os.environ.get("BENCH_KEY_CHUNK", 64))
+LOG_DOMAIN = int(os.environ.get("BENCH_LOG_DOMAIN", 20))
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    try:
+        cache = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.host_eval import (
+        full_domain_evaluate_host,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import aes_jax, backend_jax, evaluator
+    from distributed_point_functions_tpu.parallel import sharded
+
+    variants = sys.argv[1:] or ["walk", "perlevel"]
+    print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
+
+    bits = 64
+    dpf = DistributedPointFunction.create(DpfParameters(LOG_DOMAIN, Int(bits)))
+    rng = np.random.default_rng(7)
+    alphas = [int(x) for x in rng.integers(0, 1 << LOG_DOMAIN, size=NUM_KEYS)]
+    betas = [[int(x) for x in rng.integers(1, 1 << 63, size=NUM_KEYS)]]
+    t0 = time.time()
+    keys, _ = dpf.generate_keys_batch(alphas, betas)
+    print(f"keygen: {time.time() - t0:.2f}s for {NUM_KEYS} keys")
+
+    t0 = time.time()
+    host_vals = full_domain_evaluate_host(dpf, keys)
+    want = np.bitwise_xor.reduce(host_vals, axis=1)
+    del host_vals
+    print(f"host oracle: {time.time() - t0:.2f}s")
+
+    v = dpf.validator
+    stop_level = v.hierarchy_to_tree[0]
+    lds = LOG_DOMAIN
+    keep = 1 << (lds - stop_level)
+    domain = 1 << lds
+
+    # ---- walk program: one dispatch per chunk --------------------------------
+    @functools.partial(
+        jax.jit, static_argnames=("num_levels", "bits", "party", "xor_group")
+    )
+    def walk_chunk(
+        seeds, path_masks, cw_planes, ccl, ccr, corrections,
+        num_levels, bits, party, xor_group,
+    ):
+        w = path_masks.shape[1]
+        control0 = jnp.full(
+            w, 0xFFFFFFFF if party else 0, jnp.uint32
+        )
+
+        def one(seed, cw, l, r, corr):
+            # Packed planes of a replicated seed: plane b is just bit b of
+            # the seed broadcast over every lane word — no pack shuffle.
+            seed_bits = (
+                (seed[:, None] >> jnp.arange(32, dtype=jnp.uint32))
+                & jnp.uint32(1)
+            ).reshape(128)
+            planes = jnp.broadcast_to(
+                (seed_bits * jnp.uint32(0xFFFFFFFF))[:, None], (128, w)
+            )
+            planes, control = backend_jax.evaluate_seeds_planes(
+                planes, control0, path_masks, cw, l, r
+            )
+            hashed = backend_jax.hash_value_planes(planes)
+            blocks = aes_jax.unpack_from_planes(hashed)
+            ctrl = backend_jax.unpack_mask_device(control)
+            vals = evaluator._correct_values(
+                blocks, ctrl, corr, bits, party, xor_group
+            )  # [lanes, epb, lpe]
+            lanes, epb, lpe = vals.shape
+            return vals[:, :keep].reshape(lanes * keep, lpe)
+
+        return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
+
+    # ---- fused doubling program (optionally barrier-separated levels) -------
+    @functools.partial(
+        jax.jit,
+        static_argnames=("levels", "bits", "party", "xor_group", "barrier"),
+    )
+    def fused_chunk(
+        seeds, control, cw_planes, ccl, ccr, corrections, order,
+        levels, bits, party, xor_group, barrier,
+    ):
+        def one(s, c, cw, l, r, corr):
+            planes = aes_jax.pack_to_planes(s)
+            for lev in range(levels):
+                planes, c = backend_jax.expand_one_level(
+                    planes, c, cw[lev], l[lev], r[lev]
+                )
+                if barrier:
+                    planes, c = jax.lax.optimization_barrier((planes, c))
+            hashed = backend_jax.hash_value_planes(planes)
+            blocks = aes_jax.unpack_from_planes(hashed)
+            ctrl = backend_jax.unpack_mask_device(c)
+            return evaluator._correct_values(
+                blocks, ctrl, corr, bits, party, xor_group
+            )
+
+        out = jax.vmap(one)(seeds, control, cw_planes, ccl, ccr, corrections)
+        out = out[:, order][:, :, :keep]
+        k, n_blocks, kept, lpe = out.shape
+        return out.reshape(k, n_blocks * kept, lpe)
+
+    fold = jax.jit(lambda x: jnp.bitwise_xor.reduce(x, axis=1))
+
+    def run_variant(name: str) -> None:
+        batch = evaluator.KeyBatch.from_keys(dpf, keys)
+        folds = []
+        t_start = time.time()
+        compile_s = None
+        for start in range(0, NUM_KEYS, KEY_CHUNK):
+            idx = np.arange(start, min(start + KEY_CHUNK, NUM_KEYS))
+            kb = batch.take(idx)
+            k = kb.seeds.shape[0]
+            if name == "walk":
+                w = (1 << stop_level) // 32
+                path_masks = sharded._leaf_path_masks(
+                    jnp.uint32(0), 1 << stop_level, stop_level
+                )
+                cw_dev, ccl, ccr = kb.device_cw_arrays(0)
+                out = walk_chunk(
+                    jnp.asarray(kb.seeds),
+                    path_masks,
+                    jnp.asarray(cw_dev),
+                    jnp.asarray(ccl),
+                    jnp.asarray(ccr),
+                    jnp.asarray(evaluator._correction_limbs(kb.value_corrections, bits)),
+                    num_levels=stop_level,
+                    bits=bits,
+                    party=kb.party,
+                    xor_group=False,
+                )
+                out = out[:, :domain]
+            elif name in ("fused", "fused_barrier"):
+                host_levels = min(5, stop_level)
+                device_levels = stop_level - host_levels
+                control0 = np.full(k, bool(kb.party), dtype=bool)
+                seeds_h, control_h = evaluator._host_expand(
+                    kb.seeds, control0, kb, host_levels
+                )
+                m = seeds_h.shape[1]
+                control_mask = aes_jax.pack_bit_mask(control_h)
+                cw_dev, ccl, ccr = kb.device_cw_arrays(host_levels)
+                order = backend_jax.expansion_output_order(m, m, device_levels)
+                out = fused_chunk(
+                    jnp.asarray(seeds_h),
+                    jnp.asarray(control_mask),
+                    jnp.asarray(cw_dev),
+                    jnp.asarray(ccl),
+                    jnp.asarray(ccr),
+                    jnp.asarray(evaluator._correction_limbs(kb.value_corrections, bits)),
+                    jnp.asarray(order),
+                    levels=device_levels,
+                    bits=bits,
+                    party=kb.party,
+                    xor_group=False,
+                    barrier=(name == "fused_barrier"),
+                )
+                out = out[:, :domain]
+            elif name == "perlevel":
+                gen = evaluator.full_domain_evaluate_chunks(
+                    dpf, [keys[i] for i in idx], key_chunk=k, leaf_order=False
+                )
+                _, out = next(gen)
+            else:
+                raise SystemExit(f"unknown variant {name}")
+            folds.append(np.asarray(fold(out)))
+            out.delete() if hasattr(out, "delete") else None
+            if compile_s is None:
+                compile_s = time.time() - t_start
+        elapsed = time.time() - t_start
+        got = np.concatenate(folds, axis=0)[:NUM_KEYS]
+        got64 = got[:, 0].astype(np.uint64) | (
+            got[:, 1].astype(np.uint64) << np.uint64(32)
+        )
+        n_bad = int((got64 != want).sum())
+        total = NUM_KEYS * domain
+        # Steady-state rate: exclude the first chunk (compile + warmup).
+        n_chunks = -(-NUM_KEYS // KEY_CHUNK)
+        steady = (
+            (total - KEY_CHUNK * domain) / (elapsed - compile_s)
+            if n_chunks > 1 and elapsed > compile_s
+            else total / elapsed
+        )
+        print(
+            f"{name}: {elapsed:.2f}s total (first chunk {compile_s:.2f}s), "
+            f"{total/elapsed/1e6:.1f} M evals/s incl. compile, "
+            f"{steady/1e6:.1f} M evals/s steady, "
+            f"verify: {'OK' if n_bad == 0 else f'MISMATCH {n_bad}/{NUM_KEYS} keys'}"
+        )
+
+    for name in variants:
+        try:
+            run_variant(name)
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
