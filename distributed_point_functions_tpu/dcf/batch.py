@@ -225,10 +225,11 @@ def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
     """Host-engine fused batched DCF evaluation (native AES-NI).
 
     The same O(n) one-walk-per-point pass as `batch_evaluate`, executed in
-    native/dpf_native.cc — one FFI call per key. Covers every scalar group
-    the DCF supports: additive Int up to 64 bits on the packed u64 kernel
+    native/dpf_native.cc — one FFI call per key. Covers every Int/XorWrapper
+    width: additive Int up to 64 bits on the packed u64 kernel
     (`dpf_dcf_evaluate_u64`), 128-bit and XOR-group values on the two-word
-    kernel (`dpf_dcf_evaluate_wide`). Returns uint64[K, P] shares for
+    kernel (`dpf_dcf_evaluate_wide`); IntModN outputs use the per-point host
+    path (DistributedComparisonFunction.evaluate). Returns uint64[K, P] shares for
     bits <= 64, uint64[K, P, 2] (lo, hi) for 128-bit values — bit-identical
     to the device path.
     """

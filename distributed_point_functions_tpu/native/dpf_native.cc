@@ -434,30 +434,105 @@ void dpf_expand_forest(const uint8_t* rks_left, const uint8_t* rks_right,
   }
 }
 
-// Fused batched DCF evaluation for one key, <= 64-bit additive outputs
-// (the O(n) root-to-leaf pass of dcf/batch.py on the host): each point
-// walks the incremental DPF's tree once; at every capturing depth d the
-// current seed is value-hashed, the addressed element extracted, the value
-// correction applied under the control bit, party-negated, and accumulated
-// into the point's sum iff acc_mask says the point's bit at that level is
-// 0 (f(x) = sum of prefix shares where bit_i(x) = 0,
+// Fused batched DCF evaluation: each point walks the incremental DPF's
+// tree ONCE; at every capturing depth d the current seed is value-hashed,
+// the addressed element extracted, the value correction applied under the
+// control bit, party-negated, and accumulated into the point's sum iff
+// acc_mask says the point's bit at that level is 0 (f(x) = sum of prefix
+// shares where bit_i(x) = 0,
 // /root/reference/dcf/distributed_comparison_function.h:83-107 — but one
 // walk total instead of one per bit). 4 points pipelined; value hash and
 // walk AES interleave in the same registers.
 //
-//   vc:        (T+1) * epb uint64 value corrections (by depth, element)
+// One templated walk, two accumulator policies: the descent/capture
+// structure is shared and only "extract + correct + accumulate" differs
+// (packed uint64 vs two-word (lo, hi) groups) — policies inline, so the
+// generated code matches the previously hand-split kernels.
+//
 //   capture:   (T+1) bytes, 1 if a hierarchy level outputs at this depth
 //   acc_mask:  (T+1) x P bytes (1 = accumulate)
 //   block_sel: (T+1) x P int32 element index within the block
 //   paths:     P x 16 bytes (tree index at the final depth)
-//   out:       P uint64 accumulated shares
-void dpf_dcf_evaluate_u64(
-    const uint8_t* rks_left, const uint8_t* rks_right, const uint8_t* rks_value,
-    const uint8_t* seed0, int party, const uint8_t* cw_seeds,
-    const uint8_t* cw_left, const uint8_t* cw_right, const uint64_t* vc,
-    const uint8_t* capture, const uint8_t* acc_mask, const int32_t* block_sel,
-    const uint8_t* paths, int value_bits, int epb, int levels /* T */,
-    size_t n_points, uint64_t* out) {
+}  // extern "C"
+
+namespace {
+
+// <= 64-bit additive Int: one uint64 accumulator per point.
+struct DcfAccU64 {
+  using Acc = uint64_t;
+  const uint64_t* vc;  // [T+1, epb]
+  uint64_t mask;
+  int value_bits, epb, party;
+  void init(Acc& a) const { a = 0; }
+  void consume(Acc& a, const uint64_t blk[2], int depth, int32_t sel,
+               uint8_t ctrl, uint8_t accumulate) const {
+    const int bit_off = static_cast<int>(sel) * value_bits;
+    uint64_t v = blk[bit_off >> 6] >> (bit_off & 63);
+    v &= mask;
+    if (ctrl) v = (v + vc[static_cast<size_t>(depth) * epb + sel]) & mask;
+    if (party) v = (0 - v) & mask;
+    if (accumulate) a = (a + v) & mask;
+  }
+  void store(uint64_t* out, size_t i, const Acc& a) const { out[i] = a; }
+};
+
+// Every scalar group up to 128 bits: (lo, hi) uint64 pair accumulators,
+// additive (two-word carry/borrow) or XOR (no party negation).
+struct DcfAccWide {
+  struct Acc {
+    uint64_t lo, hi;
+  };
+  const uint64_t* vc;  // [T+1, epb, 2]
+  uint64_t lo_mask, hi_mask;
+  int value_bits, epb, party, is_xor;
+  void init(Acc& a) const { a.lo = a.hi = 0; }
+  void consume(Acc& a, const uint64_t blk[2], int depth, int32_t sel,
+               uint8_t ctrl, uint8_t accumulate) const {
+    const int bit_off = static_cast<int>(sel) * value_bits;
+    uint64_t v_lo = (blk[bit_off >> 6] >> (bit_off & 63)) & lo_mask;
+    uint64_t v_hi = (value_bits > 64 ? blk[1] : 0) & hi_mask;
+    const uint64_t* c = vc + (static_cast<size_t>(depth) * epb + sel) * 2;
+    if (is_xor) {
+      if (ctrl) {
+        v_lo ^= c[0];
+        v_hi ^= c[1];
+      }
+      if (accumulate) {
+        a.lo ^= v_lo;
+        a.hi ^= v_hi;
+      }
+      return;
+    }
+    if (ctrl) {
+      const uint64_t s_lo = v_lo + c[0];
+      v_hi = (v_hi + c[1] + (s_lo < v_lo ? 1 : 0)) & hi_mask;
+      v_lo = s_lo & lo_mask;
+    }
+    if (party) {
+      const uint64_t n_lo = (0 - v_lo) & lo_mask;
+      v_hi = ((0 - v_hi) - (v_lo != 0 ? 1 : 0)) & hi_mask;
+      v_lo = n_lo;
+    }
+    if (accumulate) {
+      const uint64_t s_lo = a.lo + v_lo;
+      a.hi = (a.hi + v_hi + (s_lo < a.lo ? 1 : 0)) & hi_mask;
+      a.lo = s_lo & lo_mask;
+    }
+  }
+  void store(uint64_t* out, size_t i, const Acc& a) const {
+    out[i * 2] = a.lo;
+    out[i * 2 + 1] = a.hi;
+  }
+};
+
+template <typename Policy, typename OutT>
+void dcf_walk_impl(const uint8_t* rks_left, const uint8_t* rks_right,
+                   const uint8_t* rks_value, const uint8_t* seed0, int party,
+                   const uint8_t* cw_seeds, const uint8_t* cw_left,
+                   const uint8_t* cw_right, const uint8_t* capture,
+                   const uint8_t* acc_mask, const int32_t* block_sel,
+                   const uint8_t* paths, int levels, size_t n_points,
+                   const Policy& policy, OutT* out) {
   __m128i rl[11], rdiff[11], rv[11];
   load_rks(rks_left, rl);
   {
@@ -467,18 +542,17 @@ void dpf_dcf_evaluate_u64(
   }
   load_rks(rks_value, rv);
   const __m128i low_bit = _mm_set_epi64x(0, 1);
-  const uint64_t value_mask =
-      value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
   const size_t stride = n_points;  // row stride of acc_mask / block_sel
 
   parallel_ranges(n_points, 4, [&](size_t begin, size_t end) {
   for (size_t i0 = begin; i0 < end; i0 += 4) {
-    const int lanes =
-        static_cast<int>(end - i0 < 4 ? end - i0 : 4);
+    const int lanes = static_cast<int>(end - i0 < 4 ? end - i0 : 4);
     __m128i s[4];
-    uint64_t path_lo[4] = {0}, path_hi[4] = {0}, acc[4] = {0, 0, 0, 0};
+    uint64_t path_lo[4] = {0}, path_hi[4] = {0};
+    typename Policy::Acc acc[4];
     uint8_t t[4] = {0};
     for (int j = 0; j < lanes; ++j) {
+      policy.init(acc[j]);
       s[j] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(seed0));
       const uint64_t* p =
           reinterpret_cast<const uint64_t*>(paths + 16 * (i0 + j));
@@ -488,9 +562,9 @@ void dpf_dcf_evaluate_u64(
     }
     for (int depth = 0; depth <= levels; ++depth) {
       if (capture[depth]) {
-        // Value hash of the current seeds (one block: values <= 64 bits),
-        // element select, correction under control bit, party negation,
-        // masked accumulate.
+        // Value hash of the current seeds, element select, correction
+        // under control bit, party negation, masked accumulate — the
+        // group-specific part lives in the policy.
         __m128i b[4], sg[4];
         for (int j = 0; j < lanes; ++j) {
           sg[j] = sigma(s[j]);
@@ -502,17 +576,9 @@ void dpf_dcf_evaluate_u64(
           b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rv[10]), sg[j]);
           uint64_t blk[2];
           _mm_storeu_si128(reinterpret_cast<__m128i*>(blk), b[j]);
-          const int32_t sel = block_sel[depth * stride + i0 + j];
-          const int bit_off = static_cast<int>(sel) * value_bits;
-          uint64_t v = blk[bit_off >> 6] >> (bit_off & 63);
-          if ((bit_off & 63) != 0 && value_bits > 64 - (bit_off & 63))
-            v |= blk[(bit_off >> 6) + 1] << (64 - (bit_off & 63));
-          v &= value_mask;
-          if (t[j])
-            v = (v + vc[static_cast<size_t>(depth) * epb + sel]) & value_mask;
-          if (party) v = (0 - v) & value_mask;
-          if (acc_mask[depth * stride + i0 + j])
-            acc[j] = (acc[j] + v) & value_mask;
+          policy.consume(acc[j], blk, depth,
+                         block_sel[depth * stride + i0 + j], t[j],
+                         acc_mask[depth * stride + i0 + j]);
         }
       }
       if (depth == levels) break;
@@ -547,16 +613,36 @@ void dpf_dcf_evaluate_u64(
         s[j] = _mm_andnot_si128(low_bit, b[j]);
       }
     }
-    for (int j = 0; j < lanes; ++j) out[i0 + j] = acc[j];
+    for (int j = 0; j < lanes; ++j) policy.store(out, i0 + j, acc[j]);
   }
   });
 }
 
-// Generalization of dpf_dcf_evaluate_u64 to every scalar group the DCF
-// supports: additive Int up to 128 bits (two-word carry arithmetic) and
-// XOR groups of any width (accumulate = XOR, no party negation). Values
-// and corrections travel as (lo, hi) uint64 pairs; out is uint64[P, 2].
-// Same walk/capture structure and pipelining as the u64 kernel.
+}  // namespace
+
+extern "C" {
+
+// <= 64-bit additive outputs; vc: (T+1) x epb uint64; out: P uint64.
+void dpf_dcf_evaluate_u64(
+    const uint8_t* rks_left, const uint8_t* rks_right, const uint8_t* rks_value,
+    const uint8_t* seed0, int party, const uint8_t* cw_seeds,
+    const uint8_t* cw_left, const uint8_t* cw_right, const uint64_t* vc,
+    const uint8_t* capture, const uint8_t* acc_mask, const int32_t* block_sel,
+    const uint8_t* paths, int value_bits, int epb, int levels /* T */,
+    size_t n_points, uint64_t* out) {
+  DcfAccU64 policy;
+  policy.vc = vc;
+  policy.mask = value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
+  policy.value_bits = value_bits;
+  policy.epb = epb;
+  policy.party = party;
+  dcf_walk_impl(rks_left, rks_right, rks_value, seed0, party, cw_seeds,
+                cw_left, cw_right, capture, acc_mask, block_sel, paths,
+                levels, n_points, policy, out);
+}
+
+// Every scalar group up to 128 bits (additive Int or XOR); values and
+// corrections travel as (lo, hi) uint64 pairs; out: P x 2 uint64.
 void dpf_dcf_evaluate_wide(
     const uint8_t* rks_left, const uint8_t* rks_right, const uint8_t* rks_value,
     const uint8_t* seed0, int party, const uint8_t* cw_seeds,
@@ -564,129 +650,20 @@ void dpf_dcf_evaluate_wide(
     const uint8_t* capture, const uint8_t* acc_mask, const int32_t* block_sel,
     const uint8_t* paths, int value_bits, int is_xor, int epb,
     int levels /* T */, size_t n_points, uint64_t* out) {
-  __m128i rl[11], rdiff[11], rv[11];
-  load_rks(rks_left, rl);
-  {
-    __m128i rr[11];
-    load_rks(rks_right, rr);
-    for (int i = 0; i < 11; ++i) rdiff[i] = _mm_xor_si128(rl[i], rr[i]);
-  }
-  load_rks(rks_value, rv);
-  const __m128i low_bit = _mm_set_epi64x(0, 1);
-  const uint64_t lo_mask =
-      value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
-  const uint64_t hi_mask =
+  DcfAccWide policy;
+  policy.vc = vc;
+  policy.lo_mask = value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
+  policy.hi_mask =
       value_bits >= 128
           ? ~0ULL
           : (value_bits > 64 ? ((1ULL << (value_bits - 64)) - 1) : 0);
-  const size_t stride = n_points;  // row stride of acc_mask / block_sel
-
-  parallel_ranges(n_points, 4, [&](size_t begin, size_t end) {
-  for (size_t i0 = begin; i0 < end; i0 += 4) {
-    const int lanes = static_cast<int>(end - i0 < 4 ? end - i0 : 4);
-    __m128i s[4];
-    uint64_t path_lo[4] = {0}, path_hi[4] = {0};
-    uint64_t acc_lo[4] = {0, 0, 0, 0}, acc_hi[4] = {0, 0, 0, 0};
-    uint8_t t[4] = {0};
-    for (int j = 0; j < lanes; ++j) {
-      s[j] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(seed0));
-      const uint64_t* p =
-          reinterpret_cast<const uint64_t*>(paths + 16 * (i0 + j));
-      path_lo[j] = p[0];
-      path_hi[j] = p[1];
-      t[j] = static_cast<uint8_t>(party & 1);
-    }
-    for (int depth = 0; depth <= levels; ++depth) {
-      if (capture[depth]) {
-        __m128i b[4], sg[4];
-        for (int j = 0; j < lanes; ++j) {
-          sg[j] = sigma(s[j]);
-          b[j] = _mm_xor_si128(sg[j], rv[0]);
-        }
-        for (int r = 1; r < 10; ++r)
-          for (int j = 0; j < lanes; ++j) b[j] = _mm_aesenc_si128(b[j], rv[r]);
-        for (int j = 0; j < lanes; ++j) {
-          b[j] = _mm_xor_si128(_mm_aesenclast_si128(b[j], rv[10]), sg[j]);
-          uint64_t blk[2];
-          _mm_storeu_si128(reinterpret_cast<__m128i*>(blk), b[j]);
-          const int32_t sel = block_sel[depth * stride + i0 + j];
-          const int bit_off = static_cast<int>(sel) * value_bits;
-          // Element (lo, hi) starting at bit_off; value_bits <= 128 and
-          // elements never straddle the block boundary.
-          uint64_t v_lo = blk[bit_off >> 6] >> (bit_off & 63);
-          uint64_t v_hi = 0;
-          if ((bit_off & 63) != 0 && value_bits > 64 - (bit_off & 63))
-            v_lo |= blk[(bit_off >> 6) + 1] << (64 - (bit_off & 63));
-          if (value_bits > 64) v_hi = blk[1] >> (bit_off & 63);
-          v_lo &= lo_mask;
-          v_hi &= hi_mask;
-          const uint64_t* c = vc + (static_cast<size_t>(depth) * epb + sel) * 2;
-          if (is_xor) {
-            if (t[j]) {
-              v_lo ^= c[0];
-              v_hi ^= c[1];
-            }
-            if (acc_mask[depth * stride + i0 + j]) {
-              acc_lo[j] ^= v_lo;
-              acc_hi[j] ^= v_hi;
-            }
-          } else {
-            if (t[j]) {
-              const uint64_t s_lo = v_lo + c[0];
-              v_hi = (v_hi + c[1] + (s_lo < v_lo ? 1 : 0)) & hi_mask;
-              v_lo = s_lo & lo_mask;
-            }
-            if (party) {
-              const uint64_t n_lo = (0 - v_lo) & lo_mask;
-              v_hi = ((0 - v_hi) - (v_lo != 0 ? 1 : 0)) & hi_mask;
-              v_lo = n_lo;
-            }
-            if (acc_mask[depth * stride + i0 + j]) {
-              const uint64_t s_lo = acc_lo[j] + v_lo;
-              acc_hi[j] =
-                  (acc_hi[j] + v_hi + (s_lo < acc_lo[j] ? 1 : 0)) & hi_mask;
-              acc_lo[j] = s_lo & lo_mask;
-            }
-          }
-        }
-      }
-      if (depth == levels) break;
-      const int bit_index = levels - 1 - depth;
-      const __m128i cw = _mm_loadu_si128(
-          reinterpret_cast<const __m128i*>(cw_seeds + 16 * depth));
-      const uint8_t ccl = cw_left[depth], ccr = cw_right[depth];
-      __m128i m[4], sg[4], b[4];
-      uint8_t bit[4];
-      for (int j = 0; j < lanes; ++j) {
-        bit[j] = static_cast<uint8_t>(
-            ((bit_index < 64 ? path_lo[j] : path_hi[j]) >> (bit_index & 63)) &
-            1);
-        m[j] = _mm_set1_epi8(bit[j] ? static_cast<char>(0xFF) : 0);
-        sg[j] = sigma(s[j]);
-        b[j] = _mm_xor_si128(
-            sg[j], _mm_xor_si128(rl[0], _mm_and_si128(rdiff[0], m[j])));
-      }
-      for (int r = 1; r < 10; ++r)
-        for (int j = 0; j < lanes; ++j)
-          b[j] = _mm_aesenc_si128(
-              b[j], _mm_xor_si128(rl[r], _mm_and_si128(rdiff[r], m[j])));
-      for (int j = 0; j < lanes; ++j) {
-        b[j] = _mm_xor_si128(
-            _mm_aesenclast_si128(
-                b[j], _mm_xor_si128(rl[10], _mm_and_si128(rdiff[10], m[j]))),
-            sg[j]);
-        if (t[j]) b[j] = _mm_xor_si128(b[j], cw);
-        uint8_t nt = static_cast<uint8_t>(_mm_cvtsi128_si64(b[j]) & 1);
-        t[j] = static_cast<uint8_t>(nt ^ (t[j] & (bit[j] ? ccr : ccl)));
-        s[j] = _mm_andnot_si128(low_bit, b[j]);
-      }
-    }
-    for (int j = 0; j < lanes; ++j) {
-      out[(i0 + j) * 2] = acc_lo[j];
-      out[(i0 + j) * 2 + 1] = acc_hi[j];
-    }
-  }
-  });
+  policy.value_bits = value_bits;
+  policy.epb = epb;
+  policy.party = party;
+  policy.is_xor = is_xor;
+  dcf_walk_impl(rks_left, rks_right, rks_value, seed0, party, cw_seeds,
+                cw_left, cw_right, capture, acc_mask, block_sel, paths,
+                levels, n_points, policy, out);
 }
 
 // Value-PRG hash with block offsets: out[i*bn + j] = MMO(in[i] + j) for
