@@ -145,12 +145,19 @@ class DistributedComparisonFunction:
         return result
 
     def batch_evaluate(
-        self, keys: Sequence[DcfKey], xs: Sequence[int]
+        self, keys: Sequence[DcfKey], xs: Sequence[int], engine: str = "device"
     ) -> np.ndarray:
-        """Fused device evaluation of every key at every point.
+        """Fused evaluation of every key at every point (one tree walk per
+        point instead of the reference's walk-per-bit).
 
-        Returns uint32[K, P, lpe] limb values (see dcf/batch.py).
+        engine="device" returns uint32[K, P, lpe] limb values;
+        engine="host" runs the native AES-NI kernels and returns uint64[K, P]
+        (bits <= 64) or uint64[K, P, 2] (lo, hi) pairs (see dcf/batch.py).
         """
         from . import batch
 
+        if engine == "host":
+            return batch.batch_evaluate_host(self, keys, xs)
+        if engine != "device":
+            raise ValueError(f"engine must be 'device' or 'host', got {engine!r}")
         return batch.batch_evaluate(self, keys, xs)

@@ -160,11 +160,15 @@ class MultipleIntervalContainmentGate:
             res.append(self._combine(key, x, s_p, s_q_prime, i))
         return res
 
-    def batch_eval(self, key: MicKey, xs: Sequence[int]) -> np.ndarray:
+    def batch_eval(
+        self, key: MicKey, xs: Sequence[int], engine: str = "device"
+    ) -> np.ndarray:
         """Fused evaluation of all intervals for a batch of masked inputs.
 
-        One device DCF pass over len(xs) * 2m lanes. Returns an object
-        ndarray [len(xs), m] of share values mod N.
+        One fused DCF pass over len(xs) * 2m lanes — on the device
+        (engine="device") or the native AES-NI host engine (engine="host";
+        the gate's Int(128) values ride the two-word wide kernel). Returns
+        an object ndarray [len(xs), m] of share values mod N.
         """
         n = 1 << self.log_group_size
         for x in xs:
@@ -175,8 +179,14 @@ class MultipleIntervalContainmentGate:
         all_points: List[int] = []
         for x in xs:
             all_points.extend(self._eval_points(int(x)))
-        evals = self._dcf.batch_evaluate([key.dcf_key], all_points)
-        values = evaluator.values_to_numpy(evals, 128)[0]  # [len(xs)*2m]
+        evals = self._dcf.batch_evaluate([key.dcf_key], all_points, engine=engine)
+        if engine == "host":  # uint64[1, P, 2] (lo, hi) pairs
+            values = (
+                evals[0, :, 0].astype(object)
+                | (evals[0, :, 1].astype(object) << 64)
+            )
+        else:
+            values = evaluator.values_to_numpy(evals, 128)[0]  # [len(xs)*2m]
         m = len(self.intervals)
         out = np.zeros((len(xs), m), dtype=object)
         for xi, x in enumerate(xs):

@@ -417,6 +417,113 @@ def _expand_batch_jit(
     return out.reshape(k, n_blocks * epb, lpe)
 
 
+@functools.lru_cache(maxsize=2)  # O(L * 2^L) bytes per entry — keep few
+def _walk_path_masks(num_levels: int) -> np.ndarray:
+    """Packed per-level path masks for a full-domain walk: lane i follows the
+    root-to-leaf path of leaf i (level l reads bit num_levels-1-l of i).
+
+    Built word-wise without a [L, 2^L] bool intermediate: for leaf-bit
+    positions >= 5 all 32 lanes of a word agree (word = 0 / ~0 by the word
+    index bit), below 5 every word carries one constant 32-lane pattern.
+    Returns uint32[num_levels, max(32, 2^num_levels) // 32].
+    """
+    lanes = max(32, 1 << num_levels)
+    n_words = lanes // 32
+    masks = np.empty((num_levels, n_words), np.uint32)
+    widx = np.arange(n_words, dtype=np.uint64)
+    for l in range(num_levels):
+        b = num_levels - 1 - l
+        if b >= 5:
+            masks[l] = np.where(
+                (widx >> np.uint64(b - 5)) & np.uint64(1), _FULL32, 0
+            ).astype(np.uint32)
+        else:
+            masks[l] = np.uint32(
+                sum(1 << i for i in range(32) if (i >> b) & 1)
+            )
+    return masks
+
+
+_FULL32 = np.uint32(0xFFFFFFFF)
+
+
+def _walk_one_key(seed, path_masks, control0, cw, l, r):
+    """Shared walk preamble of the walk-mode kernels: replicated-seed planes
+    (plane b = bit b of the seed broadcast over every lane word — no pack
+    shuffle needed) walked down every leaf path at once. Returns
+    (planes uint32[128, W], control uint32[W])."""
+    w = path_masks.shape[1]
+    seed_bits = (
+        (seed[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & jnp.uint32(1)
+    ).reshape(128)
+    planes = jnp.broadcast_to(
+        (seed_bits * jnp.uint32(0xFFFFFFFF))[:, None], (128, w)
+    )
+    return backend_jax.evaluate_seeds_planes(
+        planes, control0, path_masks, cw, l, r
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("bits", "party", "xor_group", "keep"),
+)
+def _walk_chunk_jit(
+    seeds,  # uint32[K, 4] root seeds
+    path_masks,  # uint32[L, W] shared across keys
+    cw_planes,  # uint32[K, L, 128]
+    ccl,  # uint32[K, L]
+    ccr,  # uint32[K, L]
+    corrections,  # uint32[K, epb, lpe]
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+):
+    """Full-domain evaluation, ONE program per key chunk: every leaf lane
+    walks its own root-to-leaf path via the `evaluate_seeds_planes` scan.
+
+    ~num_levels/2 x the AES work of the doubling expansion, but a single
+    dispatch with a near-constant trace size, and lane i IS leaf i — no
+    leaf-order gather exists at all. Returns uint32[K, lanes * keep, lpe]
+    in leaf order (trim to the domain on the caller side)."""
+    control0 = jnp.full(path_masks.shape[1], _FULL32 if party else 0, jnp.uint32)
+
+    def one(seed, cw, l, r, corr):
+        planes, control = _walk_one_key(seed, path_masks, control0, cw, l, r)
+        hashed = backend_jax.hash_value_planes(planes)
+        blocks = aes_jax.unpack_from_planes(hashed)
+        ctrl = backend_jax.unpack_mask_device(control)
+        vals = _correct_values(
+            blocks, ctrl, corr, bits, party, xor_group
+        )  # [lanes, epb, lpe]
+        lanes, _epb, lpe = vals.shape
+        return vals[:, :keep].reshape(lanes * keep, lpe)
+
+    return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "party", "keep"))
+def _walk_chunk_codec_jit(
+    seeds, path_masks, cw_planes, ccl, ccr, corrections, spec, party, keep,
+):
+    """Codec (IntModN / Tuple) variant of `_walk_chunk_jit`."""
+    control0 = jnp.full(path_masks.shape[1], _FULL32 if party else 0, jnp.uint32)
+
+    def one(seed, cw, l, r, corrs):
+        planes, control = _walk_one_key(seed, path_masks, control0, cw, l, r)
+        stream = backend_jax.hash_value_stream(planes, spec.blocks_needed)
+        ctrl = backend_jax.unpack_mask_device(control)
+        vals = value_codec.correct_values(stream, ctrl, corrs, spec, party)
+        outs = []
+        for v in vals:  # [lanes, epb, lpe_c]
+            lanes, _epb, lpe = v.shape
+            outs.append(v[:, :keep].reshape(lanes * keep, lpe))
+        return tuple(outs)
+
+    return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
+
+
 def full_domain_evaluate_chunks(
     dpf: DistributedPointFunction,
     keys: Sequence[DpfKey],
@@ -424,6 +531,7 @@ def full_domain_evaluate_chunks(
     key_chunk: int = 32,
     host_levels: Optional[int] = None,
     leaf_order: bool = True,
+    mode: str = "levels",
 ):
     """Full-domain evaluation, yielding *device-resident* results per chunk.
 
@@ -438,7 +546,27 @@ def full_domain_evaluate_chunks(
     leaf_order=False skips the per-evaluation leaf-order gather and yields
     values in expansion (lane) order: consumers can instead permute their
     static data once with `lane_order_map` at setup time.
+
+    mode="levels" (default) runs the host-driven per-level doubling
+    expansion (one small XLA program per level). mode="walk" runs ONE
+    program per chunk in which every leaf lane walks its own root-to-leaf
+    path (`lax.scan` over levels at full width): ~num_levels/2 x the AES
+    arithmetic, but no per-level dispatch and — because lane i IS leaf i —
+    no leaf-order gather at all (leaf_order and host_levels are ignored;
+    output is always leaf order). Which wins is platform-dependent; see
+    tools/tpu_variants.py for the measured comparison.
     """
+    if mode not in ("levels", "walk"):
+        raise ValueError(f"mode must be 'levels' or 'walk', got {mode!r}")
+    if mode == "walk" and (not leaf_order or host_levels is not None):
+        # Silent acceptance would corrupt lane-order consumers: walk output
+        # is always leaf order, so a caller that permuted its static data
+        # with lane_order_map would reduce against wrong domain indices.
+        raise ValueError(
+            "mode='walk' always yields leaf order and does no host "
+            "pre-expansion; leaf_order=False / host_levels are not "
+            "compatible with it"
+        )
     v = dpf.validator
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
@@ -456,6 +584,57 @@ def full_domain_evaluate_chunks(
     lds = v.parameters[hierarchy_level].log_domain_size
     keep_per_block = 1 << (lds - stop_level)
     assert keep_per_block <= value_type.elements_per_block()
+    domain = 1 << lds
+
+    num_keys = len(keys)
+
+    def chunks():
+        # Pad the last chunk with key 0 so every chunk compiles to the same
+        # shape; padded rows are trimmed after concatenation. Yields
+        # (key_batch, num_valid_keys).
+        for start in range(0, num_keys, key_chunk):
+            idx = np.arange(start, min(start + key_chunk, num_keys))
+            valid = idx.shape[0]
+            pad = key_chunk - valid if num_keys > key_chunk else 0
+            if pad:
+                idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
+            yield batch.take(idx), valid
+
+    if mode == "walk":
+        path_masks = jnp.asarray(_walk_path_masks(stop_level))
+        for kb, valid in chunks():
+            cw_dev, ccl, ccr = kb.device_cw_arrays(0)
+            if scalar_fast:
+                out = _walk_chunk_jit(
+                    jnp.asarray(kb.seeds),
+                    path_masks,
+                    jnp.asarray(cw_dev),
+                    jnp.asarray(ccl),
+                    jnp.asarray(ccr),
+                    jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
+                    bits=bits,
+                    party=batch.party,
+                    xor_group=xor_group,
+                    keep=keep_per_block,
+                )
+                out = out[:, :domain]
+            else:
+                out = _walk_chunk_codec_jit(
+                    jnp.asarray(kb.seeds),
+                    path_masks,
+                    jnp.asarray(cw_dev),
+                    jnp.asarray(ccl),
+                    jnp.asarray(ccr),
+                    tuple(jnp.asarray(a) for a in kb.codec_corrections),
+                    spec=spec,
+                    party=batch.party,
+                    keep=keep_per_block,
+                )
+                out = tuple(o[:, :domain] for o in out)
+                if not spec.is_tuple:
+                    out = out[0]
+            yield valid, out
+        return
 
     # Host expands until one packed word (32 lanes) is full.
     if host_levels is None:
@@ -463,15 +642,7 @@ def full_domain_evaluate_chunks(
     host_levels = min(host_levels, stop_level)
     device_levels = stop_level - host_levels
 
-    num_keys = len(keys)
-    for start in range(0, num_keys, key_chunk):
-        # Pad the last chunk with key 0 so every chunk compiles to the same
-        # shape; padded rows are trimmed after concatenation.
-        idx = np.arange(start, min(start + key_chunk, num_keys))
-        pad = key_chunk - idx.shape[0] if num_keys > key_chunk else 0
-        if pad:
-            idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
-        kb = batch.take(idx)
+    for kb, valid in chunks():
         k = kb.seeds.shape[0]
         control0 = np.full(k, bool(kb.party), dtype=bool)
         seeds_h, control_h = _host_expand(kb.seeds, control0, kb, host_levels)
@@ -500,7 +671,6 @@ def full_domain_evaluate_chunks(
             planes, control = _expand_level_batch_jit(
                 planes, control, cw_dev[:, level], ccl[:, level], ccr[:, level]
             )
-        domain = 1 << v.parameters[hierarchy_level].log_domain_size
         if scalar_fast:
             out = _finalize_batch_jit(
                 planes,
@@ -532,7 +702,7 @@ def full_domain_evaluate_chunks(
                 out = tuple(o[:, :domain] for o in out)
             if not spec.is_tuple:
                 out = out[0]
-        yield key_chunk - pad if pad else min(key_chunk, num_keys - start), out
+        yield valid, out
 
 
 def full_domain_evaluate(

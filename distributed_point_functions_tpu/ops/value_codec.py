@@ -30,6 +30,7 @@ as in EvaluateUntil, /root/reference/dpf/distributed_point_function.h:776-808).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Sequence, Tuple
 
 import jax
@@ -311,16 +312,151 @@ def _clear_low_bits(a: jnp.ndarray, bits: int) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def _mul32x32(a: jnp.ndarray, b: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact u32 x u32 -> (lo, hi) u32 via 16-bit splits (no u64 needed —
+    works with jax_enable_x64 off, and XLA:TPU lowers u32 natively)."""
+    mask = _U32(0xFFFF)
+    a0, a1 = a & mask, a >> _U32(16)
+    b0, b1 = b & mask, b >> _U32(16)
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    mid = (ll >> _U32(16)) + (lh & mask) + (hl & mask)
+    lo = (ll & mask) | ((mid & mask) << _U32(16))
+    hi = hh + (lh >> _U32(16)) + (hl >> _U32(16)) + (mid >> _U32(16))
+    return lo, hi
+
+
+def _mul_const_wide(v: jnp.ndarray, c: int, out_limbs: int) -> jnp.ndarray:
+    """u32[..., L] limb vector x host constant c -> u32[..., out_limbs]
+    (low out_limbs limbs of the exact product), schoolbook with carries."""
+    L = v.shape[-1]
+    c_limbs = [(c >> (32 * i)) & 0xFFFFFFFF for i in range(out_limbs)]
+    acc = [jnp.zeros(v.shape[:-1], _U32) for _ in range(out_limbs)]
+
+    def add_into(k, x):
+        # acc[k:] += x with carry propagation (x: u32 array).
+        carry = x
+        for i in range(k, out_limbs):
+            s = acc[i] + carry
+            carry = (s < acc[i]).astype(_U32)
+            acc[i] = s
+
+    for i in range(L):
+        for j, cl in enumerate(c_limbs):
+            if cl == 0 or i + j >= out_limbs:
+                continue
+            lo, hi = _mul32x32(v[..., i], _U32(cl))
+            add_into(i + j, lo)
+            if i + j + 1 < out_limbs:
+                add_into(i + j + 1, hi)
+    return jnp.stack(acc, axis=-1)
+
+
+def _sub_wide_vec(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod 2^(32n) for equal-limb u32 vectors."""
+    n = a.shape[-1]
+    parts = []
+    borrow = jnp.zeros(a.shape[:-1], dtype=_U32)
+    for l in range(n):
+        t = a[..., l] - b[..., l]
+        b1 = (t > a[..., l]).astype(_U32)
+        d = t - borrow
+        b2 = (d > t).astype(_U32)
+        parts.append(d)
+        borrow = b1 | b2
+    return jnp.stack(parts, axis=-1)
+
+
+@functools.lru_cache(maxsize=None)
+def _mod_fold_plan(modulus: int, in_limbs: int = 4):
+    """Host-side plan for folding a 32*in_limbs-bit value mod `modulus`.
+
+    Returns (folds, final_shifts, work_limbs) where folds is a tuple of
+    (split_limbs, C, prod_limbs) steps replacing v with
+    (v >> 32*split) * C + (v mod 2^(32*split)), C = 2^(32*split) mod N —
+    value preserved mod N, bound tracked exactly with Python ints — and
+    final_shifts is the descending list of k for the ending
+    "if v >= N << k: v -= N << k" chain. None when folding cannot beat the
+    bit-serial loop (modulus far below a power of 2^32, so C stays large).
+    """
+    rl = max((modulus.bit_length() + 31) // 32, 1)
+    C = ((1 << (32 * rl)) % modulus)
+    bound = 1 << (32 * in_limbs)  # exclusive upper bound on the value
+    folds = []
+    for _ in range(32):
+        if bound <= (modulus << 8):
+            break
+        hi_bound = (bound - 1) >> (32 * rl)
+        if hi_bound == 0:
+            break
+        new_bound = hi_bound * C + (1 << (32 * rl))
+        if new_bound >= bound:  # stalled (lo term dominates): finish by chain
+            break
+        prod_limbs = max(((hi_bound * C).bit_length() + 31) // 32, rl)
+        work = max(prod_limbs, rl + 1)
+        folds.append((rl, C, work))
+        bound = new_bound
+    if bound > (modulus << 33):  # ending chain would be too long
+        return None
+    final_shifts = []
+    k = 0
+    while (modulus << k) < bound:
+        k += 1
+    for s in range(k - 1, -1, -1):
+        final_shifts.append(s)
+    work_limbs = max((bound.bit_length() + 31) // 32, rl)
+    return tuple(folds), tuple(final_shifts), work_limbs
+
+
+def _mod_by_const_folded(block: jnp.ndarray, modulus: int, plan) -> jnp.ndarray:
+    """Applies a _mod_fold_plan: returns block % modulus as u32 limbs
+    (ceil(nbits/32) limbs), fully vectorized — no 128-step serial loop."""
+    folds, final_shifts, work_limbs = plan
+    v = block
+    for split, C, prod_limbs in folds:
+        lo = v[..., :split]
+        hi = v[..., split:]
+        if hi.shape[-1] == 0:
+            break
+        prod = _mul_const_wide(hi, C, prod_limbs)
+        width = max(prod_limbs, split) + 1
+        v = _add_wide(prod, lo, width)
+    # Trim to the plan's working width (bound-safe).
+    if v.shape[-1] > work_limbs:
+        v = v[..., :work_limbs]
+    elif v.shape[-1] < work_limbs:
+        v = jnp.concatenate(
+            [v, jnp.zeros(v.shape[:-1] + (work_limbs - v.shape[-1],), _U32)],
+            axis=-1,
+        )
+    for s in final_shifts:
+        ns = _int_to_limbs(modulus << s, work_limbs)
+        ge = _ge_const(v, ns)
+        v = jnp.where(ge[..., None], _sub_const(v, ns), v)
+    lpe = max(((modulus - 1).bit_length() + 31) // 32, 1)
+    return v[..., :lpe]
+
+
 def divmod_by_const(
     block: jnp.ndarray, modulus: int, need_quotient: bool
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(block // modulus, block % modulus) for uint32[..., 4] 128-bit blocks.
 
-    Bit-serial restoring division via ``lax.fori_loop`` — 128 iterations of
-    shift/compare/conditional-subtract on u32 limbs; TPU has no 128-bit (or
-    even 64x64) integer divide. The quotient (needed only for the IntModN
-    refill chain, int_mod_n.h:165-170) is collected from the subtract
-    decisions of the same loop.
+    Fast path (every practical IntModN modulus — 2^32-5, 2^64-59, 2^80-65
+    style primes sit just below a power of 2^32): residue folding
+    v -> (v >> 32r) * (2^(32r) mod N) + (v mod 2^(32r)) with host-tracked
+    exact bounds, finished by a short shift-subtract chain — ~10^2 fully
+    vectorized u32 ops instead of 128 serial loop iterations. The quotient,
+    needed only for the IntModN refill chain (int_mod_n.h:165-170), comes
+    from one exact identity: block - r = q*N, so q = (block - r) * N^{-1}
+    mod 2^128 for odd N (the Montgomery inverse is a host constant).
+
+    Fallback (even non-power-of-2 N, or N so far below a power of 2^32 that
+    folding diverges): bit-serial restoring division via ``lax.fori_loop``
+    — 128 iterations of shift/compare/conditional-subtract; TPU has no
+    128-bit (or even 64x64) integer divide.
 
     Returns (quotient uint32[..., 4], remainder uint32[..., rl]).
     """
@@ -340,6 +476,26 @@ def divmod_by_const(
             q = jnp.concatenate(
                 [qv, jnp.zeros(block.shape[:-1] + (pad,), _U32)], axis=-1
             )
+        return q, r
+    plan = _mod_fold_plan(modulus, block.shape[-1])
+    if plan is not None and (not need_quotient or modulus % 2 == 1):
+        r = _mod_by_const_folded(block, modulus, plan)
+        if not need_quotient:
+            return jnp.zeros(block.shape[:-1] + (4,), _U32), r
+        # q = (block - r) * N^{-1} mod 2^128: block - r is exactly q*N and
+        # q < 2^128, so the low-128-bit product with the odd modulus's
+        # inverse recovers q exactly.
+        inv = pow(modulus, -1, 1 << 128)
+        pad = block.shape[-1] - r.shape[-1]
+        r_pad = (
+            jnp.concatenate(
+                [r, jnp.zeros(r.shape[:-1] + (pad,), _U32)], axis=-1
+            )
+            if pad
+            else r
+        )
+        diff = _sub_wide_vec(block, r_pad)
+        q = _mul_const_wide(diff, inv, 4)
         return q, r
     rl = (nbits + 1 + 31) // 32  # remainder register holds values < 2N
     n_limbs = _int_to_limbs(modulus, rl)

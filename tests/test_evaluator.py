@@ -262,3 +262,60 @@ def test_lane_order_output_codec_path():
     rebuilt = np.zeros_like(leaf)
     rebuilt[:, m[ok]] = lane[:, ok]
     np.testing.assert_array_equal(rebuilt, leaf)
+
+
+def test_walk_mode_matches_levels_mode():
+    """mode='walk' (single-program leaf-path walk) is bit-identical to the
+    default per-level doubling expansion across packing regimes and value
+    types, including the padded last chunk."""
+    from distributed_point_functions_tpu.core.value_types import IntModN, TupleType
+
+    rng = np.random.default_rng(0xA11C)
+    cases = [
+        (DpfParameters(9, Int(64)), 5),   # scalar, 2 elements/block
+        (DpfParameters(7, Int(16)), 3),   # deep packing (8 epb)
+        (DpfParameters(6, XorWrapper(128)), 4),  # XOR group, 1 epb
+        (DpfParameters(5, IntModN(64, (1 << 64) - 59)), 3),  # codec scalar
+        (DpfParameters(5, TupleType(Int(32), Int(32))), 3),  # codec tuple
+    ]
+    for params, num_keys in cases:
+        dpf = DistributedPointFunction.create(params)
+        lds = params.log_domain_size
+        alphas = [int(a) for a in rng.integers(0, 1 << lds, size=num_keys)]
+        if isinstance(params.value_type, TupleType):
+            betas = [[(7, 9)] * num_keys]
+        else:
+            betas = [[int(b) for b in rng.integers(1, 100, size=num_keys)]]
+        keys, _ = dpf.generate_keys_batch(alphas, betas)
+
+        def collect(mode):
+            outs = []
+            for valid, out in evaluator.full_domain_evaluate_chunks(
+                dpf, keys, key_chunk=2, mode=mode
+            ):
+                if isinstance(out, tuple):
+                    outs.append(tuple(np.asarray(o)[:valid] for o in out))
+                else:
+                    outs.append(np.asarray(out)[:valid])
+            if isinstance(outs[0], tuple):
+                return tuple(
+                    np.concatenate([o[c] for o in outs]) for c in range(len(outs[0]))
+                )
+            return np.concatenate(outs)
+
+        got_levels = collect("levels")
+        got_walk = collect("walk")
+        if isinstance(got_levels, tuple):
+            for a, b in zip(got_levels, got_walk):
+                np.testing.assert_array_equal(a, b)
+        else:
+            np.testing.assert_array_equal(got_levels, got_walk)
+
+    with pytest.raises(ValueError, match="mode must be"):
+        list(
+            evaluator.full_domain_evaluate_chunks(
+                DistributedPointFunction.create(DpfParameters(4, Int(64))),
+                [],
+                mode="bogus",
+            )
+        )

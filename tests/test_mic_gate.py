@@ -50,6 +50,13 @@ def test_mic_gate_batch_eval_matches_host():
     xs = [0, 4, 5, 12, 13, 30, 63, 32]
     b0 = gate.batch_eval(k0, xs)
     b1 = gate.batch_eval(k1, xs)
+    from distributed_point_functions_tpu import native
+
+    if native.available():
+        # Host engine (wide 128-bit kernel) agrees with the device pass.
+        h0 = gate.batch_eval(k0, xs, engine="host")
+        h1 = gate.batch_eval(k1, xs, engine="host")
+        assert (h0 == b0).all() and (h1 == b1).all()
     for xi, x in enumerate(xs):
         host0 = gate.eval(k0, x)
         host1 = gate.eval(k1, x)

@@ -194,3 +194,32 @@ def test_modn_point_eval_large_base():
     assert va == host
     for j, x in enumerate(points):
         assert (va[j] + vb[j]) % MOD80 == (beta if x == alpha else 0)
+
+
+def test_divmod_by_const_fold_and_serial_paths():
+    """divmod_by_const against Python divmod over random 128-bit blocks for
+    moduli spanning the fold plan space (tiny, mid, power-adjacent, huge)
+    and the serial fallback (even modulus with quotient)."""
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.ops import value_codec as vc
+
+    rng = np.random.default_rng(0xD17)
+    blocks = rng.integers(0, 2**32, size=(64, 4), dtype=np.uint32)
+    blocks[0] = 0xFFFFFFFF
+    blocks[1] = 0
+    ints = [
+        int(b[0]) | int(b[1]) << 32 | int(b[2]) << 64 | int(b[3]) << 96
+        for b in blocks
+    ]
+    moduli = [3, 255, 2**32 - 5, 2**33 + 1, 10**18 + 9, 2**64 - 59,
+              2**80 - 65, 2**127 - 1, 2**128 - 159, 6, (2**62) + 2]
+    for m in moduli:
+        q, r = vc.divmod_by_const(jnp.asarray(blocks), m, True)
+        q, r = np.asarray(q), np.asarray(r)
+        for i, v in enumerate(ints):
+            wq, wr = divmod(v, m)
+            gr = sum(int(r[i, l]) << (32 * l) for l in range(r.shape[1]))
+            gq = sum(int(q[i, l]) << (32 * l) for l in range(4))
+            assert gr == wr, (m, hex(v))
+            assert gq == wq % (1 << 128), (m, hex(v))
