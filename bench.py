@@ -42,6 +42,10 @@ BASELINE_EVALS_PER_SEC = 13e6
 LOG_DOMAIN = int(os.environ.get("BENCH_LOG_DOMAIN", 20))
 NUM_KEYS = int(os.environ.get("BENCH_KEYS", 1024))
 KEY_CHUNK = int(os.environ.get("BENCH_KEY_CHUNK", 64))
+# Device execution strategy: "levels" (per-level dispatch) or "walk" (one
+# program per chunk); see ops/evaluator.full_domain_evaluate_chunks and
+# tools/tpu_variants.py for the measured comparison.
+MODE = os.environ.get("BENCH_MODE", "levels")
 # CPU fallback config (native AES-NI host engine, ~45 s; shrinks further
 # when the native library is unavailable and the numpy oracle must run).
 CPU_LOG_DOMAIN = int(os.environ.get("BENCH_CPU_LOG_DOMAIN", 20))
@@ -151,7 +155,7 @@ def _run(platform: str, log_domain: int, num_keys: int, key_chunk: int) -> dict:
         folds = []
         total_valid = 0
         for valid, out in evaluator.full_domain_evaluate_chunks(
-            dpf, key_subset, key_chunk=chunk
+            dpf, key_subset, key_chunk=chunk, mode=MODE
         ):
             total_valid += valid
             folds.append(jnp.bitwise_xor.reduce(out, axis=1))  # [chunk, lpe]

@@ -552,9 +552,10 @@ def full_domain_evaluate_chunks(
     program per chunk in which every leaf lane walks its own root-to-leaf
     path (`lax.scan` over levels at full width): ~num_levels/2 x the AES
     arithmetic, but no per-level dispatch and — because lane i IS leaf i —
-    no leaf-order gather at all (leaf_order and host_levels are ignored;
-    output is always leaf order). Which wins is platform-dependent; see
-    tools/tpu_variants.py for the measured comparison.
+    no leaf-order gather at all: output is always leaf order, and passing
+    leaf_order=False or host_levels raises ValueError (neither knob can
+    apply). Which wins is platform-dependent; see tools/tpu_variants.py for
+    the measured comparison.
     """
     if mode not in ("levels", "walk"):
         raise ValueError(f"mode must be 'levels' or 'walk', got {mode!r}")

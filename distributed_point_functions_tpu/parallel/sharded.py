@@ -372,29 +372,36 @@ def _pir_fold_jit(values, db_lane):
 
 
 class PreparedPirDatabase:
-    """Lane-order, device-resident PIR database (prepare_pir_database).
+    """Device-resident PIR database (prepare_pir_database), in the row
+    order of the evaluation mode that will consume it: "lane" (expansion
+    lane order, for the per-level mode's gather-free fold) or "natural"
+    (domain order, for walk mode whose lane i IS leaf i).
 
     A distinct type on purpose: for epb=1 value types the lane-ordered
     array has exactly `domain` rows, so a bare device array would pass
     `pir_query_batch`'s shape check and silently produce XOR inner
     products against a permuted DB."""
 
-    __slots__ = ("lane_db",)
+    __slots__ = ("lane_db", "order")
 
-    def __init__(self, lane_db):
+    def __init__(self, lane_db, order: str = "lane"):
         self.lane_db = lane_db
+        self.order = order
 
 
 def prepare_pir_database(
     dpf: DistributedPointFunction,
     db_limbs: np.ndarray,  # uint32[D, lpe]
     host_levels=None,
+    order: str = "lane",
 ) -> "PreparedPirDatabase":
-    """Permutes a PIR database into the expansion's lane order and uploads
-    it to the device ONCE. A PIR server's DB is static: re-uploading it per
-    query batch would put the host link (megabytes/s through this image's
-    tunnel) on the query path — prepare at setup, query forever after.
-    Returns the PreparedPirDatabase `pir_query_batch_chunked` consumes."""
+    """Uploads a PIR database to the device ONCE, permuted for its consumer:
+    order="lane" (default) permutes into the per-level expansion's lane
+    order so the fold needs no gather; order="natural" uploads domain order
+    as-is (walk-mode output is domain-trimmed) for `pir_query_batch_chunked`
+    mode="walk". A PIR server's DB is static: re-uploading it per query
+    batch would put the host link (megabytes/s through this image's tunnel)
+    on the query path — prepare at setup, query forever after."""
     from ..ops import evaluator as ev
 
     v = dpf.validator
@@ -406,11 +413,19 @@ def prepare_pir_database(
             f"db has {db_limbs.shape[0]} rows; the DPF domain has {domain} "
             "elements — they must match exactly"
         )
+    if order == "natural":
+        # Walk-mode output is already trimmed to the domain, so the natural
+        # DB uploads as-is.
+        return PreparedPirDatabase(jnp.asarray(db_limbs), order="natural")
+    if order != "lane":
+        raise errors.InvalidArgumentError(
+            f"order must be 'lane' or 'natural', got {order!r}"
+        )
     m = ev.lane_order_map(dpf, hierarchy_level, host_levels)
     db_lane = np.zeros((m.shape[0], db_limbs.shape[1]), dtype=np.uint32)
     valid = m >= 0
     db_lane[valid] = db_limbs[m[valid]]
-    return PreparedPirDatabase(jnp.asarray(db_lane))
+    return PreparedPirDatabase(jnp.asarray(db_lane), order="lane")
 
 
 def pir_query_batch_chunked(
@@ -419,26 +434,37 @@ def pir_query_batch_chunked(
     db_limbs: np.ndarray,  # uint32[D, lpe]
     key_chunk: int = 64,
     host_levels=None,
+    mode: str = "levels",
 ) -> np.ndarray:
-    """Single-device PIR answers via the chunked per-level evaluator.
+    """Single-device PIR answers via the chunked bulk evaluator.
 
-    The headline-bench execution shape (ops/evaluator.full_domain_evaluate_
-    chunks: host-driven per-level dispatch, small XLA programs) applied to
-    the PIR inner product: the database is permuted ONCE into the
-    expansion's lane order (`lane_order_map`, so no per-query leaf-order
-    gather exists at all), and each key chunk folds against it on device.
-    On one v5e chip this runs the 2^24 x 64-query BASELINE config ~60x
-    faster than the monolithic walk+expand shard_map program, whose 20+
-    unrolled AES levels in a single program spill (PERF.md). For multi-chip
-    domain sharding use `pir_query_batch`.
+    mode="levels": the headline-bench execution shape (ops/evaluator.
+    full_domain_evaluate_chunks: host-driven per-level dispatch, small XLA
+    programs) — the database is permuted ONCE into the expansion's lane
+    order (`lane_order_map`, so no per-query leaf-order gather exists at
+    all) and each key chunk folds against it on device. On one v5e chip
+    this runs the 2^24 x 64-query BASELINE config ~60x faster than the
+    monolithic walk+expand shard_map program, whose 20+ unrolled AES levels
+    in a single program spill (PERF.md). mode="walk": ONE program per chunk
+    (every leaf lane walks its own path — see full_domain_evaluate_chunks),
+    folding against the NATURAL-order DB. For multi-chip domain sharding
+    use `pir_query_batch`.
 
     `db_limbs` may be a host uint32[D, lpe] array (permuted + uploaded on
-    every call — fine for tests, wrong for serving) or the device array
-    returned by `prepare_pir_database` (upload once, query many).
+    every call — fine for tests, wrong for serving) or the
+    PreparedPirDatabase from `prepare_pir_database` (upload once, query
+    many; its order must match the mode: "lane" for levels, "natural" for
+    walk).
     """
     from ..ops import evaluator as ev
 
+    want_order = "natural" if mode == "walk" else "lane"
     if isinstance(db_limbs, PreparedPirDatabase):
+        if db_limbs.order != want_order:
+            raise errors.InvalidArgumentError(
+                f"mode={mode!r} needs a {want_order!r}-order "
+                f"PreparedPirDatabase, got {db_limbs.order!r}"
+            )
         db_dev = db_limbs.lane_db
     elif isinstance(db_limbs, jax.Array):
         raise errors.InvalidArgumentError(
@@ -446,14 +472,17 @@ def pir_query_batch_chunked(
             "host array); a bare device array's row order is ambiguous"
         )
     else:
-        db_dev = prepare_pir_database(dpf, db_limbs, host_levels).lane_db
+        db_dev = prepare_pir_database(
+            dpf, db_limbs, host_levels, order=want_order
+        ).lane_db
     outs = []
     for n_valid, vals in ev.full_domain_evaluate_chunks(
         dpf,
         keys,
         key_chunk=key_chunk,
-        host_levels=host_levels,
-        leaf_order=False,
+        host_levels=host_levels if mode == "levels" else None,
+        leaf_order=(mode == "walk"),
+        mode=mode,
     ):
         outs.append(np.asarray(_pir_fold_jit(vals, db_dev))[:n_valid])
         # Free the chunk's [chunk, domain, lpe] values NOW: at large domains

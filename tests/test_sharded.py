@@ -129,3 +129,41 @@ def test_multihost_single_process_degenerates():
     key, _ = dpf.generate_keys(5, 9)
     out = np.asarray(sharded.sharded_full_domain_evaluate(dpf, [key], mesh))
     np.testing.assert_array_equal(out, evaluator.full_domain_evaluate(dpf, [key]))
+
+
+def test_pir_chunked_modes_reconstruct():
+    """pir_query_batch_chunked reconstructs DB records in both execution
+    modes (per-level lane-order fold and walk-mode natural-order fold), and
+    rejects a PreparedPirDatabase whose order does not match the mode."""
+    import pytest
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import XorWrapper
+    from distributed_point_functions_tpu.parallel import sharded
+    from distributed_point_functions_tpu.utils import errors
+
+    rng = np.random.default_rng(0x51A)
+    log_domain = 9
+    domain = 1 << log_domain
+    dpf = DistributedPointFunction.create(
+        DpfParameters(log_domain, XorWrapper(128))
+    )
+    db = rng.integers(0, 2**32, size=(domain, 4), dtype=np.uint32)
+    beta = (1 << 128) - 1
+    targets = [3, 200, 511]
+    keys_a, keys_b = zip(*(dpf.generate_keys(t, beta) for t in targets))
+    for mode, order in (("levels", "lane"), ("walk", "natural")):
+        prepared = sharded.prepare_pir_database(dpf, db, order=order)
+        ra = sharded.pir_query_batch_chunked(
+            dpf, list(keys_a), prepared, key_chunk=2, mode=mode
+        )
+        rb = sharded.pir_query_batch_chunked(
+            dpf, list(keys_b), prepared, key_chunk=2, mode=mode
+        )
+        rec = ra ^ rb
+        for i, t in enumerate(targets):
+            np.testing.assert_array_equal(rec[i], db[t], err_msg=mode)
+    wrong = sharded.prepare_pir_database(dpf, db, order="lane")
+    with pytest.raises(errors.InvalidArgumentError, match="natural"):
+        sharded.pir_query_batch_chunked(dpf, list(keys_a), wrong, mode="walk")
