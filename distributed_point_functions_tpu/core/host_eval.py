@@ -81,35 +81,59 @@ def full_domain_evaluate_host(
 
     from .. import native
 
-    use_native_tree = native.available()
-    if use_native_tree:
+    if native.available():
+        # Fully fused native evaluation: expansion to the last level, then
+        # ONE streaming pass doing final level + value hash + correction
+        # (the engine is DRAM-bound; the fused tail removes two full-size
+        # read+write passes over the leaf arrays).
         rkl = np.asarray(backend_numpy._PRG_LEFT._round_keys, dtype=np.uint8)
         rkr = np.asarray(backend_numpy._PRG_RIGHT._round_keys, dtype=np.uint8)
+        rkv = np.asarray(backend_numpy._PRG_VALUE._round_keys, dtype=np.uint8)
+        # (lo, hi) uint64 pairs per element correction.
+        vc_wide = np.stack(
+            [
+                vc[..., 0].astype(np.uint64)
+                | (vc[..., 1].astype(np.uint64) << np.uint64(32)),
+                vc[..., 2].astype(np.uint64)
+                | (vc[..., 3].astype(np.uint64) << np.uint64(32)),
+            ],
+            axis=-1,
+        )  # [K, epb, 2]
+        elem_dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32, 64: np.uint64}
+        for j in range(num_keys):
+            if bits in (64, 128):
+                # Output rows are exactly the kernel's byte layout
+                # (2^stop * keep == domain for power-of-2 bitsizes): stream
+                # straight into them, no copy pass.
+                native.expand_tree_values(
+                    rkl, rkr, rkv,
+                    batch.seeds[j],
+                    batch.cw_seeds[j], batch.cw_left[j], batch.cw_right[j],
+                    batch.party, stop_level,
+                    vc_wide[j], bits, xor_group, keep_per_block,
+                    out=out[j],
+                )
+                continue
+            raw = native.expand_tree_values(
+                rkl, rkr, rkv,
+                batch.seeds[j],
+                batch.cw_seeds[j], batch.cw_left[j], batch.cw_right[j],
+                batch.party, stop_level,
+                vc_wide[j], bits, xor_group, keep_per_block,
+            )
+            out[j] = raw.view(elem_dtype[bits])[:domain]
+        return out
 
     for start in range(0, num_keys, key_chunk):
         idx = np.arange(start, min(start + key_chunk, num_keys))
         kb = batch.take(idx)
         k = idx.shape[0]
-        if use_native_tree:
-            # Whole tree per key in one native call (no per-level numpy
-            # interleave passes): ~10x the vectorized-numpy expansion.
-            n_blocks = 1 << stop_level
-            seeds = np.empty((k, n_blocks, 4), dtype=np.uint32)
-            control = np.empty((k, n_blocks), dtype=bool)
-            for j in range(k):
-                s, c = native.expand_tree(
-                    rkl, rkr, kb.seeds[j], kb.cw_seeds[j], kb.cw_left[j],
-                    kb.cw_right[j], kb.party, stop_level,
-                )
-                seeds[j] = s
-                control[j] = c.astype(bool)
-        else:
-            control0 = np.full(k, bool(kb.party), dtype=bool)
-            # Vectorized doubling expansion on the numpy oracle.
-            seeds, control = evaluator._host_expand(
-                kb.seeds, control0, kb, stop_level
-            )  # [k, 2^stop, 4], [k, 2^stop]
-            n_blocks = seeds.shape[1]
+        control0 = np.full(k, bool(kb.party), dtype=bool)
+        # Vectorized doubling expansion on the numpy oracle.
+        seeds, control = evaluator._host_expand(
+            kb.seeds, control0, kb, stop_level
+        )  # [k, 2^stop, 4], [k, 2^stop]
+        n_blocks = seeds.shape[1]
         hashed = backend_numpy._PRG_VALUE.evaluate_limbs(
             seeds.reshape(k * n_blocks, 4)
         ).reshape(k, n_blocks, 4)

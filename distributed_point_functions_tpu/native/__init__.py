@@ -87,6 +87,16 @@ def _load():
                 ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                 ctypes.c_size_t, ctypes.c_void_p,
             ]
+            lib.dpf_finish_tree_values.argtypes = [ctypes.c_void_p] * 6 + [
+                ctypes.c_uint8, ctypes.c_uint8, ctypes.c_int, ctypes.c_size_t,
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p,
+            ]
+            lib.dpf_hash_correct_values.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_size_t, ctypes.c_void_p,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+            ]
             _lib = lib
         except Exception:
             _lib = None
@@ -245,50 +255,6 @@ def value_hash(round_keys: np.ndarray, in_limbs: np.ndarray, blocks_needed: int)
     return out
 
 
-def expand_tree(
-    rks_left: np.ndarray,
-    rks_right: np.ndarray,
-    seed_limbs: np.ndarray,  # uint32[4]
-    cw_seed_limbs: np.ndarray,  # uint32[L, 4]
-    cw_left: np.ndarray,  # bool/uint8[L]
-    cw_right: np.ndarray,  # bool/uint8[L]
-    party: int,
-    levels: int,
-):
-    """Full doubling expansion of one key in native code.
-
-    Returns (seeds uint32[2^levels, 4], control uint8[2^levels]) in leaf
-    order — bit-identical to the numpy oracle's level-by-level expansion.
-    """
-    lib = _load()
-    assert lib is not None
-    n = 1 << levels
-    out_seeds = np.empty((n, 4), dtype=np.uint32)
-    out_control = np.empty(n, dtype=np.uint8)
-    scratch = np.empty((n, 4), dtype=np.uint32)
-    if not hasattr(lib, "_expand_tree_typed"):
-        lib.dpf_expand_tree.argtypes = [ctypes.c_void_p] * 6 + [
-            ctypes.c_int, ctypes.c_int,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-        ]
-        lib._expand_tree_typed = True
-    ptr = lambda a: np.ascontiguousarray(a).ctypes.data_as(ctypes.c_void_p)
-    lib.dpf_expand_tree(
-        ptr(rks_left),
-        ptr(rks_right),
-        ptr(np.ascontiguousarray(seed_limbs, dtype=np.uint32)),
-        ptr(np.ascontiguousarray(cw_seed_limbs, dtype=np.uint32)),
-        ptr(np.ascontiguousarray(cw_left, dtype=np.uint8)),
-        ptr(np.ascontiguousarray(cw_right, dtype=np.uint8)),
-        int(party),
-        int(levels),
-        out_seeds.ctypes.data_as(ctypes.c_void_p),
-        out_control.ctypes.data_as(ctypes.c_void_p),
-        scratch.ctypes.data_as(ctypes.c_void_p),
-    )
-    return out_seeds, out_control
-
-
 def dcf_evaluate_u64(
     rks_left: np.ndarray,
     rks_right: np.ndarray,
@@ -388,6 +354,93 @@ def dcf_evaluate_wide(
         int(vc.shape[1]),
         levels,
         n,
+        out.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out
+
+
+def expand_tree_values(
+    rks_left: np.ndarray,
+    rks_right: np.ndarray,
+    rks_value: np.ndarray,
+    seed_limbs: np.ndarray,  # uint32[4]
+    cw_seed_limbs: np.ndarray,  # uint32[L, 4]
+    cw_left: np.ndarray,  # bool/uint8[L]
+    cw_right: np.ndarray,  # bool/uint8[L]
+    party: int,
+    levels: int,
+    vc_wide: np.ndarray,  # uint64[epb, 2] (lo, hi) value corrections
+    value_bits: int,
+    is_xor: bool,
+    keep_per_block: int,
+    out: np.ndarray = None,
+) -> np.ndarray:
+    """Full-domain evaluation of one key fused in native code: doubling
+    expansion to the last level, then one streaming pass doing the final
+    level + value hash + correction + party negation, emitting only output
+    element bytes (one pass instead of expand/hash/correct each re-reading
+    full-size buffers — the host engine is DRAM-bound at these shapes).
+
+    Returns uint8[2^levels * keep_per_block * value_bits/8] little-endian
+    element bytes; view with the element dtype on the caller side. Pass a
+    C-contiguous `out` array of exactly that byte size to write results in
+    place (the headline engine streams directly into its output rows).
+    """
+    lib = _load()
+    assert lib is not None
+    vc_wide = np.ascontiguousarray(vc_wide, dtype=np.uint64)
+    n_out_bytes = (1 << levels) * keep_per_block * (value_bits // 8)
+    if out is None:
+        out = np.empty(n_out_bytes, dtype=np.uint8)
+    else:
+        assert out.flags["C_CONTIGUOUS"] and out.nbytes == n_out_bytes, (
+            out.nbytes, n_out_bytes
+        )
+        out = out.view(np.uint8).reshape(-1)
+    ptr = lambda a: np.ascontiguousarray(a).ctypes.data_as(ctypes.c_void_p)
+    if levels == 0:
+        ctl = np.array([party & 1], dtype=np.uint8)
+        lib.dpf_hash_correct_values(
+            ptr(rks_value),
+            ptr(np.ascontiguousarray(seed_limbs, dtype=np.uint32)),
+            ctl.ctypes.data_as(ctypes.c_void_p),
+            int(party),
+            1,
+            vc_wide.ctypes.data_as(ctypes.c_void_p),
+            int(value_bits),
+            1 if is_xor else 0,
+            int(keep_per_block),
+            out.ctypes.data_as(ctypes.c_void_p),
+        )
+        return out
+    parents, ctl_parents = expand_forest(
+        rks_left,
+        rks_right,
+        np.ascontiguousarray(seed_limbs, dtype=np.uint32).reshape(1, 4),
+        np.array([party & 1], dtype=np.uint8),
+        cw_seed_limbs[: levels - 1],
+        cw_left[: levels - 1],
+        cw_right[: levels - 1],
+        levels - 1,
+    )
+    last = levels - 1
+    lib.dpf_finish_tree_values(
+        ptr(rks_left),
+        ptr(rks_right),
+        ptr(rks_value),
+        parents.ctypes.data_as(ctypes.c_void_p),
+        np.ascontiguousarray(ctl_parents, dtype=np.uint8).ctypes.data_as(
+            ctypes.c_void_p
+        ),
+        ptr(np.ascontiguousarray(cw_seed_limbs[last], dtype=np.uint32)),
+        int(bool(cw_left[last])),
+        int(bool(cw_right[last])),
+        int(party),
+        parents.shape[0],
+        vc_wide.ctypes.data_as(ctypes.c_void_p),
+        int(value_bits),
+        1 if is_xor else 0,
+        int(keep_per_block),
         out.ctypes.data_as(ctypes.c_void_p),
     )
     return out

@@ -12,12 +12,19 @@
 
 #include <cstddef>
 #include <cstdint>
+#include <cstring>
 #include <cstdlib>
 #include <memory>
 #include <thread>
 #include <vector>
 
 #if defined(__AES__) && defined(__SSSE3__)
+#include <immintrin.h>
+// VAES intrinsics + the target attribute need gcc >= 9 or clang;
+// older toolchains still build the full 128-bit AES-NI engine.
+#if defined(__x86_64__) && (defined(__clang__) || __GNUC__ >= 9)
+#define DPF_HAVE_VAES 1
+#endif
 #include <wmmintrin.h>
 #include <tmmintrin.h>
 
@@ -90,6 +97,383 @@ inline void load_rks(const uint8_t* bytes, __m128i* rks) {
     rks[i] = _mm_loadu_si128(reinterpret_cast<const __m128i*>(bytes + 16 * i));
 }
 
+// ---------------------------------------------------------------------------
+// VAES / AVX-512 wide path: 4 AES blocks per 512-bit register, runtime
+// dispatched (this image's CPUs have VAES; older hosts fall back to the
+// 128-bit AES-NI path above). Outputs are bit-identical either way —
+// the differential suites run with DPF_TPU_NO_VAES=1 to pin that.
+// ---------------------------------------------------------------------------
+
+
+// Shared output-element emitter for the fused value kernels: one hash
+// block -> corrected, party-negated element bytes at dst.
+inline void emit_corrected_elements(const uint64_t blk[2], uint8_t ctrl,
+                                    const uint64_t* vc, int value_bits,
+                                    int is_xor, int party, int keep,
+                                    uint64_t lo_mask, uint64_t hi_mask,
+                                    size_t elem_bytes, uint8_t* dst) {
+  for (int e = 0; e < keep; ++e) {
+    const int bit_off = e * value_bits;
+    uint64_t v_lo = (blk[bit_off >> 6] >> (bit_off & 63)) & lo_mask;
+    uint64_t v_hi = (value_bits > 64 ? blk[1] : 0) & hi_mask;
+    const uint64_t* c = vc + 2 * e;
+    if (is_xor) {
+      if (ctrl) {
+        v_lo ^= c[0];
+        v_hi ^= c[1];
+      }
+    } else {
+      if (ctrl) {
+        const uint64_t s_lo = v_lo + c[0];
+        v_hi = (v_hi + c[1] + (s_lo < v_lo ? 1 : 0)) & hi_mask;
+        v_lo = s_lo & lo_mask;
+      }
+      if (party) {
+        const uint64_t n_lo = (0 - v_lo) & lo_mask;
+        v_hi = ((0 - v_hi) - (v_lo != 0 ? 1 : 0)) & hi_mask;
+        v_lo = n_lo;
+      }
+    }
+    uint8_t* d = dst + static_cast<size_t>(e) * elem_bytes;
+    if (elem_bytes <= 8) {
+      std::memcpy(d, &v_lo, elem_bytes);
+    } else {
+      std::memcpy(d, &v_lo, 8);
+      std::memcpy(d + 8, &v_hi, 8);
+    }
+  }
+}
+
+
+// Whole-block vectorized correction for full-block outputs (keep == epb,
+// bits <= 64): one lane-wise group op over the 16-byte hash block, wrap
+// mod 2^bits automatic per lane.
+inline __m128i correct_block_vec(__m128i h, uint8_t ctrl, __m128i vc_vec,
+                                 int value_bits, int is_xor, int party) {
+  const __m128i gated = ctrl ? vc_vec : _mm_setzero_si128();
+  if (is_xor) return _mm_xor_si128(h, gated);
+  __m128i v;
+  switch (value_bits) {
+    case 8:
+      v = _mm_add_epi8(h, gated);
+      if (party) v = _mm_sub_epi8(_mm_setzero_si128(), v);
+      break;
+    case 16:
+      v = _mm_add_epi16(h, gated);
+      if (party) v = _mm_sub_epi16(_mm_setzero_si128(), v);
+      break;
+    case 32:
+      v = _mm_add_epi32(h, gated);
+      if (party) v = _mm_sub_epi32(_mm_setzero_si128(), v);
+      break;
+    default:  // 64
+      v = _mm_add_epi64(h, gated);
+      if (party) v = _mm_sub_epi64(_mm_setzero_si128(), v);
+      break;
+  }
+  return v;
+}
+
+inline bool use_vaes() {
+#if !defined(DPF_HAVE_VAES)
+  return false;  // toolchain lacks VAES intrinsics; 128-bit AES-NI path
+#else
+  static const bool on = [] {
+    if (std::getenv("DPF_TPU_NO_VAES") != nullptr) return false;
+    return __builtin_cpu_supports("avx512f") &&
+           __builtin_cpu_supports("avx512bw") &&
+           __builtin_cpu_supports("vaes") != 0;
+  }();
+  return on;
+#endif
+}
+
+#if defined(DPF_HAVE_VAES)
+#define DPF_VAES_TARGET __attribute__((target("avx512f,avx512bw,vaes")))
+
+// sigma per 128-bit lane: out.lo64 = hi64, out.hi64 = hi64 ^ lo64.
+DPF_VAES_TARGET inline __m512i sigma512(__m512i x) {
+  __m512i hi_hi = _mm512_shuffle_epi32(x, _MM_PERM_DCDC);
+  __m512i zero_lo = _mm512_bslli_epi128(x, 8);
+  return _mm512_xor_si512(hi_hi, zero_lo);
+}
+
+// MMO hash of a 16-block-aligned range [begin, end): 16 blocks (4 regs) in
+// flight per iteration.
+DPF_VAES_TARGET void mmo_hash_vaes(const __m128i* rks, const uint8_t* in,
+                                   uint8_t* out, size_t begin, size_t end) {
+  __m512i rk[11];
+  for (int i = 0; i < 11; ++i) rk[i] = _mm512_broadcast_i32x4(rks[i]);
+  for (size_t i = begin; i + 16 <= end; i += 16) {
+    __m512i s[4], b[4];
+    for (int j = 0; j < 4; ++j) {
+      __m512i x = _mm512_loadu_si512(in + 16 * (i + 4 * j));
+      s[j] = sigma512(x);
+      b[j] = _mm512_xor_si512(s[j], rk[0]);
+    }
+    for (int r = 1; r < 10; ++r)
+      for (int j = 0; j < 4; ++j) b[j] = _mm512_aesenc_epi128(b[j], rk[r]);
+    for (int j = 0; j < 4; ++j) {
+      b[j] = _mm512_xor_si512(_mm512_aesenclast_epi128(b[j], rk[10]), s[j]);
+      _mm512_storeu_si512(out + 16 * (i + 4 * j), b[j]);
+    }
+  }
+}
+
+// One doubling level over parents [begin, end) (4-aligned bulk): 4 parents
+// = 8 child blocks (two 512-bit streams) per iteration; children
+// interleaved [L0 R0 L1 R1 | L2 R2 L3 R3] by a qword cross-permute.
+DPF_VAES_TARGET void expand_level_vaes(
+    const __m128i* rl128, const __m128i* rr128, __m128i cw128, uint8_t ccl,
+    uint8_t ccr, const uint8_t* cur, const uint8_t* ctl_cur, uint8_t* nxt,
+    uint8_t* ctl_nxt, size_t begin, size_t end) {
+  __m512i rl[11], rr[11];
+  for (int i = 0; i < 11; ++i) {
+    rl[i] = _mm512_broadcast_i32x4(rl128[i]);
+    rr[i] = _mm512_broadcast_i32x4(rr128[i]);
+  }
+  const __m512i cw = _mm512_broadcast_i32x4(cw128);
+  // Bit 0 of each 128-bit block = bit 0 of its even qword lane.
+  const __m512i low_bit512 =
+      _mm512_maskz_set1_epi64(static_cast<__mmask8>(0x55), 1);
+  const __m512i idx0 = _mm512_setr_epi64(0, 1, 8, 9, 2, 3, 10, 11);
+  const __m512i idx1 = _mm512_setr_epi64(4, 5, 12, 13, 6, 7, 14, 15);
+  size_t i = begin;
+  // 8 parents per iteration: 4 independent AES streams in flight (the AES
+  // units need ~5 to hide latency; 2 streams leave them half idle).
+  for (; i + 8 <= end; i += 8) {
+    __m512i x0 = _mm512_loadu_si512(cur + 16 * i);
+    __m512i x1 = _mm512_loadu_si512(cur + 16 * (i + 4));
+    __m512i sg0 = sigma512(x0), sg1 = sigma512(x1);
+    __m512i bl0 = _mm512_xor_si512(sg0, rl[0]);
+    __m512i br0 = _mm512_xor_si512(sg0, rr[0]);
+    __m512i bl1 = _mm512_xor_si512(sg1, rl[0]);
+    __m512i br1 = _mm512_xor_si512(sg1, rr[0]);
+    for (int r = 1; r < 10; ++r) {
+      bl0 = _mm512_aesenc_epi128(bl0, rl[r]);
+      br0 = _mm512_aesenc_epi128(br0, rr[r]);
+      bl1 = _mm512_aesenc_epi128(bl1, rl[r]);
+      br1 = _mm512_aesenc_epi128(br1, rr[r]);
+    }
+    bl0 = _mm512_xor_si512(_mm512_aesenclast_epi128(bl0, rl[10]), sg0);
+    br0 = _mm512_xor_si512(_mm512_aesenclast_epi128(br0, rr[10]), sg0);
+    bl1 = _mm512_xor_si512(_mm512_aesenclast_epi128(bl1, rl[10]), sg1);
+    br1 = _mm512_xor_si512(_mm512_aesenclast_epi128(br1, rr[10]), sg1);
+    for (int g = 0; g < 2; ++g) {
+      const size_t p = i + 4 * g;
+      __m512i bl = g ? bl1 : bl0, br = g ? br1 : br0;
+      const uint8_t t0 = ctl_cur[p], t1 = ctl_cur[p + 1],
+                    t2 = ctl_cur[p + 2], t3 = ctl_cur[p + 3];
+      const __mmask8 tm = static_cast<__mmask8>(
+          (t0 ? 0x03 : 0) | (t1 ? 0x0C : 0) | (t2 ? 0x30 : 0) |
+          (t3 ? 0xC0 : 0));
+      bl = _mm512_mask_xor_epi64(bl, tm, bl, cw);
+      br = _mm512_mask_xor_epi64(br, tm, br, cw);
+      const __mmask8 kl = _mm512_test_epi64_mask(bl, low_bit512);
+      const __mmask8 kr = _mm512_test_epi64_mask(br, low_bit512);
+      bl = _mm512_andnot_si512(low_bit512, bl);
+      br = _mm512_andnot_si512(low_bit512, br);
+      _mm512_storeu_si512(nxt + 16 * 2 * p,
+                          _mm512_permutex2var_epi64(bl, idx0, br));
+      _mm512_storeu_si512(nxt + 16 * (2 * p + 4),
+                          _mm512_permutex2var_epi64(bl, idx1, br));
+      const uint8_t ts[4] = {t0, t1, t2, t3};
+      for (int j = 0; j < 4; ++j) {
+        ctl_nxt[2 * (p + j)] = static_cast<uint8_t>(
+            (((kl >> (2 * j)) & 1)) ^ (ts[j] & ccl));
+        ctl_nxt[2 * (p + j) + 1] = static_cast<uint8_t>(
+            (((kr >> (2 * j)) & 1)) ^ (ts[j] & ccr));
+      }
+    }
+  }
+  for (; i + 4 <= end; i += 4) {
+    __m512i x = _mm512_loadu_si512(cur + 16 * i);
+    __m512i sg = sigma512(x);
+    __m512i bl = _mm512_xor_si512(sg, rl[0]);
+    __m512i br = _mm512_xor_si512(sg, rr[0]);
+    for (int r = 1; r < 10; ++r) {
+      bl = _mm512_aesenc_epi128(bl, rl[r]);
+      br = _mm512_aesenc_epi128(br, rr[r]);
+    }
+    bl = _mm512_xor_si512(_mm512_aesenclast_epi128(bl, rl[10]), sg);
+    br = _mm512_xor_si512(_mm512_aesenclast_epi128(br, rr[10]), sg);
+    const uint8_t t0 = ctl_cur[i], t1 = ctl_cur[i + 1], t2 = ctl_cur[i + 2],
+                  t3 = ctl_cur[i + 3];
+    const __mmask8 tm = static_cast<__mmask8>(
+        (t0 ? 0x03 : 0) | (t1 ? 0x0C : 0) | (t2 ? 0x30 : 0) | (t3 ? 0xC0 : 0));
+    bl = _mm512_mask_xor_epi64(bl, tm, bl, cw);
+    br = _mm512_mask_xor_epi64(br, tm, br, cw);
+    // Child control bits: LSB of each block (qword lanes 0,2,4,6).
+    const __mmask8 kl = _mm512_test_epi64_mask(bl, low_bit512);
+    const __mmask8 kr = _mm512_test_epi64_mask(br, low_bit512);
+    bl = _mm512_andnot_si512(low_bit512, bl);
+    br = _mm512_andnot_si512(low_bit512, br);
+    _mm512_storeu_si512(nxt + 16 * 2 * i,
+                        _mm512_permutex2var_epi64(bl, idx0, br));
+    _mm512_storeu_si512(nxt + 16 * (2 * i + 4),
+                        _mm512_permutex2var_epi64(bl, idx1, br));
+    const uint8_t ts[4] = {t0, t1, t2, t3};
+    for (int j = 0; j < 4; ++j) {
+      ctl_nxt[2 * (i + j)] = static_cast<uint8_t>(
+          (((kl >> (2 * j)) & 1)) ^ (ts[j] & ccl));
+      ctl_nxt[2 * (i + j) + 1] = static_cast<uint8_t>(
+          (((kr >> (2 * j)) & 1)) ^ (ts[j] & ccr));
+    }
+  }
+}
+
+// Fused final level + value hash + correction, VAES: 4 parents = two
+// 512-bit walk streams + two 512-bit value-hash streams per iteration.
+DPF_VAES_TARGET void finish_tree_values_vaes(
+    const __m128i* rl128, const __m128i* rr128, const __m128i* rv128,
+    const uint8_t* parents, const uint8_t* ctl_parents, __m128i cw128,
+    uint8_t cw_ctl_left, uint8_t cw_ctl_right, int party, size_t begin,
+    size_t end, const uint64_t* vc, int value_bits, int is_xor,
+    int keep_per_block, uint64_t lo_mask, uint64_t hi_mask,
+    size_t elem_bytes, size_t leaf_bytes, bool full_vec, __m128i vc_vec,
+    uint8_t* out) {
+  __m512i rl[11], rr[11], rv[11];
+  for (int i = 0; i < 11; ++i) {
+    rl[i] = _mm512_broadcast_i32x4(rl128[i]);
+    rr[i] = _mm512_broadcast_i32x4(rr128[i]);
+    rv[i] = _mm512_broadcast_i32x4(rv128[i]);
+  }
+  const __m512i cw = _mm512_broadcast_i32x4(cw128);
+  const __m512i low_bit512 =
+      _mm512_maskz_set1_epi64(static_cast<__mmask8>(0x55), 1);
+  const __m512i idx0 = _mm512_setr_epi64(0, 1, 8, 9, 2, 3, 10, 11);
+  const __m512i idx1 = _mm512_setr_epi64(4, 5, 12, 13, 6, 7, 14, 15);
+  const __m512i vc512 = _mm512_broadcast_i32x4(vc_vec);
+  alignas(64) uint64_t blk_l[8], blk_r[8];
+  size_t i = begin;
+  for (; i + 4 <= end; i += 4) {
+    __m512i x = _mm512_loadu_si512(parents + 16 * i);
+    __m512i sg = sigma512(x);
+    __m512i bl = _mm512_xor_si512(sg, rl[0]);
+    __m512i br = _mm512_xor_si512(sg, rr[0]);
+    for (int r = 1; r < 10; ++r) {
+      bl = _mm512_aesenc_epi128(bl, rl[r]);
+      br = _mm512_aesenc_epi128(br, rr[r]);
+    }
+    bl = _mm512_xor_si512(_mm512_aesenclast_epi128(bl, rl[10]), sg);
+    br = _mm512_xor_si512(_mm512_aesenclast_epi128(br, rr[10]), sg);
+    const uint8_t t0 = ctl_parents[i], t1 = ctl_parents[i + 1],
+                  t2 = ctl_parents[i + 2], t3 = ctl_parents[i + 3];
+    const __mmask8 tm = static_cast<__mmask8>(
+        (t0 ? 0x03 : 0) | (t1 ? 0x0C : 0) | (t2 ? 0x30 : 0) | (t3 ? 0xC0 : 0));
+    bl = _mm512_mask_xor_epi64(bl, tm, bl, cw);
+    br = _mm512_mask_xor_epi64(br, tm, br, cw);
+    const __mmask8 kl = _mm512_test_epi64_mask(bl, low_bit512);
+    const __mmask8 kr = _mm512_test_epi64_mask(br, low_bit512);
+    bl = _mm512_andnot_si512(low_bit512, bl);
+    br = _mm512_andnot_si512(low_bit512, br);
+    const __m512i vgl = sigma512(bl), vgr = sigma512(br);
+    __m512i hl = _mm512_xor_si512(vgl, rv[0]);
+    __m512i hr = _mm512_xor_si512(vgr, rv[0]);
+    for (int r = 1; r < 10; ++r) {
+      hl = _mm512_aesenc_epi128(hl, rv[r]);
+      hr = _mm512_aesenc_epi128(hr, rv[r]);
+    }
+    hl = _mm512_xor_si512(_mm512_aesenclast_epi128(hl, rv[10]), vgl);
+    hr = _mm512_xor_si512(_mm512_aesenclast_epi128(hr, rv[10]), vgr);
+    const uint8_t ts[4] = {t0, t1, t2, t3};
+    uint8_t tl[4], tr[4];
+    for (int j = 0; j < 4; ++j) {
+      tl[j] = static_cast<uint8_t>((((kl >> (2 * j)) & 1)) ^
+                                   (ts[j] & cw_ctl_left));
+      tr[j] = static_cast<uint8_t>((((kr >> (2 * j)) & 1)) ^
+                                   (ts[j] & cw_ctl_right));
+    }
+    if (full_vec) {
+      // Lane-wise correction of all 8 children, gated per 128-bit child
+      // block by its control bit (qword-granular masks), then one qword
+      // cross-permute into leaf order and two direct 64-byte stores.
+      const __mmask8 cml = static_cast<__mmask8>(
+          (tl[0] ? 0x03 : 0) | (tl[1] ? 0x0C : 0) | (tl[2] ? 0x30 : 0) |
+          (tl[3] ? 0xC0 : 0));
+      const __mmask8 cmr = static_cast<__mmask8>(
+          (tr[0] ? 0x03 : 0) | (tr[1] ? 0x0C : 0) | (tr[2] ? 0x30 : 0) |
+          (tr[3] ? 0xC0 : 0));
+      __m512i gl = _mm512_maskz_mov_epi64(cml, vc512);
+      __m512i gr = _mm512_maskz_mov_epi64(cmr, vc512);
+      __m512i vl, vr;
+      if (is_xor) {
+        vl = _mm512_xor_si512(hl, gl);
+        vr = _mm512_xor_si512(hr, gr);
+      } else {
+        const __m512i z = _mm512_setzero_si512();
+        switch (value_bits) {
+          case 8:
+            vl = _mm512_add_epi8(hl, gl);
+            vr = _mm512_add_epi8(hr, gr);
+            if (party) {
+              vl = _mm512_sub_epi8(z, vl);
+              vr = _mm512_sub_epi8(z, vr);
+            }
+            break;
+          case 16:
+            vl = _mm512_add_epi16(hl, gl);
+            vr = _mm512_add_epi16(hr, gr);
+            if (party) {
+              vl = _mm512_sub_epi16(z, vl);
+              vr = _mm512_sub_epi16(z, vr);
+            }
+            break;
+          case 32:
+            vl = _mm512_add_epi32(hl, gl);
+            vr = _mm512_add_epi32(hr, gr);
+            if (party) {
+              vl = _mm512_sub_epi32(z, vl);
+              vr = _mm512_sub_epi32(z, vr);
+            }
+            break;
+          default:  // 64
+            vl = _mm512_add_epi64(hl, gl);
+            vr = _mm512_add_epi64(hr, gr);
+            if (party) {
+              vl = _mm512_sub_epi64(z, vl);
+              vr = _mm512_sub_epi64(z, vr);
+            }
+            break;
+        }
+      }
+      const size_t leaf = 2 * i;
+      _mm512_storeu_si512(out + leaf * 16,
+                          _mm512_permutex2var_epi64(vl, idx0, vr));
+      _mm512_storeu_si512(out + (leaf + 4) * 16,
+                          _mm512_permutex2var_epi64(vl, idx1, vr));
+      continue;
+    }
+    _mm512_store_si512(blk_l, hl);
+    _mm512_store_si512(blk_r, hr);
+    for (int j = 0; j < 4; ++j) {
+      const size_t leaf = 2 * (i + j);
+      emit_corrected_elements(blk_l + 2 * j, tl[j], vc, value_bits, is_xor,
+                              party, keep_per_block, lo_mask, hi_mask,
+                              elem_bytes, out + leaf * leaf_bytes);
+      emit_corrected_elements(blk_r + 2 * j, tr[j], vc, value_bits, is_xor,
+                              party, keep_per_block, lo_mask, hi_mask,
+                              elem_bytes, out + (leaf + 1) * leaf_bytes);
+    }
+  }
+}
+
+#else
+inline void mmo_hash_vaes(const __m128i*, const uint8_t*, uint8_t*, size_t,
+                          size_t) {}
+inline void expand_level_vaes(const __m128i*, const __m128i*, __m128i,
+                              uint8_t, uint8_t, const uint8_t*,
+                              const uint8_t*, uint8_t*, uint8_t*, size_t,
+                              size_t) {}
+inline void finish_tree_values_vaes(const __m128i*, const __m128i*,
+                                    const __m128i*, const uint8_t*,
+                                    const uint8_t*, __m128i, uint8_t, uint8_t,
+                                    int, size_t, size_t, const uint64_t*, int,
+                                    int, int, uint64_t, uint64_t, size_t,
+                                    size_t, bool, __m128i, uint8_t*) {}
+
+#endif
+
 }  // namespace
 
 extern "C" {
@@ -121,8 +505,13 @@ void dpf_mmo_hash(const uint8_t* rks_bytes, const uint8_t* in, uint8_t* out,
                   size_t n) {
   __m128i rks[11];
   load_rks(rks_bytes, rks);
-  parallel_ranges(n, 8, [&](size_t begin, size_t end) {
+  parallel_ranges(n, 16, [&](size_t begin, size_t end) {
   size_t i = begin;
+  if (use_vaes() && end - i >= 16) {
+    const size_t bulk = i + ((end - i) / 16) * 16;
+    mmo_hash_vaes(rks, in, out, i, bulk);
+    i = bulk;
+  }
   for (; i + 8 <= end; i += 8) {
     __m128i s[8];
     for (int j = 0; j < 8; ++j)
@@ -171,38 +560,6 @@ void dpf_mmo_hash_masked(const uint8_t* rks_left, const uint8_t* rks_right,
     b = _mm_xor_si128(b, s);
     _mm_storeu_si128(reinterpret_cast<__m128i*>(out + 16 * i), b);
   }
-}
-
-// Full doubling expansion of one key, all levels in native code: seeds/
-// control ping-pong between two buffers; per level every parent hashes
-// under both PRG keys (left child then right child, leaf order), XORs the
-// correction seed where the parent's control bit is set, extracts and
-// corrects the child control bits. The per-level layout matches the
-// framework's host oracle (core/backend_numpy.py) bit for bit.
-//
-//   rks_left/right: 11x16-byte round keys of the two PRG keys
-//   seed0:          16-byte root seed
-//   cw_seeds:       levels x 16 bytes of correction seeds
-//   cw_left/right:  levels bytes (0/1) of control corrections
-//   party:          0/1 (initial control bit)
-//   out_seeds:      (1 << levels) * 16 bytes, leaf order
-//   out_control:    (1 << levels) bytes (0/1)
-//   scratch:        (1 << levels) * 16 bytes working buffer
-void dpf_expand_forest(const uint8_t*, const uint8_t*, const uint8_t*,
-                       const uint8_t*, const uint8_t*, const uint8_t*,
-                       const uint8_t*, size_t, int, uint8_t*, uint8_t*,
-                       uint8_t*);  // forward declaration (defined below)
-
-// Full doubling expansion of one key: the n=1 case of dpf_expand_forest
-// (4-wide pipelined, worker threads at wide levels).
-void dpf_expand_tree(const uint8_t* rks_left, const uint8_t* rks_right,
-                     const uint8_t* seed0, const uint8_t* cw_seeds,
-                     const uint8_t* cw_left, const uint8_t* cw_right,
-                     int party, int levels, uint8_t* out_seeds,
-                     uint8_t* out_control, uint8_t* scratch) {
-  const uint8_t ctl0 = static_cast<uint8_t>(party & 1);
-  dpf_expand_forest(rks_left, rks_right, seed0, &ctl0, cw_seeds, cw_left,
-                    cw_right, 1, levels, out_seeds, out_control, scratch);
 }
 
 // Batched point-evaluation walk: n seeds descend `levels` tree levels, each
@@ -368,6 +725,12 @@ void dpf_expand_forest(const uint8_t* rks_left, const uint8_t* rks_right,
     const uint8_t ccl = cw_left[level], ccr = cw_right[level];
     parallel_ranges(parents, 4, [&](size_t a, size_t bnd) {
       size_t i = a;
+      if (use_vaes() && bnd - i >= 4) {
+        const size_t bulk = i + ((bnd - i) / 4) * 4;
+        expand_level_vaes(rl, rr, cw, ccl, ccr, cur, ctl_cur, nxt, ctl_nxt,
+                          i, bulk);
+        i = bulk;
+      }
       for (; i + 4 <= bnd; i += 4) {
         __m128i sg[4], bl[4], br[4];
         uint8_t t[4];
@@ -432,6 +795,185 @@ void dpf_expand_forest(const uint8_t* rks_left, const uint8_t* rks_right,
     ctl_cur = ctl_nxt;
     ctl_nxt = ctmp;
   }
+}
+
+// Fused tail of full-domain evaluation of one key: expands the LAST tree
+// level from the 2^(levels-1) parent seeds, value-hashes each child in the
+// same register file, applies the value correction under the child control
+// bit and the party negation, and writes ONLY the output element bytes.
+// The separate passes it replaces (final expand writes 16 B/leaf, value
+// hash reads+writes 32 B/leaf, numpy correction reads 16 B/leaf) made the
+// host engine DRAM-bound; this pass streams 16 B/parent in and
+// keep*bits/8 B/leaf out. Values travel as raw little-endian bytes —
+// out[(leaf*keep + e) * bits/8 ...] — exactly the ConvertBytesToArrayOf
+// layout (/root/reference/dpf/internal/value_type_helpers.h:506-520).
+//
+//   parents:      2^(levels-1) seeds (from dpf_expand_forest at levels-1)
+//   vc:           epb x (lo, hi) uint64 value corrections
+//   ctl_parents:  2^(levels-1) bytes
+//   out:          2^levels * keep * (value_bits/8) bytes
+void dpf_finish_tree_values(
+    const uint8_t* rks_left, const uint8_t* rks_right, const uint8_t* rks_value,
+    const uint8_t* parents, const uint8_t* ctl_parents, const uint8_t* cw_seed,
+    uint8_t cw_ctl_left, uint8_t cw_ctl_right, int party, size_t n_parents,
+    const uint64_t* vc, int value_bits, int is_xor, int keep_per_block,
+    uint8_t* out) {
+  __m128i rl[11], rr[11], rv[11];
+  load_rks(rks_left, rl);
+  load_rks(rks_right, rr);
+  load_rks(rks_value, rv);
+  const __m128i low_bit = _mm_set_epi64x(0, 1);
+  const __m128i cw = _mm_loadu_si128(reinterpret_cast<const __m128i*>(cw_seed));
+  const uint64_t lo_mask =
+      value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
+  const uint64_t hi_mask = value_bits >= 128 ? ~0ULL : 0;
+  const size_t elem_bytes = static_cast<size_t>(value_bits) / 8;
+  const size_t leaf_bytes = elem_bytes * keep_per_block;
+  // Full-block outputs take the vectorized lane-wise correction + a direct
+  // 16-byte store; partial blocks / 128-bit go through the scalar emitter.
+  const bool full_vec =
+      value_bits <= 64 && keep_per_block * value_bits == 128;
+  __m128i vc_vec = _mm_setzero_si128();
+  if (full_vec) {
+    uint8_t tmp[16] = {0};
+    for (int e = 0; e < keep_per_block; ++e)
+      std::memcpy(tmp + e * elem_bytes, vc + 2 * e, elem_bytes);
+    vc_vec = _mm_loadu_si128(reinterpret_cast<const __m128i*>(tmp));
+  }
+
+  // One child's hash block -> corrected output elements.
+  auto emit = [&](const __m128i hashed, uint8_t ctrl, uint8_t* dst) {
+    if (full_vec) {
+      _mm_storeu_si128(
+          reinterpret_cast<__m128i*>(dst),
+          correct_block_vec(hashed, ctrl, vc_vec, value_bits, is_xor, party));
+      return;
+    }
+    uint64_t blk[2];
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(blk), hashed);
+    emit_corrected_elements(blk, ctrl, vc, value_bits, is_xor, party,
+                            keep_per_block, lo_mask, hi_mask, elem_bytes,
+                            dst);
+  };
+
+  parallel_ranges(n_parents, 4, [&](size_t begin, size_t end) {
+    size_t i = begin;
+    if (use_vaes() && end - i >= 4) {
+      const size_t bulk = i + ((end - i) / 4) * 4;
+      finish_tree_values_vaes(rl, rr, rv, parents, ctl_parents, cw,
+                              cw_ctl_left, cw_ctl_right, party, i, bulk, vc,
+                              value_bits, is_xor, keep_per_block, lo_mask,
+                              hi_mask, elem_bytes, leaf_bytes, full_vec,
+                              vc_vec, out);
+      i = bulk;
+    }
+    for (; i + 4 <= end; i += 4) {
+      // 8 walk-AES streams (4 parents x {left, right} children)...
+      __m128i sg[4], bl[4], br[4];
+      uint8_t t[4];
+      for (int j = 0; j < 4; ++j) {
+        sg[j] = sigma(_mm_loadu_si128(
+            reinterpret_cast<const __m128i*>(parents + 16 * (i + j))));
+        t[j] = ctl_parents[i + j];
+        bl[j] = _mm_xor_si128(sg[j], rl[0]);
+        br[j] = _mm_xor_si128(sg[j], rr[0]);
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int j = 0; j < 4; ++j) {
+          bl[j] = _mm_aesenc_si128(bl[j], rl[r]);
+          br[j] = _mm_aesenc_si128(br[j], rr[r]);
+        }
+      // ...then 8 value-AES streams over the children, same registers.
+      __m128i cl[4], cr[4], vgl[4], vgr[4];
+      uint8_t tl[4], tr[4];
+      for (int j = 0; j < 4; ++j) {
+        const __m128i corr = t[j] ? cw : _mm_setzero_si128();
+        __m128i l = _mm_xor_si128(
+            _mm_xor_si128(_mm_aesenclast_si128(bl[j], rl[10]), sg[j]), corr);
+        __m128i r = _mm_xor_si128(
+            _mm_xor_si128(_mm_aesenclast_si128(br[j], rr[10]), sg[j]), corr);
+        tl[j] = static_cast<uint8_t>((_mm_cvtsi128_si64(l) & 1) ^
+                                     (t[j] & cw_ctl_left));
+        tr[j] = static_cast<uint8_t>((_mm_cvtsi128_si64(r) & 1) ^
+                                     (t[j] & cw_ctl_right));
+        l = _mm_andnot_si128(low_bit, l);
+        r = _mm_andnot_si128(low_bit, r);
+        vgl[j] = sigma(l);
+        vgr[j] = sigma(r);
+        cl[j] = _mm_xor_si128(vgl[j], rv[0]);
+        cr[j] = _mm_xor_si128(vgr[j], rv[0]);
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int j = 0; j < 4; ++j) {
+          cl[j] = _mm_aesenc_si128(cl[j], rv[r]);
+          cr[j] = _mm_aesenc_si128(cr[j], rv[r]);
+        }
+      for (int j = 0; j < 4; ++j) {
+        const __m128i hl =
+            _mm_xor_si128(_mm_aesenclast_si128(cl[j], rv[10]), vgl[j]);
+        const __m128i hr =
+            _mm_xor_si128(_mm_aesenclast_si128(cr[j], rv[10]), vgr[j]);
+        const size_t leaf = 2 * (i + j);
+        emit(hl, tl[j], out + leaf * leaf_bytes);
+        emit(hr, tr[j], out + (leaf + 1) * leaf_bytes);
+      }
+    }
+    for (; i < end; ++i) {  // scalar tail
+      const __m128i sg = sigma(_mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(parents + 16 * i)));
+      const uint8_t t = ctl_parents[i];
+      const __m128i corr = t ? cw : _mm_setzero_si128();
+      __m128i bl = _mm_xor_si128(sg, rl[0]);
+      __m128i br = _mm_xor_si128(sg, rr[0]);
+      for (int r = 1; r < 10; ++r) {
+        bl = _mm_aesenc_si128(bl, rl[r]);
+        br = _mm_aesenc_si128(br, rr[r]);
+      }
+      bl = _mm_xor_si128(
+          _mm_xor_si128(_mm_aesenclast_si128(bl, rl[10]), sg), corr);
+      br = _mm_xor_si128(
+          _mm_xor_si128(_mm_aesenclast_si128(br, rr[10]), sg), corr);
+      const uint8_t tl = static_cast<uint8_t>((_mm_cvtsi128_si64(bl) & 1) ^
+                                              (t & cw_ctl_left));
+      const uint8_t tr = static_cast<uint8_t>((_mm_cvtsi128_si64(br) & 1) ^
+                                              (t & cw_ctl_right));
+      bl = _mm_andnot_si128(low_bit, bl);
+      br = _mm_andnot_si128(low_bit, br);
+      const __m128i vgl = sigma(bl), vgr = sigma(br);
+      const __m128i hl = _mm_xor_si128(encrypt(vgl, rv), vgl);
+      const __m128i hr = _mm_xor_si128(encrypt(vgr, rv), vgr);
+      const size_t leaf = 2 * i;
+      emit(hl, tl, out + leaf * leaf_bytes);
+      emit(hr, tr, out + (leaf + 1) * leaf_bytes);
+    }
+  });
+}
+
+// Value hash + correction only (the levels == 0 shape of
+// dpf_finish_tree_values: the seeds are already the leaves).
+void dpf_hash_correct_values(
+    const uint8_t* rks_value, const uint8_t* leaves, const uint8_t* ctl,
+    int party, size_t n_leaves, const uint64_t* vc, int value_bits,
+    int is_xor, int keep_per_block, uint8_t* out) {
+  __m128i rv[11];
+  load_rks(rks_value, rv);
+  const uint64_t lo_mask =
+      value_bits >= 64 ? ~0ULL : ((1ULL << value_bits) - 1);
+  const uint64_t hi_mask = value_bits >= 128 ? ~0ULL : 0;
+  const size_t elem_bytes = static_cast<size_t>(value_bits) / 8;
+  const size_t leaf_bytes = elem_bytes * keep_per_block;
+  parallel_ranges(n_leaves, 8, [&](size_t begin, size_t end) {
+    for (size_t i = begin; i < end; ++i) {
+      const __m128i sg = sigma(_mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(leaves + 16 * i)));
+      const __m128i h = _mm_xor_si128(encrypt(sg, rv), sg);
+      uint64_t blk[2];
+      _mm_storeu_si128(reinterpret_cast<__m128i*>(blk), h);
+      emit_corrected_elements(blk, ctl[i], vc, value_bits, is_xor, party,
+                              keep_per_block, lo_mask, hi_mask, elem_bytes,
+                              out + i * leaf_bytes);
+    }
+  });
 }
 
 // Fused batched DCF evaluation: each point walks the incremental DPF's
@@ -713,9 +1255,6 @@ void dpf_expand_key(const uint8_t*, uint8_t*) {}
 void dpf_mmo_hash(const uint8_t*, const uint8_t*, uint8_t*, size_t) {}
 void dpf_mmo_hash_masked(const uint8_t*, const uint8_t*, const uint8_t*,
                          const uint8_t*, uint8_t*, size_t) {}
-void dpf_expand_tree(const uint8_t*, const uint8_t*, const uint8_t*,
-                     const uint8_t*, const uint8_t*, const uint8_t*, int, int,
-                     uint8_t*, uint8_t*, uint8_t*) {}
 void dpf_evaluate_seeds(const uint8_t*, const uint8_t*, const uint8_t*,
                         const uint8_t*, const uint8_t*, const uint8_t*,
                         const uint8_t*, const uint8_t*, size_t, int, uint8_t*,
@@ -725,6 +1264,13 @@ void dpf_expand_forest(const uint8_t*, const uint8_t*, const uint8_t*,
                        const uint8_t*, size_t, int, uint8_t*, uint8_t*,
                        uint8_t*) {}
 void dpf_value_hash(const uint8_t*, const uint8_t*, size_t, int, uint8_t*) {}
+void dpf_finish_tree_values(const uint8_t*, const uint8_t*, const uint8_t*,
+                            const uint8_t*, const uint8_t*, const uint8_t*,
+                            uint8_t, uint8_t, int, size_t, const uint64_t*,
+                            int, int, int, uint8_t*) {}
+void dpf_hash_correct_values(const uint8_t*, const uint8_t*, const uint8_t*,
+                             int, size_t, const uint64_t*, int, int, int,
+                             uint8_t*) {}
 void dpf_dcf_evaluate_u64(const uint8_t*, const uint8_t*, const uint8_t*,
                           const uint8_t*, int, const uint8_t*, const uint8_t*,
                           const uint8_t*, const uint64_t*, const uint8_t*,
