@@ -89,39 +89,19 @@ def full_domain_evaluate_host(
         rkl = np.asarray(backend_numpy._PRG_LEFT._round_keys, dtype=np.uint8)
         rkr = np.asarray(backend_numpy._PRG_RIGHT._round_keys, dtype=np.uint8)
         rkv = np.asarray(backend_numpy._PRG_VALUE._round_keys, dtype=np.uint8)
-        # (lo, hi) uint64 pairs per element correction.
-        vc_wide = np.stack(
-            [
-                vc[..., 0].astype(np.uint64)
-                | (vc[..., 1].astype(np.uint64) << np.uint64(32)),
-                vc[..., 2].astype(np.uint64)
-                | (vc[..., 3].astype(np.uint64) << np.uint64(32)),
-            ],
-            axis=-1,
-        )  # [K, epb, 2]
-        elem_dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32, 64: np.uint64}
+        vc_wide = pack_vc_wide(vc)  # [K, epb, 2]
+        ctl0 = np.array([batch.party & 1], dtype=np.uint8)
         for j in range(num_keys):
-            if bits in (64, 128):
-                # Output rows are exactly the kernel's byte layout
-                # (2^stop * keep == domain for power-of-2 bitsizes): stream
-                # straight into them, no copy pass.
-                native.expand_tree_values(
-                    rkl, rkr, rkv,
-                    batch.seeds[j],
-                    batch.cw_seeds[j], batch.cw_left[j], batch.cw_right[j],
-                    batch.party, stop_level,
-                    vc_wide[j], bits, xor_group, keep_per_block,
-                    out=out[j],
-                )
-                continue
-            raw = native.expand_tree_values(
-                rkl, rkr, rkv,
-                batch.seeds[j],
+            # 2^stop * keep == domain exactly for power-of-2 bitsizes, so
+            # native-width rows stream in place (sub-32-bit elements into
+            # the uint64 rows take one upcast copy inside the helper).
+            fused_forest_values_into(
+                out[j], rkl, rkr, rkv,
+                batch.seeds[j : j + 1], ctl0,
                 batch.cw_seeds[j], batch.cw_left[j], batch.cw_right[j],
                 batch.party, stop_level,
                 vc_wide[j], bits, xor_group, keep_per_block,
             )
-            out[j] = raw.view(elem_dtype[bits])[:domain]
         return out
 
     for start in range(0, num_keys, key_chunk):
@@ -142,6 +122,59 @@ def full_domain_evaluate_host(
         )
         out[idx] = vals[:, :domain]
     return out
+
+
+
+def pack_vc_wide(vc: np.ndarray) -> np.ndarray:
+    """uint32[..., 4] correction limb rows -> uint64[..., 2] (lo, hi) pairs
+    (the native fused kernels' correction layout)."""
+    return np.stack(
+        [
+            vc[..., 0].astype(np.uint64)
+            | (vc[..., 1].astype(np.uint64) << np.uint64(32)),
+            vc[..., 2].astype(np.uint64)
+            | (vc[..., 3].astype(np.uint64) << np.uint64(32)),
+        ],
+        axis=-1,
+    )
+
+
+def fused_forest_values_into(
+    out_row: np.ndarray,
+    rkl, rkr, rkv,
+    seeds: np.ndarray,  # uint32[N, 4] roots
+    control: np.ndarray,  # uint8[N]
+    cw, cl, cr,
+    party: int,
+    levels: int,
+    vc_wide_row: np.ndarray,  # uint64[epb, 2]
+    bits: int,
+    xor_group: bool,
+    keep_per_block: int,
+) -> None:
+    """One key's fused native forest evaluation into `out_row`.
+
+    Owns the native kernel's calling convention in ONE place for both host
+    engines (full-domain and hierarchical). Streams directly into the row
+    when its byte size matches the kernel output (native element width —
+    always true for 32/64/128-bit rows); otherwise one width-view upcast
+    copy (sub-32-bit elements into wider rows).
+    """
+    from .. import native
+
+    n_bytes = (seeds.shape[0] << levels) * keep_per_block * (bits // 8)
+    if out_row.flags["C_CONTIGUOUS"] and out_row.nbytes == n_bytes:
+        native.expand_forest_values(
+            rkl, rkr, rkv, seeds, control, cw, cl, cr, party, levels,
+            vc_wide_row, bits, xor_group, keep_per_block, out=out_row,
+        )
+        return
+    raw = native.expand_forest_values(
+        rkl, rkr, rkv, seeds, control, cw, cl, cr, party, levels,
+        vc_wide_row, bits, xor_group, keep_per_block,
+    )
+    width = {8: np.uint8, 16: np.uint16, 32: np.uint32, 64: np.uint64}[bits]
+    out_row[...] = raw.view(width).reshape(out_row.shape)
 
 
 def correct_scalar_blocks(

@@ -386,26 +386,58 @@ def expand_tree_values(
     C-contiguous `out` array of exactly that byte size to write results in
     place (the headline engine streams directly into its output rows).
     """
+    return expand_forest_values(
+        rks_left, rks_right, rks_value,
+        np.ascontiguousarray(seed_limbs, dtype=np.uint32).reshape(1, 4),
+        np.array([party & 1], dtype=np.uint8),
+        cw_seed_limbs, cw_left, cw_right, party, levels,
+        vc_wide, value_bits, is_xor, keep_per_block, out=out,
+    )
+
+
+def expand_forest_values(
+    rks_left: np.ndarray,
+    rks_right: np.ndarray,
+    rks_value: np.ndarray,
+    seeds: np.ndarray,  # uint32[N, 4] roots
+    control: np.ndarray,  # bool/uint8[N]
+    cw_seed_limbs: np.ndarray,  # uint32[L, 4]
+    cw_left: np.ndarray,
+    cw_right: np.ndarray,
+    party: int,
+    levels: int,
+    vc_wide: np.ndarray,  # uint64[epb, 2]
+    value_bits: int,
+    is_xor: bool,
+    keep_per_block: int,
+    out: np.ndarray = None,
+) -> np.ndarray:
+    """Forest variant of `expand_tree_values`: N prefix roots expand
+    `levels` levels with the final level fused into the value hash +
+    correction pass (root j's outputs land contiguously). For hierarchy
+    tails where the expansion state is not needed afterwards.
+
+    Returns uint8[(N << levels) * keep_per_block * value_bits/8] element
+    bytes (or writes into a matching C-contiguous `out`).
+    """
     lib = _load()
     assert lib is not None
     vc_wide = np.ascontiguousarray(vc_wide, dtype=np.uint64)
-    n_out_bytes = (1 << levels) * keep_per_block * (value_bits // 8)
+    n = seeds.shape[0]
+    n_out_bytes = (n << levels) * keep_per_block * (value_bits // 8)
     if out is None:
         out = np.empty(n_out_bytes, dtype=np.uint8)
     else:
-        assert out.flags["C_CONTIGUOUS"] and out.nbytes == n_out_bytes, (
-            out.nbytes, n_out_bytes
-        )
+        assert out.flags["C_CONTIGUOUS"] and out.nbytes == n_out_bytes
         out = out.view(np.uint8).reshape(-1)
     ptr = lambda a: np.ascontiguousarray(a).ctypes.data_as(ctypes.c_void_p)
     if levels == 0:
-        ctl = np.array([party & 1], dtype=np.uint8)
         lib.dpf_hash_correct_values(
             ptr(rks_value),
-            ptr(np.ascontiguousarray(seed_limbs, dtype=np.uint32)),
-            ctl.ctypes.data_as(ctypes.c_void_p),
+            ptr(np.ascontiguousarray(seeds, dtype=np.uint32)),
+            ptr(np.ascontiguousarray(control, dtype=np.uint8)),
             int(party),
-            1,
+            n,
             vc_wide.ctypes.data_as(ctypes.c_void_p),
             int(value_bits),
             1 if is_xor else 0,
@@ -414,14 +446,10 @@ def expand_tree_values(
         )
         return out
     parents, ctl_parents = expand_forest(
-        rks_left,
-        rks_right,
-        np.ascontiguousarray(seed_limbs, dtype=np.uint32).reshape(1, 4),
-        np.array([party & 1], dtype=np.uint8),
-        cw_seed_limbs[: levels - 1],
-        cw_left[: levels - 1],
-        cw_right[: levels - 1],
-        levels - 1,
+        rks_left, rks_right, seeds,
+        np.ascontiguousarray(control, dtype=np.uint8),
+        cw_seed_limbs[: levels - 1], cw_left[: levels - 1],
+        cw_right[: levels - 1], levels - 1,
     )
     last = levels - 1
     lib.dpf_finish_tree_values(

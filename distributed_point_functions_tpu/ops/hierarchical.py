@@ -302,9 +302,13 @@ def evaluate_until_batch(
 
     levels = stop_level - start_level
     if engine == "host":
+        # Expansion state is only needed when a further hierarchy level
+        # will resume from it; the final level can take the fused native
+        # tail (no seed/control materialization at the leaf level).
+        need_state = hierarchy_level < v.num_hierarchy_levels - 1
         outs, new_seeds, new_control = _expand_batch_host(
             batch, np.asarray(seeds0), np.asarray(control0), start_level,
-            levels, keep_per_block, value_type,
+            levels, keep_per_block, value_type, need_state=need_state,
         )
     elif mesh is not None:
         outs, new_seeds, new_control = _expand_batch_sharded(
@@ -379,12 +383,17 @@ def _expand_batch_host(
     levels: int,
     keep_per_block: int,
     value_type,
+    need_state: bool = True,
 ):
     """Host-engine counterpart of _expand_batch: the doubling expansion runs
     in the native AES-NI library (one call per key), value hash + correction
-    vectorized in numpy (core/host_eval.correct_scalar_blocks). Scalar
+    vectorized in numpy (core/host_eval.correct_scalar_blocks) — or, when
+    `need_state` is False (final hierarchy level: nothing resumes from the
+    leaf seeds), the fully fused native forest pass (expansion tail + value
+    hash + correction in one stream; see PERF.md "Host side"). Scalar
     Int/XorWrapper only; outputs are host format (uint64 / uint32 limb rows)
     in the same leaf order as the device path."""
+    from .. import native
     from ..core import backend_numpy, host_eval
     from ..core.value_types import Int, XorWrapper
 
@@ -397,6 +406,29 @@ def _expand_batch_host(
     xor_group = isinstance(value_type, XorWrapper)
     k, num_parents = seeds0.shape[0], seeds0.shape[1]
     n_out = num_parents << levels
+    if not need_state and native.available():
+        rkl = np.asarray(backend_numpy._PRG_LEFT._round_keys, dtype=np.uint8)
+        rkr = np.asarray(backend_numpy._PRG_RIGHT._round_keys, dtype=np.uint8)
+        rkv = np.asarray(backend_numpy._PRG_VALUE._round_keys, dtype=np.uint8)
+        vc_wide = host_eval.pack_vc_wide(batch.value_corrections)
+        n_vals = n_out * keep_per_block
+        if bits == 128:
+            outs = np.empty((k, n_vals, 4), dtype=np.uint32)
+        elif bits == 64:
+            outs = np.empty((k, n_vals), dtype=np.uint64)
+        else:
+            outs = np.empty((k, n_vals), dtype=np.uint32)
+        for j in range(k):
+            host_eval.fused_forest_values_into(
+                outs[j], rkl, rkr, rkv,
+                seeds0[j], control0[j].astype(np.uint8),
+                batch.cw_seeds[j, start_level : start_level + levels],
+                batch.cw_left[j, start_level : start_level + levels],
+                batch.cw_right[j, start_level : start_level + levels],
+                batch.party, levels, vc_wide[j], bits, xor_group,
+                keep_per_block,
+            )
+        return outs, None, None
     new_seeds = np.empty((k, n_out, 4), dtype=np.uint32)
     new_control = np.empty((k, n_out), dtype=bool)
     for j in range(k):
