@@ -156,9 +156,11 @@ def fused_forest_values_into(
 
     Owns the native kernel's calling convention in ONE place for both host
     engines (full-domain and hierarchical). Streams directly into the row
-    when its byte size matches the kernel output (native element width —
-    always true for 32/64/128-bit rows); otherwise one width-view upcast
-    copy (sub-32-bit elements into wider rows).
+    when it is C-contiguous at the kernel's exact byte size (native element
+    width rows — e.g. the hierarchical engine's uint32 rows for 32-bit
+    values, uint64 for 64-bit, uint32[..., 4] for 128-bit); otherwise one
+    width-view copy (e.g. the full-domain engine's uint64 rows for sub-64
+    widths, per its documented return type).
     """
     from .. import native
 
@@ -173,6 +175,9 @@ def fused_forest_values_into(
         rkl, rkr, rkv, seeds, control, cw, cl, cr, party, levels,
         vc_wide_row, bits, xor_group, keep_per_block,
     )
+    if bits == 128:  # limb rows
+        out_row[...] = raw.view(np.uint32).reshape(out_row.shape)
+        return
     width = {8: np.uint8, 16: np.uint16, 32: np.uint32, 64: np.uint64}[bits]
     out_row[...] = raw.view(width).reshape(out_row.shape)
 

@@ -359,42 +359,6 @@ def dcf_evaluate_wide(
     return out
 
 
-def expand_tree_values(
-    rks_left: np.ndarray,
-    rks_right: np.ndarray,
-    rks_value: np.ndarray,
-    seed_limbs: np.ndarray,  # uint32[4]
-    cw_seed_limbs: np.ndarray,  # uint32[L, 4]
-    cw_left: np.ndarray,  # bool/uint8[L]
-    cw_right: np.ndarray,  # bool/uint8[L]
-    party: int,
-    levels: int,
-    vc_wide: np.ndarray,  # uint64[epb, 2] (lo, hi) value corrections
-    value_bits: int,
-    is_xor: bool,
-    keep_per_block: int,
-    out: np.ndarray = None,
-) -> np.ndarray:
-    """Full-domain evaluation of one key fused in native code: doubling
-    expansion to the last level, then one streaming pass doing the final
-    level + value hash + correction + party negation, emitting only output
-    element bytes (one pass instead of expand/hash/correct each re-reading
-    full-size buffers — the host engine is DRAM-bound at these shapes).
-
-    Returns uint8[2^levels * keep_per_block * value_bits/8] little-endian
-    element bytes; view with the element dtype on the caller side. Pass a
-    C-contiguous `out` array of exactly that byte size to write results in
-    place (the headline engine streams directly into its output rows).
-    """
-    return expand_forest_values(
-        rks_left, rks_right, rks_value,
-        np.ascontiguousarray(seed_limbs, dtype=np.uint32).reshape(1, 4),
-        np.array([party & 1], dtype=np.uint8),
-        cw_seed_limbs, cw_left, cw_right, party, levels,
-        vc_wide, value_bits, is_xor, keep_per_block, out=out,
-    )
-
-
 def expand_forest_values(
     rks_left: np.ndarray,
     rks_right: np.ndarray,
@@ -412,7 +376,7 @@ def expand_forest_values(
     keep_per_block: int,
     out: np.ndarray = None,
 ) -> np.ndarray:
-    """Forest variant of `expand_tree_values`: N prefix roots expand
+    """Fused forest evaluation: N prefix roots expand
     `levels` levels with the final level fused into the value hash +
     correction pass (root j's outputs land contiguously). For hierarchy
     tails where the expansion state is not needed afterwards.

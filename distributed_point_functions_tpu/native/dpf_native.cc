@@ -1067,6 +1067,117 @@ struct DcfAccWide {
   }
 };
 
+
+#if defined(DPF_HAVE_VAES)
+// VAES range of the fused DCF walk: 8 points per iteration as two 512-bit
+// groups of 4; per-point PRG key selection is one masked qword XOR of the
+// (rl, rl^rr) round-key pair per AES round. Captures hash in the same
+// register file; element extract/correct/accumulate stays scalar via the
+// policy (a few ops per point per depth — not the hot part).
+template <typename Policy, typename OutT>
+DPF_VAES_TARGET void dcf_walk_vaes_range(
+    const __m128i* rl128, const __m128i* rdiff128, const __m128i* rv128,
+    const uint8_t* seed0, int party, const uint8_t* cw_seeds,
+    const uint8_t* cw_left, const uint8_t* cw_right, const uint8_t* capture,
+    const uint8_t* acc_mask, const int32_t* block_sel, const uint8_t* paths,
+    int levels, size_t stride, size_t begin, size_t end,
+    const Policy& policy, OutT* out) {
+  __m512i rl[11], rdiff[11], rv[11];
+  for (int i = 0; i < 11; ++i) {
+    rl[i] = _mm512_broadcast_i32x4(rl128[i]);
+    rdiff[i] = _mm512_broadcast_i32x4(rdiff128[i]);
+    rv[i] = _mm512_broadcast_i32x4(rv128[i]);
+  }
+  const __m512i low_bit512 =
+      _mm512_maskz_set1_epi64(static_cast<__mmask8>(0x55), 1);
+  const __m512i seed512 = _mm512_broadcast_i32x4(
+      _mm_loadu_si128(reinterpret_cast<const __m128i*>(seed0)));
+  alignas(64) uint64_t blk[8];
+  for (size_t i0 = begin; i0 + 8 <= end; i0 += 8) {
+    __m512i s[2] = {seed512, seed512};
+    uint64_t path_lo[8], path_hi[8];
+    typename Policy::Acc acc[8];
+    uint8_t t[8];
+    for (int j = 0; j < 8; ++j) {
+      policy.init(acc[j]);
+      const uint64_t* p =
+          reinterpret_cast<const uint64_t*>(paths + 16 * (i0 + j));
+      path_lo[j] = p[0];
+      path_hi[j] = p[1];
+      t[j] = static_cast<uint8_t>(party & 1);
+    }
+    for (int depth = 0; depth <= levels; ++depth) {
+      if (capture[depth]) {
+        __m512i sg[2], b[2];
+        for (int g = 0; g < 2; ++g) {
+          sg[g] = sigma512(s[g]);
+          b[g] = _mm512_xor_si512(sg[g], rv[0]);
+        }
+        for (int r = 1; r < 10; ++r)
+          for (int g = 0; g < 2; ++g) b[g] = _mm512_aesenc_epi128(b[g], rv[r]);
+        for (int g = 0; g < 2; ++g) {
+          b[g] = _mm512_xor_si512(_mm512_aesenclast_epi128(b[g], rv[10]),
+                                  sg[g]);
+          _mm512_store_si512(blk, b[g]);
+          for (int j = 0; j < 4; ++j) {
+            const size_t pt = i0 + 4 * g + j;
+            policy.consume(acc[4 * g + j], blk + 2 * j, depth,
+                           block_sel[depth * stride + pt], t[4 * g + j],
+                           acc_mask[depth * stride + pt]);
+          }
+        }
+      }
+      if (depth == levels) break;
+      const int bit_index = levels - 1 - depth;
+      const __m512i cw512 = _mm512_broadcast_i32x4(_mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(cw_seeds + 16 * depth)));
+      const uint8_t ccl = cw_left[depth], ccr = cw_right[depth];
+      uint8_t bit[8];
+      __mmask8 km[2], tm[2];
+      for (int g = 0; g < 2; ++g) {
+        uint8_t m = 0, tmg = 0;
+        for (int j = 0; j < 4; ++j) {
+          const int q = 4 * g + j;
+          bit[q] = static_cast<uint8_t>(
+              ((bit_index < 64 ? path_lo[q] : path_hi[q]) >>
+               (bit_index & 63)) &
+              1);
+          if (bit[q]) m |= static_cast<uint8_t>(0x03 << (2 * j));
+          if (t[q]) tmg |= static_cast<uint8_t>(0x03 << (2 * j));
+        }
+        km[g] = m;
+        tm[g] = tmg;
+      }
+      __m512i sg[2], b[2];
+      for (int g = 0; g < 2; ++g) {
+        sg[g] = sigma512(s[g]);
+        b[g] = _mm512_xor_si512(
+            sg[g], _mm512_mask_xor_epi64(rl[0], km[g], rl[0], rdiff[0]));
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int g = 0; g < 2; ++g)
+          b[g] = _mm512_aesenc_epi128(
+              b[g], _mm512_mask_xor_epi64(rl[r], km[g], rl[r], rdiff[r]));
+      for (int g = 0; g < 2; ++g) {
+        b[g] = _mm512_xor_si512(
+            _mm512_aesenclast_epi128(
+                b[g], _mm512_mask_xor_epi64(rl[10], km[g], rl[10], rdiff[10])),
+            sg[g]);
+        b[g] = _mm512_mask_xor_epi64(b[g], tm[g], b[g], cw512);
+        const __mmask8 k8 = _mm512_test_epi64_mask(b[g], low_bit512);
+        for (int j = 0; j < 4; ++j) {
+          const int q = 4 * g + j;
+          const uint8_t nt = static_cast<uint8_t>((k8 >> (2 * j)) & 1);
+          t[q] = static_cast<uint8_t>(nt ^ (t[q] & (bit[q] ? ccr : ccl)));
+        }
+        s[g] = _mm512_andnot_si512(low_bit512, b[g]);
+      }
+    }
+    for (int j = 0; j < 8; ++j) policy.store(out, i0 + j, acc[j]);
+  }
+}
+#endif  // DPF_HAVE_VAES
+
 template <typename Policy, typename OutT>
 void dcf_walk_impl(const uint8_t* rks_left, const uint8_t* rks_right,
                    const uint8_t* rks_value, const uint8_t* seed0, int party,
@@ -1086,8 +1197,18 @@ void dcf_walk_impl(const uint8_t* rks_left, const uint8_t* rks_right,
   const __m128i low_bit = _mm_set_epi64x(0, 1);
   const size_t stride = n_points;  // row stride of acc_mask / block_sel
 
-  parallel_ranges(n_points, 4, [&](size_t begin, size_t end) {
-  for (size_t i0 = begin; i0 < end; i0 += 4) {
+  parallel_ranges(n_points, 8, [&](size_t begin, size_t end) {
+  size_t start = begin;
+#if defined(DPF_HAVE_VAES)
+  if (use_vaes() && end - start >= 8) {
+    const size_t bulk = start + ((end - start) / 8) * 8;
+    dcf_walk_vaes_range(rl, rdiff, rv, seed0, party, cw_seeds, cw_left,
+                        cw_right, capture, acc_mask, block_sel, paths,
+                        levels, stride, start, bulk, policy, out);
+    start = bulk;
+  }
+#endif
+  for (size_t i0 = start; i0 < end; i0 += 4) {
     const int lanes = static_cast<int>(end - i0 < 4 ? end - i0 : 4);
     __m128i s[4];
     uint64_t path_lo[4] = {0}, path_hi[4] = {0};
