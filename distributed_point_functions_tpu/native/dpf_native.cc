@@ -474,6 +474,91 @@ inline void finish_tree_values_vaes(const __m128i*, const __m128i*,
 
 #endif
 
+
+#if defined(DPF_HAVE_VAES)
+// VAES range of the point-evaluation walk: 8 seeds per iteration as two
+// 512-bit groups; per-lane PRG key selection is one masked qword XOR per
+// round (rk = rl ^ (rdiff & path_bit_mask)).
+DPF_VAES_TARGET void evaluate_seeds_vaes_range(
+    const __m128i* rl128, const __m128i* rdiff128, const uint8_t* seeds_in,
+    const uint8_t* ctl_in, const uint8_t* paths, const uint8_t* cw_seeds,
+    const uint8_t* cw_left, const uint8_t* cw_right, int levels,
+    size_t begin, size_t end, uint8_t* seeds_out, uint8_t* ctl_out) {
+  __m512i rl[11], rdiff[11];
+  for (int i = 0; i < 11; ++i) {
+    rl[i] = _mm512_broadcast_i32x4(rl128[i]);
+    rdiff[i] = _mm512_broadcast_i32x4(rdiff128[i]);
+  }
+  const __m512i low_bit512 =
+      _mm512_maskz_set1_epi64(static_cast<__mmask8>(0x55), 1);
+  for (size_t i0 = begin; i0 + 8 <= end; i0 += 8) {
+    __m512i s[2];
+    s[0] = _mm512_loadu_si512(seeds_in + 16 * i0);
+    s[1] = _mm512_loadu_si512(seeds_in + 16 * (i0 + 4));
+    uint64_t path_lo[8], path_hi[8];
+    uint8_t t[8];
+    for (int j = 0; j < 8; ++j) {
+      const uint64_t* p =
+          reinterpret_cast<const uint64_t*>(paths + 16 * (i0 + j));
+      path_lo[j] = p[0];
+      path_hi[j] = p[1];
+      t[j] = ctl_in[i0 + j];
+    }
+    for (int level = 0; level < levels; ++level) {
+      const int bit_index = levels - 1 - level;
+      const __m512i cw512 = _mm512_broadcast_i32x4(_mm_loadu_si128(
+          reinterpret_cast<const __m128i*>(cw_seeds + 16 * level)));
+      const uint8_t ccl = cw_left[level], ccr = cw_right[level];
+      uint8_t bit[8];
+      __mmask8 km[2], tm[2];
+      for (int g = 0; g < 2; ++g) {
+        uint8_t m = 0, tmg = 0;
+        for (int j = 0; j < 4; ++j) {
+          const int q = 4 * g + j;
+          bit[q] = static_cast<uint8_t>(
+              (bit_index >= 128)
+                  ? 0
+                  : (((bit_index < 64 ? path_lo[q] : path_hi[q]) >>
+                      (bit_index & 63)) &
+                     1));
+          if (bit[q]) m |= static_cast<uint8_t>(0x03 << (2 * j));
+          if (t[q]) tmg |= static_cast<uint8_t>(0x03 << (2 * j));
+        }
+        km[g] = m;
+        tm[g] = tmg;
+      }
+      __m512i sg[2], b[2];
+      for (int g = 0; g < 2; ++g) {
+        sg[g] = sigma512(s[g]);
+        b[g] = _mm512_xor_si512(
+            sg[g], _mm512_mask_xor_epi64(rl[0], km[g], rl[0], rdiff[0]));
+      }
+      for (int r = 1; r < 10; ++r)
+        for (int g = 0; g < 2; ++g)
+          b[g] = _mm512_aesenc_epi128(
+              b[g], _mm512_mask_xor_epi64(rl[r], km[g], rl[r], rdiff[r]));
+      for (int g = 0; g < 2; ++g) {
+        b[g] = _mm512_xor_si512(
+            _mm512_aesenclast_epi128(
+                b[g], _mm512_mask_xor_epi64(rl[10], km[g], rl[10], rdiff[10])),
+            sg[g]);
+        b[g] = _mm512_mask_xor_epi64(b[g], tm[g], b[g], cw512);
+        const __mmask8 k8 = _mm512_test_epi64_mask(b[g], low_bit512);
+        for (int j = 0; j < 4; ++j) {
+          const int q = 4 * g + j;
+          const uint8_t nt = static_cast<uint8_t>((k8 >> (2 * j)) & 1);
+          t[q] = static_cast<uint8_t>(nt ^ (t[q] & (bit[q] ? ccr : ccl)));
+        }
+        s[g] = _mm512_andnot_si512(low_bit512, b[g]);
+      }
+    }
+    _mm512_storeu_si512(seeds_out + 16 * i0, s[0]);
+    _mm512_storeu_si512(seeds_out + 16 * (i0 + 4), s[1]);
+    for (int j = 0; j < 8; ++j) ctl_out[i0 + j] = t[j];
+  }
+}
+#endif  // DPF_HAVE_VAES
+
 }  // namespace
 
 extern "C" {
@@ -592,6 +677,15 @@ void dpf_evaluate_seeds(const uint8_t* rks_left, const uint8_t* rks_right,
 
   parallel_ranges(n, 8, [&](size_t begin, size_t end) {
   size_t i = begin;
+#if defined(DPF_HAVE_VAES)
+  if (use_vaes() && end - i >= 8) {
+    const size_t bulk = i + ((end - i) / 8) * 8;
+    evaluate_seeds_vaes_range(rl, rdiff, seeds_in, ctl_in, paths, cw_seeds,
+                              cw_left, cw_right, levels, i, bulk, seeds_out,
+                              ctl_out);
+    i = bulk;
+  }
+#endif
   for (; i + 8 <= end; i += 8) {
     __m128i s[8];
     uint64_t path_lo[8], path_hi[8];
