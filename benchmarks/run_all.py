@@ -46,8 +46,21 @@ def main():
             results.append({"bench": script, "error": f"bad output: {line[:200]}"})
         print(line, flush=True)
     out = os.path.join(here, "results.json")
+    # Merge with existing records by "bench" name: fresh runs replace their
+    # own previous entries but hand-recorded measurements (cpu-host-engine
+    # records with date/provenance notes) survive.
+    merged = []
+    try:
+        with open(out) as f:
+            merged = [
+                e
+                for e in json.load(f)
+                if e.get("bench") not in {r.get("bench") for r in results}
+            ]
+    except Exception:
+        pass
     with open(out, "w") as f:
-        json.dump(results, f, indent=2)
+        json.dump(merged + results, f, indent=2)
     print(f"# wrote {out}", file=sys.stderr)
 
 

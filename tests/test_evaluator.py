@@ -319,3 +319,20 @@ def test_walk_mode_matches_levels_mode():
                 mode="bogus",
             )
         )
+
+
+def test_walk_path_masks_matches_sharded_leaf_masks():
+    """The host word-wise walk-mask builder (evaluator._walk_path_masks) and
+    the device lane-wise builder (sharded._leaf_path_masks) are independent
+    implementations of the same leaf->path-bit mapping; pin them equal."""
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.parallel import sharded
+
+    for num_levels in (1, 3, 5, 6, 9, 11):
+        host = evaluator._walk_path_masks(num_levels)
+        lanes = max(32, 1 << num_levels)
+        dev = np.asarray(
+            sharded._leaf_path_masks(jnp.uint32(0), lanes, num_levels)
+        )
+        np.testing.assert_array_equal(host, dev, err_msg=str(num_levels))

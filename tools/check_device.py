@@ -60,6 +60,10 @@ def main() -> int:
         tuple(int(v) for v in s.split("x"))
         for s in os.environ.get("CHECK_SHAPES", "64x20").split(",")
     ]
+    # Execution strategy under test: "levels" (per-level dispatch, the
+    # default) or "walk" (single program per chunk) — the two program
+    # shapes fail independently on a broken backend (PERF.md).
+    mode = os.environ.get("CHECK_MODE", "levels")
     for num_keys, lds in shapes:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
         alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
@@ -69,7 +73,7 @@ def main() -> int:
         want = np.bitwise_xor.reduce(host, axis=1)
         folds = []
         for valid, out in evaluator.full_domain_evaluate_chunks(
-            dpf, keys, key_chunk=num_keys
+            dpf, keys, key_chunk=num_keys, mode=mode
         ):
             folds.append(np.asarray(jnp.bitwise_xor.reduce(out, axis=1))[:valid])
         got = np.concatenate(folds, axis=0)
@@ -78,7 +82,7 @@ def main() -> int:
         )
         bad = int((got64 != want).sum())
         status = "OK" if bad == 0 else f"MISMATCH ({bad}/{num_keys} keys)"
-        print(f"keys={num_keys:4d} log_domain={lds:3d}: {status}")
+        print(f"keys={num_keys:4d} log_domain={lds:3d} mode={mode}: {status}")
         failures += bad
     if failures:
         print(
