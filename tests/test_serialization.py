@@ -349,3 +349,73 @@ def test_errors():
         ser.decode_value_type(b"")  # no oneof set
     with pytest.raises(InvalidArgumentError):
         list(ser.wire.iter_fields(b"\xff"))  # truncated varint
+
+
+def test_fuzz_roundtrip_random_types_and_keys():
+    """Seeded fuzz: random parameter stacks (mixed value types, hierarchy
+    shapes) -> keygen -> serialize -> parse -> re-serialize byte-stable,
+    and the parsed key still evaluates to correct shares."""
+    import numpy as np
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import (
+        Int, IntModN, TupleType, XorWrapper,
+    )
+    from distributed_point_functions_tpu.protos import serialization as ser
+
+    rng = np.random.default_rng(0xF022)
+
+    def rand_modn():
+        base = int(32 << rng.integers(0, 2))
+        return IntModN(base, (1 << base) - [5, 59][base == 64])
+
+    def rand_type(depth=0):
+        kinds = ["int", "xor", "modn"] + (["tuple"] if depth == 0 else [])
+        k = kinds[rng.integers(0, len(kinds))]
+        if k == "int":
+            return Int(int(8 << rng.integers(0, 5)))
+        if k == "xor":
+            return XorWrapper(int(8 << rng.integers(0, 5)))
+        if k == "modn":
+            return rand_modn()
+        # All IntModN elements of a tuple must share one type (library
+        # constraint), so draw the modn type once and reuse it.
+        modn = rand_modn()
+        elems = []
+        for _ in range(int(rng.integers(2, 4))):
+            e = rand_type(1)
+            elems.append(modn if isinstance(e, IntModN) else e)
+        return TupleType(*elems)
+
+    def sample(vt):
+        if isinstance(vt, TupleType):
+            return tuple(sample(e) for e in vt.elements)
+        if isinstance(vt, IntModN):
+            return int(rng.integers(1, min(vt.modulus, 1 << 62)))
+        return int(rng.integers(1, 1 << min(vt.bitsize, 62)))
+
+    for trial in range(12):
+        n_levels = int(rng.integers(1, 3))
+        lds_list = sorted(
+            int(x) for x in rng.choice(np.arange(1, 11), size=n_levels, replace=False)
+        )
+        params = [DpfParameters(l, rand_type()) for l in lds_list]
+        dpf = DistributedPointFunction.create_incremental(params)
+        lds = lds_list[-1]
+        alpha = int(rng.integers(0, 1 << lds))
+        betas = [sample(p.value_type) for p in params]
+        ka, kb = dpf.generate_keys_incremental(alpha, betas)
+        parsed = []
+        for key in (ka, kb):
+            buf = ser.serialize_dpf_key(key, params)
+            p = ser.parse_dpf_key(buf)
+            assert p == key, (trial, params)
+            assert ser.serialize_dpf_key(p, params) == buf
+            parsed.append(p)
+        # Parsed keys still satisfy the share-sum property at alpha.
+        pa, pb = parsed
+        va = dpf.evaluate_at(pa, n_levels - 1, [alpha])[0]
+        vb = dpf.evaluate_at(pb, n_levels - 1, [alpha])[0]
+        vt = params[-1].value_type
+        assert vt.add(va, vb) == betas[-1], (trial, vt, alpha)
