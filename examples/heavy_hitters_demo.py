@@ -1,0 +1,141 @@
+"""Two-server private heavy-hitters over the wire format.
+
+The deployment story the reference's experiments/benchmarks gesture at
+(BM_HeavyHitters, distributed_point_function_benchmark.cc:306-340; the
+Poplar/heavy-hitters literature): N clients each hold a private value;
+two non-colluding servers learn WHICH values are held by >= `threshold`
+clients — and nothing else about individual clients.
+
+Protocol (semi-honest, additive shares mod 2^64):
+
+1. Every client builds an incremental DPF key pair for the point function
+   f(x) = 1 at its value, with one hierarchy level per `bits_per_level`
+   bits, and sends one serialized key to each server (the byte-compatible
+   wire format — servers parse, never see plaintext values).
+2. Level by level, each server batch-evaluates ALL client keys under the
+   surviving candidate prefixes (ops/hierarchical.py BatchedContext — the
+   native host engine) and sums the per-prefix shares over clients.
+3. The servers exchange their per-prefix aggregate shares (two uint64
+   vectors — the only communication), reconstruct counts, and keep the
+   prefixes with count >= threshold for the next level. Individual
+   contributions stay hidden inside the aggregates.
+
+Run: python examples/heavy_hitters_demo.py  (CPU; a few seconds)
+"""
+
+import collections
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+BITS = 16  # value width
+BITS_PER_LEVEL = 2
+NUM_CLIENTS = int(os.environ.get("HH_CLIENTS", 120))
+THRESHOLD = int(os.environ.get("HH_THRESHOLD", 8))
+
+
+def main() -> int:
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import hierarchical
+    from distributed_point_functions_tpu.protos import serialization as ser
+
+    rng = np.random.default_rng(2026)
+
+    # --- client values: a few heavy hitters + uniform noise --------------
+    heavy = [0xBEEF, 0x1234, 0xC0DE]
+    values = []
+    for h in heavy:
+        values += [h] * (THRESHOLD + int(rng.integers(0, 5)))
+    while len(values) < NUM_CLIENTS:
+        values.append(int(rng.integers(0, 1 << BITS)))
+    rng.shuffle(values)
+    values = values[:NUM_CLIENTS]
+    true_counts = collections.Counter(values)
+    want = sorted(v for v, c in true_counts.items() if c >= THRESHOLD)
+
+    params = [
+        DpfParameters(lds, Int(64))
+        for lds in range(BITS_PER_LEVEL, BITS + 1, BITS_PER_LEVEL)
+    ]
+    dpf = DistributedPointFunction.create_incremental(params)
+    n_levels = len(params)
+
+    # --- clients: keygen + serialize (one key per server) ----------------
+    t0 = time.time()
+    wire_a, wire_b = [], []
+    for v in values:
+        ka, kb = dpf.generate_keys_incremental(v, [1] * n_levels)
+        wire_a.append(ser.serialize_dpf_key(ka, params))
+        wire_b.append(ser.serialize_dpf_key(kb, params))
+    key_bytes = sum(len(b) for b in wire_a)
+    print(
+        f"# {NUM_CLIENTS} clients: keygen + serialize {time.time() - t0:.2f}s, "
+        f"{key_bytes / NUM_CLIENTS:.0f} B/key on the wire"
+    )
+
+    # --- servers: parse once, then level-by-level aggregation ------------
+    keys_a = [ser.parse_dpf_key(b) for b in wire_a]
+    keys_b = [ser.parse_dpf_key(b) for b in wire_b]
+    ctx_a = hierarchical.BatchedContext.create(dpf, keys_a)
+    ctx_b = hierarchical.BatchedContext.create(dpf, keys_b)
+
+    t0 = time.time()
+    prefixes = []
+    for level in range(n_levels):
+        # Each server: shares for every candidate child prefix, summed over
+        # clients (the aggregate hides individual contributions).
+        out_a = hierarchical.evaluate_until_batch(
+            ctx_a, level, prefixes, engine="host"
+        )
+        out_b = hierarchical.evaluate_until_batch(
+            ctx_b, level, prefixes, engine="host"
+        )
+        agg_a = out_a.astype(np.uint64).sum(axis=0, dtype=np.uint64)
+        agg_b = out_b.astype(np.uint64).sum(axis=0, dtype=np.uint64)
+        # The only server-to-server exchange: two aggregate vectors.
+        counts = (agg_a + agg_b).astype(np.uint64)  # mod 2^64
+        n_candidates = counts.shape[0]
+        survivors = np.nonzero(counts >= THRESHOLD)[0]
+        # Candidate i is (prefix index << bits_per_level) + child — in the
+        # batched path outputs are ordered by sorted prefix then leaf.
+        if prefixes:
+            base = np.repeat(
+                np.asarray(prefixes, dtype=np.uint64), 1 << BITS_PER_LEVEL
+            )
+            child = np.tile(
+                np.arange(1 << BITS_PER_LEVEL, dtype=np.uint64),
+                len(prefixes),
+            )
+            cand = (base << np.uint64(BITS_PER_LEVEL)) + child
+        else:
+            cand = np.arange(n_candidates, dtype=np.uint64)
+        prefixes = sorted(int(cand[i]) for i in survivors)
+        print(
+            f"# level {level}: {n_candidates} candidates -> "
+            f"{len(prefixes)} survivors"
+        )
+        if not prefixes:
+            break
+    elapsed = time.time() - t0
+
+    got = sorted(prefixes)
+    print(f"# aggregation: {elapsed:.2f}s for {n_levels} levels x {NUM_CLIENTS} clients")
+    print(f"heavy hitters found: {[hex(v) for v in got]}")
+    print(f"expected:            {[hex(v) for v in want]}")
+    if got != want:
+        print("MISMATCH")
+        return 1
+    for v in got:
+        print(f"  {hex(v)}: true count {true_counts[v]}")
+    print("OK: servers learned only the heavy hitters and their counts")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
