@@ -554,8 +554,11 @@ def full_domain_evaluate_chunks(
     arithmetic, but no per-level dispatch and — because lane i IS leaf i —
     no leaf-order gather at all: output is always leaf order, and passing
     leaf_order=False or host_levels raises ValueError (neither knob can
-    apply). Which wins is platform-dependent; see tools/tpu_variants.py for
-    the measured comparison.
+    apply). Walk-mode plane state is ~16 B x 2^tree_level per key held live
+    for the whole program — size key_chunk to the device memory (e.g.
+    2^24-leaf domains want key_chunk <= 8 on a 16 GB chip). Which wins is
+    platform-dependent; see tools/tpu_variants.py for the measured
+    comparison.
     """
     if mode not in ("levels", "walk"):
         raise ValueError(f"mode must be 'levels' or 'walk', got {mode!r}")
