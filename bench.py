@@ -254,8 +254,71 @@ def _run_cpu_host_engine(log_domain: int, num_keys: int, key_chunk: int) -> dict
     return _result(log_domain, num_keys, total_evals / elapsed, "cpu-host-engine")
 
 
+def _run_device_subprocess(platform: str, timeout: float):
+    """Runs the device benchmark in a KILLABLE subprocess.
+
+    The axon tunnel has been observed hanging not just at backend init (the
+    probe covers that) but at arbitrary points mid-run — an in-process hang
+    would eat the driver's whole time budget and lose the round's artifact
+    (the round-1 failure mode). The child runs `_run(platform, ...)` and
+    prints one JSON line; on timeout its whole process GROUP is killed
+    (the TPU runtime may spawn helpers that would otherwise keep the pipes
+    open) and the caller falls back to the CPU engine. Returns the parsed
+    result dict or None.
+    """
+    env = dict(os.environ)
+    env["BENCH_INNER"] = "1"
+    env["BENCH_PLATFORM"] = platform
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        start_new_session=True,  # own process group: killpg reaps helpers
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            stdout, stderr = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            stdout, stderr = "", ""
+        # Keep the child's diagnostics (faulthandler stacks, progress logs)
+        # — they are the only record of WHERE the device run hung.
+        partial = stderr or (
+            e.stderr.decode("utf-8", "replace")
+            if isinstance(e.stderr, bytes)
+            else (e.stderr or "")
+        )
+        sys.stderr.write(partial[-4000:])
+        _log(f"device benchmark subprocess timed out after {timeout:.0f}s")
+        return None
+    sys.stderr.write((stderr or "")[-4000:])
+    if proc.returncode != 0:
+        _log(f"device benchmark subprocess rc={proc.returncode}")
+        return None
+    line = (stdout or "").strip().splitlines()[-1] if (stdout or "").strip() else ""
+    try:
+        parsed = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        _log(f"device benchmark subprocess bad output: {line[:200]}")
+        return None
+    if not isinstance(parsed, dict) or "error" in parsed:
+        return None
+    return parsed
+
+
 def main() -> None:
     result = _result(LOG_DOMAIN, NUM_KEYS, 0, "none")
+    inner = os.environ.get("BENCH_INNER") == "1"
+    cpu_cfg = (CPU_LOG_DOMAIN, CPU_NUM_KEYS, min(KEY_CHUNK, CPU_NUM_KEYS))
     try:
         platform = os.environ.get("BENCH_PLATFORM")
         if platform is None:
@@ -263,21 +326,29 @@ def main() -> None:
             if platform is None:
                 _log("default backend unreachable; falling back to CPU")
                 platform = "cpu"
-        if platform == "cpu":
-            cfg = (CPU_LOG_DOMAIN, CPU_NUM_KEYS, min(KEY_CHUNK, CPU_NUM_KEYS))
-        else:
-            cfg = (LOG_DOMAIN, NUM_KEYS, KEY_CHUNK)
-        try:
-            result = _run(platform, *cfg)
-        except Exception:
-            _log("benchmark run failed:\n" + traceback.format_exc())
-            if platform != "cpu":
-                _log("retrying on CPU fallback config")
-                result = _run(
-                    "cpu", CPU_LOG_DOMAIN, CPU_NUM_KEYS, min(KEY_CHUNK, CPU_NUM_KEYS)
-                )
+        if inner and platform != "cpu":
+            # Child: device attempt ONLY — fallback is the parent's job
+            # (a child-side CPU rerun would just burn the kill timeout).
+            try:
+                result = _run(platform, LOG_DOMAIN, NUM_KEYS, KEY_CHUNK)
+            except Exception as e:
+                result["error"] = f"{type(e).__name__}: {e}"
+                _log("device run failed:\n" + traceback.format_exc())
+            print(json.dumps(result), flush=True)
+            return
+        if platform != "cpu":
+            # Parent: device attempt in a killable subprocess, then ONE CPU
+            # fallback attempt on any failure.
+            parsed = _run_device_subprocess(
+                platform, float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
+            )
+            if parsed is not None:
+                result = parsed
             else:
-                raise
+                _log("device attempt failed; CPU host-engine fallback")
+                result = _run("cpu", *cpu_cfg)
+        else:
+            result = _run("cpu", *cpu_cfg)
     except Exception as e:
         result["error"] = (
             f"{type(e).__name__}: {e} (all attempts failed; metric string "
