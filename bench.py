@@ -89,22 +89,36 @@ def _result(log_domain: int, num_keys: int, evals_per_sec: float, platform: str)
 
 def _probe_default_backend(timeout: float):
     """Checks in a subprocess (killable on hang) that the default JAX
-    backend initializes. Returns its platform name or None."""
+    backend initializes. Returns its platform name or None. Same
+    process-group kill as _run_device_subprocess: the tunnel runtime may
+    spawn helpers that would keep the pipes open past the child's death."""
     code = "import jax; print(jax.default_backend())"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
     try:
-        r = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout,
-            capture_output=True,
-            text=True,
-        )
+        stdout, stderr = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
         _log(f"backend probe timed out after {timeout:.0f}s")
         return None
-    if r.returncode != 0:
-        _log(f"backend probe failed rc={r.returncode}: {r.stderr.strip()[-400:]}")
+    if proc.returncode != 0:
+        _log(f"backend probe failed rc={proc.returncode}: {stderr.strip()[-400:]}")
         return None
-    return r.stdout.strip().splitlines()[-1] if r.stdout.strip() else None
+    return stdout.strip().splitlines()[-1] if stdout.strip() else None
 
 
 def _init_jax(platform):
