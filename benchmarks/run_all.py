@@ -46,21 +46,40 @@ def main():
             results.append({"bench": script, "error": f"bad output: {line[:200]}"})
         print(line, flush=True)
     out = os.path.join(here, "results.json")
-    # Merge with existing records by "bench" name: fresh runs replace their
-    # own previous entries but hand-recorded measurements (cpu-host-engine
-    # records with date/provenance notes) survive.
+    # Merge with existing records. A fresh entry replaces a stored one only
+    # when bench name AND platform match — a CPU smoke run must never
+    # clobber a TPU-day recording (or vice versa); mismatched-platform
+    # reruns are stored under "<bench>@<platform>". Hand-recorded entries
+    # (distinct bench names) always survive.
+    def slot(e):
+        return (e.get("bench"), e.get("platform"))
+
+    fresh = {}
+    for r in results:
+        fresh[slot(r)] = r
     merged = []
     try:
         with open(out) as f:
-            merged = [
-                e
-                for e in json.load(f)
-                if e.get("bench") not in {r.get("bench") for r in results}
-            ]
+            stored = json.load(f)
     except Exception:
-        pass
+        stored = []
+    for e in stored:
+        if slot(e) not in fresh:
+            merged.append(e)
+    for r in results:
+        name = r.get("bench")
+        same_name_other_platform = any(
+            e.get("bench") == name and e.get("platform") != r.get("platform")
+            for e in stored
+        )
+        if same_name_other_platform and slot(r) not in {slot(e) for e in stored}:
+            r = dict(r)
+            r["bench"] = f"{name}@{r.get('platform')}"
+            # replace a previous suffixed record of the same platform
+            merged = [e for e in merged if e.get("bench") != r["bench"]]
+        merged.append(r)
     with open(out, "w") as f:
-        json.dump(merged + results, f, indent=2)
+        json.dump(merged, f, indent=2)
     print(f"# wrote {out}", file=sys.stderr)
 
 
