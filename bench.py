@@ -41,11 +41,26 @@ BASELINE_EVALS_PER_SEC = 13e6
 
 LOG_DOMAIN = int(os.environ.get("BENCH_LOG_DOMAIN", 20))
 NUM_KEYS = int(os.environ.get("BENCH_KEYS", 1024))
-KEY_CHUNK = int(os.environ.get("BENCH_KEY_CHUNK", 64))
-# Device execution strategy: "levels" (per-level dispatch) or "walk" (one
-# program per chunk); see ops/evaluator.full_domain_evaluate_chunks and
-# tools/tpu_variants.py for the measured comparison.
-MODE = os.environ.get("BENCH_MODE", "levels")
+# Device chunk: at most ~14M leaves per dispatched program (the verified
+# side of this tunnel's ~16M-leaf miscompute threshold, PERF.md), i.e. 14
+# keys at the default log-domain 20. Domains >= 2^24 exceed the threshold
+# even at 1 key/chunk — there the run proceeds and relies on host-oracle
+# verification to quarantine a miscomputing result.
+KEY_CHUNK = int(
+    os.environ.get("BENCH_KEY_CHUNK", max(1, (14 << 20) >> LOG_DOMAIN))
+)
+# Host-engine chunk (CPU fallback/comparison runs): independent of the
+# device knob so CPU numbers stay comparable across device-side retuning.
+CPU_KEY_CHUNK = int(os.environ.get("BENCH_CPU_KEY_CHUNK", 64))
+# Device execution strategy: "fused" (default; ONE program per chunk —
+# doubling expansion + value hash + correction in a single dispatch),
+# "levels" (per-level dispatch) or "walk" (root-to-leaf walk per lane).
+# Measured on the v5e tunnel 2026-07-31 (PERF.md): fused 58.2 M evals/s
+# verified vs walk 19.0 M vs levels unverifiable at 64-key chunks. The
+# 14-key chunk keeps each dispatch under the ~16M-leaf threshold above
+# which this tunnel's compile stack miscomputes (host-oracle verification
+# below catches any drift and falls back).
+MODE = os.environ.get("BENCH_MODE", "fused")
 # CPU fallback config (native AES-NI host engine, ~45 s; shrinks further
 # when the native library is unavailable and the numpy oracle must run).
 CPU_LOG_DOMAIN = int(os.environ.get("BENCH_CPU_LOG_DOMAIN", 20))
@@ -219,18 +234,18 @@ def _run(platform: str, log_domain: int, num_keys: int, key_chunk: int) -> dict:
     result = _result(log_domain, num_keys, evals_per_sec, backend)
     result["verified_keys"] = f"{n_ok}/{len(sample)}"
     if not verified:
+        # Report the failure and quarantine the meaningless rate; the CPU
+        # fallback is the PARENT's job — running it here, inside the
+        # killable device subprocess, could blow BENCH_TPU_TIMEOUT and
+        # discard this diagnosis along with it.
+        result["value"] = 0
+        result["vs_baseline"] = 0
+        result["device_unverified_evals_per_sec"] = round(evals_per_sec)
         result["error"] = (
-            "device outputs FAILED host-oracle verification on sampled keys; "
-            "the evals/s figure measures a miscomputing program — falling "
-            "back to the CPU host engine for an honest number"
+            "device outputs FAILED host-oracle verification on sampled "
+            "keys; the quarantined rate measures a miscomputing program"
         )
         _log(result["error"])
-        fallback = _run_cpu_host_engine(
-            CPU_LOG_DOMAIN, CPU_NUM_KEYS, min(key_chunk, CPU_NUM_KEYS)
-        )
-        fallback["device_unverified_evals_per_sec"] = round(evals_per_sec)
-        fallback["device_verified_keys"] = f"{n_ok}/{len(sample)}"
-        return fallback
     return result
 
 
@@ -324,6 +339,54 @@ def _run_device_subprocess(platform: str, timeout: float):
     except (json.JSONDecodeError, ValueError):
         _log(f"device benchmark subprocess bad output: {line[:200]}")
         return None
+    # Error results are returned too: they may carry diagnostics worth
+    # merging into the fallback record (e.g. the quarantined unverified
+    # device rate).
+    return parsed if isinstance(parsed, dict) else None
+
+
+def _run_cpu_comparison_subprocess(timeout: float):
+    """Runs the full-size host-engine comparison in a killable subprocess.
+
+    Returns the parsed result dict, or None when it failed, timed out, or
+    was skipped (rc=3: native AES-NI library unavailable — the numpy
+    oracle would measure a different, shrunken workload)."""
+    env = dict(os.environ)
+    env["BENCH_INNER"] = "1"
+    env["BENCH_PLATFORM"] = "cpu"
+    env["BENCH_COMPARE"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        _log(f"host-engine comparison timed out after {timeout:.0f}s; skipped")
+        return None
+    sys.stderr.write((stderr or "")[-2000:])
+    if proc.returncode != 0:
+        _log(f"host-engine comparison skipped/failed rc={proc.returncode}")
+        return None
+    line = (stdout or "").strip().splitlines()[-1] if (stdout or "").strip() else ""
+    try:
+        parsed = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        return None
     if not isinstance(parsed, dict) or "error" in parsed:
         return None
     return parsed
@@ -332,7 +395,7 @@ def _run_device_subprocess(platform: str, timeout: float):
 def main() -> None:
     result = _result(LOG_DOMAIN, NUM_KEYS, 0, "none")
     inner = os.environ.get("BENCH_INNER") == "1"
-    cpu_cfg = (CPU_LOG_DOMAIN, CPU_NUM_KEYS, min(KEY_CHUNK, CPU_NUM_KEYS))
+    cpu_cfg = (CPU_LOG_DOMAIN, CPU_NUM_KEYS, min(CPU_KEY_CHUNK, CPU_NUM_KEYS))
     try:
         platform = os.environ.get("BENCH_PLATFORM")
         if platform is None:
@@ -340,6 +403,19 @@ def main() -> None:
             if platform is None:
                 _log("default backend unreachable; falling back to CPU")
                 platform = "cpu"
+        if inner and platform == "cpu" and os.environ.get("BENCH_COMPARE") == "1":
+            # Comparison child: the host engine on the DEVICE config, only
+            # meaningful on the native AES-NI engine (rc=3 = skipped).
+            from distributed_point_functions_tpu import native
+
+            if not native.available():
+                _log("native engine unavailable; comparison skipped")
+                sys.exit(3)
+            result = _run_cpu_host_engine(
+                LOG_DOMAIN, NUM_KEYS, min(CPU_KEY_CHUNK, NUM_KEYS)
+            )
+            print(json.dumps(result), flush=True)
+            return
         if inner and platform != "cpu":
             # Child: device attempt ONLY — fallback is the parent's job
             # (a child-side CPU rerun would just burn the kill timeout).
@@ -351,16 +427,52 @@ def main() -> None:
             print(json.dumps(result), flush=True)
             return
         if platform != "cpu":
-            # Parent: device attempt in a killable subprocess, then ONE CPU
-            # fallback attempt on any failure.
+            # Parent: device attempt in a killable subprocess; every CPU
+            # run happens HERE, outside the killable window, so a slow
+            # comparison can never discard a verified device measurement.
             parsed = _run_device_subprocess(
                 platform, float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
             )
-            if parsed is not None:
+            if parsed is not None and "error" not in parsed:
                 result = parsed
+                # The framework also ships the native AES-NI host engine
+                # for this exact workload (no JAX, no TPU-claim
+                # contention); report whichever engine is faster on this
+                # box, keeping the other in a side field. On this image
+                # the verified device rate is capped by the tunnel's
+                # ~16M-leaf miscompute threshold + ~66 ms dispatch
+                # latency (PERF.md), so the 1-core VAES engine can win.
+                # The comparison runs in its own KILLABLE subprocess with
+                # a bounded timeout: a stalled host run must never cost
+                # the already-verified device measurement. It is skipped
+                # entirely when the native library is absent — the numpy
+                # oracle would measure a shrunken different workload under
+                # a field name claiming the native engine.
+                cpu = _run_cpu_comparison_subprocess(
+                    float(os.environ.get("BENCH_CPU_TIMEOUT", 300))
+                )
+                if cpu is not None:
+                    if cpu["value"] > result["value"]:
+                        cpu["device_verified_evals_per_sec"] = result["value"]
+                        cpu["device_verified_keys"] = result.get("verified_keys")
+                        result = cpu
+                    else:
+                        result["cpu_host_engine_evals_per_sec"] = cpu["value"]
             else:
                 _log("device attempt failed; CPU host-engine fallback")
                 result = _run("cpu", *cpu_cfg)
+                if isinstance(parsed, dict):
+                    for f in (
+                        "device_unverified_evals_per_sec",
+                        "verified_keys",
+                    ):
+                        if f in parsed:
+                            result.setdefault(
+                                "device_verified_keys"
+                                if f == "verified_keys"
+                                else f,
+                                parsed[f],
+                            )
         else:
             result = _run("cpu", *cpu_cfg)
     except Exception as e:

@@ -51,7 +51,10 @@ def _expand_kernel(
     h = h ^ (cw_ref[:, 0][:, None] & c[None, :])
     cc = jnp.where(child == 0, cc_ref[0, 0], cc_ref[0, 1])
     new_control = h[0] ^ (c & cc)
-    h = h.at[0].set(jnp.zeros_like(h[0]))
+    # Zero the LSB plane without h.at[0].set(...): scatter does not lower
+    # in Pallas TPU kernels (observed NotImplementedError on v5e).
+    row = jax.lax.broadcasted_iota(jnp.uint32, h.shape, 0)
+    h = jnp.where(row == 0, jnp.uint32(0), h)
     out_planes_ref[:, :] = h
     out_control_ref[0, :] = new_control
 

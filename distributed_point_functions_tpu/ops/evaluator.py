@@ -281,32 +281,6 @@ def _host_expand(
 # ---------------------------------------------------------------------------
 
 
-def _expand_hash_correct(
-    seeds,  # uint32[M, 4] in-order seeds of ONE key (M % 32 == 0)
-    control,  # uint32[M//32] packed control mask
-    cw_planes,  # uint32[L, 128] (device levels only)
-    ccl,  # uint32[L]
-    ccr,  # uint32[L]
-    corrections,  # uint32[epb, lpe]
-    levels: int,
-    bits: int,
-    party: int,
-    xor_group: bool,
-):
-    """Single-key fused program: pack -> `levels` doublings -> value hash ->
-    correction. Returns uint32[M * 2^levels, epb, lpe] in *lane* order (use
-    `_expansion_order` to restore leaf order)."""
-    planes = aes_jax.pack_to_planes(seeds)
-    for level in range(levels):
-        planes, control = backend_jax.expand_one_level(
-            planes, control, cw_planes[level], ccl[level], ccr[level]
-        )
-    hashed = backend_jax.hash_value_planes(planes)
-    blocks = aes_jax.unpack_from_planes(hashed)  # [M<<levels, 4]
-    ctrl_bits = backend_jax.unpack_mask_device(control)
-    return _correct_values(blocks, ctrl_bits, corrections, bits, party, xor_group)
-
-
 @jax.jit
 def _pack_batch_jit(seeds, control_mask):
     """uint32[K, M, 4] seeds -> uint32[K, 128, M//32] planes (+ control)."""
@@ -388,33 +362,48 @@ def _finalize_batch_codec_jit(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("levels", "bits", "party", "xor_group")
+    jax.jit,
+    static_argnames=(
+        "levels", "bits", "party", "xor_group", "keep_per_block", "reorder",
+        "spec",
+    ),
 )
-def _expand_batch_jit(
+def _fused_chunk_jit(
     seeds,  # uint32[K, M, 4]
-    control,  # uint32[K, M//32]
+    control_mask,  # uint32[K, M//32]
     cw_planes,  # uint32[K, L, 128]
     ccl,  # uint32[K, L]
     ccr,  # uint32[K, L]
-    corrections,  # uint32[K, epb, lpe]
+    corrections,  # uint32[K, epb, lpe], or a tuple of per-component arrays
     order,  # int[M << levels] leaf-order gather
     levels: int,
-    bits: int,
     party: int,
-    xor_group: bool,
+    keep_per_block: int,
+    reorder: bool = True,
+    bits: int = 0,  # scalar fast path (spec=None)
+    xor_group: bool = False,
+    spec=None,  # codec path (IntModN / Tuple) when set
 ):
-    fn = functools.partial(
-        _expand_hash_correct,
-        levels=levels,
-        bits=bits,
-        party=party,
-        xor_group=xor_group,
+    """ONE program per chunk: pack -> all doubling levels -> value hash ->
+    correction (-> optional leaf-order restore). The fewest-dispatches shape:
+    through a high-dispatch-latency device link (~66 ms/dispatch measured on
+    this image's tunnel, PERF.md) per-level dispatch costs more than the
+    whole chunk's arithmetic."""
+    planes, control = _pack_batch_jit(seeds, control_mask)
+    for level in range(levels):
+        planes, control = _expand_level_batch_jit(
+            planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
+        )
+    if spec is None:
+        return _finalize_batch_jit(
+            planes, control, corrections, order,
+            bits=bits, party=party, xor_group=xor_group,
+            keep_per_block=keep_per_block, reorder=reorder,
+        )
+    return _finalize_batch_codec_jit(
+        planes, control, corrections, order,
+        spec=spec, party=party, keep_per_block=keep_per_block, reorder=reorder,
     )
-    out = jax.vmap(fn)(seeds, control, cw_planes, ccl, ccr, corrections)
-    # [K, lanes, epb, lpe] -> leaf order -> flat element order
-    out = out[:, order]
-    k, n_blocks, epb, lpe = out.shape
-    return out.reshape(k, n_blocks * epb, lpe)
 
 
 @functools.lru_cache(maxsize=2)  # O(L * 2^L) bytes per entry — keep few
@@ -548,20 +537,26 @@ def full_domain_evaluate_chunks(
     static data once with `lane_order_map` at setup time.
 
     mode="levels" (default) runs the host-driven per-level doubling
-    expansion (one small XLA program per level). mode="walk" runs ONE
-    program per chunk in which every leaf lane walks its own root-to-leaf
-    path (`lax.scan` over levels at full width): ~num_levels/2 x the AES
-    arithmetic, but no per-level dispatch and — because lane i IS leaf i —
-    no leaf-order gather at all: output is always leaf order, and passing
-    leaf_order=False or host_levels raises ValueError (neither knob can
-    apply). Walk-mode plane state is ~16 B x 2^tree_level per key held live
-    for the whole program — size key_chunk to the device memory (e.g.
-    2^24-leaf domains want key_chunk <= 8 on a 16 GB chip). Which wins is
-    platform-dependent; see tools/tpu_variants.py for the measured
-    comparison.
+    expansion (one small XLA program per level). mode="fused" runs the same
+    doubling expansion as ONE XLA program per chunk (pack + every level +
+    value hash + correction in a single dispatch): the winning shape when
+    per-dispatch latency is high (~66 ms through this image's TPU tunnel,
+    PERF.md) at the cost of one large program compile per chunk shape.
+    mode="walk" runs ONE program per chunk in which every leaf lane walks
+    its own root-to-leaf path (`lax.scan` over levels at full width):
+    ~num_levels/2 x the AES arithmetic, but no per-level dispatch and —
+    because lane i IS leaf i — no leaf-order gather at all: output is
+    always leaf order, and passing leaf_order=False or host_levels raises
+    ValueError (neither knob can apply). Walk-mode plane state is
+    ~16 B x 2^tree_level per key held live for the whole program — size
+    key_chunk to the device memory (e.g. 2^24-leaf domains want
+    key_chunk <= 8 on a 16 GB chip). Which wins is platform-dependent; see
+    tools/tpu_variants.py for the measured comparison.
     """
-    if mode not in ("levels", "walk"):
-        raise ValueError(f"mode must be 'levels' or 'walk', got {mode!r}")
+    if mode not in ("levels", "fused", "walk"):
+        raise ValueError(
+            f"mode must be 'levels', 'fused' or 'walk', got {mode!r}"
+        )
     if mode == "walk" and (not leaf_order or host_levels is not None):
         # Silent acceptance would corrupt lane-order consumers: walk output
         # is always leaf order, so a caller that permuted its static data
@@ -592,6 +587,19 @@ def full_domain_evaluate_chunks(
 
     num_keys = len(keys)
 
+    def _trim(out):
+        # Trim to the actual domain size (block packing may overshoot) and
+        # unwrap single-component codec outputs. Only valid in leaf order —
+        # lane order keeps padded lanes for the consumer's one-time permute.
+        if leaf_order:
+            if isinstance(out, tuple):
+                out = tuple(o[:, :domain] for o in out)
+            else:
+                out = out[:, :domain]
+        if isinstance(out, tuple) and not spec.is_tuple:
+            out = out[0]
+        return out
+
     def chunks():
         # Pad the last chunk with key 0 so every chunk compiles to the same
         # shape; padded rows are trimmed after concatenation. Yields
@@ -621,7 +629,6 @@ def full_domain_evaluate_chunks(
                     xor_group=xor_group,
                     keep=keep_per_block,
                 )
-                out = out[:, :domain]
             else:
                 out = _walk_chunk_codec_jit(
                     jnp.asarray(kb.seeds),
@@ -634,10 +641,7 @@ def full_domain_evaluate_chunks(
                     party=batch.party,
                     keep=keep_per_block,
                 )
-                out = tuple(o[:, :domain] for o in out)
-                if not spec.is_tuple:
-                    out = out[0]
-            yield valid, out
+            yield valid, _trim(out)
         return
 
     # Host expands until one packed word (32 lanes) is full.
@@ -665,12 +669,27 @@ def full_domain_evaluate_chunks(
         order_np = backend_jax.expansion_output_order(
             m, seeds_p.shape[1], device_levels
         )
-        planes, control = _pack_batch_jit(
-            jnp.asarray(seeds_p), jnp.asarray(control_mask)
-        )
         cw_dev = jnp.asarray(cw_dev)
         ccl = jnp.asarray(ccl)
         ccr = jnp.asarray(ccr)
+        if mode == "fused":
+            if scalar_fast:
+                corr = jnp.asarray(_correction_limbs(kb.value_corrections, bits))
+                kind = dict(bits=bits, xor_group=xor_group)
+            else:
+                corr = tuple(jnp.asarray(a) for a in kb.codec_corrections)
+                kind = dict(spec=spec)
+            out = _fused_chunk_jit(
+                jnp.asarray(seeds_p), jnp.asarray(control_mask),
+                cw_dev, ccl, ccr, corr, jnp.asarray(order_np),
+                levels=device_levels, party=batch.party,
+                keep_per_block=keep_per_block, reorder=leaf_order, **kind,
+            )
+            yield valid, _trim(out)
+            continue
+        planes, control = _pack_batch_jit(
+            jnp.asarray(seeds_p), jnp.asarray(control_mask)
+        )
         for level in range(device_levels):
             planes, control = _expand_level_batch_jit(
                 planes, control, cw_dev[:, level], ccl[:, level], ccr[:, level]
@@ -687,10 +706,6 @@ def full_domain_evaluate_chunks(
                 keep_per_block=keep_per_block,
                 reorder=leaf_order,
             )
-            # Trim to the actual domain size (block packing may overshoot);
-            # only valid in leaf order — lane order keeps padded lanes.
-            if leaf_order:
-                out = out[:, :domain]
         else:
             out = _finalize_batch_codec_jit(
                 planes,
@@ -702,11 +717,7 @@ def full_domain_evaluate_chunks(
                 keep_per_block=keep_per_block,
                 reorder=leaf_order,
             )
-            if leaf_order:
-                out = tuple(o[:, :domain] for o in out)
-            if not spec.is_tuple:
-                out = out[0]
-        yield valid, out
+        yield valid, _trim(out)
 
 
 def full_domain_evaluate(

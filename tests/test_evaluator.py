@@ -265,9 +265,10 @@ def test_lane_order_output_codec_path():
 
 
 def test_walk_mode_matches_levels_mode():
-    """mode='walk' (single-program leaf-path walk) is bit-identical to the
-    default per-level doubling expansion across packing regimes and value
-    types, including the padded last chunk."""
+    """mode='walk' (single-program leaf-path walk) and mode='fused'
+    (single-program doubling expansion) are bit-identical to the default
+    per-level doubling expansion across packing regimes and value types,
+    including the padded last chunk."""
     from distributed_point_functions_tpu.core.value_types import IntModN, TupleType
 
     rng = np.random.default_rng(0xA11C)
@@ -305,11 +306,15 @@ def test_walk_mode_matches_levels_mode():
 
         got_levels = collect("levels")
         got_walk = collect("walk")
+        got_fused = collect("fused")
         if isinstance(got_levels, tuple):
             for a, b in zip(got_levels, got_walk):
                 np.testing.assert_array_equal(a, b)
+            for a, b in zip(got_levels, got_fused):
+                np.testing.assert_array_equal(a, b)
         else:
             np.testing.assert_array_equal(got_levels, got_walk)
+            np.testing.assert_array_equal(got_levels, got_fused)
 
     with pytest.raises(ValueError, match="mode must be"):
         list(
