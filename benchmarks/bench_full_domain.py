@@ -23,31 +23,60 @@ def bench(jax, smoke):
 
     log_domain = int(os.environ.get("BENCH_LOG_DOMAIN", 12 if smoke else 20))
     reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
+    mode = os.environ.get("BENCH_MODE", "fused")
     dpf = DistributedPointFunction.create(
         DpfParameters(log_domain, XorWrapper(128))
     )
-    key, _ = dpf.generate_keys(123, 1 << 100)
+    # One key per rep: identical repeated programs time as ~0 through this
+    # image's tunnel (server-side result caching, PERF.md) — every timed
+    # iteration must compute something new, and its fold must reach the
+    # host inside the timed region.
+    rng = np.random.default_rng(123)
+    alphas = [int(a) for a in rng.integers(0, 1 << log_domain, size=reps + 1)]
+    keys, _ = dpf.generate_keys_batch(alphas, [[1 << 100] * (reps + 1)])
 
-    def run():
-        for _, out in evaluator.full_domain_evaluate_chunks(dpf, [key]):
-            fold = jnp.bitwise_xor.reduce(out, axis=1)
-        jax.block_until_ready(fold)
+    def run(key):
+        folds = []
+        for _, out in evaluator.full_domain_evaluate_chunks(
+            dpf, [key], mode=mode
+        ):
+            folds.append(jnp.bitwise_xor.reduce(out, axis=1))
+        return np.asarray(folds[-1])
 
     with Timer() as warm:
-        run()
+        fold0 = run(keys[0])
     log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    # Host-oracle check of the warmup key: a rate from a miscomputing
+    # program is worthless (PERF.md "Platform findings").
+    from distributed_point_functions_tpu.core.host_eval import (
+        full_domain_evaluate_host,
+    )
+
+    host = full_domain_evaluate_host(dpf, [keys[0]])
+    want = np.bitwise_xor.reduce(host, axis=1)
+    verified = (np.asarray(fold0[0]) == want[0]).all()
+    log(f"device-vs-host verification: {'OK' if verified else 'MISMATCH'}")
+
     with Timer() as t:
-        for _ in range(reps):
-            run()
+        for key in keys[1:]:
+            run(key)
     evals = (1 << log_domain) * reps
-    return {
+    result = {
         "bench": "full_domain",
         "metric": f"full-domain eval, log_domain={log_domain}, XorWrapper<u128>, 1 key",
         "value": round(evals / t.elapsed),
         "unit": "evals/s",
-        "config": {"log_domain": log_domain, "value_type": "XorWrapper<u128>"},
+        "config": {
+            "log_domain": log_domain,
+            "value_type": "XorWrapper<u128>",
+            "mode": mode,
+        },
         "seconds_per_expansion": t.elapsed / reps,
+        "verified": bool(verified),
     }
+    if not verified:
+        result["error"] = "device output failed host-oracle verification"
+    return result
 
 
 if __name__ == "__main__":

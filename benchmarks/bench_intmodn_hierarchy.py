@@ -40,30 +40,39 @@ def bench(jax, smoke):
     params = [DpfParameters(d, vt) for d in domains]
     dpf = DistributedPointFunction.create_incremental(params)
     rng = np.random.default_rng(3)
-    alphas = [int(x) for x in rng.integers(0, 1 << domains[-1], size=num_keys)]
-    betas = [
-        [int(x) % MOD64 for x in rng.integers(1, 1 << 63, size=num_keys)]
-        for _ in range(num_levels)
-    ]
+    # Two independent key sets: warmup compiles + runs on the first, the
+    # timed pass runs on the second — identical repeated programs time as
+    # ~0 through this image's tunnel (server-side result caching, PERF.md).
+    key_sets = []
     with Timer() as tk:
-        keys, _ = dpf.generate_keys_batch(alphas, betas)
-    log(f"keygen: {tk.elapsed:.2f}s for {num_keys} keys x {num_levels} levels")
+        for _ in range(2):
+            alphas = [
+                int(x) for x in rng.integers(0, 1 << domains[-1], size=num_keys)
+            ]
+            betas = [
+                [int(x) % MOD64 for x in rng.integers(1, 1 << 63, size=num_keys)]
+                for _ in range(num_levels)
+            ]
+            ks, _ = dpf.generate_keys_batch(alphas, betas)
+            key_sets.append(ks)
+    log(f"keygen: {tk.elapsed:.2f}s for 2x{num_keys} keys x {num_levels} levels")
 
-    def run_level(level):
+    def run_level(ks, level):
+        folds = []
         for _, out in evaluator.full_domain_evaluate_chunks(
-            dpf, keys, hierarchy_level=level, key_chunk=key_chunk
+            dpf, ks, hierarchy_level=level, key_chunk=key_chunk
         ):
-            fold = jnp.bitwise_xor.reduce(out, axis=1)
-        jax.block_until_ready(fold)
+            folds.append(jnp.bitwise_xor.reduce(out, axis=1))
+        return np.asarray(folds[-1])  # pulled: timing must include execution
 
     with Timer() as warm:
         for level in range(num_levels):
-            run_level(level)
+            run_level(key_sets[0], level)
     log(f"warmup all {num_levels} levels (compile + run): {warm.elapsed:.1f}s")
 
     with Timer() as t:
         for level in range(num_levels):
-            run_level(level)
+            run_level(key_sets[1], level)
     evals = num_keys * sum(1 << d for d in domains)
     return {
         "bench": "intmodn_hierarchy",

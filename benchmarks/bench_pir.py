@@ -34,10 +34,23 @@ def bench(jax, smoke):
         DpfParameters(log_domain, XorWrapper(128))
     )
     rng = np.random.default_rng(17)
-    targets = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_queries)]
+    reps = int(os.environ.get("BENCH_REPS", 2))
+    # Distinct query batch per rep (identical repeated programs time as ~0
+    # through this image's tunnel, PERF.md); both parties' keys for the
+    # warmup batch so the responses can be verified end-to-end.
+    beta = (1 << 128) - 1  # all-ones: responses XOR to DB[target]
+    batches, targets0 = [], None
     with Timer() as tk:
-        keys, _ = dpf.generate_keys_batch(targets, [[1] * num_queries])
-    log(f"keygen: {tk.elapsed:.2f}s for {num_queries} queries")
+        for r in range(reps + 1):
+            targets = [
+                int(x) for x in rng.integers(0, 1 << log_domain, size=num_queries)
+            ]
+            ka, kb = dpf.generate_keys_batch(targets, [[beta] * num_queries])
+            if r == 0:
+                targets0, keys_b = targets, kb
+            batches.append(ka)
+    log(f"keygen: {tk.elapsed:.2f}s for {(reps + 1) * num_queries} queries")
+    keys = batches[0]
     db = rng.integers(0, 2**32, size=(1 << log_domain, 4), dtype=np.uint32)
 
     single_chip = mesh.shape["keys"] == 1 and mesh.shape["domain"] == 1
@@ -55,33 +68,47 @@ def bench(jax, smoke):
         jax.block_until_ready(db_dev.lane_db if single_chip else db_dev)
     log(f"db setup (permute + upload): {tdb.elapsed:.1f}s")
 
-    def run():
+    def run(qkeys):
         if single_chip:
             # One device: the chunked per-level path (headline execution
             # shape, DB pre-permuted to lane order) — no shard_map needed.
             return sharded.pir_query_batch_chunked(
-                dpf, keys, db_dev, key_chunk=key_chunk
+                dpf, qkeys, db_dev, key_chunk=key_chunk
             )
         outs = []
         for start in range(0, num_queries, key_chunk):
             outs.append(
                 sharded.pir_query_batch(
-                    dpf, keys[start : start + key_chunk], db_dev, mesh
+                    dpf, qkeys[start : start + key_chunk], db_dev, mesh
                 )
             )
         return np.concatenate(outs, axis=0)
 
     with Timer() as warm:
-        out = run()
+        out = run(keys)
     assert out.shape == (num_queries, 4)
     log(f"warmup (compile + run): {warm.elapsed:.1f}s")
-    reps = int(os.environ.get("BENCH_REPS", 2))
+    # End-to-end verification of the warmup batch: server B's responses
+    # XOR server A's must reconstruct the target records.
+    out_b = run(keys_b)
+    recovered = np.asarray(out) ^ np.asarray(out_b)
+    n_ok = sum(
+        1
+        for i, tgt in enumerate(targets0)
+        if (recovered[i] == db[tgt]).all()
+    )
+    verified = n_ok == num_queries
+    log(f"two-server reconstruction: {n_ok}/{num_queries} records OK")
     with Timer() as t:
-        for _ in range(reps):
-            run()
+        for qkeys in batches[1:]:
+            run(qkeys)
     queries = num_queries * reps
     scanned = queries * (1 << log_domain)
+    result_extra = {} if verified else {
+        "error": "two-server reconstruction failed on the warmup batch"
+    }
     return {
+        **result_extra,
         "bench": "pir",
         "metric": (
             f"two-server PIR, 2^{log_domain} x 128-bit DB, "
@@ -89,6 +116,7 @@ def bench(jax, smoke):
         ),
         "value": round(queries / t.elapsed, 2),
         "unit": "queries/s",
+        "verified": bool(verified),
         "config": {
             "log_domain": log_domain,
             "num_queries": num_queries,

@@ -9,7 +9,14 @@ from distributed_point_functions_tpu.ops import aes_pallas, backend_jax
 RNG = np.random.default_rng(0xBA11A5)
 
 
-@pytest.mark.parametrize("w,bw", [(32, 32), (64, 32), (128, 128)])
+@pytest.mark.parametrize(
+    "w,bw",
+    [
+        (32, 32),
+        pytest.param(64, 32, marks=pytest.mark.slow),
+        pytest.param(128, 128, marks=pytest.mark.slow),
+    ],
+)
 def test_pallas_expand_matches_xla(w, bw):
     planes = jnp.asarray(RNG.integers(0, 2**32, size=(128, w), dtype=np.uint32))
     control = jnp.asarray(RNG.integers(0, 2**32, size=(w,), dtype=np.uint32))

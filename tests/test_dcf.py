@@ -87,6 +87,9 @@ def test_batch_evaluate_matches_host(bits):
             )
 
 
+@pytest.mark.slow  # XOR-group device coverage also lives in
+# test_batch_evaluate_host_wide_groups[xor128]; this adds the
+# dcf.batch_evaluate API shape for XorWrapper
 def test_batch_evaluate_xor_group():
     from distributed_point_functions_tpu.ops import evaluator
 
@@ -159,14 +162,17 @@ def test_batch_evaluate_host_matches_device():
     for vt in (Int(16), Int(64)):
         dcf = DistributedComparisonFunction.create(9, vt)
         alphas = [7, 300, 511]
-        keys = []
+        keys_a, keys_b = [], []
         for a in alphas:
             ka, kb = dcf.generate_keys(a, 5)
-            keys.extend([ka, kb])
+            keys_a.append(ka)
+            keys_b.append(kb)
         xs = [int(x) for x in rng.integers(0, 512, size=25)] + [0, 511]
-        for key in keys:
-            host = dcf_batch.batch_evaluate_host(dcf, [key], xs)[0]
-            dev = np.asarray(dcf_batch.batch_evaluate(dcf, [key], xs))[0]
+        # One batched call per party (not per key): same coverage, and the
+        # device program compiles/dispatches once per shape.
+        for keys in (keys_a, keys_b):
+            host = dcf_batch.batch_evaluate_host(dcf, keys, xs)
+            dev = np.asarray(dcf_batch.batch_evaluate(dcf, keys, xs))
             dev64 = dev[..., 0].astype(np.uint64)
             if dev.shape[-1] > 1:
                 dev64 |= dev[..., 1].astype(np.uint64) << np.uint64(32)
@@ -174,9 +180,16 @@ def test_batch_evaluate_host_matches_device():
             np.testing.assert_array_equal(host & mask, dev64 & mask)
 
 
-def test_batch_evaluate_host_wide_groups():
+@pytest.mark.parametrize(
+    "case",
+    ["xor128", "int128"]
+    + [pytest.param(c, marks=pytest.mark.slow) for c in ("xor16", "xor64")],
+)
+def test_batch_evaluate_host_wide_groups(case):
     """The wide native kernel (XOR groups, 128-bit values) vs the device
-    path and the share-sum property."""
+    path and the share-sum property. Fast cases cover the two distinct
+    kernel paths (XOR group, additive 128-bit); narrower XOR widths are
+    slow-marked."""
     import numpy as np
     import pytest
 
@@ -204,13 +217,13 @@ def test_batch_evaluate_host_wide_groups():
         return out
 
     rng = np.random.default_rng(0x1DCF)
-    cases = [
-        (XorWrapper(16), 0xABCD),
-        (XorWrapper(64), (1 << 64) - 3),
-        (XorWrapper(128), (1 << 128) - 1),
-        (Int(128), (1 << 100) + 17),
-    ]
-    for vt, beta in cases:
+    cases = {
+        "xor16": (XorWrapper(16), 0xABCD),
+        "xor64": (XorWrapper(64), (1 << 64) - 3),
+        "xor128": (XorWrapper(128), (1 << 128) - 1),
+        "int128": (Int(128), (1 << 100) + 17),
+    }
+    for vt, beta in [cases[case]]:
         dcf = DistributedComparisonFunction.create(8, vt)
         alpha = 113
         ka, kb = dcf.generate_keys(alpha, beta)

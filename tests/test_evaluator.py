@@ -109,9 +109,9 @@ def test_full_domain_host_levels_split():
     dpf = DistributedPointFunction.create(DpfParameters(8, Int(32)))
     ka, _ = dpf.generate_keys(200, 99)
     base = evaluator.full_domain_evaluate(dpf, [ka], host_levels=5)
-    # hl=9 exceeds the tree depth (stop_level=6 for lds=8/Int32) and
-    # exercises the host_levels clamp.
-    for hl in [0, 3, 9]:
+    # hl=0 exercises the all-device lane-pad path; hl=9 exceeds the tree
+    # depth (stop_level=6 for lds=8/Int32) and exercises the clamp.
+    for hl in [0, 9]:
         other = evaluator.full_domain_evaluate(dpf, [ka], host_levels=hl)
         np.testing.assert_array_equal(base, other)
 
@@ -264,22 +264,28 @@ def test_lane_order_output_codec_path():
     np.testing.assert_array_equal(rebuilt, leaf)
 
 
-def test_walk_mode_matches_levels_mode():
+@pytest.mark.parametrize(
+    "which",
+    ["scalar", "tuple"]
+    + [pytest.param(w, marks=pytest.mark.slow) for w in ("packed", "xor", "modn")],
+)
+def test_walk_mode_matches_levels_mode(which):
     """mode='walk' (single-program leaf-path walk) and mode='fused'
     (single-program doubling expansion) are bit-identical to the default
     per-level doubling expansion across packing regimes and value types,
-    including the padded last chunk."""
+    including the padded last chunk. The fast cases cover the scalar and
+    codec program families; the remaining packing regimes are slow-marked."""
     from distributed_point_functions_tpu.core.value_types import IntModN, TupleType
 
     rng = np.random.default_rng(0xA11C)
-    cases = [
-        (DpfParameters(9, Int(64)), 5),   # scalar, 2 elements/block
-        (DpfParameters(7, Int(16)), 3),   # deep packing (8 epb)
-        (DpfParameters(6, XorWrapper(128)), 4),  # XOR group, 1 epb
-        (DpfParameters(5, IntModN(64, (1 << 64) - 59)), 3),  # codec scalar
-        (DpfParameters(5, TupleType(Int(32), Int(32))), 3),  # codec tuple
-    ]
-    for params, num_keys in cases:
+    cases = {
+        "scalar": (DpfParameters(9, Int(64)), 5),   # scalar, 2 elements/block
+        "packed": (DpfParameters(7, Int(16)), 3),   # deep packing (8 epb)
+        "xor": (DpfParameters(6, XorWrapper(128)), 4),  # XOR group, 1 epb
+        "modn": (DpfParameters(5, IntModN(64, (1 << 64) - 59)), 3),  # codec scalar
+        "tuple": (DpfParameters(5, TupleType(Int(32), Int(32))), 3),  # codec tuple
+    }
+    for params, num_keys in [cases[which]]:
         dpf = DistributedPointFunction.create(params)
         lds = params.log_domain_size
         alphas = [int(a) for a in rng.integers(0, 1 << lds, size=num_keys)]

@@ -58,10 +58,11 @@ def test_mic_gate_batch_eval_matches_host():
         h1 = gate.batch_eval(k1, xs, engine="host")
         assert (h0 == b0).all() and (h1 == b1).all()
     for xi, x in enumerate(xs):
-        host0 = gate.eval(k0, x)
-        host1 = gate.eval(k1, x)
-        assert list(b0[xi]) == host0, x
-        assert list(b1[xi]) == host1, x
+        if xi < 3:  # per-point host walk is O(log n) EvaluateAt calls each
+            host0 = gate.eval(k0, x)
+            host1 = gate.eval(k1, x)
+            assert list(b0[xi]) == host0, x
+            assert list(b1[xi]) == host1, x
         x_real = (x - r_in) % n
         want = plaintext_mic(x_real, intervals)
         for i in range(len(intervals)):
