@@ -54,6 +54,9 @@ def bench(jax, smoke):
     db = rng.integers(0, 2**32, size=(1 << log_domain, 4), dtype=np.uint32)
 
     single_chip = mesh.shape["keys"] == 1 and mesh.shape["domain"] == 1
+    # mode="fused" auto-slabs so no single program exceeds the tunnel's
+    # miscompute threshold — the only correct single-chip mode at 2^24.
+    mode = os.environ.get("BENCH_PIR_MODE", "fused")
     # The DB is the server's static state: permute/upload once at setup
     # (prepare_pir_database) — per-query upload would measure the host
     # link, not the query engine.
@@ -61,7 +64,9 @@ def bench(jax, smoke):
 
     with Timer() as tdb:
         db_dev = (
-            sharded.prepare_pir_database(dpf, db)
+            sharded.prepare_pir_database(
+                dpf, db, order="natural" if mode in ("walk", "fused") else "lane"
+            )
             if single_chip
             else jnp.asarray(db)
         )
@@ -70,10 +75,9 @@ def bench(jax, smoke):
 
     def run(qkeys):
         if single_chip:
-            # One device: the chunked per-level path (headline execution
-            # shape, DB pre-permuted to lane order) — no shard_map needed.
+            # One device: the chunked bulk path — no shard_map needed.
             return sharded.pir_query_batch_chunked(
-                dpf, qkeys, db_dev, key_chunk=key_chunk
+                dpf, qkeys, db_dev, key_chunk=key_chunk, mode=mode
             )
         outs = []
         for start in range(0, num_queries, key_chunk):

@@ -521,6 +521,7 @@ def full_domain_evaluate_chunks(
     host_levels: Optional[int] = None,
     leaf_order: bool = True,
     mode: str = "levels",
+    lane_slab: Optional[int] = None,
 ):
     """Full-domain evaluation, yielding *device-resident* results per chunk.
 
@@ -552,11 +553,28 @@ def full_domain_evaluate_chunks(
     key_chunk to the device memory (e.g. 2^24-leaf domains want
     key_chunk <= 8 on a 16 GB chip). Which wins is platform-dependent; see
     tools/tpu_variants.py for the measured comparison.
+    `lane_slab` (mode="fused", leaf_order=True only) splits each key
+    chunk's expansion into multiple dispatches of `lane_slab` host lanes
+    each, yielding ceil(M / lane_slab) leaf-contiguous pieces per chunk in
+    leaf order — piece j of a chunk covers domain indices
+    [j * lane_slab * 2^device_levels * keep, ...). Required when one
+    program's output would exceed a platform's safe size (this image's
+    tunnel miscomputes programs materializing >= ~16M leaves, PERF.md);
+    see `plan_slabs` for sizing. Must be a multiple of 32 (packed-word
+    granularity).
     """
     if mode not in ("levels", "fused", "walk"):
         raise ValueError(
             f"mode must be 'levels', 'fused' or 'walk', got {mode!r}"
         )
+    if lane_slab is not None:
+        if mode != "fused" or not leaf_order:
+            raise ValueError(
+                "lane_slab requires mode='fused' with leaf_order=True "
+                "(lane-order consumers cannot model the slab structure)"
+            )
+        if lane_slab % 32 or lane_slab <= 0:
+            raise ValueError(f"lane_slab must be a positive multiple of 32, got {lane_slab}")
     if mode == "walk" and (not leaf_order or host_levels is not None):
         # Silent acceptance would corrupt lane-order consumers: walk output
         # is always leaf order, so a caller that permuted its static data
@@ -586,6 +604,19 @@ def full_domain_evaluate_chunks(
     domain = 1 << lds
 
     num_keys = len(keys)
+    # (lanes, levels) -> DEVICE-resident leaf-order gather: the index array
+    # is ~MBs at serving sizes, and re-uploading it per dispatch would put
+    # the host link (megabytes/s through this image's tunnel) on the hot
+    # path. (expansion_output_order itself is already lru_cached host-side.)
+    _order_dev = {}
+
+    def _order_on_device(m_order, lanes, levels):
+        key = (m_order, lanes, levels)
+        if key not in _order_dev:
+            _order_dev[key] = jnp.asarray(
+                backend_jax.expansion_output_order(m_order, lanes, levels)
+            )
+        return _order_dev[key]
 
     def _trim(out):
         # Trim to the actual domain size (block packing may overshoot) and
@@ -666,9 +697,7 @@ def full_domain_evaluate_chunks(
             )
         control_mask = aes_jax.pack_bit_mask(control_p)
         cw_dev, ccl, ccr = kb.device_cw_arrays(host_levels)
-        order_np = backend_jax.expansion_output_order(
-            m, seeds_p.shape[1], device_levels
-        )
+        order_dev = _order_on_device(m, seeds_p.shape[1], device_levels)
         cw_dev = jnp.asarray(cw_dev)
         ccl = jnp.asarray(ccl)
         ccr = jnp.asarray(ccr)
@@ -679,13 +708,23 @@ def full_domain_evaluate_chunks(
             else:
                 corr = tuple(jnp.asarray(a) for a in kb.codec_corrections)
                 kind = dict(spec=spec)
-            out = _fused_chunk_jit(
-                jnp.asarray(seeds_p), jnp.asarray(control_mask),
-                cw_dev, ccl, ccr, corr, jnp.asarray(order_np),
-                levels=device_levels, party=batch.party,
-                keep_per_block=keep_per_block, reorder=leaf_order, **kind,
-            )
-            yield valid, _trim(out)
+            m_lanes = seeds_p.shape[1]
+            slab = min(lane_slab, m_lanes) if lane_slab else m_lanes
+            for lo in range(0, m_lanes, slab):
+                s = min(slab, m_lanes - lo)
+                if s == m_lanes:
+                    seeds_s, mask_s, order_s = seeds_p, control_mask, order_dev
+                else:
+                    seeds_s = seeds_p[:, lo : lo + s]
+                    mask_s = control_mask[:, lo // 32 : (lo + s) // 32]
+                    order_s = _order_on_device(s, s, device_levels)
+                out = _fused_chunk_jit(
+                    jnp.asarray(seeds_s), jnp.asarray(mask_s),
+                    cw_dev, ccl, ccr, corr, order_s,
+                    levels=device_levels, party=batch.party,
+                    keep_per_block=keep_per_block, reorder=leaf_order, **kind,
+                )
+                yield valid, _trim(out)
             continue
         planes, control = _pack_batch_jit(
             jnp.asarray(seeds_p), jnp.asarray(control_mask)
@@ -699,7 +738,7 @@ def full_domain_evaluate_chunks(
                 planes,
                 control,
                 jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
-                jnp.asarray(order_np),
+                order_dev,
                 bits=bits,
                 party=batch.party,
                 xor_group=xor_group,
@@ -711,13 +750,52 @@ def full_domain_evaluate_chunks(
                 planes,
                 control,
                 tuple(jnp.asarray(a) for a in kb.codec_corrections),
-                jnp.asarray(order_np),
+                order_dev,
                 spec=spec,
                 party=batch.party,
                 keep_per_block=keep_per_block,
                 reorder=leaf_order,
             )
         yield valid, _trim(out)
+
+
+def plan_slabs(
+    dpf: DistributedPointFunction,
+    key_chunk: int,
+    hierarchy_level: int = -1,
+    max_out_bytes: int = 112 << 20,
+    min_host_levels: int = 5,
+) -> Tuple[int, Optional[int]]:
+    """Sizes (host_levels, lane_slab) so one fused dispatch materializes at
+    most `max_out_bytes` of output for a key_chunk-key program.
+
+    The default budget is the verified side of this image's tunnel
+    miscompute threshold (~117 MB computes bit-exactly, ~134 MB corrupts —
+    PERF.md "2026-07-31"); programs under it need no slabbing and get
+    (min_host_levels, None). Pass the result into
+    `full_domain_evaluate_chunks(..., mode="fused", host_levels=h,
+    lane_slab=s)`.
+    """
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    stop_level = v.hierarchy_to_tree[hierarchy_level]
+    value_type = v.parameters[hierarchy_level].value_type
+    spec = value_codec.build_spec(value_type, v.blocks_needed[hierarchy_level])
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep = 1 << (lds - stop_level)
+    bytes_per_leaf = keep * 4 * sum(c.lpe for c in spec.components)
+    budget_leaves = max(1, max_out_bytes // (bytes_per_leaf * key_chunk))
+    if (1 << stop_level) <= budget_leaves:
+        return min(min_host_levels, stop_level), None
+    # Host-expand until one 32-lane slab fits the budget, then take as many
+    # whole 32-lane groups per dispatch as fit.
+    h = min(min_host_levels, stop_level)
+    while h < stop_level and (32 << (stop_level - h)) > budget_leaves:
+        h += 1
+    leaves_per_lane = 1 << (stop_level - h)
+    slab = max(32, (budget_leaves // leaves_per_lane) // 32 * 32)
+    return h, slab
 
 
 def full_domain_evaluate(

@@ -371,6 +371,14 @@ def _pir_fold_jit(values, db_lane):
     return jnp.bitwise_xor.reduce(values & db_lane[None, :, :], axis=1)
 
 
+@jax.jit
+def _pir_fold_slab_jit(values, db, off):
+    """XOR inner product of a leaf-contiguous values piece against rows
+    [off, off + piece) of a natural-order DB (one compile for any offset)."""
+    piece = jax.lax.dynamic_slice_in_dim(db, off, values.shape[1], axis=0)
+    return jnp.bitwise_xor.reduce(values & piece[None, :, :], axis=1)
+
+
 class PreparedPirDatabase:
     """Device-resident PIR database (prepare_pir_database), in the row
     order of the evaluation mode that will consume it: "lane" (expansion
@@ -447,18 +455,24 @@ def pir_query_batch_chunked(
     monolithic walk+expand shard_map program, whose 20+ unrolled AES levels
     in a single program spill (PERF.md). mode="walk": ONE program per chunk
     (every leaf lane walks its own path — see full_domain_evaluate_chunks),
-    folding against the NATURAL-order DB. For multi-chip domain sharding
-    use `pir_query_batch`.
+    folding against the NATURAL-order DB. mode="fused": ONE doubling-
+    expansion program per dispatch, auto-slabbed by `evaluator.plan_slabs`
+    so no single program materializes more output than the platform
+    computes correctly (this image's tunnel corrupts >= ~128 MB programs,
+    PERF.md) — each leaf-contiguous piece folds against the matching
+    NATURAL-order DB rows and pieces XOR into the running answer. This is
+    the only correct single-chip mode at 2^24+ domains on the tunnel. For
+    multi-chip domain sharding use `pir_query_batch`.
 
     `db_limbs` may be a host uint32[D, lpe] array (permuted + uploaded on
     every call — fine for tests, wrong for serving) or the
     PreparedPirDatabase from `prepare_pir_database` (upload once, query
     many; its order must match the mode: "lane" for levels, "natural" for
-    walk).
+    walk/fused).
     """
     from ..ops import evaluator as ev
 
-    want_order = "natural" if mode == "walk" else "lane"
+    want_order = "natural" if mode in ("walk", "fused") else "lane"
     if isinstance(db_limbs, PreparedPirDatabase):
         if db_limbs.order != want_order:
             raise errors.InvalidArgumentError(
@@ -475,6 +489,22 @@ def pir_query_batch_chunked(
         db_dev = prepare_pir_database(
             dpf, db_limbs, host_levels, order=want_order
         ).lane_db
+    if mode == "fused":
+        h, slab = ev.plan_slabs(dpf, key_chunk, min_host_levels=host_levels or 5)
+        outs = []
+        acc, off = None, 0
+        for n_valid, vals in ev.full_domain_evaluate_chunks(
+            dpf, keys, key_chunk=key_chunk, host_levels=h, mode="fused",
+            lane_slab=slab,
+        ):
+            fold = _pir_fold_slab_jit(vals, db_dev, off)
+            vals.delete()
+            acc = fold if acc is None else acc ^ fold
+            off += vals.shape[1]
+            if off >= db_dev.shape[0]:  # chunk complete
+                outs.append(np.asarray(acc)[:n_valid])
+                acc, off = None, 0
+        return np.concatenate(outs, axis=0)
     outs = []
     for n_valid, vals in ev.full_domain_evaluate_chunks(
         dpf,

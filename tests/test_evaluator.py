@@ -347,3 +347,43 @@ def test_walk_path_masks_matches_sharded_leaf_masks():
             sharded._leaf_path_masks(jnp.uint32(0), lanes, num_levels)
         )
         np.testing.assert_array_equal(host, dev, err_msg=str(num_levels))
+
+
+def test_fused_lane_slab_pieces_match_unslabbed():
+    """lane_slab splits a fused chunk into leaf-contiguous pieces whose
+    concatenation is bit-identical to the unslabbed expansion (the shape
+    that keeps every dispatch under a platform's safe program size)."""
+    dpf = DistributedPointFunction.create(DpfParameters(11, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 1500, 2047], [[9, 8, 7]])
+    plain = []
+    for v, out in evaluator.full_domain_evaluate_chunks(
+        dpf, keys, key_chunk=2, mode="fused"
+    ):
+        plain.append(np.asarray(out)[:v])
+    plain = np.concatenate(plain)
+    rows, cur = [], None
+    for v, out in evaluator.full_domain_evaluate_chunks(
+        dpf, keys, key_chunk=2, mode="fused", host_levels=6, lane_slab=32
+    ):
+        a = np.asarray(out)
+        cur = a if cur is None else np.concatenate([cur, a], axis=1)
+        if cur.shape[1] == plain.shape[1]:
+            rows.append(cur[:v])
+            cur = None
+    assert cur is None  # pieces covered each chunk's domain exactly
+    np.testing.assert_array_equal(plain, np.concatenate(rows))
+    # plan_slabs sizes under the budget and rejects misuse
+    h, s = evaluator.plan_slabs(dpf, key_chunk=2, max_out_bytes=1 << 14)
+    assert s is None or (s % 32 == 0 and s >= 32)
+    with pytest.raises(ValueError, match="lane_slab requires"):
+        list(
+            evaluator.full_domain_evaluate_chunks(
+                dpf, keys, mode="levels", lane_slab=32
+            )
+        )
+    with pytest.raises(ValueError, match="multiple of 32"):
+        list(
+            evaluator.full_domain_evaluate_chunks(
+                dpf, keys, mode="fused", lane_slab=17
+            )
+        )

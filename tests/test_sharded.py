@@ -167,3 +167,29 @@ def test_pir_chunked_modes_reconstruct():
     wrong = sharded.prepare_pir_database(dpf, db, order="lane")
     with pytest.raises(errors.InvalidArgumentError, match="natural"):
         sharded.pir_query_batch_chunked(dpf, list(keys_a), wrong, mode="walk")
+
+
+def test_pir_chunked_fused_slabbed_reconstructs():
+    """mode='fused' with auto-slabbing (the only correct single-chip mode at
+    domains whose full expansion exceeds a platform's safe program size)
+    reconstructs records exactly, including with a forced tiny slab budget."""
+    from distributed_point_functions_tpu.ops import evaluator as ev
+
+    dpf = DistributedPointFunction.create(DpfParameters(12, XorWrapper(128)))
+    rng = np.random.default_rng(41)
+    db = rng.integers(0, 2**32, size=(1 << 12, 4), dtype=np.uint32)
+    targets = [3, 900, 4095]
+    beta = (1 << 128) - 1
+    ka, kb = dpf.generate_keys_batch(targets, [[beta] * 3])
+    dbp = sharded.prepare_pir_database(dpf, db, order="natural")
+    orig = ev.plan_slabs
+    # Budget small enough to force ~8 slabs per chunk.
+    ev.plan_slabs = lambda d, k, **kw: orig(d, k, max_out_bytes=1 << 16)
+    try:
+        ra = sharded.pir_query_batch_chunked(dpf, ka, dbp, key_chunk=2, mode="fused")
+        rb = sharded.pir_query_batch_chunked(dpf, kb, dbp, key_chunk=2, mode="fused")
+    finally:
+        ev.plan_slabs = orig
+    rec = ra ^ rb
+    for i, t in enumerate(targets):
+        np.testing.assert_array_equal(rec[i], db[t])
