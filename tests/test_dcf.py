@@ -59,16 +59,16 @@ def test_dcf_intmodn():
 def test_batch_evaluate_matches_host(bits):
     from distributed_point_functions_tpu.ops import evaluator
 
-    dcf = DistributedComparisonFunction.create(16, Int(bits))
-    alphas = [0, 1, 30000, 65535]
+    dcf = DistributedComparisonFunction.create(12, Int(bits))
+    alphas = [0, 1, 3000, 4095]
     beta = 777
     keys_a, keys_b = [], []
     for alpha in alphas:
         ka, kb = dcf.generate_keys(alpha, beta)
         keys_a.append(ka)
         keys_b.append(kb)
-    xs = [0, 1, 2, 29999, 30000, 30001, 65534, 65535] + [
-        int(x) for x in RNG.integers(0, 65536, size=8)
+    xs = [0, 1, 2, 2999, 3000, 3001, 4094, 4095] + [
+        int(x) for x in RNG.integers(0, 4096, size=8)
     ]
     got_a = evaluator.values_to_numpy(dcf.batch_evaluate(keys_a, xs), bits)
     got_b = evaluator.values_to_numpy(dcf.batch_evaluate(keys_b, xs), bits)

@@ -279,7 +279,7 @@ def test_walk_mode_matches_levels_mode(which):
 
     rng = np.random.default_rng(0xA11C)
     cases = {
-        "scalar": (DpfParameters(9, Int(64)), 5),   # scalar, 2 elements/block
+        "scalar": (DpfParameters(8, Int(64)), 5),   # scalar, 2 elements/block
         "packed": (DpfParameters(7, Int(16)), 3),   # deep packing (8 epb)
         "xor": (DpfParameters(6, XorWrapper(128)), 4),  # XOR group, 1 epb
         "modn": (DpfParameters(5, IntModN(64, (1 << 64) - 59)), 3),  # codec scalar
@@ -353,8 +353,8 @@ def test_fused_lane_slab_pieces_match_unslabbed():
     """lane_slab splits a fused chunk into leaf-contiguous pieces whose
     concatenation is bit-identical to the unslabbed expansion (the shape
     that keeps every dispatch under a platform's safe program size)."""
-    dpf = DistributedPointFunction.create(DpfParameters(11, Int(64)))
-    keys, _ = dpf.generate_keys_batch([5, 1500, 2047], [[9, 8, 7]])
+    dpf = DistributedPointFunction.create(DpfParameters(9, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 300, 511], [[9, 8, 7]])
     plain = []
     for v, out in evaluator.full_domain_evaluate_chunks(
         dpf, keys, key_chunk=2, mode="fused"

@@ -175,10 +175,10 @@ def test_pir_chunked_fused_slabbed_reconstructs():
     reconstructs records exactly, including with a forced tiny slab budget."""
     from distributed_point_functions_tpu.ops import evaluator as ev
 
-    dpf = DistributedPointFunction.create(DpfParameters(12, XorWrapper(128)))
+    dpf = DistributedPointFunction.create(DpfParameters(10, XorWrapper(128)))
     rng = np.random.default_rng(41)
-    db = rng.integers(0, 2**32, size=(1 << 12, 4), dtype=np.uint32)
-    targets = [3, 900, 4095]
+    db = rng.integers(0, 2**32, size=(1 << 10, 4), dtype=np.uint32)
+    targets = [3, 900, 1023]
     beta = (1 << 128) - 1
     ka, kb = dpf.generate_keys_batch(targets, [[beta] * 3])
     dbp = sharded.prepare_pir_database(dpf, db, order="natural")
