@@ -57,10 +57,20 @@ def bench(jax, smoke):
             key_sets.append(ks)
     log(f"keygen: {tk.elapsed:.2f}s for 2x{num_keys} keys x {num_levels} levels")
 
+    # Per-level slab plans: the deep levels (2^21, 2^24) exceed the
+    # tunnel's safe program size even at 4-key chunks — without slabbing
+    # their outputs are silently corrupt (PERF.md threshold bisect).
+    plans = [
+        evaluator.plan_slabs(dpf, key_chunk, hierarchy_level=lv)
+        for lv in range(num_levels)
+    ]
+
     def run_level(ks, level):
+        h, slab = plans[level]
         folds = []
         for _, out in evaluator.full_domain_evaluate_chunks(
-            dpf, ks, hierarchy_level=level, key_chunk=key_chunk
+            dpf, ks, hierarchy_level=level, key_chunk=key_chunk,
+            mode="fused", host_levels=h, lane_slab=slab,
         ):
             folds.append(jnp.bitwise_xor.reduce(out, axis=1))
         return np.asarray(folds[-1])  # pulled: timing must include execution
@@ -70,11 +80,45 @@ def bench(jax, smoke):
             run_level(key_sets[0], level)
     log(f"warmup all {num_levels} levels (compile + run): {warm.elapsed:.1f}s")
 
+    # Host-oracle verification at the deepest level the reference host
+    # path can afford (domain <= 2^15), with a FORCED small lane_slab so
+    # the check exercises the same multi-piece slab slicing/concatenation
+    # machinery the timed deep levels rely on — at this domain plan_slabs
+    # itself would return no slabbing and the slab branch would go
+    # unvalidated (a rate from a miscomputing program is worthless, PERF.md).
+    ver_level = max(
+        (lv for lv, d in enumerate(domains) if d <= 15), default=0
+    )
+    ver_stop = dpf.validator.hierarchy_to_tree[ver_level]
+    ver_h = min(ver_stop, 7)  # >= 64 host lanes -> slab 32 gives >= 2 pieces
+    pieces = [
+        np.asarray(out)[0]
+        for _, out in evaluator.full_domain_evaluate_chunks(
+            dpf, key_sets[0][:1], hierarchy_level=ver_level, key_chunk=1,
+            mode="fused", host_levels=ver_h, lane_slab=32,
+        )
+    ]
+    log(f"verification pieces: {len(pieces)}")
+    v_out = np.concatenate(pieces, axis=0)
+    from distributed_point_functions_tpu.ops import value_codec
+
+    spec = value_codec.build_spec(vt, dpf.validator.blocks_needed[ver_level])
+    got = value_codec.values_to_host((v_out,), spec)
+    ctx = dpf.create_evaluation_context(key_sets[0][0])
+    want = dpf.evaluate_until(ver_level, [], ctx)
+    verified = got == want
+    log(f"device-vs-host verification (level {ver_level}, "
+        f"2^{domains[ver_level]}): {'OK' if verified else 'MISMATCH'}")
+
     with Timer() as t:
         for level in range(num_levels):
             run_level(key_sets[1], level)
     evals = num_keys * sum(1 << d for d in domains)
+    result_extra = {} if verified else {
+        "error": "device output failed host-oracle verification"
+    }
     return {
+        **result_extra,
         "bench": "intmodn_hierarchy",
         "metric": (
             f"{num_levels}-level IntModN<u64> hierarchy, {num_keys} keys, "
@@ -82,6 +126,7 @@ def bench(jax, smoke):
         ),
         "value": round(evals / t.elapsed),
         "unit": "evals/s",
+        "verified": bool(verified),
         "config": {"domains": domains, "num_keys": num_keys, "modulus": MOD64},
         "seconds_all_levels": t.elapsed,
     }

@@ -37,7 +37,10 @@ jax.config.update("jax_enable_x64", True)
 _cache_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
 try:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Cache even tiny programs: the suite's ~200-test tail compiles many
+    # sub-second programs whose aggregate recompile cost is minutes on
+    # this image's single vCPU.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 except Exception:
     pass
 
