@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -562,6 +563,14 @@ def full_domain_evaluate_chunks(
     tunnel miscomputes programs materializing >= ~16M leaves, PERF.md);
     see `plan_slabs` for sizing. Must be a multiple of 32 (packed-word
     granularity).
+
+    Opt-in auto-slabbing: when the DPF_TPU_MAX_PROGRAM_BYTES env var is
+    set (> 0) and mode="fused" with leaf_order=True and neither lane_slab
+    nor host_levels given, oversized programs are auto-slabbed via
+    `plan_slabs` under that budget (112 << 20 is the verified side of this
+    image's tunnel threshold). Deliberately NOT on by default: slabbing
+    changes the yield structure (several pieces per key chunk), which
+    one-yield-per-chunk consumers must opt into knowingly.
     """
     if mode not in ("levels", "fused", "walk"):
         raise ValueError(
@@ -602,6 +611,28 @@ def full_domain_evaluate_chunks(
     keep_per_block = 1 << (lds - stop_level)
     assert keep_per_block <= value_type.elements_per_block()
     domain = 1 << lds
+
+    # Opt-in auto-slabbing (see docstring). Only in full-auto sizing: an
+    # explicit host_levels may be too shallow for a >= 32-lane slab, so
+    # user-pinned splits keep user control. Sized by the ACTUAL program
+    # key count: chunks() does not pad when the batch is smaller than
+    # key_chunk.
+    budget = int(os.environ.get("DPF_TPU_MAX_PROGRAM_BYTES", "0"))
+    if (
+        budget > 0
+        and mode == "fused"
+        and leaf_order
+        and lane_slab is None
+        and host_levels is None
+    ):
+        auto_h, auto_slab = plan_slabs(
+            dpf,
+            max(1, min(key_chunk, len(keys))),
+            hierarchy_level,
+            max_out_bytes=budget,
+        )
+        if auto_slab is not None:
+            host_levels, lane_slab = auto_h, auto_slab
 
     num_keys = len(keys)
     # (lanes, levels) -> DEVICE-resident leaf-order gather: the index array

@@ -490,7 +490,11 @@ def pir_query_batch_chunked(
             dpf, db_limbs, host_levels, order=want_order
         ).lane_db
     if mode == "fused":
-        h, slab = ev.plan_slabs(dpf, key_chunk, min_host_levels=host_levels or 5)
+        h, slab = ev.plan_slabs(
+            dpf,
+            max(1, min(key_chunk, len(keys))),
+            min_host_levels=host_levels or 5,
+        )
         outs = []
         acc, off = None, 0
         for n_valid, vals in ev.full_domain_evaluate_chunks(

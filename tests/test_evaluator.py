@@ -387,3 +387,24 @@ def test_fused_lane_slab_pieces_match_unslabbed():
                 dpf, keys, mode="fused", lane_slab=17
             )
         )
+
+
+def test_fused_auto_slab_protects_by_default(monkeypatch):
+    """With DPF_TPU_MAX_PROGRAM_BYTES set and no explicit sizing, fused
+    mode auto-slabs programs over the budget (opt-in protection on
+    platforms that miscompute oversized programs) and the pieces
+    reassemble bit-exactly; budget 0 / unset disables it."""
+    dpf = DistributedPointFunction.create(DpfParameters(9, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5], [[9]])
+    monkeypatch.setenv("DPF_TPU_MAX_PROGRAM_BYTES", str(1 << 11))
+    pieces = list(
+        evaluator.full_domain_evaluate_chunks(dpf, keys, key_chunk=1, mode="fused")
+    )
+    assert len(pieces) > 1
+    full = np.concatenate([np.asarray(o) for _, o in pieces], axis=1)
+    monkeypatch.setenv("DPF_TPU_MAX_PROGRAM_BYTES", "0")
+    ((v0, out0),) = list(
+        evaluator.full_domain_evaluate_chunks(dpf, keys, key_chunk=1, mode="fused")
+    )
+    assert v0 == 1
+    np.testing.assert_array_equal(full, np.asarray(out0))

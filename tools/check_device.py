@@ -61,8 +61,12 @@ def main() -> int:
         for s in os.environ.get("CHECK_SHAPES", "64x20").split(",")
     ]
     # Execution strategy under test: "levels" (per-level dispatch, the
-    # default) or "walk" (single program per chunk) — the two program
-    # shapes fail independently on a broken backend (PERF.md).
+    # default), "fused" (single program per chunk) or "walk" (leaf-path
+    # walk) — the program shapes fail independently on a broken backend
+    # (PERF.md). This tool measures the RAW platform: auto-slabbing would
+    # hide exactly the over-threshold programs being probed, so it is
+    # force-disabled regardless of the caller's environment.
+    os.environ["DPF_TPU_MAX_PROGRAM_BYTES"] = "0"
     mode = os.environ.get("CHECK_MODE", "levels")
     for num_keys, lds in shapes:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
