@@ -1,0 +1,74 @@
+"""Worker for the two-process multi-host test (test_sharded.py).
+
+Run as: python multihost_worker.py <process_id> <num_processes> <port> <out.npy>
+
+Each process joins the jax.distributed cluster on 127.0.0.1:<port>, takes
+its contiguous slice of a deterministic key batch (seeds fixed, so every
+process derives identical keys), evaluates it over its LOCAL (keys, domain)
+mesh — the multi-host design of parallel/multihost.py: no cross-process
+collectives exist because the DPF math has no cross-key terms — and saves
+its share outputs for the parent to verify.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> None:
+    pid, n_proc, port, outp = (
+        int(sys.argv[1]),
+        int(sys.argv[2]),
+        sys.argv[3],
+        sys.argv[4],
+    )
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.parallel import multihost, sharded
+
+    multihost.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=n_proc,
+        process_id=pid,
+    )
+    assert jax.process_count() == n_proc, jax.process_count()
+
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(16)))
+    rng = np.random.default_rng(7)
+    num_keys = 5
+    alphas = [int(a) for a in rng.integers(0, 256, size=num_keys)]
+    seeds = rng.integers(0, 2**32, size=(num_keys, 2, 4), dtype=np.uint32)
+    keys_a, _ = dpf.generate_keys_batch(alphas, [[9] * num_keys], seeds=seeds)
+
+    lo, hi = multihost.local_key_slice(num_keys)
+    mesh = multihost.local_mesh()  # this process's 2 virtual devices
+    out = np.asarray(sharded.sharded_full_domain_evaluate(dpf, keys_a[lo:hi], mesh))
+    np.save(outp, out)
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "lo": lo,
+                "hi": hi,
+                "global_devices": jax.device_count(),
+                "local_devices": len(jax.local_devices()),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()
