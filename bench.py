@@ -41,26 +41,34 @@ BASELINE_EVALS_PER_SEC = 13e6
 
 LOG_DOMAIN = int(os.environ.get("BENCH_LOG_DOMAIN", 20))
 NUM_KEYS = int(os.environ.get("BENCH_KEYS", 1024))
-# Device chunk: at most ~14M leaves per dispatched program (the verified
-# side of this tunnel's ~16M-leaf miscompute threshold, PERF.md), i.e. 14
-# keys at the default log-domain 20. Domains >= 2^24 exceed the threshold
-# even at 1 key/chunk — there the run proceeds and relies on host-oracle
-# verification to quarantine a miscomputing result.
+# Device chunk. mode="fold": sized to HBM (the [chunk, domain, lpe] value
+# buffer lives inside one program) — 128 keys at the default log-domain 20,
+# the measured optimum. Other modes emit full values, where the tunnel's
+# ~117 MB output threshold binds instead (14 keys at log-domain 20).
+_FOLD_CHUNK = max(1, (128 << 20) >> LOG_DOMAIN)
+_VALUES_CHUNK = max(1, (14 << 20) >> LOG_DOMAIN)
 KEY_CHUNK = int(
-    os.environ.get("BENCH_KEY_CHUNK", max(1, (14 << 20) >> LOG_DOMAIN))
+    os.environ.get(
+        "BENCH_KEY_CHUNK",
+        _FOLD_CHUNK if os.environ.get("BENCH_MODE", "fold") == "fold"
+        else _VALUES_CHUNK,
+    )
 )
 # Host-engine chunk (CPU fallback/comparison runs): independent of the
 # device knob so CPU numbers stay comparable across device-side retuning.
 CPU_KEY_CHUNK = int(os.environ.get("BENCH_CPU_KEY_CHUNK", 64))
-# Device execution strategy: "fused" (default; ONE program per chunk —
-# doubling expansion + value hash + correction in a single dispatch),
+# Device execution strategy: "fold" (default; ONE program per chunk that
+# materializes every value in HBM behind an optimization_barrier and
+# XOR-folds it in-program — output is a tiny [chunk, lpe], so the tunnel's
+# large-output miscompute threshold never binds and chunks scale to 128+),
+# "fused" (per-chunk program emitting full values, 14-key output cap),
 # "levels" (per-level dispatch) or "walk" (root-to-leaf walk per lane).
-# Measured on the v5e tunnel 2026-07-31 (PERF.md): fused 58.2 M evals/s
-# verified vs walk 19.0 M vs levels unverifiable at 64-key chunks. The
-# 14-key chunk keeps each dispatch under the ~16M-leaf threshold above
-# which this tunnel's compile stack miscomputes (host-oracle verification
-# below catches any drift and falls back).
-MODE = os.environ.get("BENCH_MODE", "fused")
+# Measured on the v5e tunnel 2026-07-31 (PERF.md): fold 63.8 M evals/s
+# verified at 128-key chunks vs fused 58.2 M at the cap vs walk 19.0 M vs
+# levels unverifiable — the device compute ceiling is ~60 M evals/s here
+# regardless of dispatch count; fold's win is correctness at any size.
+# Host-oracle verification below catches any drift and falls back.
+MODE = os.environ.get("BENCH_MODE", "fold")
 # CPU fallback config (native AES-NI host engine, ~45 s; shrinks further
 # when the native library is unavailable and the numpy oracle must run).
 CPU_LOG_DOMAIN = int(os.environ.get("BENCH_CPU_LOG_DOMAIN", 20))
@@ -183,11 +191,20 @@ def _run(platform: str, log_domain: int, num_keys: int, key_chunk: int) -> dict:
     def run_once(key_subset, chunk, verbose=False):
         folds = []
         total_valid = 0
-        for valid, out in evaluator.full_domain_evaluate_chunks(
-            dpf, key_subset, key_chunk=chunk, mode=MODE
-        ):
+        if MODE == "fold":
+            gen = evaluator.full_domain_fold_chunks(
+                dpf, key_subset, key_chunk=chunk
+            )
+        else:
+            gen = (
+                (valid, jnp.bitwise_xor.reduce(out, axis=1))
+                for valid, out in evaluator.full_domain_evaluate_chunks(
+                    dpf, key_subset, key_chunk=chunk, mode=MODE
+                )
+            )
+        for valid, fold in gen:
             total_valid += valid
-            folds.append(jnp.bitwise_xor.reduce(out, axis=1))  # [chunk, lpe]
+            folds.append(fold)  # [chunk, lpe]
             if verbose:
                 jax.block_until_ready(folds[-1])
                 _log(f"chunk {len(folds)} done ({time.time() - t0:.1f}s)")

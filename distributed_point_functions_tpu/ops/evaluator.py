@@ -40,6 +40,7 @@ from ..core import backend_numpy, uint128
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..core.value_types import Int, XorWrapper
+from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, value_codec
 
 # ---------------------------------------------------------------------------
@@ -493,6 +494,149 @@ def _walk_chunk_jit(
     return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("levels", "bits", "party", "xor_group", "keep"),
+)
+def _fused_fold_chunk_jit(
+    seeds,  # uint32[K, M, 4]
+    control_mask,  # uint32[K, M//32]
+    cw_planes,  # uint32[K, L, 128]
+    ccl,  # uint32[K, L]
+    ccr,  # uint32[K, L]
+    corrections,  # uint32[K, epb, lpe]
+    db,  # uint32[lanes * keep, lpe] FLAT lane-order database, or None
+    levels: int,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+):
+    """Fused expansion with an IN-PROGRAM consumer: every value is
+    materialized in HBM (optimization_barrier below forces the buffer) and
+    XOR-folded — against a lane-order database when `db` is given (the PIR
+    inner product), plain otherwise — so the program's OUTPUT is a tiny
+    [K, lpe]. On this image's tunnel, programs whose *output* exceeds
+    ~117 MB miscompute while multi-GB *internal* buffers compute correctly
+    (PERF.md 2026-07-31 fold-in-program finding), making this the shape
+    that both verifies and scales: 63.8 M evals/s host-verified at 128-key
+    chunks (vs 58.2 M for the out-of-program fold at its 14-key output
+    cap) with no output-size limit at any domain."""
+    planes, control = _pack_batch_jit(seeds, control_mask)
+    for level in range(levels):
+        planes, control = _expand_level_batch_jit(
+            planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
+        )
+    hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
+    blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
+    ctrl = jax.vmap(backend_jax.unpack_mask_device)(control)
+    fn = functools.partial(
+        _correct_values, bits=bits, party=party, xor_group=xor_group
+    )
+    values = jax.vmap(fn)(blocks, ctrl, corrections)  # [K, lanes, epb, lpe]
+    values = values[:, :, :keep]
+    # The consumer reads a real HBM buffer, not a fused-away expression:
+    # the measured semantics stay "materialize every output + consume".
+    values = jax.lax.optimization_barrier(values)
+    values = values.reshape(values.shape[0], -1, values.shape[-1])
+    if db is not None:
+        # db is the flat lane-order database [lanes * keep, lpe]
+        # (prepare_pir_database order="lane"): padded positions hold zeros,
+        # so garbage lanes cannot contribute to the inner product.
+        values = values & db[None, :, :]
+    return jnp.bitwise_xor.reduce(values, axis=1)
+
+
+def full_domain_fold_chunks(
+    dpf: DistributedPointFunction,
+    keys: Sequence[DpfKey],
+    hierarchy_level: int = -1,
+    key_chunk: int = 128,
+    host_levels: Optional[int] = None,
+    db_lane=None,
+):
+    """Full-domain evaluation with the consumer fused INTO each program.
+
+    Yields (num_valid_keys, fold) where fold is uint32[key_chunk, lpe]: the
+    XOR fold of every (lane-order) domain value of each key — AND-masked
+    against `db_lane` first when given (the FLAT uint32[positions, lpe]
+    lane-order array from `prepare_pir_database(order="lane").lane_db`,
+    i.e. `lane_order_map` applied to the natural-order rows: the
+    two-server-PIR inner product).
+    One dispatch per key chunk, output bytes ~nothing: both the fastest
+    shape through a high-dispatch-latency link and the only one whose
+    per-program output stays small at any domain/chunk size (PERF.md
+    "fold-in-program"). Values never leave the device; use
+    `full_domain_evaluate_chunks` when the caller needs them.
+
+    Scalar Int/XorWrapper value types only (the XOR fold of mod-N limb
+    shares has no protocol meaning).
+    """
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    backend_jax.log_backend_once()
+    batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    spec = batch.spec
+    if not (spec.is_scalar_direct and spec.blocks_needed == 1):
+        raise NotImplementedError(
+            "full_domain_fold_chunks supports scalar Int/XorWrapper value "
+            "types; evaluate IntModN/Tuple outputs via "
+            "full_domain_evaluate_chunks"
+        )
+    bits, xor_group = _value_kind(value_type)
+    stop_level = batch.num_levels
+    if stop_level < 5:
+        # Below one packed word the expansion pads lanes whose garbage a
+        # plain fold would absorb; domains this small have no use for the
+        # bulk fold path anyway.
+        raise NotImplementedError(
+            "full_domain_fold_chunks requires a tree of depth >= 5; use "
+            "full_domain_evaluate for small domains"
+        )
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep = 1 << (lds - stop_level)
+    num_keys = len(keys)
+    if host_levels is None:
+        host_levels = 5
+    elif host_levels < 5:
+        # A silent clamp would desynchronize this generator from a
+        # lane_order_map/PIR database the caller built at the smaller
+        # host_levels (mismatched lane counts surface as opaque broadcast
+        # errors inside the jit).
+        raise InvalidArgumentError(
+            f"full_domain_fold_chunks requires host_levels >= 5 (one full "
+            f"packed word), got {host_levels}"
+        )
+    host_levels = min(host_levels, stop_level)
+    device_levels = stop_level - host_levels
+
+    db_dev = None
+    if db_lane is not None:
+        db_dev = jnp.asarray(db_lane)
+
+    for kb, valid in _key_chunks(batch, num_keys, key_chunk):
+        k = kb.seeds.shape[0]
+        control0 = np.full(k, bool(kb.party), dtype=bool)
+        seeds_h, control_h = _host_expand(kb.seeds, control0, kb, host_levels)
+        cw_dev, ccl, ccr = kb.device_cw_arrays(host_levels)
+        yield valid, _fused_fold_chunk_jit(
+            jnp.asarray(seeds_h),
+            jnp.asarray(aes_jax.pack_bit_mask(control_h)),
+            jnp.asarray(cw_dev),
+            jnp.asarray(ccl),
+            jnp.asarray(ccr),
+            jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
+            db_dev,
+            levels=device_levels,
+            bits=bits,
+            party=batch.party,
+            xor_group=xor_group,
+            keep=keep,
+        )
+
+
 @functools.partial(jax.jit, static_argnames=("spec", "party", "keep"))
 def _walk_chunk_codec_jit(
     seeds, path_masks, cw_planes, ccl, ccr, corrections, spec, party, keep,
@@ -512,6 +656,20 @@ def _walk_chunk_codec_jit(
         return tuple(outs)
 
     return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
+
+
+def _key_chunks(batch: KeyBatch, num_keys: int, key_chunk: int):
+    """Yields (key_batch, num_valid_keys) in key_chunk-sized chunks, padding
+    the last chunk with key 0 so every chunk compiles to one shape (no pad
+    when the whole batch is smaller than key_chunk — smaller programs
+    compile on their own). Padded rows are trimmed by the caller."""
+    for start in range(0, num_keys, key_chunk):
+        idx = np.arange(start, min(start + key_chunk, num_keys))
+        valid = idx.shape[0]
+        pad = key_chunk - valid if num_keys > key_chunk else 0
+        if pad:
+            idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
+        yield batch.take(idx), valid
 
 
 def full_domain_evaluate_chunks(
@@ -663,16 +821,7 @@ def full_domain_evaluate_chunks(
         return out
 
     def chunks():
-        # Pad the last chunk with key 0 so every chunk compiles to the same
-        # shape; padded rows are trimmed after concatenation. Yields
-        # (key_batch, num_valid_keys).
-        for start in range(0, num_keys, key_chunk):
-            idx = np.arange(start, min(start + key_chunk, num_keys))
-            valid = idx.shape[0]
-            pad = key_chunk - valid if num_keys > key_chunk else 0
-            if pad:
-                idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
-            yield batch.take(idx), valid
+        return _key_chunks(batch, num_keys, key_chunk)
 
     if mode == "walk":
         path_masks = jnp.asarray(_walk_path_masks(stop_level))

@@ -455,7 +455,12 @@ def pir_query_batch_chunked(
     monolithic walk+expand shard_map program, whose 20+ unrolled AES levels
     in a single program spill (PERF.md). mode="walk": ONE program per chunk
     (every leaf lane walks its own path — see full_domain_evaluate_chunks),
-    folding against the NATURAL-order DB. mode="fused": ONE doubling-
+    folding against the NATURAL-order DB. mode="fold" (fastest): the inner
+    product runs INSIDE each chunk's program against the lane-order DB
+    (evaluator.full_domain_fold_chunks) — values are materialized in HBM
+    behind an optimization_barrier and consumed there, so the program
+    output is a tiny [chunk, lpe] and the tunnel's large-output miscompute
+    never applies. mode="fused": ONE doubling-
     expansion program per dispatch, auto-slabbed by `evaluator.plan_slabs`
     so no single program materializes more output than the platform
     computes correctly (this image's tunnel corrupts >= ~128 MB programs,
@@ -473,6 +478,13 @@ def pir_query_batch_chunked(
     from ..ops import evaluator as ev
 
     want_order = "natural" if mode in ("walk", "fused") else "lane"
+    if mode == "fold":
+        # In-program inner product (evaluator.full_domain_fold_chunks):
+        # values never leave the program, the fold consumes the lane-order
+        # DB, and the program's tiny [chunk, lpe] output sidesteps the
+        # tunnel's large-output miscompute at ANY domain size — the fastest
+        # AND always-correct single-chip mode (PERF.md "fold-in-program").
+        want_order = "lane"
     if isinstance(db_limbs, PreparedPirDatabase):
         if db_limbs.order != want_order:
             raise errors.InvalidArgumentError(
@@ -489,6 +501,14 @@ def pir_query_batch_chunked(
         db_dev = prepare_pir_database(
             dpf, db_limbs, host_levels, order=want_order
         ).lane_db
+    if mode == "fold":
+        rows = []
+        for valid, fold in ev.full_domain_fold_chunks(
+            dpf, keys, key_chunk=key_chunk, host_levels=host_levels,
+            db_lane=db_dev,
+        ):
+            rows.append(np.asarray(fold)[:valid])
+        return np.concatenate(rows, axis=0)
     if mode == "fused":
         h, slab = ev.plan_slabs(
             dpf,

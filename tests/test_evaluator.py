@@ -408,3 +408,34 @@ def test_fused_auto_slab_protects_by_default(monkeypatch):
     )
     assert v0 == 1
     np.testing.assert_array_equal(full, np.asarray(out0))
+
+
+def test_full_domain_fold_chunks_matches_values_fold():
+    """The in-program XOR fold (full_domain_fold_chunks — values
+    materialized behind an optimization_barrier and consumed in-program,
+    tiny output) equals folding the full value output, for additive and
+    XOR groups, including the padded last chunk."""
+    for vt, betas in ((Int(64), [9, 8, 7]), (XorWrapper(128), [9, 8, 7])):
+        dpf = DistributedPointFunction.create(DpfParameters(9, vt))
+        keys, _ = dpf.generate_keys_batch([5, 77, 300], [betas])
+        vals = evaluator.full_domain_evaluate(dpf, keys)
+        want = np.bitwise_xor.reduce(vals, axis=1)
+        got = []
+        for valid, fold in evaluator.full_domain_fold_chunks(
+            dpf, keys, key_chunk=2
+        ):
+            got.append(np.asarray(fold)[:valid])
+        np.testing.assert_array_equal(np.concatenate(got), want)
+    # codec types and tiny domains are rejected, not silently mis-folded
+    dpf_small = DistributedPointFunction.create(DpfParameters(3, Int(64)))
+    ks, _ = dpf_small.generate_keys_batch([1], [[2]])
+    with pytest.raises(NotImplementedError, match="depth >= 5"):
+        list(evaluator.full_domain_fold_chunks(dpf_small, ks))
+    from distributed_point_functions_tpu.core.value_types import IntModN
+
+    dpf_modn = DistributedPointFunction.create(
+        DpfParameters(9, IntModN(64, (1 << 64) - 59))
+    )
+    km, _ = dpf_modn.generate_keys_batch([1], [[2]])
+    with pytest.raises(NotImplementedError, match="scalar Int/XorWrapper"):
+        list(evaluator.full_domain_fold_chunks(dpf_modn, km))
