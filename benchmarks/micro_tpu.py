@@ -6,6 +6,17 @@ AES compute, the leaf-order gather, or device->host transfers?
 
 Run:  python benchmarks/micro_tpu.py            (real chip)
       JAX_PLATFORMS=cpu python benchmarks/micro_tpu.py   (smoke)
+
+HONESTY: through this image's tunnel, repeating one input returns
+server-cached results at ~0 cost — that methodology produced the wildly
+inflated 2026-07-29 table PERF.md now strikes through (dispatch "0.21 ms"
+vs the honest 65.7 ms; AES "5.8 G blocks/s" vs honest tens of M).
+`timeit` now pulls a tiny device-side checksum per call and accepts
+`variants` (distinct inputs per iteration), but THE CALL SITES IN THIS
+FILE STILL PASS SINGLE INPUTS: treat every number it prints as a LOWER
+BOUND on a caching backend. The authoritative measurements live in
+`benchmarks/*.py` and `bench.py`, which implement the full
+distinct-inputs + host-verified methodology.
 """
 
 import functools
@@ -22,12 +33,33 @@ import jax.numpy as jnp
 from distributed_point_functions_tpu.ops import aes_jax, backend_jax
 
 
-def timeit(fn, *args, n=5, warmup=1):
+def timeit(fn, *args, n=5, warmup=1, variants=None):
+    """Honest wall time per call: rotates over `variants` distinct input
+    tuples and pulls a checksum of every output to the host inside the
+    timed region — identical repeated programs time as ~0 through this
+    image's tunnel (server-side result caching), and bare
+    block_until_ready has returned early on it. Without `variants` it
+    falls back to repeating the single `args`: such timings remain
+    SUSPECT on caching backends (the pull fetches real bytes but the
+    server may skip recomputation) — treat them as lower bounds only."""
+    inputs = list(variants) if variants else [args]
+    if len(inputs) > 1 and n > len(inputs):
+        print(
+            f"# timeit: n={n} > {len(inputs)} variants — repeats may be "
+            "served from a result cache",
+            file=sys.stderr,
+        )
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+        jax.block_until_ready(fn(*inputs[0]))
     t0 = time.perf_counter()
-    for _ in range(n):
-        out = jax.block_until_ready(fn(*args))
+    out = None
+    for i in range(n):
+        out = fn(*inputs[i % len(inputs)])
+        # Pin each result with a TINY pull (8 words of the first leaf,
+        # sliced device-side) — a full-array pull would measure the MB/s
+        # host link, not the op.
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        np.asarray(jnp.ravel(leaf)[:8])
     dt = (time.perf_counter() - t0) / n
     return dt, out
 

@@ -18,6 +18,11 @@ program shape is a measurement, not a guess (PERF.md "Platform findings"):
 * fused_barrier  — same, with jax.lax.optimization_barrier between levels to
                    suppress cross-level fusion (probe: is the corruption a
                    fusion-pass bug?).
+* fold           — the library's in-program consumer shape
+                   (evaluator.full_domain_fold_chunks): values materialized
+                   in HBM behind a barrier and XOR-folded inside the
+                   program; output [chunk, lpe], so the tunnel's
+                   large-output miscompute never applies.
 
 Each strategy is timed end-to-end over NUM_KEYS keys in KEY_CHUNK-key chunks
 with every chunk's XOR fold pulled to the host, then verified against the
@@ -222,6 +227,15 @@ def main() -> int:
                     barrier=(name == "fused_barrier"),
                 )
                 out = out[:, :domain]
+            elif name == "fold":
+                gen = evaluator.full_domain_fold_chunks(
+                    dpf, [keys[i] for i in idx], key_chunk=len(idx)
+                )
+                _, fold_out = next(gen)
+                folds.append(np.asarray(fold_out))
+                if compile_s is None:
+                    compile_s = time.time() - t_start
+                continue
             elif name == "perlevel":
                 gen = evaluator.full_domain_evaluate_chunks(
                     dpf, [keys[i] for i in idx], key_chunk=k, leaf_order=False
