@@ -104,3 +104,235 @@ def expand_one_level_pallas(
         jnp.asarray(rks),
     )
     return out_planes, out_control[0]
+
+
+# ---------------------------------------------------------------------------
+# Row-based kernel: Mosaic-compatible form
+# ---------------------------------------------------------------------------
+#
+# The tensor-shaped kernel above traces `hash_planes`, whose
+# [128, w] <-> [16, 8, w] reshapes and stacks Mosaic rejects
+# ("infer-vector-layout: unsupported shape cast" on the v5e remote
+# compiler). This variant re-expresses the identical circuit as plain
+# Python lists of 128 one-dimensional rows — only elementwise vector ops
+# and static-index row loads/stores — and bakes the fixed PRG round keys
+# in as TRACE-TIME constants (they are compile-time-known: XORs with a
+# zero plane vanish from the traced circuit entirely, the plane-space
+# analog of the reference's precomputed key schedule).
+
+
+def _sbox_rows(byte_rows):
+    """AES S-box on one byte's 8 bit-rows (LSB-first), via the shared
+    Boyar–Peralta netlist (aes_jax._bp_sbox, MSB-first order)."""
+    u = [byte_rows[7 - i] for i in range(8)]
+    s = aes_jax._bp_sbox(*u)
+    return [s[7 - k] for k in range(8)]
+
+
+def _aes_rows(rows, rk_base, rk_diff, key_mask):
+    """AES-128 on 128 bit-rows. rk_base/rk_diff: uint32[11, 16, 8] numpy
+    0/~0 constants (rk_diff applies under key_mask — per-lane key select).
+    """
+    full = np.uint32(0xFFFFFFFF)
+
+    def ark(rows, r):
+        out = []
+        for p in range(128):
+            b, i = divmod(p, 8)
+            row = rows[p]
+            if rk_base[r, b, i]:
+                row = row ^ full  # NOT: plane-constant key bit
+            if rk_diff is not None and rk_diff[r, b, i]:
+                row = row ^ key_mask
+            out.append(row)
+        return out
+
+    s = ark(rows, 0)
+    for r in range(1, 11):
+        # SubBytes per byte
+        s = [
+            bit
+            for b in range(16)
+            for bit in _sbox_rows(s[8 * b : 8 * b + 8])
+        ]
+        # ShiftRows: byte permutation
+        s = [s[8 * src + i] for src in aes_jax._SHIFT_ROWS for i in range(8)]
+        if r < 10:
+            # MixColumns on byte lists
+            cols = [[s[8 * (4 * c + rr) : 8 * (4 * c + rr) + 8] for rr in range(4)] for c in range(4)]
+
+            def xt(byte):  # GF(2^8) doubling on an 8-bit row list
+                a7 = byte[7]
+                return [
+                    a7,
+                    byte[0] ^ a7,
+                    byte[1],
+                    byte[2] ^ a7,
+                    byte[3] ^ a7,
+                    byte[4],
+                    byte[5],
+                    byte[6],
+                ]
+
+            out = []
+            for c in range(4):
+                t = [
+                    cols[c][0][i] ^ cols[c][1][i] ^ cols[c][2][i] ^ cols[c][3][i]
+                    for i in range(8)
+                ]
+                for rr in range(4):
+                    nxt = cols[c][(rr + 1) % 4]
+                    x2 = xt([cols[c][rr][i] ^ nxt[i] for i in range(8)])
+                    out.append(
+                        [cols[c][rr][i] ^ t[i] ^ x2[i] for i in range(8)]
+                    )
+            s = [bit for byte in out for bit in byte]
+        s = ark(s, r)
+    return s
+
+
+@functools.partial(jax.jit, static_argnames=("block_w", "interpret"))
+def expand_one_level_pallas_rows(
+    planes: jnp.ndarray,  # uint32[128, W]
+    control: jnp.ndarray,  # uint32[W]
+    cw_plane: jnp.ndarray,  # uint32[128]
+    ccl_mask: jnp.ndarray,
+    ccr_mask: jnp.ndarray,
+    block_w: int = 512,
+    interpret: bool = False,
+):
+    """Row-based Pallas twin of backend_jax.expand_one_level (same
+    outputs/layout as expand_one_level_pallas). Thin single-key view of the
+    batched kernel — one implementation to keep in sync."""
+    out_planes, out_control = expand_one_level_pallas_batched(
+        planes[None],
+        control[None],
+        cw_plane[None],
+        ccl_mask[None] if ccl_mask.ndim else ccl_mask.reshape(1),
+        ccr_mask[None] if ccr_mask.ndim else ccr_mask.reshape(1),
+        block_w=block_w,
+        interpret=interpret,
+    )
+    return out_planes[0], out_control[0]
+
+
+def _expand_kernel_rows_batched(rk_base, rk_diff):
+    """Key-batched row kernel: grid (2, K, W//bw); per-key correction words
+    and control-correction masks come from refs indexed by the key axis."""
+
+    def kernel(
+        planes_ref,  # uint32[1, 128, bw]
+        control_ref,  # uint32[1, 1, bw]
+        cw_ref,  # uint32[1, 128, 1]
+        cc_ref,  # uint32[1, 1, 2]
+        out_planes_ref,  # uint32[1, 128, bw]
+        out_control_ref,  # uint32[1, 1, bw]
+    ):
+        child = pl.program_id(0)
+        c = control_ref[0, 0, :]
+        w = c.shape[0]
+        key_mask = jnp.broadcast_to(
+            jnp.where(child == 0, jnp.uint32(0), jnp.uint32(0xFFFFFFFF)), (w,)
+        )
+        x = [planes_ref[0, p, :] for p in range(128)]
+        sig = [x[64 + p] for p in range(64)] + [
+            x[64 + p] ^ x[p] for p in range(64)
+        ]
+        enc = _aes_rows(sig, rk_base, rk_diff, key_mask)
+        h = [enc[p] ^ sig[p] for p in range(128)]
+        h = [h[p] ^ (cw_ref[0, p, 0] & c) for p in range(128)]
+        cc = jnp.where(child == 0, cc_ref[0, 0, 0], cc_ref[0, 0, 1])
+        new_control = h[0] ^ (c & cc)
+        h[0] = jnp.zeros_like(h[0])
+        for p in range(128):
+            out_planes_ref[0, p, :] = h[p]
+        out_control_ref[0, 0, :] = new_control
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_w", "interpret"))
+def expand_one_level_pallas_batched(
+    planes: jnp.ndarray,  # uint32[K, 128, W]
+    control: jnp.ndarray,  # uint32[K, W] lane-word control masks
+    cw_plane: jnp.ndarray,  # uint32[K, 128]
+    ccl_mask: jnp.ndarray,  # uint32[K]
+    ccr_mask: jnp.ndarray,  # uint32[K]
+    block_w: int = 2048,
+    interpret: bool = False,
+):
+    """Batched row-kernel twin of vmap(backend_jax.expand_one_level):
+    identical outputs/layout ([K, 128, 2W] with children block-concatenated
+    along the lane-word axis)."""
+    k, _, w = planes.shape
+    bw = min(block_w, w)
+    assert w % bw == 0, (w, bw)
+    kernel = _expand_kernel_rows_batched(
+        backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff")
+    )
+    grid = (2, k, w // bw)
+    out_planes, out_control = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((k, 128, 2 * w), jnp.uint32),
+            jax.ShapeDtypeStruct((k, 1, 2 * w), jnp.uint32),
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 128, bw), lambda i, kk, j: (kk, 0, j)),
+            pl.BlockSpec((1, 1, bw), lambda i, kk, j: (kk, 0, j)),
+            pl.BlockSpec((1, 128, 1), lambda i, kk, j: (kk, 0, 0)),
+            pl.BlockSpec((1, 1, 2), lambda i, kk, j: (kk, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec(
+                (1, 128, bw), lambda i, kk, j: (kk, 0, i * (w // bw) + j)
+            ),
+            pl.BlockSpec(
+                (1, 1, bw), lambda i, kk, j: (kk, 0, i * (w // bw) + j)
+            ),
+        ),
+        interpret=interpret,
+    )(
+        planes,
+        control[:, None, :],
+        cw_plane[:, :, None],
+        jnp.stack([ccl_mask, ccr_mask], axis=-1).astype(jnp.uint32)[:, None, :],
+    )
+    return out_planes, out_control[:, 0, :]
+
+
+def _value_hash_kernel_rows(rk_value):
+    """Fixed-key value-PRG hash (no key select, no corrections)."""
+
+    def kernel(planes_ref, out_ref):
+        x = [planes_ref[0, p, :] for p in range(128)]
+        sig = [x[64 + p] for p in range(64)] + [
+            x[64 + p] ^ x[p] for p in range(64)
+        ]
+        enc = _aes_rows(sig, rk_value, None, None)
+        for p in range(128):
+            out_ref[0, p, :] = enc[p] ^ sig[p]
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_w", "interpret"))
+def hash_value_planes_pallas_batched(
+    planes: jnp.ndarray,  # uint32[K, 128, W]
+    block_w: int = 2048,
+    interpret: bool = False,
+):
+    """Batched row-kernel twin of vmap(backend_jax.hash_value_planes)."""
+    k, _, w = planes.shape
+    bw = min(block_w, w)
+    assert w % bw == 0, (w, bw)
+    kernel = _value_hash_kernel_rows(backend_jax._rk_np("value"))
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((k, 128, w), jnp.uint32),
+        grid=(k, w // bw),
+        in_specs=[pl.BlockSpec((1, 128, bw), lambda kk, j: (kk, 0, j))],
+        out_specs=pl.BlockSpec((1, 128, bw), lambda kk, j: (kk, 0, j)),
+        interpret=interpret,
+    )(planes)

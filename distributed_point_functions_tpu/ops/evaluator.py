@@ -496,7 +496,7 @@ def _walk_chunk_jit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("levels", "bits", "party", "xor_group", "keep"),
+    static_argnames=("levels", "bits", "party", "xor_group", "keep", "use_pallas"),
 )
 def _fused_fold_chunk_jit(
     seeds,  # uint32[K, M, 4]
@@ -511,6 +511,7 @@ def _fused_fold_chunk_jit(
     party: int,
     xor_group: bool,
     keep: int,
+    use_pallas: bool = False,
 ):
     """Fused expansion with an IN-PROGRAM consumer: every value is
     materialized in HBM (optimization_barrier below forces the buffer) and
@@ -522,12 +523,26 @@ def _fused_fold_chunk_jit(
     that both verifies and scales: 63.8 M evals/s host-verified at 128-key
     chunks (vs 58.2 M for the out-of-program fold at its 14-key output
     cap) with no output-size limit at any domain."""
+    if use_pallas:
+        # The Mosaic row kernels run the AES ~1.6x faster than the XLA
+        # bitslice on this chip (PERF.md "Pallas, second attempt"); the
+        # narrow early levels (< 256 lane words) stay on XLA — sub-tile
+        # vectors would not map onto the (8, 128) vregs.
+        from . import aes_pallas
     planes, control = _pack_batch_jit(seeds, control_mask)
     for level in range(levels):
-        planes, control = _expand_level_batch_jit(
-            planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
-        )
-    hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
+        if use_pallas and planes.shape[2] >= 256:
+            planes, control = aes_pallas.expand_one_level_pallas_batched(
+                planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
+            )
+        else:
+            planes, control = _expand_level_batch_jit(
+                planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
+            )
+    if use_pallas and planes.shape[2] >= 256:
+        hashed = aes_pallas.hash_value_planes_pallas_batched(planes)
+    else:
+        hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
     blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
     ctrl = jax.vmap(backend_jax.unpack_mask_device)(control)
     fn = functools.partial(
@@ -554,6 +569,7 @@ def full_domain_fold_chunks(
     key_chunk: int = 128,
     host_levels: Optional[int] = None,
     db_lane=None,
+    use_pallas: Optional[bool] = None,
 ):
     """Full-domain evaluation with the consumer fused INTO each program.
 
@@ -612,6 +628,26 @@ def full_domain_fold_chunks(
     host_levels = min(host_levels, stop_level)
     device_levels = stop_level - host_levels
 
+    if use_pallas is None:
+        env = os.environ.get("DPF_TPU_PALLAS")
+        if env is not None:
+            low = env.strip().lower()
+            if low in ("1", "true", "yes", "on"):
+                use_pallas = True
+            elif low in ("0", "false", "no", "off", ""):
+                use_pallas = False
+            else:
+                raise InvalidArgumentError(
+                    f"DPF_TPU_PALLAS must be a boolean-ish value, got {env!r}"
+                )
+        else:
+            # Default ON for real TPU backends: the Mosaic row kernels run
+            # the AES ~12x faster than the XLA bitslice (PERF.md "Pallas,
+            # second attempt" — 798 M evals/s vs 63.8 M on the headline
+            # fold). CPU/interpret platforms keep the XLA path (pallas
+            # interpret mode is orders of magnitude slower than XLA:CPU).
+            use_pallas = jax.default_backend() == "tpu"
+
     db_dev = None
     if db_lane is not None:
         db_dev = jnp.asarray(db_lane)
@@ -634,6 +670,7 @@ def full_domain_fold_chunks(
             party=batch.party,
             xor_group=xor_group,
             keep=keep,
+            use_pallas=use_pallas,
         )
 
 

@@ -2,6 +2,7 @@
 
 import numpy as np
 import pytest
+import jax
 import jax.numpy as jnp
 
 from distributed_point_functions_tpu.ops import aes_pallas, backend_jax
@@ -31,3 +32,43 @@ def test_pallas_expand_matches_xla(w, bw):
         )
         np.testing.assert_array_equal(np.asarray(got_p), np.asarray(want_p))
         np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
+
+
+def test_rows_circuit_matches_hash_planes():
+    """The row-based AES circuit behind the Mosaic kernels (_aes_rows +
+    sigma, trace-time round keys, per-lane key select) is bit-equal to the
+    XLA reference hash. The pallas_call plumbing itself is validated on
+    hardware (tools/check_device.py CHECK_MODE=fold CHECK_PALLAS=1, and
+    every bench's host-oracle verification): interpret mode cannot execute
+    this circuit in reasonable time on the CI CPU."""
+    import jax
+
+    rng = np.random.default_rng(9)
+    w = 32
+    planes = rng.integers(0, 2**32, size=(128, w), dtype=np.uint32)
+    key_mask = rng.integers(0, 2, size=(w,), dtype=np.uint32) * np.uint32(
+        0xFFFFFFFF
+    )
+    with jax.disable_jit():
+        x = [jnp.asarray(planes[i]) for i in range(128)]
+        sig = [x[64 + q] for q in range(64)] + [
+            x[64 + q] ^ x[q] for q in range(64)
+        ]
+        enc = aes_pallas._aes_rows(
+            sig,
+            backend_jax._rk_np("left"),
+            backend_jax._rk_np("lr_diff"),
+            jnp.asarray(key_mask),
+        )
+        got = np.stack([np.asarray(enc[q] ^ sig[q]) for q in range(128)])
+    from distributed_point_functions_tpu.ops import aes_jax
+
+    want = np.asarray(
+        aes_jax.hash_planes(
+            jnp.asarray(planes),
+            backend_jax._rk("left"),
+            backend_jax._rk("lr_diff"),
+            jnp.asarray(key_mask),
+        )
+    )
+    np.testing.assert_array_equal(got, want)

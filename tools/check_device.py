@@ -76,10 +76,24 @@ def main() -> int:
         host = full_domain_evaluate_host(dpf, keys)
         want = np.bitwise_xor.reduce(host, axis=1)
         folds = []
-        for valid, out in evaluator.full_domain_evaluate_chunks(
-            dpf, keys, key_chunk=num_keys, mode=mode
-        ):
-            folds.append(np.asarray(jnp.bitwise_xor.reduce(out, axis=1))[:valid])
+        if mode == "fold":
+            # In-program consumer path; CHECK_PALLAS=1 forces the Mosaic
+            # row kernels (the TPU default), =0 the XLA bitslice.
+            use_pallas = {None: None, "1": True, "0": False}[
+                os.environ.get("CHECK_PALLAS")
+            ]
+            gen = evaluator.full_domain_fold_chunks(
+                dpf, keys, key_chunk=num_keys, use_pallas=use_pallas
+            )
+            for valid, fold in gen:
+                folds.append(np.asarray(fold)[:valid])
+        else:
+            for valid, out in evaluator.full_domain_evaluate_chunks(
+                dpf, keys, key_chunk=num_keys, mode=mode
+            ):
+                folds.append(
+                    np.asarray(jnp.bitwise_xor.reduce(out, axis=1))[:valid]
+                )
         got = np.concatenate(folds, axis=0)
         got64 = got[:, 0].astype(np.uint64) | (
             got[:, 1].astype(np.uint64) << np.uint64(32)
