@@ -54,13 +54,12 @@ def bench(jax, smoke):
     db = rng.integers(0, 2**32, size=(1 << log_domain, 4), dtype=np.uint32)
 
     single_chip = mesh.shape["keys"] == 1 and mesh.shape["domain"] == 1
-    # Measured 2026-07-31 at 2^24 x 64 queries, both verified 64/64:
-    # "fused" (slabbed value emission + per-piece fold programs) 3.23 q/s
-    # vs "fold" (in-program inner product, 2 GB internal value buffer)
-    # 1.74 q/s — the big in-program buffer pressures HBM, so the slabbed
-    # shape ships as the default here (the reverse of the headline bench,
-    # where fold wins).
-    mode = os.environ.get("BENCH_PIR_MODE", "fused")
+    # Measured 2026-07-31 at 2^24 x 64 queries, all verified 64/64:
+    # with the Mosaic row kernels, "fold" (in-program inner product)
+    # reaches ~21.3 q/s / 5.7 GB/s of DB scanned vs 5.2 q/s for the slabbed
+    # "fused" value-emission shape (and 3.2/1.7 q/s respectively on the
+    # XLA bitslice, where HBM pressure made slabbed fused win).
+    mode = os.environ.get("BENCH_PIR_MODE", "fold")
     # The DB is the server's static state: permute/upload once at setup
     # (prepare_pir_database) — per-query upload would measure the host
     # link, not the query engine.

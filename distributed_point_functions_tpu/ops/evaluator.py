@@ -363,11 +363,24 @@ def _finalize_batch_codec_jit(
     return tuple(outs)
 
 
+def _expand_level(planes, control, cw, ccl, ccr, use_pallas: bool):
+    """One doubling level, on the Mosaic row kernel when enabled and the
+    width fills at least one (8, 128) vreg tile region (256 lane words);
+    narrow early levels and non-TPU platforms use the XLA bitslice."""
+    if use_pallas and planes.shape[2] >= 256:
+        from . import aes_pallas
+
+        return aes_pallas.expand_one_level_pallas_batched(
+            planes, control, cw, ccl, ccr
+        )
+    return _expand_level_batch_jit(planes, control, cw, ccl, ccr)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "levels", "bits", "party", "xor_group", "keep_per_block", "reorder",
-        "spec",
+        "spec", "use_pallas",
     ),
 )
 def _fused_chunk_jit(
@@ -385,6 +398,7 @@ def _fused_chunk_jit(
     bits: int = 0,  # scalar fast path (spec=None)
     xor_group: bool = False,
     spec=None,  # codec path (IntModN / Tuple) when set
+    use_pallas: bool = False,
 ):
     """ONE program per chunk: pack -> all doubling levels -> value hash ->
     correction (-> optional leaf-order restore). The fewest-dispatches shape:
@@ -393,8 +407,9 @@ def _fused_chunk_jit(
     whole chunk's arithmetic."""
     planes, control = _pack_batch_jit(seeds, control_mask)
     for level in range(levels):
-        planes, control = _expand_level_batch_jit(
-            planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
+        planes, control = _expand_level(
+            planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level],
+            use_pallas,
         )
     if spec is None:
         return _finalize_batch_jit(
@@ -523,23 +538,15 @@ def _fused_fold_chunk_jit(
     that both verifies and scales: 63.8 M evals/s host-verified at 128-key
     chunks (vs 58.2 M for the out-of-program fold at its 14-key output
     cap) with no output-size limit at any domain."""
-    if use_pallas:
-        # The Mosaic row kernels run the AES ~1.6x faster than the XLA
-        # bitslice on this chip (PERF.md "Pallas, second attempt"); the
-        # narrow early levels (< 256 lane words) stay on XLA — sub-tile
-        # vectors would not map onto the (8, 128) vregs.
-        from . import aes_pallas
     planes, control = _pack_batch_jit(seeds, control_mask)
     for level in range(levels):
-        if use_pallas and planes.shape[2] >= 256:
-            planes, control = aes_pallas.expand_one_level_pallas_batched(
-                planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
-            )
-        else:
-            planes, control = _expand_level_batch_jit(
-                planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level]
-            )
+        planes, control = _expand_level(
+            planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level],
+            use_pallas,
+        )
     if use_pallas and planes.shape[2] >= 256:
+        from . import aes_pallas
+
         hashed = aes_pallas.hash_value_planes_pallas_batched(planes)
     else:
         hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
@@ -629,24 +636,7 @@ def full_domain_fold_chunks(
     device_levels = stop_level - host_levels
 
     if use_pallas is None:
-        env = os.environ.get("DPF_TPU_PALLAS")
-        if env is not None:
-            low = env.strip().lower()
-            if low in ("1", "true", "yes", "on"):
-                use_pallas = True
-            elif low in ("0", "false", "no", "off", ""):
-                use_pallas = False
-            else:
-                raise InvalidArgumentError(
-                    f"DPF_TPU_PALLAS must be a boolean-ish value, got {env!r}"
-                )
-        else:
-            # Default ON for real TPU backends: the Mosaic row kernels run
-            # the AES ~12x faster than the XLA bitslice (PERF.md "Pallas,
-            # second attempt" — 798 M evals/s vs 63.8 M on the headline
-            # fold). CPU/interpret platforms keep the XLA path (pallas
-            # interpret mode is orders of magnitude slower than XLA:CPU).
-            use_pallas = jax.default_backend() == "tpu"
+        use_pallas = _pallas_default()
 
     db_dev = None
     if db_lane is not None:
@@ -695,6 +685,24 @@ def _walk_chunk_codec_jit(
     return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
 
 
+def _pallas_default() -> bool:
+    """Resolves the Mosaic-kernel default: DPF_TPU_PALLAS when set
+    (1/true/yes/on vs 0/false/no/off), else ON exactly for real TPU
+    backends (PERF.md "Pallas vs XLA bitslice" — ~12x; CPU/interpret
+    platforms keep the XLA path)."""
+    env = os.environ.get("DPF_TPU_PALLAS")
+    if env is not None:
+        low = env.strip().lower()
+        if low in ("1", "true", "yes", "on"):
+            return True
+        if low in ("0", "false", "no", "off", ""):
+            return False
+        raise InvalidArgumentError(
+            f"DPF_TPU_PALLAS must be a boolean-ish value, got {env!r}"
+        )
+    return jax.default_backend() == "tpu"
+
+
 def _key_chunks(batch: KeyBatch, num_keys: int, key_chunk: int):
     """Yields (key_batch, num_valid_keys) in key_chunk-sized chunks, padding
     the last chunk with key 0 so every chunk compiles to one shape (no pad
@@ -718,6 +726,7 @@ def full_domain_evaluate_chunks(
     leaf_order: bool = True,
     mode: str = "levels",
     lane_slab: Optional[int] = None,
+    use_pallas: Optional[bool] = None,
 ):
     """Full-domain evaluation, yielding *device-resident* results per chunk.
 
@@ -830,6 +839,8 @@ def full_domain_evaluate_chunks(
             host_levels, lane_slab = auto_h, auto_slab
 
     num_keys = len(keys)
+    if use_pallas is None:
+        use_pallas = _pallas_default()
     # (lanes, levels) -> DEVICE-resident leaf-order gather: the index array
     # is ~MBs at serving sizes, and re-uploading it per dispatch would put
     # the host link (megabytes/s through this image's tunnel) on the hot
@@ -939,7 +950,8 @@ def full_domain_evaluate_chunks(
                     jnp.asarray(seeds_s), jnp.asarray(mask_s),
                     cw_dev, ccl, ccr, corr, order_s,
                     levels=device_levels, party=batch.party,
-                    keep_per_block=keep_per_block, reorder=leaf_order, **kind,
+                    keep_per_block=keep_per_block, reorder=leaf_order,
+                    use_pallas=use_pallas, **kind,
                 )
                 yield valid, _trim(out)
             continue
