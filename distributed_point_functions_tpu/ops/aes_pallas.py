@@ -336,3 +336,106 @@ def hash_value_planes_pallas_batched(
         out_specs=pl.BlockSpec((1, 128, bw), lambda kk, j: (kk, 0, j)),
         interpret=interpret,
     )(planes)
+
+
+def _walk_level_kernel_tiled(rk_base, rk_diff):
+    """One walk level for a TILE of kb keys: rows are (kb, bw) 2-D tiles so
+    narrow point batches still fill the (8, 128) vregs. Per-lane key select
+    comes from the level's path-bit mask (shared across keys); correction
+    words / control corrections are per-key columns broadcast across lanes.
+    Mirrors backend_jax.evaluate_seeds_planes's scan body."""
+
+    def kernel(
+        planes_ref,  # uint32[kb, 128, bw]
+        control_ref,  # uint32[kb, 1, bw]
+        mask_ref,  # uint32[1, 1, bw] path bits of this level
+        cw_ref,  # uint32[kb, 128, 1]
+        cc_ref,  # uint32[kb, 1, 2] (ccl, ccr) of this level
+        out_planes_ref,  # uint32[kb, 128, bw]
+        out_control_ref,  # uint32[kb, 1, bw]
+    ):
+        c = control_ref[:, 0, :]  # (kb, bw)
+        key_mask = mask_ref[0, 0, :][None, :]  # (1, bw) broadcasts
+        x = [planes_ref[:, p, :] for p in range(128)]
+        sig = [x[64 + p] for p in range(64)] + [
+            x[64 + p] ^ x[p] for p in range(64)
+        ]
+        enc = _aes_rows(sig, rk_base, rk_diff, key_mask)
+        h = [enc[p] ^ sig[p] ^ (cw_ref[:, p, :] & c) for p in range(128)]
+        l = cc_ref[:, 0, 0:1]  # (kb, 1)
+        r = cc_ref[:, 0, 1:2]
+        cc = (l & ~key_mask) | (r & key_mask)  # (kb, bw)
+        new_control = h[0] ^ (c & cc)
+        h[0] = jnp.zeros_like(h[0])
+        for p in range(128):
+            out_planes_ref[:, p, :] = h[p]
+        out_control_ref[:, 0, :] = new_control
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_w", "key_tile"))
+def walk_levels_pallas_batched(
+    planes: jnp.ndarray,  # uint32[K, 128, W]
+    control: jnp.ndarray,  # uint32[K, W]
+    path_masks: jnp.ndarray,  # uint32[L, W] shared across keys
+    cw_planes: jnp.ndarray,  # uint32[K, L, 128]
+    ccl: jnp.ndarray,  # uint32[K, L]
+    ccr: jnp.ndarray,  # uint32[K, L]
+    block_w: int = 512,
+    key_tile: int = 8,
+):
+    """Batched Mosaic twin of vmap(backend_jax.evaluate_seeds_planes):
+    walks every lane down all L levels (one pallas_call per level inside
+    one jit program). Keys are padded to a multiple of key_tile."""
+    k, _, w = planes.shape
+    levels = path_masks.shape[0]
+    bw = min(block_w, w)
+    assert w % bw == 0, (w, bw)
+    pad = (-k) % key_tile
+    if pad:
+        planes = jnp.concatenate(
+            [planes, jnp.zeros((pad, 128, w), jnp.uint32)], axis=0
+        )
+        control = jnp.concatenate(
+            [control, jnp.zeros((pad, w), jnp.uint32)], axis=0
+        )
+        cw_planes = jnp.concatenate(
+            [cw_planes, jnp.zeros((pad,) + cw_planes.shape[1:], jnp.uint32)],
+            axis=0,
+        )
+        ccl = jnp.concatenate([ccl, jnp.zeros((pad, levels), jnp.uint32)], axis=0)
+        ccr = jnp.concatenate([ccr, jnp.zeros((pad, levels), jnp.uint32)], axis=0)
+    kp = k + pad
+    kernel = _walk_level_kernel_tiled(
+        backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff")
+    )
+    ctrl = control[:, None, :]
+    cc = jnp.stack([ccl, ccr], axis=-1)  # [Kp, L, 2]
+    for level in range(levels):
+        planes, ctrl = pl.pallas_call(
+            kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((kp, 128, w), jnp.uint32),
+                jax.ShapeDtypeStruct((kp, 1, w), jnp.uint32),
+            ),
+            grid=(kp // key_tile, w // bw),
+            in_specs=[
+                pl.BlockSpec((key_tile, 128, bw), lambda kk, j: (kk, 0, j)),
+                pl.BlockSpec((key_tile, 1, bw), lambda kk, j: (kk, 0, j)),
+                pl.BlockSpec((1, 1, bw), lambda kk, j: (0, 0, j)),
+                pl.BlockSpec((key_tile, 128, 1), lambda kk, j: (kk, 0, 0)),
+                pl.BlockSpec((key_tile, 1, 2), lambda kk, j: (kk, 0, 0)),
+            ],
+            out_specs=(
+                pl.BlockSpec((key_tile, 128, bw), lambda kk, j: (kk, 0, j)),
+                pl.BlockSpec((key_tile, 1, bw), lambda kk, j: (kk, 0, j)),
+            ),
+        )(
+            planes,
+            ctrl,
+            path_masks[level][None, None, :],
+            cw_planes[:, level, :, None],
+            cc[:, level, :][:, None, :],
+        )
+    return planes[:k], ctrl[:k, 0, :]

@@ -1165,11 +1165,35 @@ def _evaluate_points_one_key(
     return values[jnp.arange(p), block_sel]  # [P, lpe]
 
 
-@functools.partial(jax.jit, static_argnames=("bits", "party", "xor_group"))
+@functools.partial(
+    jax.jit, static_argnames=("bits", "party", "xor_group", "use_pallas")
+)
 def _evaluate_points_jit(
     seeds, control, path_masks, cw_planes, ccl, ccr, corrections, block_sel,
-    bits, party, xor_group,
+    bits, party, xor_group, use_pallas=False,
 ):
+    if use_pallas:
+        from . import aes_pallas
+
+        planes = jax.vmap(aes_jax.pack_to_planes)(seeds)
+        ctrl0 = jnp.broadcast_to(
+            control[None], (seeds.shape[0],) + control.shape
+        )
+        planes, ctrl = aes_pallas.walk_levels_pallas_batched(
+            planes, ctrl0, path_masks, cw_planes, ccl, ccr
+        )
+        if planes.shape[2] >= 256:
+            hashed = aes_pallas.hash_value_planes_pallas_batched(planes)
+        else:
+            hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
+        blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
+        ctrl_bits = jax.vmap(backend_jax.unpack_mask_device)(ctrl)
+        fn = functools.partial(
+            _correct_values, bits=bits, party=party, xor_group=xor_group
+        )
+        values = jax.vmap(fn)(blocks, ctrl_bits, corrections)
+        p = block_sel.shape[0]
+        return values[:, jnp.arange(p), block_sel]
     fn = functools.partial(
         _evaluate_points_one_key, bits=bits, party=party, xor_group=xor_group
     )
@@ -1265,6 +1289,7 @@ def evaluate_at_batch(
             bits=bits,
             party=batch.party,
             xor_group=xor_group,
+            use_pallas=_pallas_default(),
         )
         return out[:, :p] if device_output else np.asarray(out)[:, :p]
     out = _evaluate_points_codec_jit(
