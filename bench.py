@@ -74,7 +74,8 @@ MODE = os.environ.get("BENCH_MODE", "fold")
 CPU_LOG_DOMAIN = int(os.environ.get("BENCH_CPU_LOG_DOMAIN", 20))
 CPU_NUM_KEYS = int(os.environ.get("BENCH_CPU_KEYS", 1024))
 CPU_NUM_KEYS_NO_NATIVE = int(os.environ.get("BENCH_CPU_KEYS_NO_NATIVE", 4))
-PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", 120))
+PROBE_ATTEMPTS = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 3))
 
 
 def _log(msg: str) -> None:
@@ -108,6 +109,22 @@ def _result(log_domain: int, num_keys: int, evals_per_sec: float, platform: str)
         "vs_baseline": round(evals_per_sec / BASELINE_EVALS_PER_SEC, 2),
         "platform": platform,
     }
+
+
+def _probe_default_backend_retrying(timeout: float, attempts: int):
+    """Retried backend probe: a transient tunnel stall at snapshot time must
+    not erase the round's TPU evidence (it did in round 2 — BENCH_r02.json
+    recorded the CPU fallback off ONE failed probe). Each retry raises the
+    timeout (t, 1.5t, 2t, ...); the probe is an optimization, not a gate —
+    the caller attempts the device run even when every probe fails."""
+    for i in range(max(1, attempts)):
+        t = timeout * (1 + 0.5 * i)
+        platform = _probe_default_backend(t)
+        if platform is not None:
+            return platform
+        if i + 1 < attempts:
+            _log(f"probe attempt {i + 1}/{attempts} failed; retrying")
+    return None
 
 
 def _probe_default_backend(timeout: float):
@@ -171,6 +188,17 @@ def _run(platform: str, log_domain: int, num_keys: int, key_chunk: int) -> dict:
     backend = jax.default_backend()
     _log(f"platform: {backend}, devices: {jax.devices()}")
 
+    if backend == "cpu" and platform == "default":
+        # Probe-failure device attempt that resolved to a CPU backend: NOT
+        # a device measurement. Error out so the parent falls back on ITS
+        # side of the killable window — accepting this run would label CPU
+        # numbers as device-verified and run the big CPU config under
+        # BENCH_TPU_TIMEOUT's kill.
+        result = _result(log_domain, num_keys, 0, "cpu")
+        result["error"] = (
+            "default backend resolved to cpu in the device-attempt child"
+        )
+        return result
     if backend == "cpu":
         # On a CPU-only host the honest engine is the native AES-NI host
         # path (the XLA bitslice exists for the TPU's sake and would measure
@@ -416,10 +444,18 @@ def main() -> None:
     try:
         platform = os.environ.get("BENCH_PLATFORM")
         if platform is None:
-            platform = _probe_default_backend(PROBE_TIMEOUT)
+            platform = _probe_default_backend_retrying(
+                PROBE_TIMEOUT, PROBE_ATTEMPTS
+            )
             if platform is None:
-                _log("default backend unreachable; falling back to CPU")
-                platform = "cpu"
+                # The probe is an optimization, not a gate: still attempt
+                # the device run inside the killable subprocess (it carries
+                # its own timeout); only its failure falls back to CPU.
+                _log(
+                    "backend probe never answered; attempting the device "
+                    "run anyway (killable subprocess)"
+                )
+                platform = "default"
         if inner and platform == "cpu" and os.environ.get("BENCH_COMPARE") == "1":
             # Comparison child: the host engine on the DEVICE config, only
             # meaningful on the native AES-NI engine (rc=3 = skipped).

@@ -30,6 +30,47 @@ from jax.experimental import pallas as pl
 from . import aes_jax, backend_jax
 
 
+def _divisor_block_w(w: int, block_w: int) -> int:
+    """Largest divisor of `w` that is <= block_w. Expansion widths are
+    slab*2^k (slab a multiple of 32), so this normally lands on a large
+    block even when w is not a multiple of the default block — a
+    caller-chosen lane_slab like 96 produces widths 3*2^k (ADVICE r2)."""
+    bw = min(block_w, w)
+    while bw > 1 and w % bw:
+        bw -= 1
+    return max(1, bw)
+
+
+def _block_plan(w: int, block_w: int):
+    """Returns (bw, wp): the kernel block width and the (possibly padded)
+    lane-word width, wp % bw == 0. Prefers an exact large divisor of w
+    (zero padding); when the best divisor is degenerate (prime-ish widths
+    would get near-width-1 blocks — Mosaic lowering failure or a
+    pathological grid), falls back to zero-padding w up to a multiple of a
+    256-capped block. Padded lanes compute on zero seeds and are trimmed
+    by the caller."""
+    bw = _divisor_block_w(w, block_w)
+    if bw == w or bw >= max(32, block_w // 8):
+        # Exact divisor with a non-degenerate block (>= one packed word,
+        # and not minuscule relative to the requested block): zero padding.
+        return bw, w
+    bw = min(block_w, 256)
+    return bw, w + (-w) % bw
+
+
+def _pad_lane_words(arrays, w: int, bw: int):
+    """Zero-pads each array's trailing lane-word axis from w up to a
+    multiple of bw. Returns (padded_arrays, padded_w)."""
+    pad = (-w) % bw
+    if pad == 0:
+        return list(arrays), w
+    out = []
+    for a in arrays:
+        cfg = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+        out.append(jnp.pad(a, cfg))
+    return out, w + pad
+
+
 def _expand_kernel(
     planes_ref,  # uint32[128, bw]
     control_ref,  # uint32[1, bw]
@@ -71,8 +112,16 @@ def expand_one_level_pallas(
 ):
     """Pallas twin of backend_jax.expand_one_level (same outputs/layout)."""
     w = planes.shape[1]
-    bw = min(block_w, w)
-    assert w % bw == 0, (w, bw)
+    bw, wp = _block_plan(w, block_w)
+    if wp != w:
+        # This legacy tensor-shaped kernel (micro-benchmarks only) has no
+        # pad-and-trim plumbing; fail loudly rather than compile a
+        # degenerate grid (r3 review). The batched row kernels pad.
+        raise NotImplementedError(
+            f"width {w} has no usable divisor block <= {block_w}; use "
+            "expand_one_level_pallas_batched, which zero-pads arbitrary "
+            "widths"
+        )
     rks = np.concatenate(
         [backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff")]
     ).reshape(22, 128)
@@ -265,17 +314,19 @@ def expand_one_level_pallas_batched(
     identical outputs/layout ([K, 128, 2W] with children block-concatenated
     along the lane-word axis)."""
     k, _, w = planes.shape
-    bw = min(block_w, w)
-    assert w % bw == 0, (w, bw)
+    bw, wp = _block_plan(w, block_w)
+    if wp != w:
+        (planes, control), _ = _pad_lane_words((planes, control), w, bw)
     kernel = _expand_kernel_rows_batched(
         backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff")
     )
-    grid = (2, k, w // bw)
+    nblk = wp // bw
+    grid = (2, k, nblk)
     out_planes, out_control = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((k, 128, 2 * w), jnp.uint32),
-            jax.ShapeDtypeStruct((k, 1, 2 * w), jnp.uint32),
+            jax.ShapeDtypeStruct((k, 128, 2 * wp), jnp.uint32),
+            jax.ShapeDtypeStruct((k, 1, 2 * wp), jnp.uint32),
         ),
         grid=grid,
         in_specs=[
@@ -286,10 +337,10 @@ def expand_one_level_pallas_batched(
         ],
         out_specs=(
             pl.BlockSpec(
-                (1, 128, bw), lambda i, kk, j: (kk, 0, i * (w // bw) + j)
+                (1, 128, bw), lambda i, kk, j: (kk, 0, i * nblk + j)
             ),
             pl.BlockSpec(
-                (1, 1, bw), lambda i, kk, j: (kk, 0, i * (w // bw) + j)
+                (1, 1, bw), lambda i, kk, j: (kk, 0, i * nblk + j)
             ),
         ),
         interpret=interpret,
@@ -299,6 +350,15 @@ def expand_one_level_pallas_batched(
         cw_plane[:, :, None],
         jnp.stack([ccl_mask, ccr_mask], axis=-1).astype(jnp.uint32)[:, None, :],
     )
+    if wp != w:
+        # Children live at [0:wp] / [wp:2wp]; re-concatenate the real lanes
+        # so the caller sees the unpadded [left | right] layout.
+        out_planes = jnp.concatenate(
+            [out_planes[:, :, :w], out_planes[:, :, wp : wp + w]], axis=2
+        )
+        out_control = jnp.concatenate(
+            [out_control[:, :, :w], out_control[:, :, wp : wp + w]], axis=2
+        )
     return out_planes, out_control[:, 0, :]
 
 
@@ -325,17 +385,18 @@ def hash_value_planes_pallas_batched(
 ):
     """Batched row-kernel twin of vmap(backend_jax.hash_value_planes)."""
     k, _, w = planes.shape
-    bw = min(block_w, w)
-    assert w % bw == 0, (w, bw)
-    kernel = _value_hash_kernel_rows(backend_jax._rk_np("value"))
-    return pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((k, 128, w), jnp.uint32),
-        grid=(k, w // bw),
+    bw, wp = _block_plan(w, block_w)
+    if wp != w:
+        (planes,), _ = _pad_lane_words((planes,), w, bw)
+    out = pl.pallas_call(
+        _value_hash_kernel_rows(backend_jax._rk_np("value")),
+        out_shape=jax.ShapeDtypeStruct((k, 128, wp), jnp.uint32),
+        grid=(k, wp // bw),
         in_specs=[pl.BlockSpec((1, 128, bw), lambda kk, j: (kk, 0, j))],
         out_specs=pl.BlockSpec((1, 128, bw), lambda kk, j: (kk, 0, j)),
         interpret=interpret,
     )(planes)
+    return out[:, :, :w] if wp != w else out
 
 
 def _walk_level_kernel_tiled(rk_base, rk_diff):
@@ -374,7 +435,9 @@ def _walk_level_kernel_tiled(rk_base, rk_diff):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("block_w", "key_tile"))
+@functools.partial(
+    jax.jit, static_argnames=("block_w", "key_tile", "interpret")
+)
 def walk_levels_pallas_batched(
     planes: jnp.ndarray,  # uint32[K, 128, W]
     control: jnp.ndarray,  # uint32[K, W]
@@ -384,21 +447,29 @@ def walk_levels_pallas_batched(
     ccr: jnp.ndarray,  # uint32[K, L]
     block_w: int = 512,
     key_tile: int = 8,
+    interpret: bool = False,
 ):
     """Batched Mosaic twin of vmap(backend_jax.evaluate_seeds_planes):
     walks every lane down all L levels (one pallas_call per level inside
-    one jit program). Keys are padded to a multiple of key_tile."""
+    one jit program). Keys are padded to a multiple of key_tile; the
+    lane-word axis takes _block_plan's route for arbitrary widths (point
+    counts are arbitrary — e.g. P=20000 -> w=625): an exact divisor block
+    when a large one exists, else zero-padding to a block multiple, with
+    the pad trimmed on return (ADVICE r2)."""
     k, _, w = planes.shape
     levels = path_masks.shape[0]
-    bw = min(block_w, w)
-    assert w % bw == 0, (w, bw)
+    bw, wp_plan = _block_plan(w, block_w)
+    (planes, control, path_masks), wp = _pad_lane_words(
+        (planes, control, path_masks), w, bw
+    )
+    assert wp == wp_plan, (w, bw, wp, wp_plan)
     pad = (-k) % key_tile
     if pad:
         planes = jnp.concatenate(
-            [planes, jnp.zeros((pad, 128, w), jnp.uint32)], axis=0
+            [planes, jnp.zeros((pad, 128, wp), jnp.uint32)], axis=0
         )
         control = jnp.concatenate(
-            [control, jnp.zeros((pad, w), jnp.uint32)], axis=0
+            [control, jnp.zeros((pad, wp), jnp.uint32)], axis=0
         )
         cw_planes = jnp.concatenate(
             [cw_planes, jnp.zeros((pad,) + cw_planes.shape[1:], jnp.uint32)],
@@ -416,10 +487,10 @@ def walk_levels_pallas_batched(
         planes, ctrl = pl.pallas_call(
             kernel,
             out_shape=(
-                jax.ShapeDtypeStruct((kp, 128, w), jnp.uint32),
-                jax.ShapeDtypeStruct((kp, 1, w), jnp.uint32),
+                jax.ShapeDtypeStruct((kp, 128, wp), jnp.uint32),
+                jax.ShapeDtypeStruct((kp, 1, wp), jnp.uint32),
             ),
-            grid=(kp // key_tile, w // bw),
+            grid=(kp // key_tile, wp // bw),
             in_specs=[
                 pl.BlockSpec((key_tile, 128, bw), lambda kk, j: (kk, 0, j)),
                 pl.BlockSpec((key_tile, 1, bw), lambda kk, j: (kk, 0, j)),
@@ -431,6 +502,7 @@ def walk_levels_pallas_batched(
                 pl.BlockSpec((key_tile, 128, bw), lambda kk, j: (kk, 0, j)),
                 pl.BlockSpec((key_tile, 1, bw), lambda kk, j: (kk, 0, j)),
             ),
+            interpret=interpret,
         )(
             planes,
             ctrl,
@@ -438,4 +510,4 @@ def walk_levels_pallas_batched(
             cw_planes[:, level, :, None],
             cc[:, level, :][:, None, :],
         )
-    return planes[:k], ctrl[:k, 0, :]
+    return planes[:k, :, :w], ctrl[:k, 0, :w]

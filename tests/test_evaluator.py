@@ -389,6 +389,38 @@ def test_fused_lane_slab_pieces_match_unslabbed():
         )
 
 
+def test_fused_lane_slab_codec_non_pow2_epb_exact_partition():
+    """Regression (ADVICE r2): with lane_slab and a codec value type whose
+    elements_per_block is NOT a power of two (Tuple<u32,u8> -> epb=3), the
+    pieces must still partition the domain exactly — keep_per_block is
+    2^(lds - stop_level), so m_lanes * 2^device_levels * keep == 2^lds and
+    no piece overshoots (guarded by an assert in the slab loop)."""
+    from distributed_point_functions_tpu.core.value_types import TupleType
+
+    t = TupleType([Int(32), Int(8)])
+    dpf = DistributedPointFunction.create(DpfParameters(12, t))
+    assert t.elements_per_block() == 3
+    keys, _ = dpf.generate_keys_batch([5, 4000], [[(7, 3), (9, 1)]])
+
+    def run(lane_slab, host_levels):
+        per_piece = []
+        for v, out in evaluator.full_domain_evaluate_chunks(
+            dpf, keys, mode="fused", lane_slab=lane_slab,
+            host_levels=host_levels,
+        ):
+            per_piece.append(tuple(np.asarray(o) for o in out))
+        return [
+            np.concatenate([p[c] for p in per_piece], axis=1)
+            for c in range(len(per_piece[0]))
+        ]
+
+    sliced = run(32, 6)  # 2 pieces per chunk
+    plain = run(None, None)
+    assert sliced[0].shape[1] == 1 << 12  # pieces cover the domain exactly
+    for a, b in zip(sliced, plain):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_fused_auto_slab_protects_by_default(monkeypatch):
     """With DPF_TPU_MAX_PROGRAM_BYTES set and no explicit sizing, fused
     mode auto-slabs programs over the budget (opt-in protection on
