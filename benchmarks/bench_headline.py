@@ -1,0 +1,60 @@
+"""Wrapper: runs the repo-root bench.py (the driver's headline benchmark)
+and re-emits its JSON line as a suite record, so `run_all.py` stores the
+headline in benchmarks/results.json through the same merge as every other
+bench — the headline claim and the machine-readable record can no longer
+drift apart (round-2 verdict: results.json held a stale pre-Pallas number
+while the README claimed the Pallas rate).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def main():
+    from common import run_killable
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Killable process group (common.run_killable). Note the device
+    # grandchild is session-separated too, so bench.py's INTERNAL killable
+    # windows (probe/device/comparison subprocess timeouts, which sum well
+    # under this backstop) are what actually guarantee the TPU claim is
+    # released; the killpg covers bench.py itself plus any non-sessioned
+    # children if it wedges outside those windows.
+    stdout, stderr, timed_out = run_killable(
+        [sys.executable, os.path.join(root, "bench.py")],
+        timeout=float(os.environ.get("BENCH_HEADLINE_TIMEOUT", 3300)),
+    )
+    if timed_out:
+        sys.stderr.write((stderr or "")[-4000:])
+        print(json.dumps({"bench": "full_domain_headline", "error": "timeout"}))
+        return
+    sys.stderr.write((stderr or "")[-4000:])
+    line = stdout.strip().splitlines()[-1] if stdout.strip() else "{}"
+    try:
+        d = json.loads(line)
+    except json.JSONDecodeError:
+        print(json.dumps({
+            "bench": "full_domain_headline",
+            "error": f"bad output: {line[:200]}",
+        }))
+        return
+    rec = {
+        "bench": "full_domain_headline",
+        "metric": d.pop("metric", None),
+        "value": d.pop("value", None),
+        "unit": d.pop("unit", None),
+        "platform": d.pop("platform", None),
+    }
+    if "error" in d:
+        # Surface in-band bench.py failures at the top level: a value-0
+        # record with the error buried in config would read as a
+        # measurement to every results.json consumer.
+        rec["error"] = d["error"]
+    rec["config"] = d  # vs_baseline, verification fields, etc.
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

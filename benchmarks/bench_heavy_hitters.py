@@ -59,11 +59,15 @@ def bench(jax, smoke):
     num_levels = int(os.environ.get("BENCH_HH_LEVELS", 16 if smoke else 128))
     num_nonzeros = int(os.environ.get("BENCH_HH_NONZEROS", 10000))
     # Default to the native host engine on every platform: at 10k prefixes
-    # x 1 key the workload is ~128 dispatches of ~1 MB expansions, and the
-    # TPU path is dispatch-bound (measured 11.45 s/key on v5e vs ~0.22-0.26
-    # s/key host — the framework provides both engines; the device wins at
-    # bulk batch sizes, not here). BENCH_HH_ENGINE=device overrides.
+    # x 1 key the workload is ~128 level advances of ~1 MB expansions and
+    # the per-level device path is dispatch-bound (measured 11.45 s/key on
+    # v5e vs ~0.22-0.26 s/key host). BENCH_HH_ENGINE=device runs the fused
+    # grouped advance (hierarchical.evaluate_levels_fused — the prefix
+    # sets are known upfront in this workload, so BENCH_HH_GROUP level
+    # advances fuse into each program); BENCH_HH_ENGINE=device-levels
+    # keeps the per-level path for comparison.
     engine = os.environ.get("BENCH_HH_ENGINE", "host")
+    group = int(os.environ.get("BENCH_HH_GROUP", 16))
 
     def make_workload(lv):
         p_lv = [DpfParameters(i + 1, Int(64)) for i in range(lv)]
@@ -74,6 +78,16 @@ def bench(jax, smoke):
 
     def run_once(d_lv, k_lv, pre, lv):
         ctx = hierarchical.BatchedContext.create(d_lv, [k_lv])
+        if engine == "device":
+            plan = [
+                (level, () if level == 0 else pre[level - 1])
+                for level in range(lv)
+            ]
+            outs = hierarchical.evaluate_levels_fused(
+                ctx, plan, group=group, device_output=True
+            )
+            jax.block_until_ready(outs[-1])
+            return outs[-1]
         out = None
         for level in range(lv):
             out = hierarchical.evaluate_until_batch(
@@ -81,7 +95,7 @@ def bench(jax, smoke):
                 level,
                 () if level == 0 else pre[level - 1],
                 device_output=True,
-                engine=engine,
+                engine="device" if engine == "device-levels" else engine,
             )
         if engine != "host":
             jax.block_until_ready(out)

@@ -1,0 +1,87 @@
+"""The ISRG two-level example hierarchy (Prio heavy-hitters sizing):
+level 0 = full 2^12 expansion, level 1 = 32 random 12-bit prefixes into a
+2^25 domain, uint32 values.
+
+Mirrors BM_IsrgExampleHierarchy
+(/root/reference/dpf/distributed_point_function_benchmark.cc:182-222): per
+iteration a FRESH context advances through both hierarchy levels. Here the
+advance runs the batched hierarchical path (one BatchedContext, device or
+native-host engine); keys are generated once outside the loop, as in the
+reference.
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def bench(jax, smoke):
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import hierarchical
+
+    lds0, lds1 = (8, 18) if smoke else (12, 25)
+    num_nonzeros = 32
+    reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
+    engine = os.environ.get("BENCH_ISRG_ENGINE", "host")
+
+    params = [DpfParameters(lds0, Int(32)), DpfParameters(lds1, Int(32))]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(1234567 % (1 << lds1), [1, 1])
+    rng = np.random.default_rng(13)
+    prefixes = np.unique(
+        rng.integers(0, 1 << lds0, size=num_nonzeros).astype(np.uint64)
+    )
+
+    from distributed_point_functions_tpu import native
+
+    if engine == "host" and not native.available():
+        engine = "device"
+    log(f"engine: {engine}, levels ({lds0}, {lds1}), {len(prefixes)} prefixes")
+
+    def run_once():
+        ctx = hierarchical.BatchedContext.create(dpf, [ka])
+        out0 = hierarchical.evaluate_until_batch(
+            ctx, 0, device_output=(engine != "host"), engine=engine
+        )
+        out1 = hierarchical.evaluate_until_batch(
+            ctx, 1, [int(p) for p in prefixes],
+            device_output=(engine != "host"), engine=engine,
+        )
+        if engine != "host":
+            jax.block_until_ready(out1)
+        return out0, out1
+
+    with Timer() as warm:
+        out0, out1 = run_once()
+    n_out = (1 << lds0) + len(prefixes) * (1 << (lds1 - lds0))
+    log(f"warmup (compile + run): {warm.elapsed:.1f}s, {n_out} outputs/iter")
+    with Timer() as t:
+        for _ in range(reps):
+            run_once()
+    per_iter = t.elapsed / reps
+
+    return {
+        "bench": "isrg_example_hierarchy",
+        "metric": (
+            f"ISRG 2-level example: 2^{lds0} full + {len(prefixes)} prefixes "
+            f"-> 2^{lds1}, uint32, 1 key"
+        ),
+        "value": round(per_iter, 5),
+        "unit": "s/iteration",
+        "config": {
+            "log_domain_sizes": [lds0, lds1],
+            "num_nonzeros": int(len(prefixes)),
+            "outputs_per_iteration": n_out,
+            "engine": engine,
+            "reps": reps,
+        },
+        **({"platform": "cpu"} if engine == "host" else {}),
+    }
+
+
+if __name__ == "__main__":
+    run_bench("isrg_example_hierarchy", bench)

@@ -32,6 +32,40 @@ def log(msg: str) -> None:
     print(f"# {msg}", file=sys.stderr, flush=True)
 
 
+def run_killable(cmd, timeout: float, env=None):
+    """Runs `cmd` in its OWN process group; on timeout the whole group is
+    SIGKILLed (the TPU runtime spawns helpers that keep pipes open past a
+    plain child kill). Returns (stdout, stderr, timed_out).
+
+    The same pattern lives inline in the repo-root bench.py (probe /
+    device / comparison subprocesses) — bench.py is deliberately stdlib-
+    standalone for the driver and cannot import this package; keep the two
+    in sync when changing kill/reap behavior."""
+    import signal
+
+    proc = subprocess.Popen(
+        cmd,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=env,
+        start_new_session=True,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+        return stdout, stderr, False
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            proc.kill()
+        try:
+            stdout, stderr = proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            stdout, stderr = "", ""
+        return stdout, stderr, True
+
+
 def probe_default_backend(timeout: float = PROBE_TIMEOUT):
     code = "import jax; print(jax.default_backend())"
     try:

@@ -7,8 +7,10 @@ import subprocess
 import sys
 
 BENCHES = [
+    "bench_headline.py",
     "bench_keygen.py",
     "bench_full_domain.py",
+    "bench_isrg.py",
     "bench_evaluate_at.py",
     "bench_intmodn_hierarchy.py",
     "bench_dcf.py",

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# CI entry point — the analog of the reference's pinned test matrix
+# (/root/reference/.bazelci/presubmit.yml). Tiers:
+#
+#   ./ci.sh            fast tier: the default pytest suite (slow-marked
+#                      compile-heavy tests excluded), CPU-only.
+#   ./ci.sh slow       weekly tier: the full suite including --runslow.
+#   ./ci.sh smoke      application smokes: experiments CLI + both demos
+#                      on reduced configs.
+#   ./ci.sh device     hardware tier: on-chip differential checks
+#                      (tools/check_device.py) — requires a reachable TPU.
+#   ./ci.sh all        fast + smoke.
+#
+# Every tier exits nonzero on the first failure. Tests force a virtual
+# 8-device CPU platform themselves (tests/conftest.py); the smokes force
+# CPU here so they never contend for the single-process TPU claim.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+tier="${1:-fast}"
+
+run_fast() {
+  python -m pytest tests/ -q -x
+}
+
+run_slow() {
+  python -m pytest tests/ -q -x --runslow
+}
+
+run_smoke() {
+  # Experiments CLI on the committed 4k-row smoke fixture (full-size
+  # fixtures regenerate deterministically: gen_data.py seeds its RNG from
+  # the fixture parameters).
+  ( cd experiments \
+    && python synthetic_data_benchmarks.py \
+         --input data/20_4096_4096_0.1.csv --log_domain_size 20 \
+         --platform cpu --engine auto --max_expansion_factor 4 \
+         --num_iterations 1 )
+  python examples/pir_demo.py --log_domain 12 --platform cpu
+  python examples/heavy_hitters_demo.py
+}
+
+run_device() {
+  python tools/check_device.py
+}
+
+case "$tier" in
+  fast) run_fast ;;
+  slow) run_slow ;;
+  smoke) run_smoke ;;
+  device) run_device ;;
+  all) run_fast; run_smoke ;;
+  *) echo "unknown tier: $tier (fast|slow|smoke|device|all)" >&2; exit 2 ;;
+esac
+echo "ci: tier '$tier' passed"

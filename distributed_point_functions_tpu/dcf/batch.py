@@ -159,6 +159,106 @@ def _dcf_batch_jit(
     )
 
 
+def _capture_batched(
+    planes,  # uint32[K, 128, W]
+    ctrl,  # uint32[K, W]
+    vc_d,  # uint32[K, epb, lpe]
+    block_sel_d,  # int32[P_pad] (shared across keys)
+    acc_mask_d,  # uint32[P_pad]
+    bits: int,
+    xor_group: bool,
+    use_pallas: bool,
+    interpret: bool,
+):
+    """Key-batched `_capture`: hash + select + correct + mask one depth."""
+    if use_pallas and planes.shape[2] >= 256:
+        from ..ops import aes_pallas
+
+        hashed = aes_pallas.hash_value_planes_pallas_batched(
+            planes, interpret=interpret
+        )
+    else:
+        hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
+    blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)  # [K, P_pad, 4]
+    ctrlb = jax.vmap(backend_jax.unpack_mask_device)(ctrl)  # [K, P_pad]
+    elems = evaluator._split_elements(blocks, bits)  # [K, P_pad, epb, lpe]
+    p_pad = elems.shape[1]
+    sel = elems[:, jnp.arange(p_pad), block_sel_d]  # [K, P_pad, lpe]
+    corr = vc_d[:, block_sel_d]  # [K, P_pad, lpe]
+    gated = corr * ctrlb[..., None]
+    if xor_group:
+        value = sel ^ gated
+    else:
+        value = evaluator._limb_add(sel, gated, bits)
+    return value * acc_mask_d[None, :, None]
+
+
+def _dcf_key_tile(k: int, p_pad: int) -> int:
+    """Key tile for the Mosaic walk: DCF point batches are often narrow
+    (W = P/32 lane words), so tile enough keys together to fill the
+    (8, 128) vregs — bounded by the key count itself."""
+    w = max(1, p_pad // 32)
+    return max(1, min(k, max(8, min(64, 1024 // w))))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("bits", "party", "xor_group", "key_tile", "interpret"),
+)
+def _dcf_batch_pallas_jit(
+    seeds,  # uint32[K, P_pad, 4] root seed broadcast
+    control_mask,  # uint32[W] (shared initial control)
+    path_masks,  # uint32[T, W]
+    cw_planes,  # uint32[K, T, 128]
+    ccl,  # uint32[K, T]
+    ccr,  # uint32[K, T]
+    vc,  # uint32[K, T+1, epb, lpe]
+    block_sel,  # int32[T+1, P_pad]
+    acc_mask,  # uint32[T+1, P_pad]
+    bits: int,
+    party: int,
+    xor_group: bool,
+    key_tile: int,
+    interpret: bool = False,
+):
+    """Mosaic-kernel variant of `_dcf_batch_jit`: the same O(n) fused walk,
+    but each tree level runs the batched Pallas walk kernel
+    (aes_pallas.walk_levels_pallas_batched, one level per call) with the
+    per-depth capture (value hash + block select + correction +
+    mask-accumulate) interleaved between levels — the structure
+    `evaluate_at_batch` uses, extended with the DCF's per-level consumer.
+    Covers BASELINE config 4 (dcf/distributed_comparison_function_benchmark.cc:24-54)
+    on the device path."""
+    from ..ops import aes_pallas
+
+    planes = jax.vmap(aes_jax.pack_to_planes)(seeds)  # [K, 128, W]
+    k = planes.shape[0]
+    ctrl = jnp.broadcast_to(control_mask[None], (k,) + control_mask.shape)
+    T = path_masks.shape[0]
+    lpe = vc.shape[-1]
+    p_pad = block_sel.shape[1]
+    acc = jnp.zeros((k, p_pad, lpe), jnp.uint32)
+    for d in range(T + 1):
+        value = _capture_batched(
+            planes, ctrl, vc[:, d], block_sel[d], acc_mask[d],
+            bits, xor_group, use_pallas=True, interpret=interpret,
+        )
+        acc = _accumulate(acc, value, bits, xor_group)
+        if d < T:
+            planes, ctrl = aes_pallas.walk_levels_pallas_batched(
+                planes, ctrl,
+                path_masks[d : d + 1],
+                cw_planes[:, d : d + 1],
+                ccl[:, d : d + 1],
+                ccr[:, d : d + 1],
+                key_tile=key_tile,
+                interpret=interpret,
+            )
+    if party == 1 and not xor_group:
+        acc = evaluator._limb_neg(acc, bits)
+    return acc
+
+
 def _prep_points(dcf, keys: Sequence, xs: Sequence[int], p_pad: int):
     """Shared host precompute for the batched evaluators: point validation,
     correction-word batch, per-point tree paths, capture tables."""
@@ -183,8 +283,14 @@ def _prep_points(dcf, keys: Sequence, xs: Sequence[int], p_pad: int):
     return batch, paths, acc_mask, block_sel, depth_to_hierarchy
 
 
-def batch_evaluate(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
-    """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe]."""
+def batch_evaluate(
+    dcf, keys: Sequence, xs: Sequence[int], use_pallas=None, interpret=False
+) -> np.ndarray:
+    """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe].
+
+    `use_pallas` (default: on for real TPU backends, see
+    evaluator._pallas_default) routes the per-level tree walk through the
+    batched Mosaic kernels instead of the XLA bitslice scan."""
     bits, xor_group = evaluator._value_kind(dcf.value_type)
     num_points = len(xs)
     k = len(keys)
@@ -204,6 +310,31 @@ def batch_evaluate(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
 
     seeds = np.broadcast_to(batch.seeds[:, None, :], (k, p_pad, 4)).copy()
     control0 = aes_jax.pack_bit_mask(np.full(p_pad, bool(batch.party), dtype=bool))
+    if use_pallas is None:
+        use_pallas = evaluator._pallas_default()
+    if p_pad // 32 < 8 and not interpret:
+        # Narrow point batches (< 256 points -> < 8 lane words) would hand
+        # the walk kernel near-degenerate blocks; the XLA scan driver is
+        # the right engine there (r3 review).
+        use_pallas = False
+    if use_pallas:
+        out = _dcf_batch_pallas_jit(
+            jnp.asarray(seeds),
+            jnp.asarray(control0),
+            jnp.asarray(path_masks),
+            jnp.asarray(cw_planes),
+            jnp.asarray(ccl),
+            jnp.asarray(ccr),
+            jnp.asarray(vc),
+            jnp.asarray(block_sel),
+            jnp.asarray(acc_mask),
+            bits=bits,
+            party=batch.party,
+            xor_group=xor_group,
+            key_tile=_dcf_key_tile(k, p_pad),
+            interpret=interpret,
+        )
+        return np.asarray(out)[:, :num_points]
     out = _dcf_batch_jit(
         jnp.asarray(seeds),
         jnp.asarray(control0),

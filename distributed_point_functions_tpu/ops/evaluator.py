@@ -938,17 +938,29 @@ def full_domain_evaluate_chunks(
                 kind = dict(spec=spec)
             m_lanes = seeds_p.shape[1]
             slab = min(lane_slab, m_lanes) if lane_slab else m_lanes
+            if lane_slab and m < 32:
+                # Host expansion below one packed word was lane-padded to
+                # 32; slicing padded lanes into pieces would emit garbage
+                # pieces. A single full piece is valid slabbing (every
+                # dispatch stays under any size bound a 32-lane program
+                # could violate), so clamp rather than reject (r3 review).
+                slab = m_lanes
             if slab < m_lanes:
                 # Multi-piece slabbing relies on pieces partitioning the
                 # domain EXACTLY: _trim's per-piece [:, :domain] cannot
                 # repair an overshooting piece (it would silently corrupt
                 # downstream offsets, e.g. the PIR natural-order advance).
-                # The invariant holds because lane padding only happens
-                # below one packed word (single-piece) and keep_per_block
-                # is 2^(lds - stop_level); guard it loudly regardless.
-                assert m_lanes * (1 << device_levels) * keep_per_block == domain, (
-                    m_lanes, device_levels, keep_per_block, domain,
-                )
+                # With the pad clamp above, m_lanes * 2^device_levels *
+                # keep_per_block == 2^lds holds by construction; raise (not
+                # assert: -O must not revert to silent corruption) if a
+                # future config breaks it.
+                if m_lanes * (1 << device_levels) * keep_per_block != domain:
+                    raise InvalidArgumentError(
+                        "lane_slab pieces would not partition the domain "
+                        f"exactly (lanes={m_lanes}, device_levels="
+                        f"{device_levels}, keep={keep_per_block}, "
+                        f"domain={domain})"
+                    )
             for lo in range(0, m_lanes, slab):
                 s = min(slab, m_lanes - lo)
                 if s == m_lanes:

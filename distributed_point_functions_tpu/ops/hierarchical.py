@@ -236,60 +236,11 @@ def evaluate_until_batch(
         start_level = v.hierarchy_to_tree[ctx.previous_hierarchy_level]
         prev_lds = v.parameters[ctx.previous_hierarchy_level].log_domain_size
         prefix_arr = _as_prefix_array(prefixes, prev_lds)
-        # Domain prefixes -> tree indices at the previous level's tree depth.
-        shift = prev_lds - start_level
-        if shift:
-            if prefix_arr.dtype == uint128.U128:
-                shifted = uint128.u128_rshift(prefix_arr, shift)
-            else:
-                shifted = prefix_arr >> np.uint64(shift)
-            # inverse maps each prefix to its tree position — reused below
-            # for the per-prefix block selection. `shifted` is sorted
-            # (prefix_arr is), so unique is a linear neighbor-compare.
-            if shifted.shape[0]:
-                is_new = np.empty(shifted.shape[0], dtype=bool)
-                is_new[0] = True
-                is_new[1:] = shifted[1:] != shifted[:-1]
-                tree = shifted[is_new]
-                tree_pos_of_prefix = np.cumsum(is_new) - 1
-            else:
-                tree, tree_pos_of_prefix = np.unique(shifted, return_inverse=True)
-        else:
-            tree = prefix_arr
-            tree_pos_of_prefix = None
+        positions, tree, tree_pos_of_prefix = _positions_for_prefixes(
+            ctx.parent_tree, ctx.child_levels, prev_lds, start_level,
+            prefix_arr, hierarchy_level,
+        )
         tree_prefixes = tree
-        # Stored state holds full child blocks of ctx.parent_tree: row of
-        # child c is pos(c >> L) * 2^L + (c & (2^L - 1)) — one search over
-        # the 2^L-times-smaller parent array instead of the child set.
-        L = ctx.child_levels
-        if tree.dtype == uint128.U128:
-            tp = uint128.u128_rshift(tree, L)
-            leaf = uint128.u128_and_low(tree, min(L, 64)).astype(np.int64)
-            if ctx.parent_tree.dtype == uint128.U128:
-                ppos = uint128.u128_searchsorted(ctx.parent_tree, tp)
-                found = ctx.parent_tree[np.minimum(ppos, len(ctx.parent_tree) - 1)] == tp
-            else:
-                # uint64 parents, U128 tree: hi must be zero or the prefix
-                # cannot be present (low-word equality alone would alias).
-                tp64 = tp["lo"]
-                ppos = np.searchsorted(ctx.parent_tree, tp64).astype(np.int64)
-                found = (
-                    ctx.parent_tree[np.minimum(ppos, len(ctx.parent_tree) - 1)]
-                    == tp64
-                ) & (tp["hi"] == 0)
-        else:
-            tp = tree >> np.uint64(L)
-            leaf = (tree & np.uint64((1 << L) - 1)).astype(np.int64)
-            ppos = np.searchsorted(ctx.parent_tree, tp).astype(np.int64)
-            found = (
-                ctx.parent_tree[np.minimum(ppos, len(ctx.parent_tree) - 1)] == tp
-            )
-        if (ppos >= len(ctx.parent_tree)).any() or not found.all():
-            raise InvalidArgumentError(
-                "Prefix not present in ctx.partial_evaluations at hierarchy "
-                f"level {hierarchy_level}"
-            )
-        positions = ppos * (1 << L) + leaf
         num_parents = len(tree)
         if engine == "host":
             pos = positions.astype(np.int64)
@@ -373,6 +324,357 @@ def evaluate_until_batch(
     if isinstance(outs, tuple):
         return tuple(np.asarray(o) for o in outs)
     return np.asarray(outs)
+
+
+# ---------------------------------------------------------------------------
+# Fused multi-level advance (heavy-hitters access pattern)
+# ---------------------------------------------------------------------------
+
+
+def _positions_for_prefixes(
+    parent_tree, child_levels, prev_lds, start_level, prefix_arr,
+    hierarchy_level,
+):
+    """Leaf-coordinate gather positions of `prefix_arr` (sorted unique domain
+    prefixes at the previous hierarchy level) into the stored expansion
+    state, plus the (tree_prefixes, tree_pos_of_prefix) bookkeeping.
+
+    Stored state holds full child blocks of `parent_tree`: the row of child
+    c is pos(c >> L) * 2^L + (c & (2^L - 1)) — one search over the
+    2^L-times-smaller parent array instead of the child set. Shared by
+    evaluate_until_batch and evaluate_levels_fused."""
+    shift = prev_lds - start_level
+    if shift:
+        if prefix_arr.dtype == uint128.U128:
+            shifted = uint128.u128_rshift(prefix_arr, shift)
+        else:
+            shifted = prefix_arr >> np.uint64(shift)
+        # inverse maps each prefix to its tree position — reused by the
+        # caller for the per-prefix block selection. `shifted` is sorted
+        # (prefix_arr is), so unique is a linear neighbor-compare.
+        if shifted.shape[0]:
+            is_new = np.empty(shifted.shape[0], dtype=bool)
+            is_new[0] = True
+            is_new[1:] = shifted[1:] != shifted[:-1]
+            tree = shifted[is_new]
+            tree_pos_of_prefix = np.cumsum(is_new) - 1
+        else:
+            tree, tree_pos_of_prefix = np.unique(shifted, return_inverse=True)
+    else:
+        tree = prefix_arr
+        tree_pos_of_prefix = None
+    L = child_levels
+    if tree.dtype == uint128.U128:
+        tp = uint128.u128_rshift(tree, L)
+        leaf = uint128.u128_and_low(tree, min(L, 64)).astype(np.int64)
+        if parent_tree.dtype == uint128.U128:
+            ppos = uint128.u128_searchsorted(parent_tree, tp)
+            found = parent_tree[np.minimum(ppos, len(parent_tree) - 1)] == tp
+        else:
+            # uint64 parents, U128 tree: hi must be zero or the prefix
+            # cannot be present (low-word equality alone would alias).
+            tp64 = tp["lo"]
+            ppos = np.searchsorted(parent_tree, tp64).astype(np.int64)
+            found = (
+                parent_tree[np.minimum(ppos, len(parent_tree) - 1)] == tp64
+            ) & (tp["hi"] == 0)
+    else:
+        tp = tree >> np.uint64(L)
+        leaf = (tree & np.uint64((1 << L) - 1)).astype(np.int64)
+        ppos = np.searchsorted(parent_tree, tp).astype(np.int64)
+        found = parent_tree[np.minimum(ppos, len(parent_tree) - 1)] == tp
+    if (ppos >= len(parent_tree)).any() or not found.all():
+        raise InvalidArgumentError(
+            "Prefix not present in ctx.partial_evaluations at hierarchy "
+            f"level {hierarchy_level}"
+        )
+    positions = ppos * (1 << L) + leaf
+    return positions, tree, tree_pos_of_prefix
+
+
+def _level_value_corrections(keys, v, hierarchy_level, bits):
+    """uint32[K, epb, lpe] value-correction limbs at one hierarchy level."""
+    stop = v.hierarchy_to_tree[hierarchy_level]
+    epb = v.parameters[hierarchy_level].value_type.elements_per_block()
+    k = len(keys)
+    vc = np.zeros((k, epb, 4), dtype=np.uint32)
+    for i, key in enumerate(keys):
+        if hierarchy_level == v.num_hierarchy_levels - 1:
+            corrections = key.last_level_value_correction
+        else:
+            corrections = key.correction_words[stop].value_correction
+        for j, c in enumerate(corrections):
+            vc[i, j] = uint128.to_limbs(int(c))
+    return evaluator._correction_limbs(vc, bits)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "meta", "bits", "party", "xor_group", "use_pallas", "emit_state",
+    ),
+)
+def _fused_advance_jit(
+    seeds,  # uint32[K, lanes0, 4] entry state (leaf order)
+    control,  # uint32[K, lanes0] 0/1
+    step_args,  # per step: (pos, cw, ccl, ccr, vc, gsel)
+    state_order,  # int64[final lanes] leaf-order gather, or None
+    meta: tuple,  # per step: tree levels to expand (static)
+    bits: int,
+    party: int,
+    xor_group: bool,
+    use_pallas: bool,
+    emit_state: bool,
+):
+    """G hierarchy-level advances in ONE program: per step, gather the
+    selected lanes, expand `meta[d]` tree levels, value-hash, correct, and
+    emit the leaf-ordered outputs through a single precomposed gather —
+    the multi-level fusion of evaluate_until_batch's device path. All
+    index tables (lane gathers `pos`, output gathers `gsel`) are computed
+    on the host with lane-order composition, so the program contains no
+    reorder dispatches at all; intermediate state stays in expansion (lane)
+    order and only the exit state is leaf-ordered (for the resumable
+    BatchedContext)."""
+    if use_pallas:
+        from . import aes_pallas
+
+    k = seeds.shape[0]
+    outs = []
+    for d, (pos, cw, ccl, ccr, vc, gsel) in enumerate(step_args):
+        s = seeds[:, pos]  # [K, Np_pad, 4]
+        c = control[:, pos]
+        mask = _pack_mask_device(c)
+        planes = jax.vmap(aes_jax.pack_to_planes)(s)
+        for l in range(meta[d]):
+            if use_pallas and planes.shape[2] >= 8:
+                planes, mask = aes_pallas.expand_one_level_pallas_batched(
+                    planes, mask, cw[:, l], ccl[:, l], ccr[:, l]
+                )
+            else:
+                planes, mask = jax.vmap(backend_jax.expand_one_level)(
+                    planes, mask, cw[:, l], ccl[:, l], ccr[:, l]
+                )
+        if use_pallas and planes.shape[2] >= 256:
+            hashed = aes_pallas.hash_value_planes_pallas_batched(planes)
+        else:
+            hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
+        blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
+        ctrlb = jax.vmap(backend_jax.unpack_mask_device)(mask)
+        fn = functools.partial(
+            evaluator._correct_values,
+            bits=bits, party=party, xor_group=xor_group,
+        )
+        vals = jax.vmap(fn)(blocks, ctrlb, vc)  # [K, lanes, epb, lpe]
+        flat = vals.reshape(k, -1, vals.shape[-1])
+        outs.append(flat[:, gsel])
+        seeds = jax.vmap(aes_jax.unpack_from_planes)(planes)
+        control = jax.vmap(backend_jax.unpack_mask_device)(mask)
+    if emit_state:
+        # Exit state leaf-ordered (the resumable BatchedContext contract).
+        seeds = seeds[:, state_order]
+        control = control[:, state_order]
+    # Non-final groups return lane-order state: the next group's first
+    # gather is precomposed with this group's lane order on the host.
+    return tuple(outs), seeds, control
+
+
+def evaluate_levels_fused(
+    ctx: BatchedContext,
+    plan: Sequence[Tuple[int, Sequence[int]]],
+    group: int = 16,
+    device_output: bool = False,
+    use_pallas: Optional[bool] = None,
+) -> list:
+    """Advances through MANY hierarchy levels with the per-level prefix sets
+    known upfront — the heavy-hitters / experiments access pattern
+    (BM_HeavyHitters, /root/reference/dpf/distributed_point_function_benchmark.cc:308-340) —
+    fusing `group` level-advances into each device program. Per-level
+    dispatch cost (the measured dominator of the 128-level hierarchy on a
+    high-latency link, PERF.md) drops by ~4*group: the per-level gather,
+    expansion, value hash + correction, and reorder all run inside one
+    program per group, with every index table precomposed on the host.
+
+    `plan` is a list of (hierarchy_level, prefixes) pairs, hierarchy levels
+    strictly increasing, prefixes at the PREVIOUS entry's level (empty iff
+    the context is fresh, first entry only) — the same contract as calling
+    evaluate_until_batch once per entry, and the context ends in the same
+    resumable state. Scalar Int/XorWrapper value types only.
+
+    Returns the per-entry value arrays: uint32[K, n_outputs, lpe] each
+    (numpy unless device_output).
+    """
+    from ..core.value_types import Int, XorWrapper
+
+    dpf, v = ctx.dpf, ctx.dpf.validator
+    k = len(ctx.keys)
+    if not plan:
+        return []
+    if use_pallas is None:
+        use_pallas = evaluator._pallas_default()
+    for (h, _) in plan:
+        if not (0 <= h < v.num_hierarchy_levels):
+            raise InvalidArgumentError(
+                "`hierarchy_level` must be less than the number of "
+                "hierarchy levels"
+            )
+        vt = v.parameters[h].value_type
+        if not isinstance(vt, (Int, XorWrapper)) or v.blocks_needed[h] != 1:
+            raise InvalidArgumentError(
+                "evaluate_levels_fused supports scalar Int/XorWrapper "
+                "outputs; use evaluate_until_batch for codec value types"
+            )
+    bits, xor_group = evaluator._value_kind(v.parameters[plan[-1][0]].value_type)
+    batch = evaluator.KeyBatch.from_keys(dpf, ctx.keys, plan[-1][0])
+    cw_all, ccl_all, ccr_all = batch.device_cw_arrays(0)
+
+    # Virtual context walk (host): build per-step tables.
+    prev_level = ctx.previous_hierarchy_level
+    parent_tree = ctx.parent_tree
+    child_levels = ctx.child_levels
+    # Lane-order map of the state the NEXT step gathers from: None = state
+    # is already leaf-ordered (the resumable ctx state at entry).
+    prev_order = None
+    steps = []  # (pos_pad, levels_d, vc, gsel, start_level)
+    for (h, prefixes) in plan:
+        if h <= prev_level:
+            raise InvalidArgumentError(
+                "`plan` hierarchy levels must be strictly increasing"
+            )
+        if (prev_level < 0) != (len(prefixes) == 0):
+            raise InvalidArgumentError(
+                "`prefixes` must be empty iff advancing a fresh context"
+            )
+        stop_level = v.hierarchy_to_tree[h]
+        lds = v.parameters[h].log_domain_size
+        keep = 1 << (lds - stop_level)
+        b_h, xg_h = evaluator._value_kind(v.parameters[h].value_type)
+        if (b_h, xg_h) != (bits, xor_group):
+            raise InvalidArgumentError(
+                "evaluate_levels_fused requires one value kind across the "
+                "plan's hierarchy levels"
+            )
+        if prev_level < 0:
+            start_level = 0
+            positions = np.zeros(1, dtype=np.int64)
+            tree = None
+            tree_pos_of_prefix = None
+            prefix_arr = None
+            prev_lds = 0
+        else:
+            start_level = v.hierarchy_to_tree[prev_level]
+            prev_lds = v.parameters[prev_level].log_domain_size
+            prefix_arr = _as_prefix_array(prefixes, prev_lds)
+            positions, tree, tree_pos_of_prefix = _positions_for_prefixes(
+                parent_tree, child_levels, prev_lds, start_level,
+                prefix_arr, h,
+            )
+        levels_d = stop_level - start_level
+        if lds - (prev_lds if prev_level >= 0 else 0) > 62:
+            raise InvalidArgumentError(
+                "Output size would be larger than 2**62. Please evaluate "
+                "fewer hierarchy levels at once."
+            )
+        # Compose with the lane order of the state being gathered from.
+        if prev_order is not None:
+            positions = prev_order[positions]
+        num_parents = positions.shape[0]
+        pad_to = max(32, -(-num_parents // 32) * 32)
+        pos_pad = np.zeros(pad_to, dtype=np.int64)
+        pos_pad[:num_parents] = positions
+        order_d = backend_jax.expansion_output_order(
+            num_parents, pad_to, levels_d
+        )
+        epb = v.parameters[h].value_type.elements_per_block()
+        # Output selection in this level's element space (block-bit
+        # sharing across tree prefixes), then composed with the lane order:
+        # element E -> lane order_d[E // keep] -> flat = lane * epb + E % keep.
+        if prev_level >= 0 and (prev_lds - start_level):
+            shift = prev_lds - start_level
+            opp = 1 << (lds - prev_lds)
+            etp = 1 << (lds - start_level)
+            block_index = (
+                uint128.u128_and_low(prefix_arr, shift)
+                if prefix_arr.dtype == uint128.U128
+                else prefix_arr & np.uint64((1 << shift) - 1)
+            )
+            starts = tree_pos_of_prefix.astype(np.int64) * etp + (
+                block_index.astype(np.int64) * opp
+            )
+            sel = (starts[:, None] + np.arange(opp, dtype=np.int64)).reshape(-1)
+        else:
+            sel = np.arange((num_parents << levels_d) * keep, dtype=np.int64)
+        gsel = order_d[sel // keep] * epb + (sel % keep)
+        vc = _level_value_corrections(ctx.keys, v, h, bits)
+        steps.append((pos_pad, levels_d, vc, gsel, start_level))
+        # Advance the virtual context.
+        prev_level = h
+        parent_tree = (
+            tree if tree is not None else np.zeros(1, dtype=np.uint64)
+        )
+        child_levels = levels_d
+        prev_order = order_d
+
+    # Entry state.
+    if ctx.previous_hierarchy_level < 0:
+        seeds0 = jnp.asarray(
+            np.broadcast_to(batch.seeds[:, None, :], (k, 1, 4)).copy()
+        )
+        control0 = jnp.asarray(
+            np.full((k, 1), np.uint32(1 if batch.party else 0))
+        )
+    else:
+        seeds0 = jnp.asarray(ctx.seeds).astype(jnp.uint32)
+        control0 = jnp.asarray(ctx.control).astype(jnp.uint32)
+
+    final_level = plan[-1][0]
+    emit_state = final_level < v.num_hierarchy_levels - 1
+    outs_all = []
+    seeds, control = seeds0, control0
+    for g0 in range(0, len(steps), group):
+        chunk = steps[g0 : g0 + group]
+        last_in_run = g0 + len(chunk) == len(steps)
+        step_args = tuple(
+            (
+                jnp.asarray(pos),
+                jnp.asarray(cw_all[:, start : start + lv]),
+                jnp.asarray(ccl_all[:, start : start + lv]),
+                jnp.asarray(ccr_all[:, start : start + lv]),
+                jnp.asarray(vc),
+                jnp.asarray(gsel),
+            )
+            for (pos, lv, vc, gsel, start) in chunk
+        )
+        meta = tuple(lv for (_, lv, _, _, _) in chunk)
+        outs, seeds, control = _fused_advance_jit(
+            seeds,
+            control,
+            step_args,
+            jnp.asarray(prev_order) if (emit_state and last_in_run) else None,
+            meta=meta,
+            bits=bits,
+            party=batch.party,
+            xor_group=xor_group,
+            use_pallas=use_pallas,
+            emit_state=emit_state and last_in_run,
+        )
+        outs_all.extend(outs)
+
+    # Context update (same contract as evaluate_until_batch).
+    if emit_state:
+        ctx.parent_tree = parent_tree
+        ctx.child_levels = child_levels
+        ctx.seeds = seeds
+        ctx.control = control
+    else:
+        ctx.parent_tree = None
+        ctx.child_levels = 0
+        ctx.seeds = None
+        ctx.control = None
+    ctx.previous_hierarchy_level = final_level
+
+    if device_output:
+        return list(outs_all)
+    return [np.asarray(o) for o in outs_all]
 
 
 def _expand_batch_host(

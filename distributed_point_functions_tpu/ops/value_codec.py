@@ -10,7 +10,9 @@ u32-limb kernels:
   subtract steps on little-endian u32 limbs), then mod-N group ops.
   Mirrors IntModNImpl::UnsafeSampleFromBytes
   (/root/reference/dpf/int_mod_n.h:154-177).
-* ``TupleType``              — struct-of-arrays: one limb array per element.
+* ``TupleType``              — struct-of-arrays: one limb array per LEAF
+  element, with arbitrary nesting flattened in leaf order (the spec records
+  the nesting tree to rebuild host values).
   Directly-convertible tuples extract each component at its static byte
   offset; tuples containing IntModN replay the sequential sampling chain
   (running 128-bit block, divmod by N, refill low bits from the byte
@@ -74,6 +76,10 @@ class ValueSpec:
     blocks_needed: int
     direct: bool  # True: offset extraction; False: sampling chain
     is_tuple: bool
+    # Nesting shape for tuples: a tree of leaf indices into `components`
+    # (int = leaf, tuple = nested tuple), e.g. Tuple<u32, Tuple<u32,u32>>
+    # -> (0, (1, 2)). None for scalar types. Hashable (jit static arg).
+    structure: object = None
 
     @property
     def is_scalar_direct(self) -> bool:
@@ -105,18 +111,33 @@ def build_spec(value_type: ValueType, blocks_needed: int) -> ValueSpec:
             is_tuple=False,
         )
     if isinstance(value_type, TupleType):
+        # Flatten arbitrary nesting into the leaf list, recording the tree
+        # of leaf indices. The reference's recursive TupleHelper
+        # (/root/reference/dpf/internal/value_type_helpers.h:341-437)
+        # consumes the byte stream in leaf order — DirectlyFromBytes
+        # advances by each element's byte size (all leaf bitsizes are byte
+        # multiples, so cumulative bit offsets coincide), and
+        # SampleAndUpdateBytes's update2 = update || (not last element)
+        # resolves, through the recursion, to "update after every leaf but
+        # the flattened-order last" — exactly the flat chain below.
         comps = []
-        for e in value_type.elements:
-            if isinstance(e, Int):
-                comps.append(("int", e.bitsize, 0))
-            elif isinstance(e, XorWrapper):
-                comps.append(("xor", e.bitsize, 0))
-            elif isinstance(e, IntModN):
-                comps.append(("modn", e.base_bitsize, e.modulus))
+
+        def _flatten(t):
+            if isinstance(t, TupleType):
+                return tuple(_flatten(e) for e in t.elements)
+            if isinstance(t, Int):
+                comps.append(("int", t.bitsize, 0))
+            elif isinstance(t, XorWrapper):
+                comps.append(("xor", t.bitsize, 0))
+            elif isinstance(t, IntModN):
+                comps.append(("modn", t.base_bitsize, t.modulus))
             else:
                 raise NotImplementedError(
-                    f"device codec does not support nested tuples ({e})"
+                    f"no device lowering for tuple element {t}"
                 )
+            return len(comps) - 1
+
+        structure = _flatten(value_type)
         direct = value_type.can_convert_directly()
         if direct:
             tbs = value_type.total_bit_size()
@@ -133,6 +154,7 @@ def build_spec(value_type: ValueType, blocks_needed: int) -> ValueSpec:
                 blocks_needed=blocks_needed,
                 direct=True,
                 is_tuple=True,
+                structure=structure,
             )
         return ValueSpec(
             components=tuple(ComponentSpec(k, b, m) for k, b, m in comps),
@@ -141,6 +163,7 @@ def build_spec(value_type: ValueType, blocks_needed: int) -> ValueSpec:
             blocks_needed=blocks_needed,
             direct=False,
             is_tuple=True,
+            structure=structure,
         )
     raise NotImplementedError(f"no device lowering for value type {value_type}")
 
@@ -154,18 +177,38 @@ def _int_to_limbs(x: int, n: int) -> np.ndarray:
     return np.array([(x >> (32 * i)) & 0xFFFFFFFF for i in range(n)], dtype=np.uint32)
 
 
+def _leaf_values(value, structure):
+    """Yields a (possibly nested) tuple value's leaves in flattened order."""
+    if isinstance(structure, int):
+        yield value
+    else:
+        for v, s in zip(value, structure):
+            yield from _leaf_values(v, s)
+
+
+def _build_nested(structure, leaves):
+    """Inverse of _leaf_values: leaf list -> nested tuple value."""
+    if isinstance(structure, int):
+        return leaves[structure]
+    return tuple(_build_nested(s, leaves) for s in structure)
+
+
 def correction_limbs(spec: ValueSpec, corrections: Sequence) -> Tuple[np.ndarray, ...]:
     """Key correction values (epb host values) -> per-component limb arrays.
 
     Returns, per component c, uint32[epb, lpe_c].
     """
-    out = []
-    for c, comp in enumerate(spec.components):
-        arr = np.zeros((spec.epb, comp.lpe), dtype=np.uint32)
-        for j, value in enumerate(corrections):
-            v = value[c] if spec.is_tuple else value
-            arr[j] = _int_to_limbs(int(v), comp.lpe)
-        out.append(arr)
+    out = [
+        np.zeros((spec.epb, comp.lpe), dtype=np.uint32)
+        for comp in spec.components
+    ]
+    for j, value in enumerate(corrections):
+        if spec.is_tuple:
+            flat = list(_leaf_values(value, spec.structure))
+        else:
+            flat = [value]
+        for c, comp in enumerate(spec.components):
+            out[c][j] = _int_to_limbs(int(flat[c]), comp.lpe)
     return tuple(out)
 
 
@@ -693,11 +736,15 @@ def component_to_numpy(values: np.ndarray, comp: ComponentSpec) -> np.ndarray:
 
 def values_to_host(arrays: Tuple[np.ndarray, ...], spec: ValueSpec) -> list:
     """Per-component limb arrays [N, lpe_c] -> flat list of host values
-    (ints, or tuples of ints for tuple types) comparable with the host path."""
+    (ints, or — possibly nested — tuples of ints for tuple types)
+    comparable with the host path."""
     comps = [
         component_to_numpy(a, c).reshape(-1) for a, c in zip(arrays, spec.components)
     ]
     n = comps[0].shape[0]
     if not spec.is_tuple:
         return [int(v) for v in comps[0]]
-    return [tuple(int(comps[c][i]) for c in range(len(comps))) for i in range(n)]
+    return [
+        _build_nested(spec.structure, [int(comps[c][i]) for c in range(len(comps))])
+        for i in range(n)
+    ]

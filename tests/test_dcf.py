@@ -87,6 +87,59 @@ def test_batch_evaluate_matches_host(bits):
             )
 
 
+@pytest.mark.parametrize("bits,xor", [(64, False), (32, True)])
+def test_dcf_batch_pallas_driver_matches_xla_driver(monkeypatch, bits, xor):
+    """Plumbing smoke for the Mosaic DCF driver (_dcf_batch_pallas_jit):
+    both drivers run with IDENTICAL cheap stand-in circuits (the real AES
+    is pinned elsewhere; interpret mode cannot execute it on the CI CPU),
+    so any per-level capture / correction-indexing / walk-interleave
+    divergence shows as an output mismatch. Values are meaningless; only
+    driver equality is asserted."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.dcf import batch as dcf_batch
+    from distributed_point_functions_tpu.ops import aes_jax, aes_pallas
+
+    vt = XorWrapper(bits) if xor else Int(bits)
+    dcf = DistributedComparisonFunction.create(10, vt)
+    keys = []
+    for alpha in [17, 900]:
+        ka, _ = dcf.generate_keys(alpha, 4242)
+        keys.append(ka)
+    xs = [0, 16, 17, 18, 511, 1023] + [
+        int(x) for x in RNG.integers(0, 1024, size=10)
+    ]
+
+    def cheap_hash_planes(planes, rk_base, rk_diff=None, key_mask=None):
+        sig = aes_jax.sigma_planes(planes)
+        enc = jnp.roll(sig, -1, axis=0)
+        if rk_diff is not None and key_mask is not None:
+            enc = enc ^ key_mask[None, :]
+        return enc ^ sig
+
+    def cheap_rows(rows, rk_base, rk_diff, key_mask):
+        out = []
+        for p in range(128):
+            row = rows[(p + 1) % 128]
+            if rk_diff is not None and key_mask is not None:
+                row = row ^ key_mask
+            out.append(row)
+        return out
+
+    jax.clear_caches()
+    monkeypatch.setattr(aes_jax, "hash_planes", cheap_hash_planes)
+    monkeypatch.setattr(aes_pallas, "_aes_rows", cheap_rows)
+    try:
+        a = dcf_batch.batch_evaluate(dcf, keys, xs, use_pallas=False)
+        b = dcf_batch.batch_evaluate(
+            dcf, keys, xs, use_pallas=True, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        jax.clear_caches()  # drop cheap-circuit traces
+
+
 @pytest.mark.slow  # XOR-group device coverage also lives in
 # test_batch_evaluate_host_wide_groups[xor128]; this adds the
 # dcf.batch_evaluate API shape for XorWrapper

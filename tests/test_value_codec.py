@@ -77,13 +77,37 @@ VALUE_CASES = [
         TupleType(IntModN(64, MOD64), IntModN(64, MOD64)),
         lambda: (randmod(MOD64), randmod(MOD64)),
     ),
+    # Nested tuples (reference typed suite,
+    # distributed_point_function_test.cc:899-1030; recursive TupleHelper
+    # value_type_helpers.h:341-437). Device codec flattens leaves.
+    (
+        TupleType(Int(32), TupleType(Int(32), Int(32))),
+        lambda: (
+            randmod(1 << 32),
+            (randmod(1 << 32), randmod(1 << 32)),
+        ),
+    ),
+    (  # nested + block packing: 32-bit total -> epb 4
+        TupleType(TupleType(Int(8), Int(8)), XorWrapper(16)),
+        lambda: (
+            (randmod(1 << 8), randmod(1 << 8)),
+            randmod(1 << 16),
+        ),
+    ),
+    (  # nested + sampling chain (IntModN leaf inside an inner tuple)
+        TupleType(Int(32), TupleType(IntModN(64, MOD64), Int(32))),
+        lambda: (
+            randmod(1 << 32),
+            (randmod(MOD64), randmod(1 << 32)),
+        ),
+    ),
 ]
 
 
 # Fast: one case per codec family (mod-N scalar, plain tuple, mixed tuple
-# with XOR + sub-32-bit packing). Slow: the remaining widths and the
-# nested / multi-block shapes.
-_FD_FAST, _FD_SLOW = (0, 2, 3), (1, 4, 5)
+# with XOR + sub-32-bit packing, nested tuple). Slow: the remaining widths
+# and the nested / multi-block shapes.
+_FD_FAST, _FD_SLOW = (0, 2, 3, 6), (1, 4, 5, 7, 8)
 
 
 @pytest.mark.parametrize(
@@ -125,9 +149,11 @@ def test_full_domain_matches_host(value_type, sample):
     [
         VALUE_CASES[0],
         VALUE_CASES[2],
+        VALUE_CASES[6],
         pytest.param(*VALUE_CASES[5], marks=pytest.mark.slow),
+        pytest.param(*VALUE_CASES[8], marks=pytest.mark.slow),
     ],
-    ids=[str(VALUE_CASES[i][0]) for i in (0, 2, 5)],
+    ids=[str(VALUE_CASES[i][0]) for i in (0, 2, 6, 5, 8)],
 )
 def test_evaluate_at_batch_matches_host(value_type, sample):
     log_domain = 10
