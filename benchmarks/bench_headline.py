@@ -31,7 +31,16 @@ def main():
         print(json.dumps({"bench": "full_domain_headline", "error": "timeout"}))
         return
     sys.stderr.write((stderr or "")[-4000:])
-    line = stdout.strip().splitlines()[-1] if stdout.strip() else "{}"
+    if not (stdout or "").strip():
+        # Hard-killed child (OOM / SIGKILL / interpreter crash): no JSON
+        # printed. Parsing "{}" here would store a null record that reads
+        # as a measurement — emit an explicit error instead (r3 review).
+        print(json.dumps({
+            "bench": "full_domain_headline",
+            "error": "bench.py produced no output (killed or crashed)",
+        }))
+        return
+    line = stdout.strip().splitlines()[-1]
     try:
         d = json.loads(line)
     except json.JSONDecodeError:

@@ -196,9 +196,18 @@ def _capture_batched(
 def _dcf_key_tile(k: int, p_pad: int) -> int:
     """Key tile for the Mosaic walk: DCF point batches are often narrow
     (W = P/32 lane words), so tile enough keys together to fill the
-    (8, 128) vregs — bounded by the key count itself."""
+    (8, 128) vregs — bounded by the key count itself. Prefers a divisor
+    of k (the walk zero-pads k up to a tile multiple and walks the dead
+    keys at every level; a large-enough exact divisor keeps the vregs
+    filled with zero padding — r3 review)."""
     w = max(1, p_pad // 32)
-    return max(1, min(k, max(8, min(64, 1024 // w))))
+    cap = max(1, min(k, max(8, min(64, 1024 // w))))
+    for t in range(cap, 0, -1):
+        if k % t == 0:
+            if t >= max(1, cap // 2):
+                return t
+            break  # only tiny divisors exist; bounded padding beats them
+    return cap
 
 
 @functools.partial(

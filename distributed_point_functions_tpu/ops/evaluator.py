@@ -1257,6 +1257,7 @@ def evaluate_at_batch(
     points: Sequence[int],
     hierarchy_level: int = -1,
     device_output: bool = False,
+    use_pallas: Optional[bool] = None,
 ):
     """Evaluates every key at every point on device.
 
@@ -1312,7 +1313,9 @@ def evaluate_at_batch(
             bits=bits,
             party=batch.party,
             xor_group=xor_group,
-            use_pallas=_pallas_default(),
+            use_pallas=(
+                _pallas_default() if use_pallas is None else use_pallas
+            ),
         )
         return out[:, :p] if device_output else np.asarray(out)[:, :p]
     out = _evaluate_points_codec_jit(
