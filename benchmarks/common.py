@@ -129,8 +129,14 @@ def run_bench(name: str, fn) -> None:
         jax = init_jax()
         platform = jax.default_backend()
         log(f"platform: {platform}, devices: {jax.devices()}")
+        # Smoke (reduced configs) when no accelerator is attached, unless
+        # BENCH_FULL=1 deliberately records full-size host-engine numbers
+        # on a CPU-only box. Smoke results are tagged so the run_all merge
+        # never lets them replace a full-size record (round 3: a CPU smoke
+        # sweep silently clobbered the TPU-day full-size host records).
+        smoke = platform == "cpu" and os.environ.get("BENCH_FULL") != "1"
         try:
-            result = fn(jax, platform == "cpu")
+            result = fn(jax, smoke)
         except Exception:
             log("bench failed:\n" + traceback.format_exc())
             if platform != "cpu" and os.environ.get("BENCH_PLATFORM") != "cpu":
@@ -158,6 +164,8 @@ def run_bench(name: str, fn) -> None:
         # (e.g. the native host engine while a TPU is attached) sets its
         # own platform; only fill it in when absent.
         result.setdefault("platform", jax.default_backend())
+        if smoke:
+            result["smoke"] = True
     except Exception as e:
         result["error"] = f"{type(e).__name__}: {e}"
     emit(result)

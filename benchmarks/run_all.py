@@ -56,15 +56,41 @@ def main():
     def slot(e):
         return (e.get("bench"), e.get("platform"))
 
-    fresh = {}
-    for r in results:
-        fresh[slot(r)] = r
-    merged = []
     try:
         with open(out) as f:
             stored = json.load(f)
     except Exception:
         stored = []
+    stored_by_slot = {slot(e): e for e in stored}
+
+    def full_size_stored(name, platform):
+        # Check the plain slot AND the '@platform'-suffixed slot the rename
+        # branch below may have stored a cross-platform rerun under — a
+        # smoke record passing the plain check would otherwise be renamed
+        # onto (and delete) the full-size suffixed record.
+        for key in ((name, platform), (f"{name}@{platform}", platform)):
+            e = stored_by_slot.get(key)
+            if e is not None and not e.get("smoke"):
+                return True
+        return False
+
+    # A smoke record (reduced config; tagged by common.run_bench) must
+    # never replace a full-size record.
+    kept = []
+    for r in results:
+        if r.get("smoke") and full_size_stored(r.get("bench"), r.get("platform")):
+            print(
+                f"# skipped smoke record for {r.get('bench')} "
+                "(full-size record exists)",
+                file=sys.stderr,
+            )
+            continue
+        kept.append(r)
+    results = kept
+    fresh = {}
+    for r in results:
+        fresh[slot(r)] = r
+    merged = []
     for e in stored:
         if slot(e) not in fresh:
             merged.append(e)
