@@ -1,0 +1,61 @@
+"""Status-matcher analogs for tests — the pytest counterpart of the
+reference's gtest matcher layer (IsOk / IsOkAndHolds / StatusIs and the
+DPF_ASSERT_OK* macros, /root/reference/dpf/internal/status_matchers.h).
+
+The reference needs matcher classes because absl::StatusOr is a value; in
+Python the error model is exceptions (utils/errors.py keeps the absl
+*categories*), so the analogs are context managers / asserting callers.
+Using these instead of raw pytest.raises pins BOTH the category and, like
+the reference's verbatim-message assertions, the message text.
+
+    from matchers import status_is, assert_ok, assert_ok_and_holds
+
+    with status_is("invalid_argument", "`alpha` must be non-negative"):
+        dpf.generate_keys(-1, 1)
+
+    keys = assert_ok(dpf.generate_keys, 5, 1)       # DPF_ASSERT_OK_AND_ASSIGN
+    # IsOkAndHolds (remember: ONE party's share is pseudorandom — assert on
+    # reconstructed values, not a single share):
+    assert_ok_and_holds(lambda: (int(a) + int(b)) % 2**64, 99)
+"""
+
+import re
+
+import pytest
+
+from distributed_point_functions_tpu.utils import errors
+
+# absl status-code name -> exception category (the reference's StatusIs
+# takes absl::StatusCode; this is the exact correspondence).
+CATEGORIES = {
+    "invalid_argument": errors.InvalidArgumentError,
+    "failed_precondition": errors.FailedPreconditionError,
+    "unimplemented": errors.UnimplementedError,
+}
+
+
+def status_is(category: str, message_substr: str = None):
+    """StatusIs(code, HasSubstr(message)): asserts the raised error's
+    category and (optionally) a verbatim message substring. Thin veneer
+    over pytest.raises — the point is the absl-code -> category mapping
+    and substring (not regex) message semantics."""
+    return pytest.raises(
+        CATEGORIES[category],
+        match=re.escape(message_substr) if message_substr else None,
+    )
+
+
+def assert_ok(fn, *args, **kwargs):
+    """DPF_ASSERT_OK_AND_ASSIGN: calls fn and returns its value; any
+    framework error fails the test with the status attached."""
+    try:
+        return fn(*args, **kwargs)
+    except errors.DpfError as e:
+        pytest.fail(f"expected OK status, got {type(e).__name__}: {e}")
+
+
+def assert_ok_and_holds(fn, expected, *args, **kwargs):
+    """IsOkAndHolds(expected): fn must succeed AND return `expected`."""
+    got = assert_ok(fn, *args, **kwargs)
+    assert got == expected, f"expected {expected!r}, got {got!r}"
+    return got

@@ -1,0 +1,50 @@
+"""The status-matcher layer (tests/matchers.py) against real API errors —
+the analog of the reference's status_matchers_test
+(/root/reference/dpf/internal/status_matchers.h usage across its suites)."""
+
+import pytest
+
+from matchers import assert_ok, assert_ok_and_holds, status_is
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int
+
+
+@pytest.fixture(scope="module")
+def dpf():
+    return DistributedPointFunction.create(DpfParameters(8, Int(64)))
+
+
+def test_status_is_matches_category_and_message(dpf):
+    with status_is("invalid_argument", "`alpha` must be smaller than"):
+        dpf.generate_keys(1 << 20, 1)
+
+
+def test_status_is_rejects_wrong_category(dpf):
+    from distributed_point_functions_tpu.utils.errors import (
+        InvalidArgumentError,
+    )
+
+    # A mismatched category propagates the original error (pytest.raises
+    # semantics), failing the enclosing test — StatusIs(kWrongCode).
+    with pytest.raises(InvalidArgumentError):
+        with status_is("failed_precondition"):
+            dpf.generate_keys(1 << 20, 1)  # raises invalid_argument
+
+
+def test_assert_ok_returns_value(dpf):
+    ka, kb = assert_ok(dpf.generate_keys, 5, 99)
+    assert ka.party == 0 and kb.party == 1
+
+
+def test_assert_ok_fails_on_error(dpf):
+    with pytest.raises(pytest.fail.Exception):
+        assert_ok(dpf.generate_keys, -1, 1)
+
+
+def test_assert_ok_and_holds(dpf):
+    ka, kb = assert_ok(dpf.generate_keys, 5, 99)
+    a = dpf.evaluate_at(ka, 0, [5])[0]
+    b = dpf.evaluate_at(kb, 0, [5])[0]
+    assert_ok_and_holds(lambda: (int(a) + int(b)) % 2**64, 99)
