@@ -408,6 +408,51 @@ def _level_value_corrections(keys, v, hierarchy_level, bits):
     return evaluator._correction_limbs(vc, bits)
 
 
+def _advance_one_step(
+    seeds, control, pos, cw, ccl, ccr, vc, gsel,
+    levels: int, bits: int, party: int, xor_group: bool, use_pallas: bool,
+):
+    """ONE hierarchy-level advance — the trace-time building block shared
+    by the unrolled and scan executors (they must stay numerically
+    identical): gather the selected lanes, expand `levels` tree levels,
+    value-hash, correct, and emit the leaf-ordered outputs through the
+    precomposed `gsel` gather. Returns (out, seeds', control') with the
+    state in expansion (lane) order."""
+    if use_pallas:
+        from . import aes_pallas
+
+    k = seeds.shape[0]
+    s = seeds[:, pos]  # [K, Np_pad, 4]
+    c = control[:, pos]
+    mask = _pack_mask_device(c)
+    planes = jax.vmap(aes_jax.pack_to_planes)(s)
+    for l in range(levels):
+        if use_pallas and planes.shape[2] >= 8:
+            planes, mask = aes_pallas.expand_one_level_pallas_batched(
+                planes, mask, cw[:, l], ccl[:, l], ccr[:, l]
+            )
+        else:
+            planes, mask = jax.vmap(backend_jax.expand_one_level)(
+                planes, mask, cw[:, l], ccl[:, l], ccr[:, l]
+            )
+    if use_pallas and planes.shape[2] >= 256:
+        hashed = aes_pallas.hash_value_planes_pallas_batched(planes)
+    else:
+        hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
+    blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
+    ctrlb = jax.vmap(backend_jax.unpack_mask_device)(mask)
+    fn = functools.partial(
+        evaluator._correct_values,
+        bits=bits, party=party, xor_group=xor_group,
+    )
+    vals = jax.vmap(fn)(blocks, ctrlb, vc)  # [K, lanes, epb, lpe]
+    flat = vals.reshape(k, -1, vals.shape[-1])
+    out = flat[:, gsel]
+    new_seeds = jax.vmap(aes_jax.unpack_from_planes)(planes)
+    new_control = jax.vmap(backend_jax.unpack_mask_device)(mask)
+    return out, new_seeds, new_control
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -435,40 +480,13 @@ def _fused_advance_jit(
     reorder dispatches at all; intermediate state stays in expansion (lane)
     order and only the exit state is leaf-ordered (for the resumable
     BatchedContext)."""
-    if use_pallas:
-        from . import aes_pallas
-
-    k = seeds.shape[0]
     outs = []
     for d, (pos, cw, ccl, ccr, vc, gsel) in enumerate(step_args):
-        s = seeds[:, pos]  # [K, Np_pad, 4]
-        c = control[:, pos]
-        mask = _pack_mask_device(c)
-        planes = jax.vmap(aes_jax.pack_to_planes)(s)
-        for l in range(meta[d]):
-            if use_pallas and planes.shape[2] >= 8:
-                planes, mask = aes_pallas.expand_one_level_pallas_batched(
-                    planes, mask, cw[:, l], ccl[:, l], ccr[:, l]
-                )
-            else:
-                planes, mask = jax.vmap(backend_jax.expand_one_level)(
-                    planes, mask, cw[:, l], ccl[:, l], ccr[:, l]
-                )
-        if use_pallas and planes.shape[2] >= 256:
-            hashed = aes_pallas.hash_value_planes_pallas_batched(planes)
-        else:
-            hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
-        blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)
-        ctrlb = jax.vmap(backend_jax.unpack_mask_device)(mask)
-        fn = functools.partial(
-            evaluator._correct_values,
-            bits=bits, party=party, xor_group=xor_group,
+        out, seeds, control = _advance_one_step(
+            seeds, control, pos, cw, ccl, ccr, vc, gsel,
+            meta[d], bits, party, xor_group, use_pallas,
         )
-        vals = jax.vmap(fn)(blocks, ctrlb, vc)  # [K, lanes, epb, lpe]
-        flat = vals.reshape(k, -1, vals.shape[-1])
-        outs.append(flat[:, gsel])
-        seeds = jax.vmap(aes_jax.unpack_from_planes)(planes)
-        control = jax.vmap(backend_jax.unpack_mask_device)(mask)
+        outs.append(out)
     if emit_state:
         # Exit state leaf-ordered (the resumable BatchedContext contract).
         seeds = seeds[:, state_order]
@@ -476,6 +494,84 @@ def _fused_advance_jit(
     # Non-final groups return lane-order state: the next group's first
     # gather is precomposed with this group's lane order on the host.
     return tuple(outs), seeds, control
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "levels", "bits", "party", "xor_group", "use_pallas", "emit_state",
+    ),
+)
+def _fused_advance_scan_jit(
+    seeds,  # uint32[K, L_in, 4] entry state
+    control,  # uint32[K, L_in] 0/1
+    pos,  # int64[G, pad_to] per-step gather positions (padded)
+    cw,  # uint32[G, K, levels, 128]
+    ccl,  # uint32[G, K, levels]
+    ccr,  # uint32[G, K, levels]
+    vc,  # uint32[G, K, epb, lpe]
+    gsel,  # int64[G, out_max] output gathers (padded with 0)
+    state_order,  # int64[...] leaf-order exit gather, or None
+    levels: int,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    use_pallas: bool,
+    emit_state: bool,
+):
+    """Scan form of `_fused_advance_jit` for G steps that all expand the
+    SAME number of tree levels at the SAME padded width: the per-step AES
+    circuits trace once (via the shared `_advance_one_step`) and
+    `lax.scan` drives them, so a 127-step heavy-hitters plan compiles ~G
+    smaller circuits instead of ~2G per group. The scan carry is the
+    lane-order state at exactly the chunk's expansion width
+    (pad_to << levels); an entry state of a different width is handled
+    outside the scan — padded up when narrower, or consumed by running
+    step 0 unrolled when wider (so a shrinking prefix set doesn't drag
+    the wide state through every iteration)."""
+    k = seeds.shape[0]
+    pad_to = pos.shape[1]
+    exp_w = pad_to << levels
+
+    def body(carry, xs):
+        seeds, control = carry
+        pos_d, cw_d, ccl_d, ccr_d, vc_d, gsel_d = xs
+        out, new_seeds, new_control = _advance_one_step(
+            seeds, control, pos_d, cw_d, ccl_d, ccr_d, vc_d, gsel_d,
+            levels, bits, party, xor_group, use_pallas,
+        )
+        return (new_seeds, new_control), out
+
+    out0 = None
+    if seeds.shape[1] > exp_w:
+        # Wide entry state: run step 0 unrolled; the carry then starts at
+        # the chunk's own width.
+        out0, seeds, control = _advance_one_step(
+            seeds, control, pos[0], cw[0], ccl[0], ccr[0], vc[0], gsel[0],
+            levels, bits, party, xor_group, use_pallas,
+        )
+        pos, cw, ccl, ccr, vc, gsel = (
+            a[1:] for a in (pos, cw, ccl, ccr, vc, gsel)
+        )
+    elif seeds.shape[1] < exp_w:
+        seeds = jnp.concatenate(
+            [seeds, jnp.zeros((k, exp_w - seeds.shape[1], 4), jnp.uint32)],
+            axis=1,
+        )
+        control = jnp.concatenate(
+            [control, jnp.zeros((k, exp_w - control.shape[1]), jnp.uint32)],
+            axis=1,
+        )
+
+    (seeds, control), outs = jax.lax.scan(
+        body, (seeds, control), (pos, cw, ccl, ccr, vc, gsel)
+    )
+    if out0 is not None:
+        outs = jnp.concatenate([out0[None], outs], axis=0)
+    if emit_state:
+        seeds = seeds[:, state_order]
+        control = control[:, state_order]
+    return outs, seeds, control
 
 
 def evaluate_levels_fused(
@@ -527,14 +623,13 @@ def evaluate_levels_fused(
     batch = evaluator.KeyBatch.from_keys(dpf, ctx.keys, plan[-1][0])
     cw_all, ccl_all, ccr_all = batch.device_cw_arrays(0)
 
-    # Virtual context walk (host): build per-step tables.
+    # Pass 1 — virtual context walk (host): raw per-step tables, BEFORE
+    # lane-order composition (which depends on each step's padded width,
+    # chosen by the grouping pass below).
     prev_level = ctx.previous_hierarchy_level
     parent_tree = ctx.parent_tree
     child_levels = ctx.child_levels
-    # Lane-order map of the state the NEXT step gathers from: None = state
-    # is already leaf-ordered (the resumable ctx state at entry).
-    prev_order = None
-    steps = []  # (pos_pad, levels_d, vc, gsel, start_level)
+    raw = []  # (positions, num_parents, levels_d, sel, keep, epb, vc, start)
     for (h, prefixes) in plan:
         if h <= prev_level:
             raise InvalidArgumentError(
@@ -574,20 +669,12 @@ def evaluate_levels_fused(
                 "Output size would be larger than 2**62. Please evaluate "
                 "fewer hierarchy levels at once."
             )
-        # Compose with the lane order of the state being gathered from.
-        if prev_order is not None:
-            positions = prev_order[positions]
         num_parents = positions.shape[0]
-        pad_to = max(32, -(-num_parents // 32) * 32)
-        pos_pad = np.zeros(pad_to, dtype=np.int64)
-        pos_pad[:num_parents] = positions
-        order_d = backend_jax.expansion_output_order(
-            num_parents, pad_to, levels_d
-        )
         epb = v.parameters[h].value_type.elements_per_block()
         # Output selection in this level's element space (block-bit
-        # sharing across tree prefixes), then composed with the lane order:
-        # element E -> lane order_d[E // keep] -> flat = lane * epb + E % keep.
+        # sharing across tree prefixes); composed with the lane order in
+        # pass 2: element E -> lane order_d[E // keep], flat = lane * epb
+        # + E % keep.
         if prev_level >= 0 and (prev_lds - start_level):
             shift = prev_lds - start_level
             opp = 1 << (lds - prev_lds)
@@ -603,15 +690,76 @@ def evaluate_levels_fused(
             sel = (starts[:, None] + np.arange(opp, dtype=np.int64)).reshape(-1)
         else:
             sel = np.arange((num_parents << levels_d) * keep, dtype=np.int64)
-        gsel = order_d[sel // keep] * epb + (sel % keep)
         vc = _level_value_corrections(ctx.keys, v, h, bits)
-        steps.append((pos_pad, levels_d, vc, gsel, start_level))
+        raw.append(
+            (positions, num_parents, levels_d, sel, keep, epb, vc, start_level)
+        )
         # Advance the virtual context.
         prev_level = h
         parent_tree = (
             tree if tree is not None else np.zeros(1, dtype=np.uint64)
         )
         child_levels = levels_d
+
+    # Grouping: greedy runs capped at `group`. A run of >= 4 steps with one
+    # common levels_d becomes a SCAN chunk — padded to one width so the AES
+    # circuits trace ONCE per chunk via lax.scan instead of once per level
+    # (compile time is the practical bound on deep hierarchies; the
+    # heavy-hitters plan is ~127 consecutive 1-level advances).
+    chunks = []  # (kind, [step indices], pad_to or None)
+    i = 0
+    while i < len(raw):
+        lv = raw[i][2]
+        j = i
+        while (
+            j < len(raw) and raw[j][2] == lv and j - i < group
+        ):
+            j += 1
+        idx = list(range(i, j))
+        if len(idx) >= 4:
+            pad_to = max(
+                max(32, -(-raw[t][1] // 32) * 32) for t in idx
+            )
+            chunks.append(("scan", idx, pad_to))
+        else:
+            chunks.append(("unroll", idx, None))
+        i = j
+    # Merge adjacent unroll chunks up to `group` (runs shorter than the
+    # scan threshold should still share a program).
+    merged_chunks = []
+    for kind, idx, pad in chunks:
+        if (
+            kind == "unroll"
+            and merged_chunks
+            and merged_chunks[-1][0] == "unroll"
+            and len(merged_chunks[-1][1]) + len(idx) <= group
+        ):
+            merged_chunks[-1] = ("unroll", merged_chunks[-1][1] + idx, None)
+        else:
+            merged_chunks.append((kind, idx, pad))
+    chunks = merged_chunks
+
+    # Pass 2 — compose gather positions with each previous step's lane
+    # order and build the padded device tables.
+    prev_order = None
+    steps = []  # (pos_pad, levels_d, vc, gsel, start_level)
+    pad_by_step = {}
+    for kind, idx, pad in chunks:
+        for t in idx:
+            pad_by_step[t] = pad
+    for t, (positions, num_parents, levels_d, sel, keep, epb, vc, start) in (
+        enumerate(raw)
+    ):
+        if prev_order is not None:
+            positions = prev_order[positions]
+        pad_to = pad_by_step[t] or max(32, -(-num_parents // 32) * 32)
+        pos_pad = np.zeros(pad_to, dtype=np.int64)
+        pos_pad[:num_parents] = positions
+        order_d = backend_jax.expansion_output_order(
+            num_parents, pad_to, levels_d
+        )
+        gsel = order_d[sel // keep] * epb + (sel % keep)
+        steps.append((pos_pad, levels_d, vc, gsel, start))
         prev_order = order_d
 
     # Entry state.
@@ -630,9 +778,49 @@ def evaluate_levels_fused(
     emit_state = final_level < v.num_hierarchy_levels - 1
     outs_all = []
     seeds, control = seeds0, control0
-    for g0 in range(0, len(steps), group):
-        chunk = steps[g0 : g0 + group]
-        last_in_run = g0 + len(chunk) == len(steps)
+    for ci, (kind, idx, pad) in enumerate(chunks):
+        chunk = [steps[t] for t in idx]
+        last_in_run = ci == len(chunks) - 1
+        emit = emit_state and last_in_run
+        so = jnp.asarray(prev_order) if emit else None
+        if kind == "scan":
+            lv = chunk[0][1]
+            out_lens = [len(g) for (_, _, _, g, _) in chunk]
+            out_max = max(out_lens)
+            gsel_pad = np.zeros((len(chunk), out_max), dtype=np.int64)
+            for gi, (_, _, _, g, _) in enumerate(chunk):
+                gsel_pad[gi, : len(g)] = g
+            outs, seeds, control = _fused_advance_scan_jit(
+                seeds,
+                control,
+                jnp.asarray(np.stack([p for (p, _, _, _, _) in chunk])),
+                jnp.asarray(
+                    np.stack(
+                        [cw_all[:, s : s + lv] for (_, _, _, _, s) in chunk]
+                    )
+                ),
+                jnp.asarray(
+                    np.stack(
+                        [ccl_all[:, s : s + lv] for (_, _, _, _, s) in chunk]
+                    )
+                ),
+                jnp.asarray(
+                    np.stack(
+                        [ccr_all[:, s : s + lv] for (_, _, _, _, s) in chunk]
+                    )
+                ),
+                jnp.asarray(np.stack([c for (_, _, c, _, _) in chunk])),
+                jnp.asarray(gsel_pad),
+                so,
+                levels=lv,
+                bits=bits,
+                party=batch.party,
+                xor_group=xor_group,
+                use_pallas=use_pallas,
+                emit_state=emit,
+            )
+            outs_all.extend(o[:, :n] for o, n in zip(outs, out_lens))
+            continue
         step_args = tuple(
             (
                 jnp.asarray(pos),
@@ -649,13 +837,13 @@ def evaluate_levels_fused(
             seeds,
             control,
             step_args,
-            jnp.asarray(prev_order) if (emit_state and last_in_run) else None,
+            so,
             meta=meta,
             bits=bits,
             party=batch.party,
             xor_group=xor_group,
             use_pallas=use_pallas,
-            emit_state=emit_state and last_in_run,
+            emit_state=emit,
         )
         outs_all.extend(outs)
 

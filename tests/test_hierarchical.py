@@ -90,6 +90,71 @@ def test_levels_fused_matches_per_level():
     np.testing.assert_array_equal(np.asarray(out_fused), np.asarray(out_ref))
 
 
+def test_levels_fused_scan_chunks_match_per_level():
+    """Heavy-hitters-shaped plan (a run of >= 4 equal 1-level advances)
+    takes the lax.scan chunk path (uniform padded width, circuits traced
+    once per chunk); outputs and the resumable state must equal the
+    per-level path exactly."""
+    levels = 9
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(0x155, [7] * levels)
+    rng = np.random.default_rng(5)
+    finals = sorted({int(x) for x in rng.integers(0, 1 << levels, size=40)})
+    pres = [
+        sorted({f >> (levels - (i + 1)) for f in finals})
+        for i in range(levels)
+    ]
+    plan = [(0, [])] + [(i, pres[i - 1]) for i in range(1, levels)]
+
+    bc_ref = hierarchical.BatchedContext.create(dpf, [ka])
+    ref = [hierarchical.evaluate_until_batch(bc_ref, h, p) for h, p in plan]
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    # group=4 forces multiple scan chunks plus the lone level-0 unroll.
+    got = hierarchical.evaluate_levels_fused(
+        bc, plan, group=4, use_pallas=False
+    )
+    assert len(got) == len(ref)
+    for d, (g, r) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(r), err_msg=f"level {d}"
+        )
+    # Both contexts are exhausted at the last hierarchy level.
+    assert bc.previous_hierarchy_level == bc_ref.previous_hierarchy_level
+    assert bc.seeds is None and bc_ref.seeds is None
+
+
+def test_levels_fused_scan_pruned_prefixes():
+    """Heavy-hitters pruning: the prefix set SHRINKS sharply mid-plan, so a
+    scan chunk's entry state is wider than its own expansion width — the
+    step-0-unrolled branch of _fused_advance_scan_jit."""
+    levels = 13
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(0x2AA, [3] * levels)
+    rng = np.random.default_rng(8)
+    finals = sorted({int(x) for x in rng.integers(0, 1 << levels, size=600)})
+    survivors = finals[:3]  # pruned after step 8 (a group boundary at 4)
+    plan = [(0, [])]
+    for i in range(1, levels):
+        src = finals if i <= 8 else survivors
+        plan.append((i, sorted({f >> (levels - i) for f in src})))
+    # The pruned steps 9..12 form a 4-step scan chunk (pad 32, expansion
+    # width 64) entered from the ~512-lane state of steps 5..8 — the
+    # wide-entry step-0-unrolled branch.
+
+    bc_ref = hierarchical.BatchedContext.create(dpf, [ka])
+    ref = [hierarchical.evaluate_until_batch(bc_ref, h, p) for h, p in plan]
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    got = hierarchical.evaluate_levels_fused(
+        bc, plan, group=4, use_pallas=False
+    )
+    for d, (g, r) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(r), err_msg=f"level {d}"
+        )
+
+
 def test_levels_fused_rejects_misuse():
     params = [DpfParameters(d, Int(64)) for d in (3, 6)]
     dpf = DistributedPointFunction.create_incremental(params)

@@ -17,7 +17,7 @@ stage() {
   local name="$1"; shift
   local tmo="$1"; shift
   echo "--- stage $name (timeout ${tmo}s) ---" | tee -a "$log"
-  timeout "$tmo" "$@" 2>&1 | tail -40 | tee -a "$log"
+  timeout -k 60 "$tmo" "$@" 2>&1 | tail -40 | tee -a "$log"
   local rc=${PIPESTATUS[0]}
   echo "--- stage $name rc=$rc ---" | tee -a "$log"
   return 0  # stages are independent; failures are visible in the log
