@@ -155,6 +155,43 @@ def test_levels_fused_scan_pruned_prefixes():
         )
 
 
+@pytest.mark.slow
+def test_levels_fused_u128_prefix_regime():
+    """Domains >= 64 bits use the vectorized-U128 prefix bookkeeping
+    (structured hi/lo arrays) in _positions_for_prefixes; the fused path
+    must agree with the per-level path there too (the 128-level
+    heavy-hitters bench crosses this boundary at level 63)."""
+    # Levels straddle the uint64 -> U128 boundary (>= 64-bit domains) with
+    # small gaps so per-level expansions stay tiny.
+    domains = [8, 20, 32, 44, 56, 62, 63, 64, 65, 66]
+    params = [DpfParameters(d, Int(64)) for d in domains]
+    dpf = DistributedPointFunction.create_incremental(params)
+    rng = np.random.default_rng(21)
+    alpha = (int(rng.integers(0, 1 << 59)) << 7) | 0x55
+    ka, _ = dpf.generate_keys_incremental(alpha, [9] * len(domains))
+
+    # Entry i's prefixes live at level i-1's domain: follow the alpha path
+    # plus its sibling (both children of the previous entry's alpha prefix,
+    # hence always evaluated).
+    D = domains[-1]
+    plan = [(0, [])]
+    for i in range(1, len(domains)):
+        ap = alpha >> (D - domains[i - 1])  # alpha's prefix at level i-1
+        cand = sorted({ap, ap ^ 1} | ({3} if i == 1 else set()))
+        plan.append((i, cand))
+
+    bc_ref = hierarchical.BatchedContext.create(dpf, [ka])
+    ref = [hierarchical.evaluate_until_batch(bc_ref, h, p) for h, p in plan]
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    got = hierarchical.evaluate_levels_fused(
+        bc, plan, group=4, use_pallas=False
+    )
+    for d, (g, r) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(r), err_msg=f"level {d}"
+        )
+
+
 def test_levels_fused_rejects_misuse():
     params = [DpfParameters(d, Int(64)) for d in (3, 6)]
     dpf = DistributedPointFunction.create_incremental(params)
