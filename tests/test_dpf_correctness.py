@@ -97,7 +97,9 @@ def random_value(vt):
     raise TypeError(vt)
 
 
-@pytest.mark.parametrize("level_step", [1, 2, 3, 5])
+@pytest.mark.parametrize(
+    "level_step", [1, 2, 3, 5, pytest.param(7, marks=pytest.mark.slow)]
+)
 def test_incremental_hierarchy_prefixes(level_step):
     log_domains = list(range(level_step, 10 + 1, level_step))
     params = [DpfParameters(ld, Int(64)) for ld in log_domains]
