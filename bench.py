@@ -483,9 +483,20 @@ def main() -> None:
             # Parent: device attempt in a killable subprocess; every CPU
             # run happens HERE, outside the killable window, so a slow
             # comparison can never discard a verified device measurement.
-            parsed = _run_device_subprocess(
-                platform, float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
-            )
+            # When every probe failed ("default"), the attempt most likely
+            # hangs at backend init — bound it tighter so the CPU fallback
+            # still lands well inside the driver's budget.
+            configured = float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
+            if platform != "default":
+                attempt_timeout = configured
+            else:
+                # Never exceed an explicitly configured device budget.
+                attempt_timeout = float(
+                    os.environ.get(
+                        "BENCH_TPU_TIMEOUT_UNPROBED", min(900.0, configured)
+                    )
+                )
+            parsed = _run_device_subprocess(platform, attempt_timeout)
             if parsed is not None and "error" not in parsed:
                 result = parsed
                 # The framework also ships the native AES-NI host engine
