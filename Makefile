@@ -1,0 +1,25 @@
+# Convenience targets over the CI script and benchmark suite.
+# The analog of the reference's `bazel test //...` entry point
+# (/root/reference/.bazelci/presubmit.yml); ci.sh holds the tier logic.
+
+.PHONY: test slow smoke device ci bench headline
+
+test:            ## fast tier: default pytest suite (CPU, virtual 8-device mesh)
+	./ci.sh fast
+
+slow:            ## weekly tier: full suite incl. --runslow parametrizations
+	./ci.sh slow
+
+smoke:           ## application smokes: experiments CLI + both demos
+	./ci.sh smoke
+
+device:          ## on-chip differential checks (requires a reachable TPU)
+	./ci.sh device
+
+ci: test smoke   ## what presubmit runs
+
+bench:           ## full benchmark suite -> benchmarks/results.json
+	python benchmarks/run_all.py
+
+headline:        ## the driver's headline metric (one JSON line)
+	python bench.py
