@@ -265,6 +265,29 @@ def expand_one_level_pallas_rows(
     return out_planes[0], out_control[0]
 
 
+def _expand_child_rows(planes_ref, control_ref, cw_ref, cc_ref, rk_base, rk_diff):
+    """Shared expand-child body for the batched row kernels: reads the refs,
+    selects this grid step's child key by mask, runs the masked AES, applies
+    seed/control corrections. Returns (h rows with h[0] zeroed, control)."""
+    child = pl.program_id(0)
+    c = control_ref[0, 0, :]
+    w = c.shape[0]
+    key_mask = jnp.broadcast_to(
+        jnp.where(child == 0, jnp.uint32(0), jnp.uint32(0xFFFFFFFF)), (w,)
+    )
+    x = [planes_ref[0, p, :] for p in range(128)]
+    sig = [x[64 + p] for p in range(64)] + [
+        x[64 + p] ^ x[p] for p in range(64)
+    ]
+    enc = _aes_rows(sig, rk_base, rk_diff, key_mask)
+    h = [enc[p] ^ sig[p] for p in range(128)]
+    h = [h[p] ^ (cw_ref[0, p, 0] & c) for p in range(128)]
+    cc = jnp.where(child == 0, cc_ref[0, 0, 0], cc_ref[0, 0, 1])
+    new_control = h[0] ^ (c & cc)
+    h[0] = jnp.zeros_like(h[0])
+    return h, new_control
+
+
 def _expand_kernel_rows_batched(rk_base, rk_diff):
     """Key-batched row kernel: grid (2, K, W//bw); per-key correction words
     and control-correction masks come from refs indexed by the key axis."""
@@ -277,22 +300,9 @@ def _expand_kernel_rows_batched(rk_base, rk_diff):
         out_planes_ref,  # uint32[1, 128, bw]
         out_control_ref,  # uint32[1, 1, bw]
     ):
-        child = pl.program_id(0)
-        c = control_ref[0, 0, :]
-        w = c.shape[0]
-        key_mask = jnp.broadcast_to(
-            jnp.where(child == 0, jnp.uint32(0), jnp.uint32(0xFFFFFFFF)), (w,)
+        h, new_control = _expand_child_rows(
+            planes_ref, control_ref, cw_ref, cc_ref, rk_base, rk_diff
         )
-        x = [planes_ref[0, p, :] for p in range(128)]
-        sig = [x[64 + p] for p in range(64)] + [
-            x[64 + p] ^ x[p] for p in range(64)
-        ]
-        enc = _aes_rows(sig, rk_base, rk_diff, key_mask)
-        h = [enc[p] ^ sig[p] for p in range(128)]
-        h = [h[p] ^ (cw_ref[0, p, 0] & c) for p in range(128)]
-        cc = jnp.where(child == 0, cc_ref[0, 0, 0], cc_ref[0, 0, 1])
-        new_control = h[0] ^ (c & cc)
-        h[0] = jnp.zeros_like(h[0])
         for p in range(128):
             out_planes_ref[0, p, :] = h[p]
         out_control_ref[0, 0, :] = new_control
@@ -313,22 +323,35 @@ def expand_one_level_pallas_batched(
     """Batched row-kernel twin of vmap(backend_jax.expand_one_level):
     identical outputs/layout ([K, 128, 2W] with children block-concatenated
     along the lane-word axis)."""
+    kernel = _expand_kernel_rows_batched(
+        backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff")
+    )
+    return _run_expand_blocked(
+        kernel, planes, control, cw_plane, ccl_mask, ccr_mask,
+        block_w, interpret,
+    )
+
+
+def _run_expand_blocked(
+    kernel, planes, control, cw_plane, ccl_mask, ccr_mask, block_w, interpret
+):
+    """Shared pallas_call scaffolding for the child-doubling kernels
+    (plain expand and fused expand+hash): block plan, lane padding, the
+    (2, K, blocks) grid with children block-concatenated along the output
+    lane axis, and the pad trim/re-concat. The kernel decides WHAT the
+    per-child outputs are (planes or hashed planes)."""
     k, _, w = planes.shape
     bw, wp = _block_plan(w, block_w)
     if wp != w:
         (planes, control), _ = _pad_lane_words((planes, control), w, bw)
-    kernel = _expand_kernel_rows_batched(
-        backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff")
-    )
     nblk = wp // bw
-    grid = (2, k, nblk)
-    out_planes, out_control = pl.pallas_call(
+    out_main, out_control = pl.pallas_call(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct((k, 128, 2 * wp), jnp.uint32),
             jax.ShapeDtypeStruct((k, 1, 2 * wp), jnp.uint32),
         ),
-        grid=grid,
+        grid=(2, k, nblk),
         in_specs=[
             pl.BlockSpec((1, 128, bw), lambda i, kk, j: (kk, 0, j)),
             pl.BlockSpec((1, 1, bw), lambda i, kk, j: (kk, 0, j)),
@@ -353,13 +376,70 @@ def expand_one_level_pallas_batched(
     if wp != w:
         # Children live at [0:wp] / [wp:2wp]; re-concatenate the real lanes
         # so the caller sees the unpadded [left | right] layout.
-        out_planes = jnp.concatenate(
-            [out_planes[:, :, :w], out_planes[:, :, wp : wp + w]], axis=2
+        out_main = jnp.concatenate(
+            [out_main[:, :, :w], out_main[:, :, wp : wp + w]], axis=2
         )
         out_control = jnp.concatenate(
             [out_control[:, :, :w], out_control[:, :, wp : wp + w]], axis=2
         )
-    return out_planes, out_control[:, 0, :]
+    return out_main, out_control[:, 0, :]
+
+
+def _expand_hash_kernel_rows_batched(rk_base, rk_diff, rk_value):
+    """Fused LAST-level kernel: one doubling expansion child + its value
+    hash in a single kernel, emitting only the hashed planes and the new
+    control row. In the fold path the final level's child planes are read
+    exactly once (by the value hash) and then discarded, so fusing removes
+    a full HBM write+read of the widest planes — the single largest memory
+    op of a doubling expansion (the last level is half of all lanes)."""
+
+    def kernel(
+        planes_ref,  # uint32[1, 128, bw]
+        control_ref,  # uint32[1, 1, bw]
+        cw_ref,  # uint32[1, 128, 1]
+        cc_ref,  # uint32[1, 1, 2]
+        out_hashed_ref,  # uint32[1, 128, bw]
+        out_control_ref,  # uint32[1, 1, bw]
+    ):
+        h, new_control = _expand_child_rows(
+            planes_ref, control_ref, cw_ref, cc_ref, rk_base, rk_diff
+        )
+        # Value hash of the child seed, chained in-register.
+        sig2 = [h[64 + p] for p in range(64)] + [
+            h[64 + p] ^ h[p] for p in range(64)
+        ]
+        enc2 = _aes_rows(sig2, rk_value, None, None)
+        for p in range(128):
+            out_hashed_ref[0, p, :] = enc2[p] ^ sig2[p]
+        out_control_ref[0, 0, :] = new_control
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("block_w", "interpret"))
+def expand_and_hash_last_level_pallas_batched(
+    planes: jnp.ndarray,  # uint32[K, 128, W]
+    control: jnp.ndarray,  # uint32[K, W]
+    cw_plane: jnp.ndarray,  # uint32[K, 128]
+    ccl_mask: jnp.ndarray,  # uint32[K]
+    ccr_mask: jnp.ndarray,  # uint32[K]
+    block_w: int = 2048,
+    interpret: bool = False,
+):
+    """Fused twin of expand_one_level_pallas_batched followed by
+    hash_value_planes_pallas_batched on its output: returns
+    (hashed uint32[K, 128, 2W], control uint32[K, 2W]) — child planes are
+    never materialized in HBM. Bit-identical to the two-kernel
+    composition (the kernel body chains the same two circuits)."""
+    kernel = _expand_hash_kernel_rows_batched(
+        backend_jax._rk_np("left"),
+        backend_jax._rk_np("lr_diff"),
+        backend_jax._rk_np("value"),
+    )
+    return _run_expand_blocked(
+        kernel, planes, control, cw_plane, ccl_mask, ccr_mask,
+        block_w, interpret,
+    )
 
 
 def _value_hash_kernel_rows(rk_value):

@@ -511,7 +511,10 @@ def _walk_chunk_jit(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("levels", "bits", "party", "xor_group", "keep", "use_pallas"),
+    static_argnames=(
+        "levels", "bits", "party", "xor_group", "keep", "use_pallas",
+        "fuse_last_hash",
+    ),
 )
 def _fused_fold_chunk_jit(
     seeds,  # uint32[K, M, 4]
@@ -527,6 +530,7 @@ def _fused_fold_chunk_jit(
     xor_group: bool,
     keep: int,
     use_pallas: bool = False,
+    fuse_last_hash: bool = False,
 ):
     """Fused expansion with an IN-PROGRAM consumer: every value is
     materialized in HBM (optimization_barrier below forces the buffer) and
@@ -539,12 +543,33 @@ def _fused_fold_chunk_jit(
     chunks (vs 58.2 M for the out-of-program fold at its 14-key output
     cap) with no output-size limit at any domain."""
     planes, control = _pack_batch_jit(seeds, control_mask)
-    for level in range(levels):
+    # Same width gate as the separate-hash path: the Mosaic kernels want
+    # >= 256 lane words (the fused kernel's input width is the LAST
+    # level's input, i.e. half the output width the hash gate sees).
+    fuse_last = (
+        fuse_last_hash
+        and use_pallas
+        and levels >= 1
+        and (planes.shape[2] << (levels - 1)) >= 128
+    )
+    expand_levels = levels - 1 if fuse_last else levels
+    for level in range(expand_levels):
         planes, control = _expand_level(
             planes, control, cw_planes[:, level], ccl[:, level], ccr[:, level],
             use_pallas,
         )
-    if use_pallas and planes.shape[2] >= 256:
+    if fuse_last:
+        # Final level + value hash in ONE Mosaic kernel: the last level's
+        # child planes (half of all lanes) never round-trip through HBM
+        # (opt-in via DPF_TPU_FUSE_LAST_HASH; fold mode discards the
+        # expansion state, so only hashed planes + control are needed).
+        from . import aes_pallas
+
+        hashed, control = aes_pallas.expand_and_hash_last_level_pallas_batched(
+            planes, control,
+            cw_planes[:, levels - 1], ccl[:, levels - 1], ccr[:, levels - 1],
+        )
+    elif use_pallas and planes.shape[2] >= 256:
         from . import aes_pallas
 
         hashed = aes_pallas.hash_value_planes_pallas_batched(planes)
@@ -642,6 +667,7 @@ def full_domain_fold_chunks(
     if db_lane is not None:
         db_dev = jnp.asarray(db_lane)
 
+    fuse_last_hash = _env_bool("DPF_TPU_FUSE_LAST_HASH", default=False)
     for kb, valid in _key_chunks(batch, num_keys, key_chunk):
         k = kb.seeds.shape[0]
         control0 = np.full(k, bool(kb.party), dtype=bool)
@@ -661,6 +687,7 @@ def full_domain_fold_chunks(
             xor_group=xor_group,
             keep=keep,
             use_pallas=use_pallas,
+            fuse_last_hash=fuse_last_hash,
         )
 
 
@@ -685,21 +712,30 @@ def _walk_chunk_codec_jit(
     return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
 
 
+def _env_bool(name: str, default: bool = False) -> bool:
+    """Boolean env flag with STRICT parsing: unrecognized values raise
+    instead of silently picking a side (a typo in an A/B benchmark flag
+    must not measure the same path twice)."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    low = env.strip().lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off", ""):
+        return False
+    raise InvalidArgumentError(
+        f"{name} must be a boolean-ish value, got {env!r}"
+    )
+
+
 def _pallas_default() -> bool:
     """Resolves the Mosaic-kernel default: DPF_TPU_PALLAS when set
     (1/true/yes/on vs 0/false/no/off), else ON exactly for real TPU
     backends (PERF.md "Pallas vs XLA bitslice" — ~12x; CPU/interpret
     platforms keep the XLA path)."""
-    env = os.environ.get("DPF_TPU_PALLAS")
-    if env is not None:
-        low = env.strip().lower()
-        if low in ("1", "true", "yes", "on"):
-            return True
-        if low in ("0", "false", "no", "off", ""):
-            return False
-        raise InvalidArgumentError(
-            f"DPF_TPU_PALLAS must be a boolean-ish value, got {env!r}"
-        )
+    if "DPF_TPU_PALLAS" in os.environ:
+        return _env_bool("DPF_TPU_PALLAS")
     return jax.default_backend() == "tpu"
 
 

@@ -128,6 +128,35 @@ def test_batched_value_hash_plumbing_interpret(cheap_rows, k, w, bw):
         np.testing.assert_array_equal(got[i], _CheapRows.np_hash(planes[i], None))
 
 
+@pytest.mark.parametrize("k,w,bw", [(2, 32, 32), (1, 37, 32)])
+def test_fused_expand_hash_matches_composition_interpret(cheap_rows, k, w, bw):
+    """expand_and_hash_last_level_pallas_batched == expand kernel followed
+    by the value-hash kernel, bit for bit (same stand-in circuit in both
+    paths), incl. the pad-and-trim route."""
+    rng = np.random.default_rng(14)
+    planes = jnp.asarray(rng.integers(0, 2**32, size=(k, 128, w), dtype=np.uint32))
+    control = jnp.asarray(rng.integers(0, 2**32, size=(k, w), dtype=np.uint32))
+    cw = jnp.asarray(rng.integers(0, 2**32, size=(k, 128), dtype=np.uint32))
+    full = np.uint32(0xFFFFFFFF)
+    ccl = jnp.asarray(
+        (rng.integers(0, 2, size=k, dtype=np.uint32) * full).astype(np.uint32)
+    )
+    ccr = jnp.asarray(
+        (rng.integers(0, 2, size=k, dtype=np.uint32) * full).astype(np.uint32)
+    )
+    got_h, got_c = aes_pallas.expand_and_hash_last_level_pallas_batched(
+        planes, control, cw, ccl, ccr, block_w=bw, interpret=True
+    )
+    exp_p, exp_c = aes_pallas.expand_one_level_pallas_batched(
+        planes, control, cw, ccl, ccr, block_w=bw, interpret=True
+    )
+    want_h = aes_pallas.hash_value_planes_pallas_batched(
+        exp_p, block_w=bw, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(got_h), np.asarray(want_h))
+    np.testing.assert_array_equal(np.asarray(got_c), np.asarray(exp_c))
+
+
 @pytest.mark.parametrize(
     "k,w,bw,levels",
     [

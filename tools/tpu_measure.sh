@@ -23,10 +23,14 @@ stage() {
   return 0  # stages are independent; failures are visible in the log
 }
 
-# 1. On-chip correctness: round-3 paths + the fold headline family.
+# 1. On-chip correctness: round-3 paths + the fold headline family,
+# including the opt-in fused last-level+value-hash kernel (A/B it:
+# verified first, then bench.py can be rerun with the flag to compare).
 CHECK_EXTRAS=all stage extras 1800 python tools/check_device.py
 CHECK_MODE=fold CHECK_PALLAS=1 CHECK_SHAPES=128x20 \
   stage fold-pallas 1800 python tools/check_device.py
+DPF_TPU_FUSE_LAST_HASH=1 CHECK_MODE=fold CHECK_PALLAS=1 CHECK_SHAPES=128x20 \
+  stage fold-fused-hash 1800 python tools/check_device.py
 
 # 2. Full benchmark suite (TPU records; merge keeps full-size CPU records).
 # run_all includes the bench_headline wrapper, so results.json gets the
@@ -35,8 +39,9 @@ stage suite 14400 python benchmarks/run_all.py
 
 # 3. The headline bench.py itself — a dress rehearsal of exactly what the
 # driver runs for BENCH_r03.json (cheap after the suite warmed the
-# compilation cache).
+# compilation cache) — then the fused-last-hash A/B.
 stage headline 2600 python bench.py
+DPF_TPU_FUSE_LAST_HASH=1 stage headline-fused-hash 2600 python bench.py
 
 # 4. Experiments device runs (hierarchical fused + direct) on dist-1 data.
 if [ ! -f experiments/data/32_1048576_1048576_0.1.csv ]; then
