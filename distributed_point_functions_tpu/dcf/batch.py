@@ -319,12 +319,16 @@ def batch_evaluate(
 
     seeds = np.broadcast_to(batch.seeds[:, None, :], (k, p_pad, 4)).copy()
     control0 = aes_jax.pack_bit_mask(np.full(p_pad, bool(batch.party), dtype=bool))
+    explicit_pallas = use_pallas is True
     if use_pallas is None:
         use_pallas = evaluator._pallas_default()
-    if p_pad // 32 < 8 and not interpret:
+    if p_pad // 32 < 8 and not interpret and not explicit_pallas:
         # Narrow point batches (< 256 points -> < 8 lane words) would hand
         # the walk kernel near-degenerate blocks; the XLA scan driver is
-        # the right engine there (r3 review).
+        # the right engine there (r3 review). Only the platform DEFAULT is
+        # downgraded — an explicit use_pallas=True (e.g. CHECK_PALLAS=1
+        # verifying the Mosaic driver) must actually run the kernel it
+        # claims to verify (ADVICE r3).
         use_pallas = False
     if use_pallas:
         out = _dcf_batch_pallas_jit(

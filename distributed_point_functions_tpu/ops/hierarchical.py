@@ -580,6 +580,7 @@ def evaluate_levels_fused(
     group: int = 16,
     device_output: bool = False,
     use_pallas: Optional[bool] = None,
+    mesh=None,
 ) -> list:
     """Advances through MANY hierarchy levels with the per-level prefix sets
     known upfront — the heavy-hitters / experiments access pattern
@@ -596,6 +597,12 @@ def evaluate_levels_fused(
     evaluate_until_batch once per entry, and the context ends in the same
     resumable state. Scalar Int/XorWrapper value types only.
 
+    With a (keys, domain) `mesh`, the KEY axis shards over the mesh's
+    'keys' axis (data-parallel: the fused per-group programs are
+    elementwise over keys, so XLA propagates the sharding from the entry
+    state with zero collectives; gather tables replicate). The key count
+    must divide evenly over the 'keys' axis.
+
     Returns the per-entry value arrays: uint32[K, n_outputs, lpe] each
     (numpy unless device_output).
     """
@@ -603,6 +610,18 @@ def evaluate_levels_fused(
 
     dpf, v = ctx.dpf, ctx.dpf.validator
     k = len(ctx.keys)
+    if group < 1:
+        # group feeds the greedy chunking loop below; 0 would make it spin
+        # forever (BENCH_HH_GROUP / CHECK_HH_GROUP env vars reach here).
+        raise InvalidArgumentError("`group` must be >= 1")
+    if mesh is not None and k % mesh.shape["keys"]:
+        # Decidable up front — don't burn the host table-construction
+        # passes on a call that cannot run.
+        raise InvalidArgumentError(
+            "evaluate_levels_fused with a mesh requires the key count "
+            f"({k}) to divide evenly over the 'keys' axis "
+            f"({mesh.shape['keys']})"
+        )
     if not plan:
         return []
     if use_pallas is None:
@@ -773,6 +792,12 @@ def evaluate_levels_fused(
     else:
         seeds0 = jnp.asarray(ctx.seeds).astype(jnp.uint32)
         control0 = jnp.asarray(ctx.control).astype(jnp.uint32)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        key_sharding = NamedSharding(mesh, PartitionSpec("keys"))
+        seeds0 = jax.device_put(seeds0, key_sharding)
+        control0 = jax.device_put(control0, key_sharding)
 
     final_level = plan[-1][0]
     emit_state = final_level < v.num_hierarchy_levels - 1

