@@ -104,8 +104,32 @@ def bench(jax, smoke):
     dpf, key, prefixes = make_workload(num_levels)
     log(f"{num_levels} levels, {len(prefixes[-1])} unique nonzeros, engine={engine}")
     with Timer() as warm:
-        run_once(dpf, key, prefixes, num_levels)
+        first = run_once(dpf, key, prefixes, num_levels)
     log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    verified = False
+    if engine != "host":
+        # Final-level outputs vs the native host engine (cheap: ~0.25 s/key)
+        # — this tunnel has miscomputed silently before, so a device rate
+        # without an oracle check is not evidence (PERF.md).
+        ctx_h = hierarchical.BatchedContext.create(dpf, [key])
+        for level in range(num_levels):
+            want = hierarchical.evaluate_until_batch(
+                ctx_h,
+                level,
+                () if level == 0 else prefixes[level - 1],
+                engine="host",
+            )
+        got = np.asarray(first)
+        got64 = (
+            got[..., 0].astype(np.uint64)
+            | (got[..., 1].astype(np.uint64) << np.uint64(32))
+        )
+        if not np.array_equal(got64, np.asarray(want)):
+            raise RuntimeError(
+                "device final-level outputs disagree with the host engine"
+            )
+        verified = True
+        log("final-level outputs verified against the host engine")
     with Timer() as t:
         run_once(dpf, key, prefixes, num_levels)
 
@@ -125,7 +149,13 @@ def bench(jax, smoke):
         log(f"level sweep: {sweep}")
 
     return {
-        "bench": "heavy_hitters",
+        # Engine-distinct slots: the fused device record must not clobber
+        # (or be clobbered by) the host-engine record on the same platform
+        # (VERDICT r3 #4: the fused-path proof needs its own dated entry).
+        "bench": (
+            "heavy_hitters" if engine == "host" else f"heavy_hitters_{engine}"
+        ),
+        **({"verified": True} if verified else {}),
         "metric": (
             f"bit-wise hierarchy, {num_levels} levels, "
             f"{num_nonzeros} uniform nonzeros, 1 key"

@@ -104,6 +104,9 @@ def bench(jax, smoke):
     rate = reps * n_blocks / t.elapsed
     return {
         "bench": "intmodn_sample",
+        # The chain is oracle-checked against the wire-exact host sampler
+        # above (raises on mismatch), so the rate is a verified one.
+        "verified": True,
         "metric": (
             f"IntModN<u32, 2^32-5> sampling, {n_blocks} blocks "
             f"(device codec chain, 1 sample/block; host sampler "

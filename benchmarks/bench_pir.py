@@ -9,6 +9,12 @@ Queries run in chunks sized to HBM.
 
 import os
 
+# Opt in to the virtual 8-device CPU platform for the sharded smoke path
+# (must be set before common.init_jax creates the backend). Other benches
+# stay on the 1-device client — the multi-device CPU client slows
+# single-device programs ~13x on this image (see common.init_jax).
+os.environ.setdefault("BENCH_MESH", "1")
+
 import numpy as np
 
 from common import Timer, log, run_bench

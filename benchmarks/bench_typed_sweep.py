@@ -1,0 +1,192 @@
+"""Typed full-domain evaluation sweep — BM_EvaluateRegularDpf across value
+types (/root/reference/dpf/distributed_point_function_benchmark.cc:29-82:
+log_domain 12..24 x {u8..u128, Tuple, IntModN}).
+
+The headline/full_domain benches cover u64 and XorWrapper<u128>; this script
+covers the remaining typed configs as a sweep: one value type per invocation
+(BENCH_TYPED_TYPE in {u8, u32, tuple_u32_u64, intmodn_u64}), log_domain
+16/18/20 on the device engine, plus the native host engine column for the
+scalar Int types (the host bulk engine is scalar-only by design). Correctness
+before rates: scalar types verify against the host engine bit-for-bit; codec
+types verify the share-sum property over the full domain from a second key
+(the product-level criterion — beta at alpha, zero elsewhere).
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+TYPES = ("u8", "u32", "tuple_u32_u64", "intmodn_u64")
+MOD_N = (1 << 64) - 59
+
+
+def _make_type(name):
+    from distributed_point_functions_tpu.core.value_types import (
+        Int,
+        IntModN,
+        TupleType,
+    )
+
+    return {
+        "u8": lambda: Int(8),
+        "u32": lambda: Int(32),
+        "tuple_u32_u64": lambda: TupleType(Int(32), Int(64)),
+        "intmodn_u64": lambda: IntModN(64, MOD_N),
+    }[name]()
+
+
+def _betas(name, rng, count):
+    if name == "tuple_u32_u64":
+        return [[(7, 9)] * count]
+    if name == "intmodn_u64":
+        return [
+            [
+                int(b)
+                for b in rng.integers(1, MOD_N, size=count, dtype=np.uint64)
+            ]
+        ]
+    bits = 8 if name == "u8" else 32
+    return [[int(b) for b in rng.integers(1, 1 << bits, size=count)]]
+
+
+def _device_values(dpf, key, jnp, evaluator):
+    """Full-domain device evaluation; returns per-component host arrays.
+    The in-program sum fold reaches the host inside the caller's timed
+    region via np.asarray (distinct keys per rep: repeated identical
+    programs time as ~0 through this image's tunnel, PERF.md)."""
+    outs = []
+    for valid, out in evaluator.full_domain_evaluate_chunks(dpf, [key]):
+        comps = out if isinstance(out, tuple) else (out,)
+        outs.append(tuple(np.asarray(c)[:valid] for c in comps))
+    return tuple(
+        np.concatenate([o[c] for o in outs], axis=0)
+        for c in range(len(outs[0]))
+    )
+
+
+def _limbs_to_int(arr):
+    """uint32[K, n, lpe] -> object/uint64 integer array."""
+    arr = np.asarray(arr)
+    if arr.ndim == 2:
+        return arr.astype(np.uint64)
+    acc = arr[..., 0].astype(object)
+    for limb in range(1, arr.shape[-1]):
+        acc = acc + (arr[..., limb].astype(object) << (32 * limb))
+    return acc
+
+
+def bench(jax, smoke):
+    import jax.numpy as jnp
+
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.host_eval import (
+        full_domain_evaluate_host,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.ops import evaluator
+
+    type_name = os.environ.get("BENCH_TYPED_TYPE", "u32")
+    if type_name not in TYPES:
+        raise ValueError(f"BENCH_TYPED_TYPE must be one of {TYPES}")
+    domains = (
+        [int(d) for d in os.environ["BENCH_TYPED_DOMAINS"].split(",")]
+        if "BENCH_TYPED_DOMAINS" in os.environ
+        else ([10] if smoke else [16, 18, 20])
+    )
+    reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 3))
+    scalar = type_name in ("u8", "u32")
+    rng = np.random.default_rng(0x7E57)
+
+    per_domain = {}
+    verified_all = True
+    for lds in domains:
+        vt = _make_type(type_name)
+        dpf = DistributedPointFunction.create(DpfParameters(lds, vt))
+        count = reps + 2  # warmup key + share-sum partner + reps
+        alphas = [int(a) for a in rng.integers(0, 1 << lds, size=count)]
+        betas = _betas(type_name, rng, count)
+        keys_a, keys_b = dpf.generate_keys_batch(alphas, betas)
+
+        with Timer() as warm:
+            got = _device_values(dpf, keys_a[0], jnp, evaluator)
+        log(f"{type_name} 2^{lds}: warmup (compile + run) {warm.elapsed:.1f}s")
+
+        # --- Correctness gate ---
+        if scalar:
+            host = full_domain_evaluate_host(dpf, [keys_a[0]])
+            bits = 8 if type_name == "u8" else 32
+            dev = _limbs_to_int(got[0][..., 0] if got[0].ndim == 3 else got[0])
+            ok = np.array_equal(dev & ((1 << bits) - 1), host & np.uint64((1 << bits) - 1))
+        else:
+            other = _device_values(dpf, keys_b[0], jnp, evaluator)
+            if type_name == "tuple_u32_u64":
+                masks = (1 << 32) - 1, (1 << 64) - 1
+                want = (7, 9)
+                ok = True
+                for c, (m, w) in enumerate(zip(masks, want)):
+                    tot = (_limbs_to_int(got[c]) + _limbs_to_int(other[c]))[0]
+                    # dtype=object: values exceed int64 and numpy would
+                    # silently coerce a plain list of big ints to float64.
+                    tot = np.array([int(t) & m for t in tot.ravel()], dtype=object)
+                    exp = np.zeros(1 << lds, dtype=object)
+                    exp[alphas[0]] = w
+                    ok = ok and np.array_equal(tot, exp)
+            else:  # intmodn: (a + b) mod N == beta at alpha, 0 elsewhere
+                tot = (_limbs_to_int(got[0]) + _limbs_to_int(other[0]))[0]
+                tot = np.array(
+                    [int(t) % MOD_N for t in tot.ravel()], dtype=object
+                )
+                nz = np.nonzero(tot)[0]
+                ok = (
+                    len(nz) == 1
+                    and nz[0] == alphas[0]
+                    and int(tot[alphas[0]]) == betas[0][0]
+                )
+        if not ok:
+            verified_all = False
+            log(f"{type_name} 2^{lds}: VERIFICATION FAILED")
+
+        # --- Device rate (warmed, distinct keys per rep) ---
+        with Timer() as t:
+            for key in keys_a[2 : 2 + reps]:
+                _device_values(dpf, key, jnp, evaluator)
+        dev_rate = (1 << lds) * reps / t.elapsed
+
+        entry = {"device_evals_per_s": round(dev_rate)}
+        if scalar:
+            full_domain_evaluate_host(dpf, [keys_a[1]])  # warm native path
+            with Timer() as th:
+                for key in keys_a[2 : 2 + reps]:
+                    full_domain_evaluate_host(dpf, [key])
+            host_rate = (1 << lds) * reps / th.elapsed
+            entry["host_evals_per_s"] = round(host_rate)
+            entry["winner"] = "device" if dev_rate > host_rate else "host"
+        else:
+            entry["host_evals_per_s"] = None
+            entry["winner"] = "device (host bulk engine is scalar-only)"
+        per_domain[str(lds)] = entry
+        log(f"{type_name} 2^{lds}: {entry}")
+
+    top = per_domain[str(domains[-1])]
+    return {
+        "bench": f"typed_full_domain_{type_name}",
+        "metric": (
+            f"full-domain eval sweep, {type_name}, log_domain "
+            f"{'/'.join(map(str, domains))}, device vs host engines"
+        ),
+        "value": top["device_evals_per_s"],
+        "unit": "evals/s",
+        "verified": bool(verified_all),
+        "config": {
+            "value_type": type_name,
+            "reps": reps,
+            "by_log_domain": per_domain,
+        },
+        **({} if verified_all else {"error": "verification failed"}),
+    }
+
+
+if __name__ == "__main__":
+    run_bench("typed_full_domain", bench)

@@ -89,9 +89,14 @@ def init_jax(platform=None):
         if platform is None:
             log("default backend unreachable; using CPU")
             platform = "cpu"
-    if platform == "cpu":
-        # Virtual 8-device mesh for sharded smoke runs; XLA_FLAGS is read at
-        # first backend init, which hasn't happened yet in this process.
+    if platform == "cpu" and os.environ.get("BENCH_MESH") == "1":
+        # Virtual 8-device mesh for sharded smoke runs (bench_pir sets
+        # BENCH_MESH at import). OPT-IN only: the multi-device CPU client
+        # slows single-device XLA programs ~13x on this 1-vCPU image
+        # (measured r4: fused heavy-hitters warm 0.96 s on 1 device vs
+        # 12.7 s under the forced 8-device platform), so benches that don't
+        # shard must never pay it. XLA_FLAGS is read at first backend init,
+        # which hasn't happened yet in this process.
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
@@ -164,6 +169,10 @@ def run_bench(name: str, fn) -> None:
         # (e.g. the native host engine while a TPU is attached) sets its
         # own platform; only fill it in when absent.
         result.setdefault("platform", jax.default_backend())
+        # Every record carries its measurement date (VERDICT r3 #6: undated
+        # entries from the caching-illusion era were indistinguishable from
+        # trusted ones).
+        result.setdefault("date", time.strftime("%Y-%m-%d"))
         if smoke:
             result["smoke"] = True
     except Exception as e:

@@ -6,6 +6,8 @@ import os
 import subprocess
 import sys
 
+# Entries are either a script name or (script, extra_env). BENCH_ONLY
+# matches the script name (all env variants of it run).
 BENCHES = [
     "bench_headline.py",
     "bench_keygen.py",
@@ -16,17 +18,27 @@ BENCHES = [
     "bench_dcf.py",
     "bench_pir.py",
     "bench_heavy_hitters.py",
+    # The fused grouped-advance engine (its own slot: heavy_hitters_device).
+    ("bench_heavy_hitters.py", {"BENCH_HH_ENGINE": "device"}),
     "bench_intmodn_sample.py",
+    # Typed full-domain sweep (BM_EvaluateRegularDpf's type axis) — one
+    # record per value type.
+    ("bench_typed_sweep.py", {"BENCH_TYPED_TYPE": "u8"}),
+    ("bench_typed_sweep.py", {"BENCH_TYPED_TYPE": "u32"}),
+    ("bench_typed_sweep.py", {"BENCH_TYPED_TYPE": "tuple_u32_u64"}),
+    ("bench_typed_sweep.py", {"BENCH_TYPED_TYPE": "intmodn_u64"}),
 ]
 
 
 def main():
     here = os.path.dirname(os.path.abspath(__file__))
     results = []
-    for script in BENCHES:
+    for entry in BENCHES:
+        script, extra_env = entry if isinstance(entry, tuple) else (entry, {})
         if os.environ.get("BENCH_ONLY") and script != os.environ["BENCH_ONLY"]:
             continue
-        print(f"# running {script}", file=sys.stderr, flush=True)
+        label = script + (f" {extra_env}" if extra_env else "")
+        print(f"# running {label}", file=sys.stderr, flush=True)
         try:
             r = subprocess.run(
                 [sys.executable, os.path.join(here, script)],
@@ -34,10 +46,13 @@ def main():
                 capture_output=True,
                 text=True,
                 timeout=float(os.environ.get("BENCH_TIMEOUT", 3600)),
+                env={**os.environ, **extra_env},
             )
         except subprocess.TimeoutExpired as e:
             sys.stderr.write((e.stderr or b"").decode("utf-8", "replace") if isinstance(e.stderr, bytes) else (e.stderr or ""))
-            results.append({"bench": script, "error": "timeout"})
+            # Error records carry the full variant label: two failing env
+            # variants of one script must not collide on a merge slot.
+            results.append({"bench": label, "error": "timeout"})
             print(json.dumps(results[-1]), flush=True)
             continue
         sys.stderr.write(r.stderr)
@@ -45,7 +60,7 @@ def main():
         try:
             results.append(json.loads(line))
         except json.JSONDecodeError:
-            results.append({"bench": script, "error": f"bad output: {line[:200]}"})
+            results.append({"bench": label, "error": f"bad output: {line[:200]}"})
         print(line, flush=True)
     out = os.path.join(here, "results.json")
     # Merge with existing records. A fresh entry replaces a stored one only

@@ -128,7 +128,10 @@ def test_batched_value_hash_plumbing_interpret(cheap_rows, k, w, bw):
         np.testing.assert_array_equal(got[i], _CheapRows.np_hash(planes[i], None))
 
 
-@pytest.mark.parametrize("k,w,bw", [(2, 32, 32), (1, 37, 32)])
+@pytest.mark.parametrize(
+    "k,w,bw",
+    [(1, 37, 32), pytest.param(2, 32, 32, marks=pytest.mark.slow)],
+)
 def test_fused_expand_hash_matches_composition_interpret(cheap_rows, k, w, bw):
     """expand_and_hash_last_level_pallas_batched == expand kernel followed
     by the value-hash kernel, bit for bit (same stand-in circuit in both
@@ -160,7 +163,7 @@ def test_fused_expand_hash_matches_composition_interpret(cheap_rows, k, w, bw):
 @pytest.mark.parametrize(
     "k,w,bw,levels",
     [
-        (2, 32, 32, 3),
+        pytest.param(2, 32, 32, 3, marks=pytest.mark.slow),
         # w=40 > block_w=32: exercises the lane-word zero-pad + trim
         # (ADVICE r2 medium: P=20000 -> w=625 crashed the shipping path).
         (1, 40, 32, 2),

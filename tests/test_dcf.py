@@ -198,6 +198,7 @@ def test_batched_dcf_keygen_matches_sequential():
     assert len(ka) == 2
 
 
+@pytest.mark.slow
 def test_batch_evaluate_host_matches_device():
     import numpy as np
     import pytest
@@ -235,8 +236,11 @@ def test_batch_evaluate_host_matches_device():
 
 @pytest.mark.parametrize(
     "case",
-    ["xor128", "int128"]
-    + [pytest.param(c, marks=pytest.mark.slow) for c in ("xor16", "xor64")],
+    ["xor128"]
+    + [
+        pytest.param(c, marks=pytest.mark.slow)
+        for c in ("int128", "xor16", "xor64")
+    ],
 )
 def test_batch_evaluate_host_wide_groups(case):
     """The wide native kernel (XOR groups, 128-bit values) vs the device

@@ -104,6 +104,7 @@ def test_full_domain_xor_group():
     assert (total == expected).all()
 
 
+@pytest.mark.slow
 def test_full_domain_host_levels_split():
     """Different host/device level splits give identical results."""
     dpf = DistributedPointFunction.create(DpfParameters(8, Int(32)))
@@ -192,6 +193,7 @@ def test_full_domain_incremental_matches_host(params, alpha):
         assert (total == expected).all(), f"level {level}"
 
 
+@pytest.mark.slow
 def test_evaluate_at_batch_incremental_intermediate_level():
     """evaluate_at_batch at an intermediate hierarchy level == host path."""
     params = [DpfParameters(3, Int(128)), DpfParameters(4, Int(32))]
@@ -389,6 +391,7 @@ def test_fused_lane_slab_pieces_match_unslabbed():
         )
 
 
+@pytest.mark.slow
 def test_fused_lane_slab_codec_non_pow2_epb_exact_partition():
     """Regression (ADVICE r2): with lane_slab and a codec value type whose
     elements_per_block is NOT a power of two (Tuple<u32,u8> -> epb=3), the
@@ -442,6 +445,7 @@ def test_fused_auto_slab_protects_by_default(monkeypatch):
     np.testing.assert_array_equal(full, np.asarray(out0))
 
 
+@pytest.mark.slow
 def test_full_domain_fold_chunks_matches_values_fold():
     """The in-program XOR fold (full_domain_fold_chunks — values
     materialized behind an optimization_barrier and consumed in-program,

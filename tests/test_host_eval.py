@@ -13,9 +13,15 @@ from distributed_point_functions_tpu.utils.errors import InvalidArgumentError
 RNG = np.random.default_rng(0x405)
 
 
-@pytest.mark.parametrize("vt", [Int(8), Int(16), Int(32), Int(64), Int(128),
-                                XorWrapper(64), XorWrapper(128)],
-                         ids=str)
+@pytest.mark.parametrize(
+    "vt",
+    [Int(32), Int(64), Int(128), XorWrapper(128)]
+    + [
+        pytest.param(v, marks=pytest.mark.slow)
+        for v in (Int(8), Int(16), XorWrapper(64))
+    ],
+    ids=str,
+)
 def test_host_engine_matches_device_path(vt):
     bits = vt.bitsize
     dpf = DistributedPointFunction.create(DpfParameters(7, vt))
@@ -98,7 +104,11 @@ def test_evaluate_at_host_rejects_non_scalar_types():
         host_eval.evaluate_at_host(dpf, [key], [0, 1])
 
 
-@pytest.mark.parametrize("vt", [Int(32), Int(128), XorWrapper(64)], ids=str)
+@pytest.mark.parametrize(
+    "vt",
+    [Int(32), Int(128), pytest.param(XorWrapper(64), marks=pytest.mark.slow)],
+    ids=str,
+)
 def test_hierarchical_host_engine_matches_device(vt):
     from distributed_point_functions_tpu.ops import hierarchical
 

@@ -39,6 +39,7 @@ def test_mic_gate_share_sum(log_group_size):
             assert got == want[i], (i, x_real)
 
 
+@pytest.mark.slow
 def test_mic_gate_batch_eval_matches_host():
     log_group_size = 6
     n = 1 << log_group_size

@@ -133,6 +133,7 @@ def test_multihost_single_process_degenerates():
     np.testing.assert_array_equal(out, evaluator.full_domain_evaluate(dpf, [key]))
 
 
+@pytest.mark.slow
 def test_pir_chunked_modes_reconstruct():
     """pir_query_batch_chunked reconstructs DB records in both execution
     modes (per-level lane-order fold and walk-mode natural-order fold), and
@@ -171,6 +172,7 @@ def test_pir_chunked_modes_reconstruct():
         sharded.pir_query_batch_chunked(dpf, list(keys_a), wrong, mode="walk")
 
 
+@pytest.mark.slow
 def test_pir_chunked_fused_slabbed_reconstructs():
     """mode='fused' with auto-slabbing (the only correct single-chip mode at
     domains whose full expansion exceeds a platform's safe program size)
@@ -197,6 +199,7 @@ def test_pir_chunked_fused_slabbed_reconstructs():
         np.testing.assert_array_equal(rec[i], db[t])
 
 
+@pytest.mark.slow
 def test_multihost_two_process_key_slicing(tmp_path):
     """REAL two-process jax.distributed run (CPU, 2 local devices each):
     each process evaluates its key slice over its local mesh; the parent
@@ -263,6 +266,7 @@ def test_multihost_two_process_key_slicing(tmp_path):
         assert total[alpha] == 9 and total.sum() == 9, f"key {i}"
 
 
+@pytest.mark.slow
 def test_pir_chunked_fold_mode_reconstructs():
     """mode='fold' (in-program inner product against the lane-order DB)
     reconstructs records exactly."""

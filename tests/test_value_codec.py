@@ -105,8 +105,9 @@ VALUE_CASES = [
 
 
 # Fast: one case per codec family (mod-N scalar, plain tuple, mixed tuple
-# with XOR + sub-32-bit packing, nested tuple). Slow: the remaining widths
-# and the nested / multi-block shapes.
+# with XOR + sub-32-bit packing, nested tuple) — the mod-N scalar case (0)
+# is the fast tier's ONE end-to-end IntModN differential, keep it here.
+# Slow: the remaining widths and the nested / multi-block shapes.
 _FD_FAST, _FD_SLOW = (0, 2, 3, 6), (1, 4, 5, 7, 8)
 
 
@@ -147,13 +148,13 @@ def test_full_domain_matches_host(value_type, sample):
 @pytest.mark.parametrize(
     "value_type,sample",
     [
-        VALUE_CASES[0],
         VALUE_CASES[2],
-        VALUE_CASES[6],
+        pytest.param(*VALUE_CASES[0], marks=pytest.mark.slow),
+        pytest.param(*VALUE_CASES[6], marks=pytest.mark.slow),
         pytest.param(*VALUE_CASES[5], marks=pytest.mark.slow),
         pytest.param(*VALUE_CASES[8], marks=pytest.mark.slow),
     ],
-    ids=[str(VALUE_CASES[i][0]) for i in (0, 2, 6, 5, 8)],
+    ids=[str(VALUE_CASES[i][0]) for i in (2, 0, 6, 5, 8)],
 )
 def test_evaluate_at_batch_matches_host(value_type, sample):
     log_domain = 10
@@ -181,7 +182,8 @@ def test_evaluate_at_batch_matches_host(value_type, sample):
 
 
 @pytest.mark.parametrize(
-    "num_levels", [2, pytest.param(3, marks=pytest.mark.slow)]
+    "num_levels",
+    [pytest.param(n, marks=pytest.mark.slow) for n in (2, 3)],
 )
 def test_intmodn_hierarchy_config3_shape(num_levels):
     """BASELINE config 3 in miniature: multi-level IntModN<u64> hierarchy

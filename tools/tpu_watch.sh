@@ -19,16 +19,27 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 log="tools/tpu_watch.log"
 state="tools/tpu_watch.state"
-interval="${TPU_WATCH_INTERVAL:-60}"
+interval="${TPU_WATCH_INTERVAL:-150}"
 probe_timeout="${TPU_WATCH_PROBE_TIMEOUT:-75}"
 max_sessions="${TPU_WATCH_MAX_SESSIONS:-1}"
+# Hard deadline (seconds since start) after which the watcher exits even
+# without a session: the driver runs bench.py at round end and only ONE
+# process may hold the TPU claim — a watcher probing (or measuring) into
+# that window would starve the round's scoreboard run.
+deadline="${TPU_WATCH_DEADLINE:-30600}"
+start_ts=$(date +%s)
 
 echo "watching" >"$state"
-echo "=== tpu_watch start $(date -u +%FT%TZ) interval=${interval}s probe_timeout=${probe_timeout}s ===" >>"$log"
+echo "=== tpu_watch start $(date -u +%FT%TZ) interval=${interval}s probe_timeout=${probe_timeout}s deadline=${deadline}s ===" >>"$log"
 
 sessions=0
 attempt=0
 while [ "$sessions" -lt "$max_sessions" ]; do
+  if [ $(($(date +%s) - start_ts)) -ge "$deadline" ]; then
+    echo "$(date -u +%FT%TZ) deadline reached without a session" >>"$log"
+    echo "failed" >"$state"
+    break
+  fi
   attempt=$((attempt + 1))
   # Killable probe: own session so killpg reaps tunnel helpers.
   setsid python - <<'EOF' >/tmp/tpu_probe_out 2>/tmp/tpu_probe_err &
@@ -63,10 +74,26 @@ EOF
   if [ "$ok" -eq 1 ]; then
     echo "$(date -u +%FT%TZ) attempt=$attempt PROBE OK backend=$backend_line -> tpu_measure.sh" >>"$log"
     echo "measuring" >"$state"
-    bash tools/tpu_measure.sh >>"$log" 2>&1
-    sessions=$((sessions + 1))
-    echo "$(date -u +%FT%TZ) tpu_measure.sh session $sessions finished" >>"$log"
-    echo "done" >"$state"
+    # The measurement session may spend at most the time left to our own
+    # deadline (plus slack the driver's bench can absorb) — a late window
+    # must not run into the end-of-round bench.py.
+    remaining=$((deadline - ($(date +%s) - start_ts)))
+    [ "$remaining" -lt 600 ] && remaining=600
+    session_log_mark=$(wc -l <"tools/tpu_session.log" 2>/dev/null || echo 0)
+    TPU_MEASURE_BUDGET="$remaining" bash tools/tpu_measure.sh >>"$log" 2>&1
+    # A session only counts when at least one substantive stage succeeded
+    # (the tunnel can drop mid-session, timing out every stage): otherwise
+    # go back to watching so a later window gets a retry.
+    if tail -n "+$((session_log_mark + 1))" tools/tpu_session.log 2>/dev/null \
+        | grep -Eq -- '--- stage (suite|headline|extras) rc=0 ---'; then
+      sessions=$((sessions + 1))
+      echo "$(date -u +%FT%TZ) tpu_measure.sh session $sessions succeeded" >>"$log"
+      echo "done" >"$state"
+    else
+      echo "$(date -u +%FT%TZ) measurement session produced no successful stage; resuming watch" >>"$log"
+      echo "watching" >"$state"
+      sleep "$interval"
+    fi
   else
     echo "$(date -u +%FT%TZ) attempt=$attempt probe down (backend=$(tail -1 /tmp/tpu_probe_out 2>/dev/null || echo '?'))" >>"$log"
     echo "watching" >"$state"
