@@ -10,6 +10,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 
 def main():
@@ -28,7 +29,8 @@ def main():
     )
     if timed_out:
         sys.stderr.write((stderr or "")[-4000:])
-        print(json.dumps({"bench": "full_domain_headline", "error": "timeout"}))
+        print(json.dumps({"bench": "full_domain_headline", "error": "timeout",
+                  "date": time.strftime("%Y-%m-%d")}))
         return
     sys.stderr.write((stderr or "")[-4000:])
     if not (stdout or "").strip():
@@ -38,6 +40,7 @@ def main():
         print(json.dumps({
             "bench": "full_domain_headline",
             "error": "bench.py produced no output (killed or crashed)",
+            "date": time.strftime("%Y-%m-%d"),
         }))
         return
     line = stdout.strip().splitlines()[-1]
@@ -47,6 +50,7 @@ def main():
         print(json.dumps({
             "bench": "full_domain_headline",
             "error": f"bad output: {line[:200]}",
+            "date": time.strftime("%Y-%m-%d"),
         }))
         return
     rec = {
@@ -62,6 +66,8 @@ def main():
         # measurement to every results.json consumer.
         rec["error"] = d["error"]
     rec["config"] = d  # vs_baseline, verification fields, etc.
+    # Same dating discipline as common.run_bench (every record is dated).
+    rec.setdefault("date", time.strftime("%Y-%m-%d"))
     print(json.dumps(rec))
 
 

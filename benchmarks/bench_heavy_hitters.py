@@ -133,6 +133,36 @@ def bench(jax, smoke):
     with Timer() as t:
         run_once(dpf, key, prefixes, num_levels)
 
+    prepared_stats = {}
+    if engine == "device":
+        # Aggregation-server shape: ONE global prefix plan replayed over
+        # many client key batches — tables composed and uploaded once
+        # (hierarchical.prepare_levels_fused), only key material per call.
+        plan = [
+            (level, () if level == 0 else prefixes[level - 1])
+            for level in range(num_levels)
+        ]
+        ctx0 = hierarchical.BatchedContext.create(dpf, [key])
+        with Timer() as tp:
+            prepared = hierarchical.prepare_levels_fused(ctx0, plan, group)
+        def run_prepared():
+            c = hierarchical.BatchedContext.create(dpf, [key])
+            outs = hierarchical.evaluate_levels_fused(
+                c, prepared, device_output=True
+            )
+            jax.block_until_ready(outs[-1])
+            return outs[-1]
+        got_p = np.asarray(run_prepared())
+        if not np.array_equal(got_p, np.asarray(first)):
+            raise RuntimeError("prepared-plan outputs diverge from the plain path")
+        with Timer() as t2:
+            run_prepared()
+        prepared_stats = {
+            "prepare_seconds": round(tp.elapsed, 4),
+            "prepared_s_per_key": round(t2.elapsed, 4),
+        }
+        log(f"prepared plan: {prepared_stats} (outputs verified vs plain path)")
+
     # The reference sweeps Range(16, 128); on the cheap host engine emit
     # the whole sweep so regenerated results keep it (device sweeps would
     # compile ~levels programs — single level only there). Every entry is
@@ -166,6 +196,7 @@ def bench(jax, smoke):
             "num_levels": num_levels,
             "num_nonzeros": num_nonzeros,
             "engine": engine,
+            **prepared_stats,
             **({"seconds_by_levels": sweep} if sweep else {}),
         },
         **({"platform": "cpu"} if engine == "host" else {}),

@@ -189,4 +189,9 @@ def bench(jax, smoke):
 
 
 if __name__ == "__main__":
-    run_bench("typed_full_domain", bench)
+    # Per-variant fallback name: error records from two env variants must
+    # not collide on one results.json merge slot.
+    run_bench(
+        f"typed_full_domain_{os.environ.get('BENCH_TYPED_TYPE', 'u32')}",
+        bench,
+    )

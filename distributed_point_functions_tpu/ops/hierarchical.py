@@ -574,58 +574,67 @@ def _fused_advance_scan_jit(
     return outs, seeds, control
 
 
-def evaluate_levels_fused(
+@dataclasses.dataclass
+class PreparedLevelsPlan:
+    """Key-independent compilation of an `evaluate_levels_fused` plan.
+
+    The virtual context walk, the scan/unroll chunk grouping, and every
+    gather/selection table are composed once and held DEVICE-RESIDENT for
+    reuse across key batches — the aggregation-server shape (one global
+    prefix plan, many client key batches; the reference's analog walks its
+    per-key btree inside EvaluateUntil each time,
+    /root/reference/dpf/distributed_point_function.cc:351-453). Profiled on
+    the 128-level heavy-hitters plan the table work is ~0.3 s/call of host
+    time, and through a high-latency link the re-upload of ~tens of MB of
+    index tables dominates; both are paid once here.
+
+    Only valid for contexts whose state matches the one captured at
+    preparation (`evaluate_levels_fused` verifies); value corrections and
+    correction words stay per-call (they are key material).
+    """
+
+    parameters: tuple  # validator parameter list captured for compat check
+    plan_levels: tuple  # hierarchy level per step (vc / cw slicing)
+    bits: int
+    xor_group: bool
+    final_level: int
+    emit_state: bool
+    # Expected entry state.
+    start_prev_level: int
+    start_parent_tree: Optional[np.ndarray]
+    start_child_levels: int
+    # Virtual exit state (becomes the context state after execution).
+    end_parent_tree: Optional[np.ndarray]
+    end_child_levels: int
+    # Per-step key-independent tables: (pos_pad_dev, levels_d, gsel_dev,
+    # start_level).
+    steps: list
+    # (kind, [step indices], scan_extras) — scan_extras is
+    # (pos_stack_dev, gsel_pad_dev, out_lens, levels_d) for "scan" chunks,
+    # None for "unroll" chunks.
+    chunks: list
+    final_order_dev: Optional[jnp.ndarray]  # state reorder for emit
+
+
+def prepare_levels_fused(
     ctx: BatchedContext,
     plan: Sequence[Tuple[int, Sequence[int]]],
     group: int = 16,
-    device_output: bool = False,
-    use_pallas: Optional[bool] = None,
-    mesh=None,
-) -> list:
-    """Advances through MANY hierarchy levels with the per-level prefix sets
-    known upfront — the heavy-hitters / experiments access pattern
-    (BM_HeavyHitters, /root/reference/dpf/distributed_point_function_benchmark.cc:308-340) —
-    fusing `group` level-advances into each device program. Per-level
-    dispatch cost (the measured dominator of the 128-level hierarchy on a
-    high-latency link, PERF.md) drops by ~4*group: the per-level gather,
-    expansion, value hash + correction, and reorder all run inside one
-    program per group, with every index table precomposed on the host.
-
-    `plan` is a list of (hierarchy_level, prefixes) pairs, hierarchy levels
-    strictly increasing, prefixes at the PREVIOUS entry's level (empty iff
-    the context is fresh, first entry only) — the same contract as calling
-    evaluate_until_batch once per entry, and the context ends in the same
-    resumable state. Scalar Int/XorWrapper value types only.
-
-    With a (keys, domain) `mesh`, the KEY axis shards over the mesh's
-    'keys' axis (data-parallel: the fused per-group programs are
-    elementwise over keys, so XLA propagates the sharding from the entry
-    state with zero collectives; gather tables replicate). The key count
-    must divide evenly over the 'keys' axis.
-
-    Returns the per-entry value arrays: uint32[K, n_outputs, lpe] each
-    (numpy unless device_output).
-    """
+) -> PreparedLevelsPlan:
+    """Builds the key-independent part of `evaluate_levels_fused` for
+    `plan` against ctx's CURRENT state (the context is not advanced).
+    The returned plan replays against any context of the same DPF
+    parameters in the same state — pass it to `evaluate_levels_fused` in
+    place of `plan`."""
     from ..core.value_types import Int, XorWrapper
 
-    dpf, v = ctx.dpf, ctx.dpf.validator
-    k = len(ctx.keys)
+    v = ctx.dpf.validator
     if group < 1:
         # group feeds the greedy chunking loop below; 0 would make it spin
         # forever (BENCH_HH_GROUP / CHECK_HH_GROUP env vars reach here).
         raise InvalidArgumentError("`group` must be >= 1")
-    if mesh is not None and k % mesh.shape["keys"]:
-        # Decidable up front — don't burn the host table-construction
-        # passes on a call that cannot run.
-        raise InvalidArgumentError(
-            "evaluate_levels_fused with a mesh requires the key count "
-            f"({k}) to divide evenly over the 'keys' axis "
-            f"({mesh.shape['keys']})"
-        )
     if not plan:
-        return []
-    if use_pallas is None:
-        use_pallas = evaluator._pallas_default()
+        raise InvalidArgumentError("`plan` must be non-empty")
     for (h, _) in plan:
         if not (0 <= h < v.num_hierarchy_levels):
             raise InvalidArgumentError(
@@ -639,16 +648,17 @@ def evaluate_levels_fused(
                 "outputs; use evaluate_until_batch for codec value types"
             )
     bits, xor_group = evaluator._value_kind(v.parameters[plan[-1][0]].value_type)
-    batch = evaluator.KeyBatch.from_keys(dpf, ctx.keys, plan[-1][0])
-    cw_all, ccl_all, ccr_all = batch.device_cw_arrays(0)
 
     # Pass 1 — virtual context walk (host): raw per-step tables, BEFORE
     # lane-order composition (which depends on each step's padded width,
     # chosen by the grouping pass below).
-    prev_level = ctx.previous_hierarchy_level
-    parent_tree = ctx.parent_tree
-    child_levels = ctx.child_levels
-    raw = []  # (positions, num_parents, levels_d, sel, keep, epb, vc, start)
+    start_prev_level = ctx.previous_hierarchy_level
+    start_parent_tree = ctx.parent_tree
+    start_child_levels = ctx.child_levels
+    prev_level = start_prev_level
+    parent_tree = start_parent_tree
+    child_levels = start_child_levels
+    raw = []  # (positions, num_parents, levels_d, sel, keep, epb, start, h)
     for (h, prefixes) in plan:
         if h <= prev_level:
             raise InvalidArgumentError(
@@ -709,9 +719,8 @@ def evaluate_levels_fused(
             sel = (starts[:, None] + np.arange(opp, dtype=np.int64)).reshape(-1)
         else:
             sel = np.arange((num_parents << levels_d) * keep, dtype=np.int64)
-        vc = _level_value_corrections(ctx.keys, v, h, bits)
         raw.append(
-            (positions, num_parents, levels_d, sel, keep, epb, vc, start_level)
+            (positions, num_parents, levels_d, sel, keep, epb, start_level, h)
         )
         # Advance the virtual context.
         prev_level = h
@@ -759,14 +768,15 @@ def evaluate_levels_fused(
     chunks = merged_chunks
 
     # Pass 2 — compose gather positions with each previous step's lane
-    # order and build the padded device tables.
+    # order and build the padded tables (host arrays here; the device
+    # upload happens once per chunk below).
     prev_order = None
-    steps = []  # (pos_pad, levels_d, vc, gsel, start_level)
+    steps_host = []  # (pos_pad, levels_d, gsel, start_level)
     pad_by_step = {}
     for kind, idx, pad in chunks:
         for t in idx:
             pad_by_step[t] = pad
-    for t, (positions, num_parents, levels_d, sel, keep, epb, vc, start) in (
+    for t, (positions, num_parents, levels_d, sel, keep, epb, start, h) in (
         enumerate(raw)
     ):
         if prev_order is not None:
@@ -778,8 +788,154 @@ def evaluate_levels_fused(
             num_parents, pad_to, levels_d
         )
         gsel = order_d[sel // keep] * epb + (sel % keep)
-        steps.append((pos_pad, levels_d, vc, gsel, start))
+        steps_host.append((pos_pad, levels_d, gsel, start))
         prev_order = order_d
+
+    final_level = plan[-1][0]
+    emit_state = final_level < v.num_hierarchy_levels - 1
+    # Device upload, once: scan chunks hold stacked tables; unroll steps
+    # hold per-step tables. Steps inside scan chunks keep host metadata
+    # only (their tables live in the stack).
+    steps = []
+    scan_steps = set()
+    for kind, idx, pad in chunks:
+        if kind == "scan":
+            scan_steps.update(idx)
+    for t, (pos_pad, levels_d, gsel, start) in enumerate(steps_host):
+        if t in scan_steps:
+            steps.append((None, levels_d, None, start))
+        else:
+            steps.append(
+                (jnp.asarray(pos_pad), levels_d, jnp.asarray(gsel), start)
+            )
+    dev_chunks = []
+    for kind, idx, pad in chunks:
+        if kind == "scan":
+            lv = steps_host[idx[0]][1]
+            out_lens = [int(steps_host[t][2].shape[0]) for t in idx]
+            out_max = max(out_lens)
+            gsel_pad = np.zeros((len(idx), out_max), dtype=np.int64)
+            for gi, t in enumerate(idx):
+                gsel_pad[gi, : out_lens[gi]] = steps_host[t][2]
+            pos_stack = np.stack([steps_host[t][0] for t in idx])
+            dev_chunks.append(
+                (
+                    kind,
+                    idx,
+                    (
+                        jnp.asarray(pos_stack),
+                        jnp.asarray(gsel_pad),
+                        out_lens,
+                        lv,
+                    ),
+                )
+            )
+        else:
+            dev_chunks.append((kind, idx, None))
+
+    return PreparedLevelsPlan(
+        parameters=tuple(v.parameters),
+        plan_levels=tuple(h for (*_, h) in raw),
+        bits=bits,
+        xor_group=xor_group,
+        final_level=final_level,
+        emit_state=emit_state,
+        start_prev_level=start_prev_level,
+        start_parent_tree=start_parent_tree,
+        start_child_levels=start_child_levels,
+        end_parent_tree=parent_tree if emit_state else None,
+        end_child_levels=child_levels if emit_state else 0,
+        steps=steps,
+        chunks=dev_chunks,
+        final_order_dev=(
+            jnp.asarray(prev_order) if emit_state else None
+        ),
+    )
+
+
+def evaluate_levels_fused(
+    ctx: BatchedContext,
+    plan,
+    group: int = 16,
+    device_output: bool = False,
+    use_pallas: Optional[bool] = None,
+    mesh=None,
+) -> list:
+    """Advances through MANY hierarchy levels with the per-level prefix sets
+    known upfront — the heavy-hitters / experiments access pattern
+    (BM_HeavyHitters, /root/reference/dpf/distributed_point_function_benchmark.cc:308-340) —
+    fusing `group` level-advances into each device program. Per-level
+    dispatch cost (the measured dominator of the 128-level hierarchy on a
+    high-latency link, PERF.md) drops by ~4*group: the per-level gather,
+    expansion, value hash + correction, and reorder all run inside one
+    program per group, with every index table precomposed on the host.
+
+    `plan` is a list of (hierarchy_level, prefixes) pairs, hierarchy levels
+    strictly increasing, prefixes at the PREVIOUS entry's level (empty iff
+    the context is fresh, first entry only) — the same contract as calling
+    evaluate_until_batch once per entry, and the context ends in the same
+    resumable state — or a `PreparedLevelsPlan` from `prepare_levels_fused`
+    (the aggregation-server shape: tables composed and uploaded once,
+    replayed across key batches; `group` is then ignored). Scalar
+    Int/XorWrapper value types only.
+
+    With a (keys, domain) `mesh`, the KEY axis shards over the mesh's
+    'keys' axis (data-parallel: the fused per-group programs are
+    elementwise over keys, so XLA propagates the sharding from the entry
+    state with zero collectives; gather tables replicate). The key count
+    must divide evenly over the 'keys' axis.
+
+    Returns the per-entry value arrays: uint32[K, n_outputs, lpe] each
+    (numpy unless device_output).
+    """
+    dpf, v = ctx.dpf, ctx.dpf.validator
+    k = len(ctx.keys)
+    if mesh is not None and k % mesh.shape["keys"]:
+        # Decidable up front — don't burn the host table-construction
+        # passes on a call that cannot run.
+        raise InvalidArgumentError(
+            "evaluate_levels_fused with a mesh requires the key count "
+            f"({k}) to divide evenly over the 'keys' axis "
+            f"({mesh.shape['keys']})"
+        )
+    if isinstance(plan, PreparedLevelsPlan):
+        prepared = plan
+        if tuple(v.parameters) != prepared.parameters:
+            raise InvalidArgumentError(
+                "prepared plan was built for a different DPF parameter list"
+            )
+        same_tree = (
+            (prepared.start_parent_tree is None) == (ctx.parent_tree is None)
+        ) and (
+            prepared.start_parent_tree is None
+            or np.array_equal(prepared.start_parent_tree, ctx.parent_tree)
+        )
+        if (
+            prepared.start_prev_level != ctx.previous_hierarchy_level
+            or prepared.start_child_levels != ctx.child_levels
+            or not same_tree
+        ):
+            raise InvalidArgumentError(
+                "prepared plan does not match the context state (it was "
+                "prepared at previous_hierarchy_level="
+                f"{prepared.start_prev_level}, the context is at "
+                f"{ctx.previous_hierarchy_level})"
+            )
+    else:
+        if not plan:
+            return []
+        prepared = prepare_levels_fused(ctx, plan, group)
+    if use_pallas is None:
+        use_pallas = evaluator._pallas_default()
+
+    bits, xor_group = prepared.bits, prepared.xor_group
+    batch = evaluator.KeyBatch.from_keys(dpf, ctx.keys, prepared.final_level)
+    cw_all, ccl_all, ccr_all = batch.device_cw_arrays(0)
+    # Per-call key material: value corrections per step.
+    vcs = [
+        _level_value_corrections(ctx.keys, v, h, bits)
+        for h in prepared.plan_levels
+    ]
 
     # Entry state.
     if ctx.previous_hierarchy_level < 0:
@@ -799,43 +955,37 @@ def evaluate_levels_fused(
         seeds0 = jax.device_put(seeds0, key_sharding)
         control0 = jax.device_put(control0, key_sharding)
 
-    final_level = plan[-1][0]
-    emit_state = final_level < v.num_hierarchy_levels - 1
+    emit_state = prepared.emit_state
     outs_all = []
     seeds, control = seeds0, control0
-    for ci, (kind, idx, pad) in enumerate(chunks):
-        chunk = [steps[t] for t in idx]
-        last_in_run = ci == len(chunks) - 1
+    for ci, (kind, idx, scan_extras) in enumerate(prepared.chunks):
+        chunk = [prepared.steps[t] for t in idx]
+        last_in_run = ci == len(prepared.chunks) - 1
         emit = emit_state and last_in_run
-        so = jnp.asarray(prev_order) if emit else None
+        so = prepared.final_order_dev if emit else None
         if kind == "scan":
-            lv = chunk[0][1]
-            out_lens = [len(g) for (_, _, _, g, _) in chunk]
-            out_max = max(out_lens)
-            gsel_pad = np.zeros((len(chunk), out_max), dtype=np.int64)
-            for gi, (_, _, _, g, _) in enumerate(chunk):
-                gsel_pad[gi, : len(g)] = g
+            pos_stack_dev, gsel_pad_dev, out_lens, lv = scan_extras
             outs, seeds, control = _fused_advance_scan_jit(
                 seeds,
                 control,
-                jnp.asarray(np.stack([p for (p, _, _, _, _) in chunk])),
+                pos_stack_dev,
                 jnp.asarray(
                     np.stack(
-                        [cw_all[:, s : s + lv] for (_, _, _, _, s) in chunk]
+                        [cw_all[:, s : s + lv] for (_, _, _, s) in chunk]
                     )
                 ),
                 jnp.asarray(
                     np.stack(
-                        [ccl_all[:, s : s + lv] for (_, _, _, _, s) in chunk]
+                        [ccl_all[:, s : s + lv] for (_, _, _, s) in chunk]
                     )
                 ),
                 jnp.asarray(
                     np.stack(
-                        [ccr_all[:, s : s + lv] for (_, _, _, _, s) in chunk]
+                        [ccr_all[:, s : s + lv] for (_, _, _, s) in chunk]
                     )
                 ),
-                jnp.asarray(np.stack([c for (_, _, c, _, _) in chunk])),
-                jnp.asarray(gsel_pad),
+                jnp.asarray(np.stack([vcs[t] for t in idx])),
+                gsel_pad_dev,
                 so,
                 levels=lv,
                 bits=bits,
@@ -848,16 +998,16 @@ def evaluate_levels_fused(
             continue
         step_args = tuple(
             (
-                jnp.asarray(pos),
+                pos_dev,
                 jnp.asarray(cw_all[:, start : start + lv]),
                 jnp.asarray(ccl_all[:, start : start + lv]),
                 jnp.asarray(ccr_all[:, start : start + lv]),
-                jnp.asarray(vc),
-                jnp.asarray(gsel),
+                jnp.asarray(vcs[t]),
+                gsel_dev,
             )
-            for (pos, lv, vc, gsel, start) in chunk
+            for t, (pos_dev, lv, gsel_dev, start) in zip(idx, chunk)
         )
-        meta = tuple(lv for (_, lv, _, _, _) in chunk)
+        meta = tuple(lv for (_, lv, _, _) in chunk)
         outs, seeds, control = _fused_advance_jit(
             seeds,
             control,
@@ -874,8 +1024,8 @@ def evaluate_levels_fused(
 
     # Context update (same contract as evaluate_until_batch).
     if emit_state:
-        ctx.parent_tree = parent_tree
-        ctx.child_levels = child_levels
+        ctx.parent_tree = prepared.end_parent_tree
+        ctx.child_levels = prepared.end_child_levels
         ctx.seeds = seeds
         ctx.control = control
     else:
@@ -883,7 +1033,7 @@ def evaluate_levels_fused(
         ctx.child_levels = 0
         ctx.seeds = None
         ctx.control = None
-    ctx.previous_hierarchy_level = final_level
+    ctx.previous_hierarchy_level = prepared.final_level
 
     if device_output:
         return list(outs_all)

@@ -220,6 +220,62 @@ def test_levels_fused_rejects_misuse():
         )
 
 
+def test_prepared_plan_replays_across_key_batches():
+    """prepare_levels_fused + replay: one key-independent table set, many
+    key batches (the aggregation-server shape). The prepared path must
+    match the plain fused path bit-for-bit for EVERY key batch, leave the
+    same resumable state, and reject a context in a different state."""
+    levels = 5
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    finals = [1, 9, 22, 30]
+    pres = [
+        sorted({f >> (levels - (i + 1)) for f in finals})
+        for i in range(levels)
+    ]
+    plan = [(0, [])] + [(i, pres[i - 1]) for i in range(1, levels - 1)]
+
+    batches = [
+        [dpf.generate_keys_incremental(a, [5] * levels)[0] for a in alphas]
+        for alphas in ([2, 9], [30, 17, 22])
+    ]
+    proto = hierarchical.BatchedContext.create(dpf, batches[0])
+    prepared = hierarchical.prepare_levels_fused(proto, plan, group=2)
+    # Preparation does not advance the context it was built from.
+    assert proto.previous_hierarchy_level == -1 and proto.seeds is None
+
+    last = levels - 1
+    for keys in batches:
+        bc_ref = hierarchical.BatchedContext.create(dpf, keys)
+        ref = hierarchical.evaluate_levels_fused(
+            bc_ref, plan, group=2, use_pallas=False
+        )
+        bc = hierarchical.BatchedContext.create(dpf, keys)
+        got = hierarchical.evaluate_levels_fused(
+            bc, prepared, use_pallas=False
+        )
+        for d, (g, r) in enumerate(zip(got, ref)):
+            np.testing.assert_array_equal(
+                np.asarray(g), np.asarray(r), err_msg=f"level {d}"
+            )
+        out_ref = hierarchical.evaluate_until_batch(bc_ref, last, pres[last - 1])
+        out_got = hierarchical.evaluate_until_batch(bc, last, pres[last - 1])
+        np.testing.assert_array_equal(np.asarray(out_got), np.asarray(out_ref))
+    # A context in a different state is rejected.
+    bc_adv = hierarchical.BatchedContext.create(dpf, batches[0])
+    hierarchical.evaluate_until_batch(bc_adv, 0)
+    with pytest.raises(InvalidArgumentError, match="does not match"):
+        hierarchical.evaluate_levels_fused(bc_adv, prepared, use_pallas=False)
+    # And a prepared plan from another parameter list is rejected.
+    other = DistributedPointFunction.create_incremental(
+        [DpfParameters(i + 2, Int(64)) for i in range(levels)]
+    )
+    ko = [other.generate_keys_incremental(3, [5] * levels)[0]]
+    bco = hierarchical.BatchedContext.create(other, ko)
+    with pytest.raises(InvalidArgumentError, match="different DPF parameter"):
+        hierarchical.evaluate_levels_fused(bco, prepared, use_pallas=False)
+
+
 @pytest.mark.slow
 def test_levels_fused_sharded_matches_unsharded():
     """evaluate_levels_fused(mesh=) — key-axis data parallelism over the

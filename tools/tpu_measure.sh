@@ -56,10 +56,16 @@ DPF_TPU_FUSE_LAST_HASH=1 CHECK_MODE=fold CHECK_PALLAS=1 CHECK_SHAPES=128x20 \
 stage suite 14400 python benchmarks/run_all.py
 
 # 3. The headline bench.py itself — a dress rehearsal of exactly what the
-# driver runs for BENCH_r03.json (cheap after the suite warmed the
+# driver runs for BENCH_r04.json (cheap after the suite warmed the
 # compilation cache) — then the fused-last-hash A/B.
 stage headline 2600 python bench.py
 DPF_TPU_FUSE_LAST_HASH=1 stage headline-fused-hash 2600 python bench.py
+
+# 3b. Heavy-hitters fused-group A/B: group=32 halves the program count
+# (~5 programs x ~66 ms dispatch vs ~9 at group=16) at double the
+# per-program compile; decide the shipping default from on-chip numbers.
+BENCH_FULL=1 BENCH_HH_ENGINE=device BENCH_HH_GROUP=32 \
+  stage hh-group32 3600 bash -c "cd benchmarks && python bench_heavy_hitters.py"
 
 # 4. Experiments device runs (hierarchical fused + direct) on dist-1 data.
 if [ ! -f experiments/data/32_1048576_1048576_0.1.csv ]; then
