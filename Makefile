@@ -2,7 +2,7 @@
 # The analog of the reference's `bazel test //...` entry point
 # (/root/reference/.bazelci/presubmit.yml); ci.sh holds the tier logic.
 
-.PHONY: test slow smoke device ci bench headline
+.PHONY: test slow smoke device ci bench headline watch measure
 
 test:            ## fast tier: default pytest suite (CPU, virtual 8-device mesh)
 	./ci.sh fast
@@ -23,3 +23,9 @@ bench:           ## full benchmark suite -> benchmarks/results.json
 
 headline:        ## the driver's headline metric (one JSON line)
 	python bench.py
+
+watch:           ## probe the TPU tunnel; fire the measurement session in the first window
+	bash tools/tpu_watch.sh
+
+measure:         ## the scripted TPU measurement session (tunnel must be up)
+	bash tools/tpu_measure.sh

@@ -52,10 +52,8 @@ def _betas(name, rng, count):
 
 
 def _device_values(dpf, key, jnp, evaluator):
-    """Full-domain device evaluation; returns per-component host arrays.
-    The in-program sum fold reaches the host inside the caller's timed
-    region via np.asarray (distinct keys per rep: repeated identical
-    programs time as ~0 through this image's tunnel, PERF.md)."""
+    """Full-domain device evaluation; returns per-component host arrays
+    (verification path — full pull, NOT used inside timed regions)."""
     outs = []
     for valid, out in evaluator.full_domain_evaluate_chunks(dpf, [key]):
         comps = out if isinstance(out, tuple) else (out,)
@@ -64,6 +62,32 @@ def _device_values(dpf, key, jnp, evaluator):
         np.concatenate([o[c] for o in outs], axis=0)
         for c in range(len(outs[0]))
     )
+
+
+def _device_fold(dpf, key, jnp, evaluator, scalar):
+    """Timed-region form: values stay device-resident and only tiny folds
+    reach the host. Pulling full 2^20-element outputs would time the host
+    link, not the device (~5 MB/s through this image's tunnel — the
+    round-2 headline mistake; PERF.md). Scalar types ride the library's
+    fused fold (full_domain_fold_chunks: expansion + fold in ONE program
+    per key chunk — the shipping consumer shape); codec types have no
+    fused fold, so each chunk takes one extra reduction dispatch (the
+    chunk output is materialized device-side, jnp.sum is a follow-on
+    program). Distinct keys per rep keep the tunnel's server-side result
+    cache out of the timing."""
+    if scalar:
+        fold = None
+        for _, f in evaluator.full_domain_fold_chunks(dpf, [key]):
+            fold = f
+        return (np.asarray(fold),)
+    folds = None
+    for valid, out in evaluator.full_domain_evaluate_chunks(dpf, [key]):
+        comps = out if isinstance(out, tuple) else (out,)
+        sums = tuple(jnp.sum(c, axis=(0, 1)) for c in comps)
+        folds = sums if folds is None else tuple(
+            f + s for f, s in zip(folds, sums)
+        )
+    return tuple(np.asarray(f) for f in folds)
 
 
 def _limbs_to_int(arr):
@@ -148,10 +172,11 @@ def bench(jax, smoke):
             verified_all = False
             log(f"{type_name} 2^{lds}: VERIFICATION FAILED")
 
-        # --- Device rate (warmed, distinct keys per rep) ---
+        # --- Device rate (warmed, distinct keys per rep, fold pulls) ---
+        _device_fold(dpf, keys_a[1], jnp, evaluator, scalar)  # warm fold
         with Timer() as t:
             for key in keys_a[2 : 2 + reps]:
-                _device_values(dpf, key, jnp, evaluator)
+                _device_fold(dpf, key, jnp, evaluator, scalar)
         dev_rate = (1 << lds) * reps / t.elapsed
 
         entry = {"device_evals_per_s": round(dev_rate)}
