@@ -56,25 +56,45 @@ def bench(jax, smoke):
         [int(x) for x in rng.integers(0, 1 << log_domain, size=num_points)]
         for _ in range(reps)
     ]
+    def timed_pull(out):
+        """Timing pull: host engine results are host arrays already; the
+        device engine's [K, P, lpe] output (2 MB at 512x512) folds to
+        [lpe] in a follow-on device program so the timed region measures
+        the evaluation, not the ~5 MB/s tunnel link (PERF.md)."""
+        if engine == "host":
+            return np.asarray(out)
+        import jax.numpy as jnp
+
+        return np.asarray(jnp.sum(out, axis=(0, 1)))
+
     with Timer() as warm:
-        out = np.asarray(run(dcf, keys, xs))
+        out = np.asarray(run(dcf, keys, xs))  # full pull: shape check only
     assert out.shape[:2] == (num_keys, num_points)
     log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    if engine != "host":
+        timed_pull(run(dcf, keys, xs))  # warm the fold program
     with Timer() as t:
         for xs_i in xs_sets:
-            np.asarray(run(dcf, keys, xs_i))
+            timed_pull(run(dcf, keys, xs_i))
     evals = num_keys * num_points * reps
     device_rate = None
     if engine == "host" and jax.default_backend() != "cpu":
         # Keep the device scan kernel under benchmark coverage even though
         # the host engine is the headline for this shape. Distinct points
-        # + host pull: identical repeats time as ~0 through this tunnel.
+        # per rep: identical repeats time as ~0 through this tunnel.
+        import jax.numpy as jnp
+
+        def dev_fold(points):
+            return np.asarray(
+                jnp.sum(dcf_batch.batch_evaluate(dcf, keys, points), axis=(0, 1))
+            )
+
         xs2 = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_points)]
         with Timer() as wd:
-            np.asarray(dcf_batch.batch_evaluate(dcf, keys, xs))
+            dev_fold(xs)
         log(f"device engine warmup: {wd.elapsed:.1f}s")
         with Timer() as td:
-            np.asarray(dcf_batch.batch_evaluate(dcf, keys, xs2))
+            dev_fold(xs2)
         device_rate = round(num_keys * num_points / td.elapsed)
         log(f"device engine: {device_rate} comparisons/s")
     return {

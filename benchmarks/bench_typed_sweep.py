@@ -76,10 +76,23 @@ def _device_fold(dpf, key, jnp, evaluator, scalar):
     program). Distinct keys per rep keep the tunnel's server-side result
     cache out of the timing."""
     if scalar:
-        fold = None
-        for _, f in evaluator.full_domain_fold_chunks(dpf, [key]):
-            fold = f
-        return (np.asarray(fold),)
+        try:
+            folds = []
+            for valid, f in evaluator.full_domain_fold_chunks(dpf, [key]):
+                folds.append(np.asarray(f)[:valid])  # key-chunk slices
+            return (np.concatenate(folds, axis=0),)
+        except NotImplementedError:
+            # Trees shallower than the fold path's floor (smoke domains):
+            # XOR-fold the evaluate path's chunks instead, matching the
+            # fold program's semantics.
+            folds = []
+            for valid, out in evaluator.full_domain_evaluate_chunks(dpf, [key]):
+                folds.append(
+                    np.asarray(
+                        jnp.bitwise_xor.reduce(out, axis=1)
+                    )[:valid]
+                )
+            return (np.concatenate(folds, axis=0),)
     folds = None
     for valid, out in evaluator.full_domain_evaluate_chunks(dpf, [key]):
         comps = out if isinstance(out, tuple) else (out,)
@@ -141,8 +154,17 @@ def bench(jax, smoke):
         if scalar:
             host = full_domain_evaluate_host(dpf, [keys_a[0]])
             bits = 8 if type_name == "u8" else 32
+            mask = np.uint64((1 << bits) - 1)
             dev = _limbs_to_int(got[0][..., 0] if got[0].ndim == 3 else got[0])
-            ok = np.array_equal(dev & ((1 << bits) - 1), host & np.uint64((1 << bits) - 1))
+            ok = np.array_equal(dev & mask, host & mask)
+            # The TIMED path is the fused fold program — a different kernel
+            # than the evaluate path checked above; verify it too (its XOR
+            # fold must equal the host values' XOR fold).
+            fold_dev = _device_fold(dpf, keys_a[0], jnp, evaluator, scalar)[0]
+            host_fold = np.bitwise_xor.reduce(host, axis=1)
+            ok = ok and np.array_equal(
+                fold_dev[:, 0].astype(np.uint64) & mask, host_fold & mask
+            )
         else:
             other = _device_values(dpf, keys_b[0], jnp, evaluator)
             if type_name == "tuple_u32_u64":
