@@ -500,6 +500,7 @@ def _fused_advance_jit(
     jax.jit,
     static_argnames=(
         "levels", "bits", "party", "xor_group", "use_pallas", "emit_state",
+        "out_lens",
     ),
 )
 def _fused_advance_scan_jit(
@@ -518,6 +519,7 @@ def _fused_advance_scan_jit(
     xor_group: bool,
     use_pallas: bool,
     emit_state: bool,
+    out_lens: tuple = (),
 ):
     """Scan form of `_fused_advance_jit` for G steps that all expand the
     SAME number of tree levels at the SAME padded width: the per-step AES
@@ -568,10 +570,19 @@ def _fused_advance_scan_jit(
     )
     if out0 is not None:
         outs = jnp.concatenate([out0[None], outs], axis=0)
+    # Per-step trims INSIDE the program: each step's real output length is
+    # static, and doing the slicing here costs nothing, whereas slicing
+    # the returned stack outside the jit dispatches ~2 device programs
+    # per step — ~8 s of pure latency for a 127-step plan through a
+    # 66 ms-dispatch link (r4 profile).
+    if out_lens:
+        trimmed = tuple(outs[i, :, :n] for i, n in enumerate(out_lens))
+    else:
+        trimmed = outs
     if emit_state:
         seeds = seeds[:, state_order]
         control = control[:, state_order]
-    return outs, seeds, control
+    return trimmed, seeds, control
 
 
 @dataclasses.dataclass
@@ -993,8 +1004,9 @@ def evaluate_levels_fused(
                 xor_group=xor_group,
                 use_pallas=use_pallas,
                 emit_state=emit,
+                out_lens=tuple(out_lens),
             )
-            outs_all.extend(o[:, :n] for o, n in zip(outs, out_lens))
+            outs_all.extend(outs)
             continue
         step_args = tuple(
             (
