@@ -300,6 +300,23 @@ def _expand_level_batch_jit(planes, control, cw_plane, ccl, ccr):
     return jax.vmap(backend_jax.expand_one_level)(planes, control, cw_plane, ccl, ccr)
 
 
+@jax.jit
+def _split_levels_jit(cw_all, ccl_all, ccr_all):
+    """Splits the stacked per-level corrections into per-level arrays in
+    ONE program. Eagerly slicing `cw_all[:, level]` in the per-level loop
+    dispatched 3 extra device programs per level — pure latency through a
+    66 ms-dispatch link (r4 dispatch audit) — while slicing inside the
+    expand program itself would widen its jit cache key from (planes
+    width) to (planes width, total levels). This keeps both properties:
+    one dispatch, and the expand programs stay keyed by width alone."""
+    L = cw_all.shape[1]
+    return (
+        tuple(cw_all[:, l] for l in range(L)),
+        tuple(ccl_all[:, l] for l in range(L)),
+        tuple(ccr_all[:, l] for l in range(L)),
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("bits", "party", "xor_group", "keep_per_block", "reorder"),
@@ -1017,9 +1034,10 @@ def full_domain_evaluate_chunks(
         planes, control = _pack_batch_jit(
             jnp.asarray(seeds_p), jnp.asarray(control_mask)
         )
+        cw_l, ccl_l, ccr_l = _split_levels_jit(cw_dev, ccl, ccr)
         for level in range(device_levels):
             planes, control = _expand_level_batch_jit(
-                planes, control, cw_dev[:, level], ccl[:, level], ccr[:, level]
+                planes, control, cw_l[level], ccl_l[level], ccr_l[level]
             )
         if scalar_fast:
             out = _finalize_batch_jit(

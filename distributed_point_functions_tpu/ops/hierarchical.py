@@ -116,6 +116,23 @@ class BatchedContext:
         return out
 
 
+@functools.partial(jax.jit, static_argnames=("pad",))
+def _pad_pack_entry_jit(seeds0, control0, pad):
+    """Entry-state preparation for _expand_batch in one program: pad the
+    parent axis to the packed width, pack control lanes to bit masks, and
+    transpose seeds to bit planes."""
+    k = seeds0.shape[0]
+    if pad:
+        seeds0 = jnp.concatenate(
+            [seeds0, jnp.zeros((k, pad, 4), jnp.uint32)], axis=1
+        )
+        control0 = jnp.concatenate(
+            [control0, jnp.zeros((k, pad), control0.dtype)], axis=1
+        )
+    control_mask = _pack_mask_device(control0)  # inlines under jit
+    return jax.vmap(aes_jax.pack_to_planes)(seeds0), control_mask
+
+
 @jax.jit
 def _pack_mask_device(bits: jnp.ndarray) -> jnp.ndarray:
     """uint32 0/1 [..., n] (n % 32 == 0) -> packed lane masks [..., n // 32]."""
@@ -519,7 +536,7 @@ def _fused_advance_scan_jit(
     xor_group: bool,
     use_pallas: bool,
     emit_state: bool,
-    out_lens: tuple = (),
+    out_lens: tuple,
 ):
     """Scan form of `_fused_advance_jit` for G steps that all expand the
     SAME number of tree levels at the SAME padded width: the per-step AES
@@ -575,10 +592,7 @@ def _fused_advance_scan_jit(
     # the returned stack outside the jit dispatches ~2 device programs
     # per step — ~8 s of pure latency for a 127-step plan through a
     # 66 ms-dispatch link (r4 profile).
-    if out_lens:
-        trimmed = tuple(outs[i, :, :n] for i, n in enumerate(out_lens))
-    else:
-        trimmed = outs
+    trimmed = tuple(outs[i, :, :n] for i, n in enumerate(out_lens))
     if emit_state:
         seeds = seeds[:, state_order]
         control = control[:, state_order]
@@ -1151,25 +1165,23 @@ def _expand_batch(
     k = seeds0.shape[0]
     num_parents = seeds0.shape[1]
     pad = pad_to - num_parents
-    seeds0 = jnp.asarray(seeds0, dtype=jnp.uint32)
-    control0 = jnp.asarray(control0)
-    if pad:
-        seeds0 = jnp.concatenate(
-            [seeds0, jnp.zeros((k, pad, 4), jnp.uint32)], axis=1
-        )
-        control0 = jnp.concatenate(
-            [control0, jnp.zeros((k, pad), control0.dtype)], axis=1
-        )
-    control_mask = _pack_mask_device(control0.astype(jnp.uint32))
-    planes = jax.vmap(aes_jax.pack_to_planes)(seeds0)
+    # Pad + mask-pack + plane-pack in ONE program: the eager concatenates
+    # and the un-jitted vmap'd pack dispatched ~30 tiny programs per call
+    # (r4 dispatch audit; pure latency through a 66 ms link).
+    planes, control_mask = _pad_pack_entry_jit(
+        jnp.asarray(seeds0, dtype=jnp.uint32),
+        jnp.asarray(control0).astype(jnp.uint32),
+        pad=pad,
+    )
 
     cw_dev, ccl, ccr = batch.device_cw_arrays(start_level)
     cw_dev = jnp.asarray(cw_dev[:, :levels])
     ccl = jnp.asarray(ccl[:, :levels])
     ccr = jnp.asarray(ccr[:, :levels])
+    cw_l, ccl_l, ccr_l = evaluator._split_levels_jit(cw_dev, ccl, ccr)
     for level in range(levels):
         planes, control_mask = evaluator._expand_level_batch_jit(
-            planes, control_mask, cw_dev[:, level], ccl[:, level], ccr[:, level]
+            planes, control_mask, cw_l[level], ccl_l[level], ccr_l[level]
         )
     order = backend_jax.expansion_output_order(num_parents, pad_to, levels)
     outs = evaluator._finalize_batch_codec_jit(
