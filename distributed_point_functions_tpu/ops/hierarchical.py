@@ -1162,7 +1162,6 @@ def _expand_batch(
     values: leaf-ordered [K, Np * 2^levels * keep, lpe] (or tuple);
     seeds/control are the *leaf-ordered* expansion state for context updates.
     """
-    k = seeds0.shape[0]
     num_parents = seeds0.shape[1]
     pad = pad_to - num_parents
     # Pad + mask-pack + plane-pack in ONE program: the eager concatenates

@@ -1,0 +1,133 @@
+"""Dispatch audit: warm calls of the main device entry points must run
+ZERO eager primitives.
+
+Eager ops between jit calls (slices, un-jitted vmaps, pads) each dispatch
+their own tiny device program. CPU timing hides them, but through this
+image's ~66 ms-dispatch tunnel they dominate: r4 found ~127 slice
+dispatches (~8 s pure latency) inside one fused heavy-hitters call and
+~18 per hierarchical level-advance (PERF.md "Round 4"). This test pins
+the audit result so a refactor can't silently reintroduce a storm.
+
+The counter hooks jax's internal eager-execution entry point; if a jax
+upgrade moves it, the test skips rather than fails.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int
+from distributed_point_functions_tpu.dcf import batch as dcf_batch
+from distributed_point_functions_tpu.dcf.dcf import DistributedComparisonFunction
+from distributed_point_functions_tpu.ops import evaluator, hierarchical
+
+
+@pytest.fixture
+def eager_counter(monkeypatch):
+    try:
+        import jax._src.dispatch as dispatch_mod
+
+        orig = dispatch_mod.apply_primitive
+    except (ImportError, AttributeError):
+        pytest.skip("jax internal apply_primitive moved; audit hook unavailable")
+    counts = {"eager": 0}
+
+    def spy(prim, *args, **kwargs):
+        counts["eager"] += 1
+        return orig(prim, *args, **kwargs)
+
+    monkeypatch.setattr(dispatch_mod, "apply_primitive", spy)
+    return counts
+
+
+def _assert_no_eager(counts, fn, name):
+    fn()  # warm: compiles + constant uploads are allowed
+    counts["eager"] = 0
+    fn()
+    assert counts["eager"] == 0, (
+        f"{name}: {counts['eager']} eager primitive dispatches in a warm "
+        "call — each is a separate device program (~66 ms latency on the "
+        "real link); move the op inside a jitted program (see PERF.md "
+        "'Round 4' dispatch audit)"
+    )
+
+
+def test_full_domain_chunks_no_eager_dispatch(eager_counter):
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9], [[1, 2]])
+
+    for mode in ("levels", "fused"):
+        _assert_no_eager(
+            eager_counter,
+            lambda: list(
+                evaluator.full_domain_evaluate_chunks(dpf, keys, mode=mode)
+            ),
+            f"full_domain_evaluate_chunks[{mode}]",
+        )
+    _assert_no_eager(
+        eager_counter,
+        lambda: list(evaluator.full_domain_fold_chunks(dpf, keys)),
+        "full_domain_fold_chunks",
+    )
+
+
+@pytest.mark.slow
+def test_evaluate_at_and_dcf_no_eager_dispatch(eager_counter):
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9], [[1, 2]])
+    pts = [int(x) for x in np.random.default_rng(1).integers(0, 1 << 10, 64)]
+    _assert_no_eager(
+        eager_counter,
+        lambda: evaluator.evaluate_at_batch(dpf, keys, pts),
+        "evaluate_at_batch",
+    )
+
+    dc = DistributedComparisonFunction.create(8, Int(64))
+    dk, _ = dc.generate_keys_batch([100, 200], [7, 9])
+    xs = [int(x) for x in np.random.default_rng(2).integers(0, 1 << 8, 48)]
+    _assert_no_eager(
+        eager_counter,
+        lambda: dcf_batch.batch_evaluate(dc, dk, xs, use_pallas=False),
+        "dcf.batch_evaluate",
+    )
+
+
+def test_hierarchical_paths_no_eager_dispatch(eager_counter):
+    params = [DpfParameters(d, Int(32)) for d in (3, 6, 9)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    key, _ = dpf.generate_keys_incremental(77, [5, 6, 7])
+
+    def walk():
+        bc = hierarchical.BatchedContext.create(dpf, [key])
+        hierarchical.evaluate_until_batch(bc, 0, device_output=True)
+        hierarchical.evaluate_until_batch(
+            bc, 1, list(range(8)), device_output=True
+        )
+        hierarchical.evaluate_until_batch(
+            bc, 2, list(range(16)), device_output=True
+        )
+
+    _assert_no_eager(eager_counter, walk, "evaluate_until_batch")
+
+    levels = 6
+    paramsf = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpff = DistributedPointFunction.create_incremental(paramsf)
+    kf, _ = dpff.generate_keys_incremental(11, [7] * levels)
+    finals = sorted({int(x) for x in np.random.default_rng(5).integers(0, 64, 20)})
+    pres = [
+        sorted({f >> (levels - (i + 1)) for f in finals})
+        for i in range(levels)
+    ]
+    plan = [(0, [])] + [(i, pres[i - 1]) for i in range(1, levels)]
+    prepared = hierarchical.prepare_levels_fused(
+        hierarchical.BatchedContext.create(dpff, [kf]), plan, 4
+    )
+
+    def fused():
+        bc = hierarchical.BatchedContext.create(dpff, [kf])
+        hierarchical.evaluate_levels_fused(
+            bc, prepared, device_output=True, use_pallas=False
+        )
+
+    _assert_no_eager(eager_counter, fused, "evaluate_levels_fused[prepared]")
