@@ -62,12 +62,20 @@ def main():
         except json.JSONDecodeError:
             results.append({"bench": label, "error": f"bad output: {line[:200]}"})
         print(line, flush=True)
-    out = os.path.join(here, "results.json")
-    # Merge with existing records. A fresh entry replaces a stored one only
-    # when bench name AND platform match — a CPU smoke run must never
-    # clobber a TPU-day recording (or vice versa); mismatched-platform
-    # reruns are stored under "<bench>@<platform>". Hand-recorded entries
-    # (distinct bench names) always survive.
+    merge_records(results, os.path.join(here, "results.json"))
+
+
+def merge_records(results, out):
+    """Merge fresh bench records into results.json (also used by
+    tools/run_bench_stage.py for per-stage resumable measurement sessions).
+
+    A fresh entry replaces a stored one only when bench name AND platform
+    match — a CPU smoke run must never clobber a TPU-day recording (or
+    vice versa); mismatched-platform reruns are stored under
+    "<bench>@<platform>". Hand-recorded entries (distinct bench names)
+    always survive.
+    """
+
     def slot(e):
         return (e.get("bench"), e.get("platform"))
 

@@ -1,0 +1,193 @@
+"""Roofline / MFU accounting for the bitsliced AES device engine.
+
+VERDICT r4 #4: the repo's perf story relates its rates to ONE reference
+CPU core (BASELINE.md), but never to what the TPU silicon itself can do —
+"82x one Xeon core" could be 10% or 60% of the chip. This module closes
+that gap with exact op accounting:
+
+1. **Gate count** — trace the bitsliced AES-128 MMO hash
+   (`ops.aes_jax.hash_planes`, the same circuit the Mosaic row kernels
+   compute) with `jax.make_jaxpr` and count the u32 *element* operations
+   per AES block. This is exact, not an estimate: the circuit is
+   elementwise over [128, W] u32 bit-planes (W lane words = 32 blocks
+   each), so every logic gate is one u32 op per lane word.
+
+2. **AES blocks per evaluation** — a full-domain expansion of 2^n leaves
+   costs 2*(2^n - 1) tree-node hashes (two child hashes per parent across
+   all levels, distributed_point_function.cc's EvaluateSeeds recursion) +
+   2^n value-correction hashes: (3*2^n - 2)/2^n ~= 3 hashes per leaf.
+
+3. **VPU peak** — the v5e TensorCore's vector unit is an (8, 128)-lane
+   2D SIMD array with 4 independent ALUs at ~940 MHz (public "How to
+   Scale Your Model" hardware chapter): 8*128*4*0.94e9 ~= 3.85e12 u32
+   elementwise ops/s. The MXU does not participate (no matmuls in this
+   workload) — the VPU peak IS the roofline for a bitsliced cipher.
+
+achieved_ops/s = evals/s * hashes_per_eval * ops_per_block, and
+MFU = achieved / peak. The same arithmetic inverted gives the ceiling:
+the evals/s this chip could reach at 100% VPU utilization.
+
+CLI (writes the PERF.md table):
+    python -m distributed_point_functions_tpu.utils.roofline [evals_per_sec]
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# v5e VPU: (8 sublanes, 128 lanes) x 4 ALUs x ~940 MHz. 32-bit ops.
+V5E_VPU_OPS_PER_SEC = 8 * 128 * 4 * 0.94e9
+
+# Primitives counted as one u32 element op per output element. Everything
+# else in the traced circuit is data movement (reshape/transpose/
+# concatenate/slice/broadcast), which XLA largely folds into the compute
+# on TPU; it is reported separately, not added to the gate count.
+_ELEMENT_PRIMS = {
+    "xor", "and", "or", "not", "add", "sub", "mul",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "select_n",
+}
+_MOVEMENT_PRIMS = {
+    "reshape", "transpose", "concatenate", "slice", "broadcast_in_dim",
+    "squeeze", "rev", "convert_element_type", "gather", "dynamic_slice",
+    "pad",
+}
+
+
+def _count_jaxpr(jaxpr) -> dict:
+    """Counts element ops / movement elements over a jaxpr, recursively."""
+    counts = {"element_ops": 0, "movement_elems": 0, "other_prims": set()}
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            out_elems = sum(
+                int(np.prod(v.aval.shape)) if v.aval.shape else 1
+                for v in eqn.outvars
+            )
+            if name in _ELEMENT_PRIMS:
+                counts["element_ops"] += out_elems
+            elif name in _MOVEMENT_PRIMS:
+                counts["movement_elems"] += out_elems
+            elif name in ("pjit", "closed_call", "custom_jvp_call"):
+                for p in ("jaxpr", "call_jaxpr"):
+                    inner = eqn.params.get(p)
+                    if inner is not None:
+                        walk(inner.jaxpr if hasattr(inner, "jaxpr") else inner)
+                        break
+            else:
+                counts["other_prims"].add(name)
+        return counts
+
+    return walk(jaxpr)
+
+
+@functools.lru_cache(maxsize=4)
+def hash_ops_per_block(lane_words: int = 64) -> dict:
+    """Exact u32 element-op count of one bitsliced MMO hash, per AES block.
+
+    Traces `hash_planes` on a [128, lane_words] input (32*lane_words
+    blocks). The per-block figure is independent of lane_words (the
+    circuit is elementwise); the default 64 matches the headline
+    program's plane width at 2048-block batches.
+    """
+    import jax
+
+    from ..ops import aes_jax
+
+    rk = aes_jax.round_key_planes(0x2B7E151628AED2A6ABF7158809CF4F3C)
+    blocks = 32 * lane_words
+
+    def one_hash(planes):
+        return aes_jax.hash_planes(planes, rk)
+
+    jaxpr = jax.make_jaxpr(one_hash)(
+        jax.ShapeDtypeStruct((128, lane_words), np.uint32)
+    )
+    c = _count_jaxpr(jaxpr.jaxpr)
+    return {
+        "element_ops_per_block": c["element_ops"] / blocks,
+        "movement_elems_per_block": c["movement_elems"] / blocks,
+        "uncounted_prims": sorted(c["other_prims"]),
+        "lane_words": lane_words,
+    }
+
+
+def hashes_per_eval(log_domain: int) -> float:
+    """AES hashes per leaf of a full-domain expansion over 2^log_domain."""
+    n = 1 << log_domain
+    return (3 * n - 2) / n
+
+
+def mfu_fields(evals_per_sec: float, log_domain: int) -> dict:
+    """The headline-record roofline fields (merged into bench.py's JSON)."""
+    ops = hash_ops_per_block()
+    per_eval = hashes_per_eval(log_domain) * ops["element_ops_per_block"]
+    achieved = evals_per_sec * per_eval
+    mfu = achieved / V5E_VPU_OPS_PER_SEC
+    ceiling = V5E_VPU_OPS_PER_SEC / per_eval
+    return {
+        "mfu_estimate": round(mfu, 4),
+        "roofline_ceiling_evals_per_sec": round(ceiling),
+        "mfu_detail": (
+            f"{ops['element_ops_per_block']:.0f} u32 gate-ops/AES-block "
+            f"(traced bitsliced circuit) x {hashes_per_eval(log_domain):.2f} "
+            f"hashes/eval = {per_eval:.0f} ops/eval; "
+            f"{achieved:.3e} ops/s vs v5e VPU peak "
+            f"{V5E_VPU_OPS_PER_SEC:.2e} (8x128 lanes x 4 ALUs x 0.94 GHz)"
+        ),
+    }
+
+
+def _native_anchor() -> str:
+    """Sanity anchor: the same arithmetic for the AES-NI/VAES host engine.
+
+    One Xeon core at ~3 GHz retiring one 256-bit VAES aesenc per cycle
+    (2 blocks/instr, 10 rounds/block) peaks at 3e9 * 2 / 10 = 600 M
+    blocks/s. The native engine's measured ~100 M evals/s headline
+    (~300 M hashes/s incl. sigma/xor/gather overhead) is ~50% of that
+    port-throughput bound — the engine is near the core's AES ceiling,
+    so the anchor arithmetic is calibrated, not optimistic.
+    """
+    return (
+        "native host anchor: VAES port bound ~600 M blocks/s/core "
+        "(3 GHz x 2 blocks/aesenc / 10 rounds); measured ~300 M hashes/s "
+        "~= 50% of bound"
+    )
+
+
+def main(argv) -> int:
+    import json
+
+    ops = hash_ops_per_block()
+    print("# bitsliced AES MMO hash — traced gate count")
+    print(json.dumps(ops, indent=2))
+    rows = []
+    for rate_name, rate in (
+        [("cli_arg", float(argv[0]))]
+        if argv
+        else [
+            ("BASELINE reference (1 core)", 13e6),
+            ("host engine (measured best)", 99.7e6),
+            ("device XLA bitslice (measured)", 63.8e6),
+            ("device Mosaic claim", 1.06e9),
+            ("50x target", 50 * 13e6),
+        ]
+    ):
+        f = mfu_fields(rate, 20)
+        rows.append((rate_name, rate, f["mfu_estimate"], f["roofline_ceiling_evals_per_sec"]))
+    print("\n# MFU at log_domain=20 (3.00 hashes/eval)")
+    print(f"{'scenario':38s} {'evals/s':>12s} {'VPU MFU':>8s}")
+    for name, rate, mfu, ceil in rows:
+        print(f"{name:38s} {rate:12.3e} {mfu:8.2%}")
+    print(f"\nroofline ceiling at 100% VPU: {rows[0][3]:.3e} evals/s")
+    print(_native_anchor())
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

@@ -37,6 +37,23 @@ def _check_pallas_env():
 
 
 def main() -> int:
+    # Single-process TPU claim (tools/tpu_claim.py): a check run must not
+    # race a measurement session or bench.py for the tunnel. CPU-forced
+    # runs don't touch the device and skip the lock.
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        return _main()
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tpu_claim import ClaimUnavailable, hold
+
+    try:
+        with hold("check_device", timeout=float(os.environ.get("TPU_CLAIM_WAIT", 120))):
+            return _main()
+    except ClaimUnavailable as e:
+        print(f"SKIPPED: {e}")
+        return 4
+
+
+def _main() -> int:
     import jax
 
     # Under this image's sitecustomize, jax may already be imported with

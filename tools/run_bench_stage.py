@@ -1,0 +1,96 @@
+"""Run ONE benchmark script as a resumable measurement-session stage.
+
+VERDICT r4 #1: a flapping tunnel must accumulate records across short
+windows, which needs (a) bench-level granularity instead of one 4-hour
+run_all stage, and (b) an exit code that tells tpu_measure.sh whether the
+stage actually produced a DEVICE record (every bench script exits 0 even
+when it fell back to CPU — the robustness contract — so rc alone can't
+gate stage completion).
+
+Usage:
+    python tools/run_bench_stage.py <bench_script.py> [KEY=VAL ...]
+
+Runs benchmarks/<bench_script.py> with the given env overrides, merges its
+one-line JSON record into benchmarks/results.json through the same merge
+as run_all.py, and exits:
+    0 — the record is a device-platform measurement (stage complete);
+    2 — the bench ran but produced a CPU/smoke/error record (retry later);
+    1 — the bench crashed or emitted unparseable output.
+
+Special env overrides handled HERE (not passed to the bench):
+    RECORD_SUFFIX=_x  appended to the record's bench name before merging —
+                      lets A/B variants (e.g. the fused last-hash headline)
+                      land in their own results.json slot instead of
+                      clobbering the primary record.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+# BENCH_STAGE_DIR: test override — where bench scripts live and where
+# results.json is written. The merge implementation always comes from the
+# real benchmarks/run_all.py.
+BENCH_DIR = os.environ.get("BENCH_STAGE_DIR") or os.path.join(ROOT, "benchmarks")
+sys.path.insert(0, os.path.join(ROOT, "benchmarks"))
+
+import run_all  # noqa: E402  (benchmarks/run_all.py — the merge)
+
+
+def main(argv):
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 1
+    script = argv[0]
+    env = dict(os.environ)
+    suffix = ""
+    for kv in argv[1:]:
+        k, _, v = kv.partition("=")
+        if k == "RECORD_SUFFIX":
+            suffix = v
+        else:
+            env[k] = v
+    print(f"# stage bench: {script} {argv[1:]}", file=sys.stderr, flush=True)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(BENCH_DIR, script)],
+        cwd=BENCH_DIR,
+        env=env,
+        capture_output=True,
+        text=True,
+    )
+    sys.stderr.write((proc.stderr or "")[-6000:])
+    line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+    if proc.returncode != 0 or not line:
+        print(f"# bench rc={proc.returncode}, no record", file=sys.stderr)
+        return 1
+    try:
+        rec = json.loads(line)
+    except json.JSONDecodeError:
+        print(f"# bench emitted unparseable output: {line[:200]}", file=sys.stderr)
+        return 1
+    if suffix and rec.get("bench"):
+        rec["bench"] = rec["bench"] + suffix
+    rec.setdefault("date", time.strftime("%Y-%m-%d"))
+    run_all.merge_records([rec], os.path.join(BENCH_DIR, "results.json"))
+    print(json.dumps(rec), flush=True)
+    platform = rec.get("platform") or ""
+    device_ok = (
+        "error" not in rec
+        and not rec.get("smoke")
+        and platform != ""
+        and not platform.startswith("cpu")
+    )
+    print(
+        f"# stage verdict: platform={platform or '?'} "
+        f"{'DEVICE RECORD' if device_ok else 'no device record'}",
+        file=sys.stderr,
+    )
+    return 0 if device_ok else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,122 @@
+"""Single-process TPU claim arbitration (VERDICT r4 weak #3).
+
+Only ONE process may initialize the axon TPU backend at a time (PERF.md
+"Platform findings": a second initializer hangs, and killing it can leave
+helper processes holding the claim). Historically the watcher-fired
+measurement session (tools/tpu_measure.sh) and the driver's end-of-round
+bench.py could collide when a tunnel window opened late in a round. Every
+TPU-touching entry point now funnels through one flock(2) on
+tools/tpu_claim.lock:
+
+  - tools/tpu_measure.sh holds it for the whole session (bash `flock`);
+  - bench.py holds it across its probe + device-attempt subprocesses;
+  - tools/check_device.py holds it for its run;
+  - tools/tpu_watch.sh holds it for each probe (skipping the probe when
+    someone is measuring).
+
+Children of a holding process set TPU_CLAIM_HELD=1 so nested acquisition
+is a no-op (flock is per open-file-description: a child re-opening the
+lock file would deadlock against its own parent).
+
+CLI (used by the dry test and for operator inspection):
+    python tools/tpu_claim.py status            # "free" or holder JSON
+    python tools/tpu_claim.py hold SECONDS      # acquire, sleep, release
+"""
+
+import contextlib
+import fcntl
+import json
+import os
+import sys
+import time
+
+LOCK_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tpu_claim.lock")
+
+
+class ClaimUnavailable(RuntimeError):
+    """The claim could not be acquired within the caller's timeout."""
+
+
+def _lock_path(path=None):
+    return path or os.environ.get("TPU_CLAIM_PATH") or LOCK_PATH
+
+
+def holder_info(path=None):
+    """Best-effort description of the current holder (may be stale — the
+    content is advisory; the flock itself is the source of truth)."""
+    try:
+        with open(_lock_path(path)) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
+
+
+@contextlib.contextmanager
+def hold(label, timeout=0.0, poll=2.0, path=None):
+    """Acquire the TPU claim within `timeout` seconds, yield, release.
+
+    No-op when TPU_CLAIM_HELD=1 (an ancestor already holds the claim).
+    Raises ClaimUnavailable when the deadline passes without the lock.
+    """
+    if os.environ.get("TPU_CLAIM_HELD") == "1":
+        yield None
+        return
+    p = _lock_path(path)
+    fd = os.open(p, os.O_RDWR | os.O_CREAT, 0o644)
+    try:
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise ClaimUnavailable(
+                        f"TPU claim held by: {holder_info(p) or 'unknown'}"
+                    )
+                time.sleep(poll)
+        os.ftruncate(fd, 0)
+        os.write(
+            fd,
+            json.dumps(
+                {
+                    "label": label,
+                    "pid": os.getpid(),
+                    "since": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                }
+            ).encode(),
+        )
+        try:
+            yield fd
+        finally:
+            with contextlib.suppress(OSError):
+                os.ftruncate(fd, 0)
+            with contextlib.suppress(OSError):
+                fcntl.flock(fd, fcntl.LOCK_UN)
+    finally:
+        os.close(fd)
+
+
+def main(argv):
+    if len(argv) >= 1 and argv[0] == "status":
+        fd = os.open(_lock_path(), os.O_RDWR | os.O_CREAT, 0o644)
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                fcntl.flock(fd, fcntl.LOCK_UN)
+                print("free")
+            except OSError:
+                print(holder_info() or "held (holder unknown)")
+        finally:
+            os.close(fd)
+        return 0
+    if len(argv) >= 2 and argv[0] == "hold":
+        with hold(f"cli:{os.getpid()}", timeout=float(os.environ.get("TPU_CLAIM_WAIT", 0))):
+            time.sleep(float(argv[1]))
+        return 0
+    print(__doc__, file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,73 +1,128 @@
 #!/usr/bin/env bash
-# TPU measurement session — run when the tunnel is reachable (fired
-# automatically by tools/tpu_watch.sh in the first reachable window).
-# Produces, in order of importance (VERDICT r3 "Next round"):
-#   1. on-chip correctness of every round-3/4 device path (check_device
-#      extras incl. the 1x1 shard_map PIR program),
-#   2. the full benchmark suite -> benchmarks/results.json (headline
-#      wrapper, fused heavy-hitters engine, typed full-domain sweep —
-#      so the driver-visible claim and the records agree),
-#   3. the headline bench.py run itself (what BENCH_r04.json will hold).
-# Each stage is independently time-bounded; a wedged stage must not eat
-# the session. Logs to stderr; stage results land in tools/tpu_session.log.
+# TPU measurement session — fired by tools/tpu_watch.sh in a reachable
+# tunnel window. Reordered in round 5 (VERDICT r4 #1): after four rounds in
+# which the tunnel never stayed up long enough for the old suite-first
+# order to reach the scoreboard number, the FIRST ~25 minutes of any window
+# now yield the headline record:
+#
+#   gate      (<=7 min)  minimal on-chip correctness of the headline
+#                        program family (fold + Mosaic kernels)
+#   headline  (<=45 min) bench.py via the bench_headline wrapper ->
+#                        results.json + the exact JSON the driver records
+#   ...then the device records for the three host-wins workloads
+#   (EvaluateAt / DCF / fused heavy-hitters, VERDICT r4 #6), the full
+#   check_device extras (r3+r4 device paths, VERDICT r4 #5), the
+#   supersede re-measures of the 2026-07-30 caching-illusion records
+#   (VERDICT r4 #7), the typed sweep on-chip (VERDICT r4 #8), A/Bs and
+#   experiments.
+#
+# Stages are RESUMABLE: completed stage names land in
+# tools/tpu_stages.state; a re-fired session (tunnel flapped) skips them,
+# so records accumulate across windows. Bench stages run through
+# tools/run_bench_stage.py, which merges the record into
+# benchmarks/results.json and exits 0 only for a genuine device-platform
+# record — a CPU fallback inside a bench never marks its stage done.
+#
+# The whole session holds the single-process TPU claim
+# (tools/tpu_claim.lock, VERDICT r4 weak #3); children see
+# TPU_CLAIM_HELD=1 so bench.py / check_device.py don't re-acquire.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 log="tools/tpu_session.log"
-# Session budget (seconds): stages that would start after it's spent are
-# skipped, most-important-first ordering ensures the correctness checks
-# and the headline land before the long tails. The watcher passes the
-# time remaining to its own deadline so a late-opening window can't run
-# into the driver's end-of-round bench.py (single-process TPU claim).
+stages="tools/tpu_stages.state"
 budget="${TPU_MEASURE_BUDGET:-28800}"
 session_start=$(date +%s)
-echo "=== tpu_measure $(date -u +%FT%TZ) budget=${budget}s ===" | tee -a "$log"
 
+exec 9>>tools/tpu_claim.lock
+if ! flock -w "${TPU_CLAIM_WAIT:-60}" 9; then
+  echo "=== tpu_measure $(date -u +%FT%TZ) ABORT: TPU claim held elsewhere ===" | tee -a "$log"
+  exit 1
+fi
+export TPU_CLAIM_HELD=1
+touch "$stages"
+echo "=== tpu_measure $(date -u +%FT%TZ) budget=${budget}s resume=[$(paste -sd, "$stages")] ===" | tee -a "$log"
+
+# stage NAME TIMEOUT CMD... — skips completed stages (unless STAGE_ALWAYS=1),
+# clips the timeout to the remaining session budget, marks the stage done
+# in $stages on rc=0. Children must not inherit the lock fd (a killed
+# stage child could otherwise keep the claim held) — hence 9>&-.
 stage() {
   local name="$1"; shift
   local tmo="$1"; shift
+  if [ "${STAGE_ALWAYS:-0}" != 1 ] && grep -qx "$name" "$stages" 2>/dev/null; then
+    echo "--- stage $name done in an earlier window; skipped (resume) ---" | tee -a "$log"
+    return 0
+  fi
   local elapsed=$(($(date +%s) - session_start))
   if [ "$elapsed" -ge "$budget" ]; then
     echo "--- stage $name SKIPPED (budget ${budget}s spent) ---" | tee -a "$log"
-    return 0
+    return 3
   fi
   if [ $((budget - elapsed)) -lt "$tmo" ]; then
     tmo=$((budget - elapsed))
     echo "--- stage $name timeout clipped to ${tmo}s (budget) ---" | tee -a "$log"
   fi
   echo "--- stage $name (timeout ${tmo}s) ---" | tee -a "$log"
-  timeout -k 60 "$tmo" "$@" 2>&1 | tail -40 | tee -a "$log"
+  timeout -k 60 "$tmo" "$@" 2>&1 9>&- | tail -40 | tee -a "$log"
   local rc=${PIPESTATUS[0]}
   echo "--- stage $name rc=$rc ---" | tee -a "$log"
-  return 0  # stages are independent; failures are visible in the log
+  if [ "$rc" -eq 0 ]; then echo "$name" >>"$stages"; fi
+  return "$rc"
 }
 
-# 1. On-chip correctness: round-3 paths + the fold headline family,
-# including the opt-in fused last-level+value-hash kernel (A/B it:
-# verified first, then bench.py can be rerun with the flag to compare).
+# 1. Gate (ALWAYS re-run: it also validates that the tunnel is sane right
+# now). Small shape = small compile; fold + Mosaic is the headline family.
+# A failing/timing-out gate aborts the session — every later record would
+# be either unobtainable (tunnel gone) or untrustworthy (miscompute).
+if ! STAGE_ALWAYS=1 \
+  CHECK_MODE=fold CHECK_PALLAS=1 CHECK_SHAPES=16x14,64x18 \
+  stage gate 420 python tools/check_device.py; then
+  echo "=== tpu_measure ABORT: gate failed (tunnel gone or miscomputing) ===" | tee -a "$log"
+  exit 1
+fi
+
+# 2. THE headline (scoreboard number): bench.py through the wrapper so the
+# record lands in results.json through the standard merge.
+BENCH_HEADLINE_TIMEOUT=2400 \
+  stage headline 2700 python tools/run_bench_stage.py bench_headline.py
+
+# 3. Device records for the three host-wins workloads (VERDICT r4 #6).
+stage evalat 1500 python tools/run_bench_stage.py bench_evaluate_at.py
+stage dcf 1500 python tools/run_bench_stage.py bench_dcf.py
+stage hh-device 2700 python tools/run_bench_stage.py bench_heavy_hitters.py BENCH_HH_ENGINE=device
+
+# 4. On-chip differential validation of every r3+r4 device path
+# (VERDICT r4 #5) + the full-size headline-family shapes.
 CHECK_EXTRAS=all stage extras 1800 python tools/check_device.py
 CHECK_MODE=fold CHECK_PALLAS=1 CHECK_SHAPES=128x20 \
-  stage fold-pallas 1800 python tools/check_device.py
+  stage fold-128x20 1200 python tools/check_device.py
 DPF_TPU_FUSE_LAST_HASH=1 CHECK_MODE=fold CHECK_PALLAS=1 CHECK_SHAPES=128x20 \
-  stage fold-fused-hash 1800 python tools/check_device.py
+  stage fold-fused-hash 1200 python tools/check_device.py
 
-# 2. Full benchmark suite (TPU records; merge keeps full-size CPU records).
-# run_all includes the bench_headline wrapper, so results.json gets the
-# headline record here.
-stage suite 14400 python benchmarks/run_all.py
+# 5. Supersede the 2026-07-30 caching-illusion records in place
+# (VERDICT r4 #7): same bench slots, honest harness, fresh dates.
+stage pir 1800 python tools/run_bench_stage.py bench_pir.py
+stage keygen 1200 python tools/run_bench_stage.py bench_keygen.py
+stage full-domain 1800 python tools/run_bench_stage.py bench_full_domain.py
+stage intmodn-sample 1200 python tools/run_bench_stage.py bench_intmodn_sample.py
+stage intmodn-hierarchy 1800 python tools/run_bench_stage.py bench_intmodn_hierarchy.py
+stage isrg 1800 python tools/run_bench_stage.py bench_isrg.py
 
-# 3. The headline bench.py itself — a dress rehearsal of exactly what the
-# driver runs for BENCH_r04.json (cheap after the suite warmed the
-# compilation cache) — then the fused-last-hash A/B.
-stage headline 2600 python bench.py
-DPF_TPU_FUSE_LAST_HASH=1 stage headline-fused-hash 2600 python bench.py
+# 6. Typed full-domain sweep on-chip (VERDICT r4 #8 — BM_EvaluateRegularDpf's
+# type axis finally gets TPU numbers).
+stage typed-u8 1500 python tools/run_bench_stage.py bench_typed_sweep.py BENCH_TYPED_TYPE=u8
+stage typed-u32 1500 python tools/run_bench_stage.py bench_typed_sweep.py BENCH_TYPED_TYPE=u32
+stage typed-tuple 1500 python tools/run_bench_stage.py bench_typed_sweep.py BENCH_TYPED_TYPE=tuple_u32_u64
+stage typed-intmodn 1500 python tools/run_bench_stage.py bench_typed_sweep.py BENCH_TYPED_TYPE=intmodn_u64
 
-# 3b. Heavy-hitters fused-group A/B: group=32 halves the program count
-# (~5 programs x ~66 ms dispatch vs ~9 at group=16) at double the
-# per-program compile; decide the shipping default from on-chip numbers.
-BENCH_FULL=1 BENCH_HH_ENGINE=device BENCH_HH_GROUP=32 \
-  stage hh-group32 3600 bash -c "cd benchmarks && python bench_heavy_hitters.py"
+# 7. A/Bs: fused last-level+value-hash headline (own results.json slot via
+# RECORD_SUFFIX) and the heavy-hitters group=32 program-count halving.
+DPF_TPU_FUSE_LAST_HASH=1 BENCH_HEADLINE_TIMEOUT=2400 \
+  stage headline-fused-hash 2700 python tools/run_bench_stage.py bench_headline.py RECORD_SUFFIX=_fused_hash
+BENCH_FULL=1 stage hh-group32 3600 python tools/run_bench_stage.py bench_heavy_hitters.py \
+  BENCH_HH_ENGINE=device BENCH_HH_GROUP=32 RECORD_SUFFIX=_group32
 
-# 4. Experiments device runs (hierarchical fused + direct) on dist-1 data.
+# 8. Experiments device runs (hierarchical fused + direct) on dist-1 data.
 if [ ! -f experiments/data/32_1048576_1048576_0.1.csv ]; then
   stage gen-data 1200 bash -c "cd experiments && python gen_data.py --log_domain_size 32"
 fi
@@ -78,4 +133,19 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
   --input data/32_1048576_1048576_0.1.csv --log_domain_size 32 \
   --engine device --only_nonzeros --num_iterations 3"
 
-echo "=== tpu_measure done $(date -u +%FT%TZ) ===" | tee -a "$log"
+# Sentinel: every resumable stage above is marked done -> the watcher can
+# stop re-firing sessions.
+required="headline evalat dcf hh-device extras fold-128x20 fold-fused-hash \
+pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
+typed-u8 typed-u32 typed-tuple typed-intmodn headline-fused-hash hh-group32 \
+exp-hier exp-direct"
+missing=""
+for s in $required; do
+  grep -qx "$s" "$stages" || missing="$missing $s"
+done
+if [ -z "$missing" ]; then
+  grep -qx all "$stages" || echo all >>"$stages"
+  echo "=== tpu_measure COMPLETE (all stages) $(date -u +%FT%TZ) ===" | tee -a "$log"
+else
+  echo "=== tpu_measure done $(date -u +%FT%TZ); remaining:$missing ===" | tee -a "$log"
+fi

@@ -1,17 +1,23 @@
 #!/usr/bin/env bash
-# Tunnel watcher (VERDICT r3 "Next round" #1): loop a cheap, killable
-# backend probe and fire tools/tpu_measure.sh in the FIRST window where the
-# axon tunnel answers. Round 3's lesson: a scripted measurement session is
-# worthless if nothing is awake when the tunnel comes back; this runs from
-# round open until it either completes a measurement session or the round
-# ends.
+# Tunnel watcher (VERDICT r3 #1, hardened r5): loop a cheap, killable
+# backend probe and fire tools/tpu_measure.sh in EVERY window where the
+# axon tunnel answers, until the measurement session reports all stages
+# complete (tools/tpu_stages.state contains "all") or the deadline passes.
+# Round 5 changes (VERDICT r4 weak #3 + #1):
+#   - the deadline is anchored to the FIRST start of the round, persisted
+#     in tools/tpu_watch.start — a restart inherits it instead of
+#     resetting the clock into the driver's end-of-round bench window
+#     (TPU_WATCH_RESET=1 explicitly starts a new round);
+#   - each probe runs under the shared TPU claim (tools/tpu_claim.lock,
+#     flock): when bench.py or a measure session holds the claim, the
+#     probe is skipped instead of racing for the single-process tunnel;
+#   - sessions are resumable per-stage, so the watcher keeps firing until
+#     the stage sentinel says everything (headline first) is recorded.
 #
 # Probe discipline (PERF.md "Platform findings", memory):
 #  - subprocess with start_new_session + killpg on timeout — a plain kill
-#    leaves tunnel helper processes holding pipes and the single-process
-#    TPU claim;
-#  - the probe child must be fully dead before tpu_measure.sh starts
-#    (only ONE process may hold the TPU claim).
+#    leaves tunnel helper processes holding pipes and the TPU claim;
+#  - the probe child must be fully dead before tpu_measure.sh starts.
 #
 # State file tools/tpu_watch.state holds one word: watching | measuring |
 # done | failed. tools/tpu_watch.log is the probe journal.
@@ -19,30 +25,59 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 log="tools/tpu_watch.log"
 state="tools/tpu_watch.state"
+startfile="tools/tpu_watch.start"
+stages="tools/tpu_stages.state"
 interval="${TPU_WATCH_INTERVAL:-150}"
 probe_timeout="${TPU_WATCH_PROBE_TIMEOUT:-75}"
-max_sessions="${TPU_WATCH_MAX_SESSIONS:-1}"
-# Hard deadline (seconds since start) after which the watcher exits even
-# without a session: the driver runs bench.py at round end and only ONE
-# process may hold the TPU claim — a watcher probing (or measuring) into
-# that window would starve the round's scoreboard run.
+# Hard deadline (seconds since the ROUND's first watcher start) after
+# which the watcher exits: the driver runs bench.py at round end and only
+# ONE process may hold the TPU claim — a watcher probing (or measuring)
+# into that window would starve the round's scoreboard run.
 deadline="${TPU_WATCH_DEADLINE:-30600}"
-start_ts=$(date +%s)
+now=$(date +%s)
+if [ "${TPU_WATCH_RESET:-0}" = 1 ] || [ ! -f "$startfile" ]; then
+  echo "$now" >"$startfile"
+  # A new round starts with a clean stage ledger — stale completions from
+  # the previous round would otherwise no-op every session (and the 'all'
+  # sentinel would make the watcher exit without a single probe).
+  rm -f "$stages"
+fi
+start_ts=$(cat "$startfile")
 
 echo "watching" >"$state"
-echo "=== tpu_watch start $(date -u +%FT%TZ) interval=${interval}s probe_timeout=${probe_timeout}s deadline=${deadline}s ===" >>"$log"
+echo "=== tpu_watch start $(date -u +%FT%TZ) interval=${interval}s probe_timeout=${probe_timeout}s deadline=${deadline}s (anchored $(date -u -d "@$start_ts" +%FT%TZ)) ===" >>"$log"
 
-sessions=0
 attempt=0
-while [ "$sessions" -lt "$max_sessions" ]; do
+while :; do
+  if grep -qx all "$stages" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) all measurement stages complete" >>"$log"
+    echo "done" >"$state"
+    break
+  fi
   if [ $(($(date +%s) - start_ts)) -ge "$deadline" ]; then
-    echo "$(date -u +%FT%TZ) deadline reached without a session" >>"$log"
-    echo "failed" >"$state"
+    echo "$(date -u +%FT%TZ) deadline reached" >>"$log"
+    if grep -qx headline "$stages" 2>/dev/null; then
+      echo "done" >"$state"
+    else
+      echo "failed" >"$state"
+    fi
     break
   fi
   attempt=$((attempt + 1))
-  # Killable probe: own session so killpg reaps tunnel helpers.
-  setsid python - <<'EOF' >/tmp/tpu_probe_out 2>/tmp/tpu_probe_err &
+
+  # Probe only while holding the TPU claim: a concurrent bench.py or
+  # measure session owns the tunnel and a parallel probe would wedge it.
+  exec 9>>tools/tpu_claim.lock
+  if ! flock -n 9; then
+    echo "$(date -u +%FT%TZ) attempt=$attempt probe skipped (TPU claim held: $(head -c 120 tools/tpu_claim.lock 2>/dev/null))" >>"$log"
+    exec 9>&-
+    sleep "$interval"
+    continue
+  fi
+
+  # Killable probe: own session so killpg reaps tunnel helpers; the lock
+  # fd must NOT leak into it (9>&-).
+  setsid python - 9>&- <<'EOF' >/tmp/tpu_probe_out 2>/tmp/tpu_probe_err &
 import jax
 print(jax.default_backend())
 EOF
@@ -70,34 +105,31 @@ EOF
     kill -KILL -- -"$probe_pid" 2>/dev/null || kill -KILL "$probe_pid" 2>/dev/null
     wait "$probe_pid" 2>/dev/null
   fi
+  # Release the claim before firing the session (tpu_measure.sh takes it
+  # itself) or sleeping.
+  exec 9>&-
 
   if [ "$ok" -eq 1 ]; then
     echo "$(date -u +%FT%TZ) attempt=$attempt PROBE OK backend=$backend_line -> tpu_measure.sh" >>"$log"
     echo "measuring" >"$state"
     # The measurement session may spend at most the time left to our own
-    # deadline (plus slack the driver's bench can absorb) — a late window
-    # must not run into the end-of-round bench.py.
+    # deadline — a late window must not run into the end-of-round bench.py.
     remaining=$((deadline - ($(date +%s) - start_ts)))
     [ "$remaining" -lt 600 ] && remaining=600
-    session_log_mark=$(wc -l <"tools/tpu_session.log" 2>/dev/null || echo 0)
     TPU_MEASURE_BUDGET="$remaining" bash tools/tpu_measure.sh >>"$log" 2>&1
-    # A session only counts when at least one substantive stage succeeded
-    # (the tunnel can drop mid-session, timing out every stage): otherwise
-    # go back to watching so a later window gets a retry.
-    if tail -n "+$((session_log_mark + 1))" tools/tpu_session.log 2>/dev/null \
-        | grep -Eq -- '--- stage (suite|headline|extras) rc=0 ---'; then
-      sessions=$((sessions + 1))
-      echo "$(date -u +%FT%TZ) tpu_measure.sh session $sessions succeeded" >>"$log"
+    if grep -qx all "$stages" 2>/dev/null; then
+      echo "$(date -u +%FT%TZ) measurement session completed ALL stages" >>"$log"
       echo "done" >"$state"
-    else
-      echo "$(date -u +%FT%TZ) measurement session produced no successful stage; resuming watch" >>"$log"
-      echo "watching" >"$state"
-      sleep "$interval"
+      break
     fi
+    done_stages=$(paste -sd, "$stages" 2>/dev/null || echo none)
+    echo "$(date -u +%FT%TZ) session ended; stages done: [$done_stages]; resuming watch" >>"$log"
+    echo "watching" >"$state"
+    sleep "$interval"
   else
     echo "$(date -u +%FT%TZ) attempt=$attempt probe down (backend=$(tail -1 /tmp/tpu_probe_out 2>/dev/null || echo '?'))" >>"$log"
     echo "watching" >"$state"
     sleep "$interval"
   fi
 done
-echo "=== tpu_watch exit $(date -u +%FT%TZ) sessions=$sessions ===" >>"$log"
+echo "=== tpu_watch exit $(date -u +%FT%TZ) state=$(cat "$state") stages=[$(paste -sd, "$stages" 2>/dev/null || echo none)] ===" >>"$log"
