@@ -173,6 +173,16 @@ def _as_prefix_array(prefixes: Sequence[int], log_domain: int) -> np.ndarray:
 
 
 @jax.jit
+def _select_block_outputs_jit(outs, sel):
+    """Per-prefix block selection (outs[:, sel]) as ONE device program.
+
+    Accepts a single array or a tuple of per-component arrays (Tuple value
+    types): jit flattens the pytree, so all component gathers fuse into one
+    program instead of one dispatch per component."""
+    return jax.tree.map(lambda o: o[:, sel], outs)
+
+
+@jax.jit
 def _gather_seeds_jit(seeds, control_unpacked, positions):
     sel = seeds[:, positions]  # [K, Np_pad, 4]
     ctrl = control_unpacked[:, positions]
@@ -314,11 +324,18 @@ def evaluate_until_batch(
             sel = (
                 starts[:, None] + np.arange(opp, dtype=np.int64)
             ).reshape(-1)
-            sel_d = sel if engine == "host" else jnp.asarray(sel)
-            if isinstance(outs, tuple):
-                outs = tuple(o[:, sel_d] for o in outs)
+            if engine == "host":
+                if isinstance(outs, tuple):
+                    outs = tuple(o[:, sel] for o in outs)
+                else:
+                    outs = outs[:, sel]
             else:
-                outs = outs[:, sel_d]
+                # Jitted gather: the eager fancy-index ran as ~7 separate
+                # device programs per advance (bounds ops + gather +
+                # broadcasts), found by the round-5 program-level dispatch
+                # audit — ~0.5 s of pure dispatch latency per advance on
+                # the 66 ms/dispatch tunnel.
+                outs = _select_block_outputs_jit(outs, jnp.asarray(sel))
 
     # Update context state: new prefixes are (tree_prefix << levels) + leaf,
     # only when a further hierarchy level exists.

@@ -91,6 +91,37 @@ def test_levels_fused_matches_per_level():
     np.testing.assert_array_equal(np.asarray(out_fused), np.asarray(out_ref))
 
 
+def test_levels_fused_scan_chunk_small_fast():
+    """Fast-tier scan-chunk differential (ADVICE r4): the in-program output
+    trims of _fused_advance_scan_jit (out_lens) are the r4 device-path
+    rework, and the other scan-chunk differentials live in the slow tier —
+    default CI must still output-verify at least one real scan chunk.
+    5 consecutive 1-level advances on a 5-level Int(64) hierarchy with
+    group=8 form one scan chunk (runs of >= 4 equal-level steps);
+    bit-for-bit equality with the per-level path."""
+    levels = 5
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(0b10110, [7] * levels)
+    rng = np.random.default_rng(11)
+    finals = sorted({int(x) for x in rng.integers(0, 1 << levels, size=12)})
+    pres = [
+        sorted({f >> (levels - (i + 1)) for f in finals})
+        for i in range(levels)
+    ]
+    plan = [(0, [])] + [(i, pres[i - 1]) for i in range(1, levels)]
+
+    bc_ref = hierarchical.BatchedContext.create(dpf, [ka])
+    ref = [hierarchical.evaluate_until_batch(bc_ref, h, p) for h, p in plan]
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    got = hierarchical.evaluate_levels_fused(bc, plan, group=8, use_pallas=False)
+    assert len(got) == len(ref)
+    for d, (g, r) in enumerate(zip(got, ref)):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(r), err_msg=f"level {d}"
+        )
+
+
 @pytest.mark.slow
 def test_levels_fused_scan_chunks_match_per_level():
     """Heavy-hitters-shaped plan (a run of >= 4 equal 1-level advances)
