@@ -122,6 +122,10 @@ def _pad_pack_entry_jit(seeds0, control0, pad):
     parent axis to the packed width, pack control lanes to bit masks, and
     transpose seeds to bit planes."""
     k = seeds0.shape[0]
+    # Cast inside the program: an eager .astype at the call site was one
+    # extra device dispatch on the first advance (bool -> uint32 entry
+    # state; round-5 program-level audit).
+    control0 = control0.astype(jnp.uint32)
     if pad:
         seeds0 = jnp.concatenate(
             [seeds0, jnp.zeros((k, pad, 4), jnp.uint32)], axis=1
@@ -1186,7 +1190,7 @@ def _expand_batch(
     # (r4 dispatch audit; pure latency through a 66 ms link).
     planes, control_mask = _pad_pack_entry_jit(
         jnp.asarray(seeds0, dtype=jnp.uint32),
-        jnp.asarray(control0).astype(jnp.uint32),
+        jnp.asarray(control0),
         pad=pad,
     )
 

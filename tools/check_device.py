@@ -143,14 +143,39 @@ def _main() -> int:
     return 0
 
 
+def _hh_plan(levels, num_finals, rng):
+    """Heavy-hitters-shaped fused-advance plan: every 1-level advance under
+    the surviving prefixes of `num_finals` random leaves."""
+    finals = sorted({int(x) for x in rng.integers(0, 1 << levels, size=num_finals)})
+    pres = [
+        sorted({f >> (levels - (i + 1)) for f in finals})
+        for i in range(levels)
+    ]
+    return [(0, [])] + [(i, pres[i - 1]) for i in range(1, levels)]
+
+
+def _fused_matches_host(hierarchical, evaluator, dpf, key, outs, plan) -> bool:
+    """Compares fused-advance outputs per level against the native host
+    engine on a fresh context (shared by the hierarchy/prepared extras)."""
+    bch = hierarchical.BatchedContext.create(dpf, [key])
+    for i, (h, p) in enumerate(plan):
+        ref = hierarchical.evaluate_until_batch(bch, h, p, engine="host")
+        got = evaluator.values_to_numpy(outs[i][0], 64)
+        if not np.array_equal(got.astype(np.uint64), ref[0].astype(np.uint64)):
+            return False
+    return True
+
+
 def _run_extras(jax, rng) -> int:
-    """Optional on-chip checks of the round-3 device paths. Select with
-    CHECK_EXTRAS=dcf,evalat,hierarchy,sharded (comma list or 'all')."""
+    """Optional on-chip checks of the round-3/4 device paths. Select with
+    CHECK_EXTRAS=dcf,evalat,hierarchy,prepared,sharded ('all' = every
+    one): DCF Mosaic walk, EvaluateAt Pallas walk, fused grouped
+    hierarchy advance, prepared-plan replay, 1x1 shard_map PIR."""
     extras = os.environ.get("CHECK_EXTRAS", "")
     if not extras:
         return 0
     want = (
-        {"dcf", "evalat", "hierarchy", "sharded"}
+        {"dcf", "evalat", "hierarchy", "prepared", "sharded"}
         if extras == "all"
         else set(x.strip() for x in extras.split(","))
     )
@@ -214,29 +239,52 @@ def _run_extras(jax, rng) -> int:
         kh, _ = dpf.generate_keys_incremental(
             int(rng.integers(0, 1 << levels)), [23] * levels
         )
-        finals = sorted(
-            {int(x) for x in rng.integers(0, 1 << levels, size=500)}
-        )
-        plan, ref_out = [(0, [])], []
-        pres = [
-            sorted({f >> (levels - (i + 1)) for f in finals})
-            for i in range(levels)
-        ]
-        for i in range(1, levels):
-            plan.append((i, pres[i - 1]))
+        plan = _hh_plan(levels, 500, rng)
         bc = hierarchical.BatchedContext.create(dpf, [kh])
         outs = hierarchical.evaluate_levels_fused(
             bc, plan, group=int(os.environ.get("CHECK_HH_GROUP", 8))
         )
-        bch = hierarchical.BatchedContext.create(dpf, [kh])
+        ok = _fused_matches_host(hierarchical, evaluator, dpf, kh, outs, plan)
+        verdict("hierarchy-fused", ok, f"({levels} levels, 500 nonzeros)")
+
+    if "prepared" in want:
+        # Prepared-plan replay (round-4 path, hierarchical.py:644-1067):
+        # compose the key-independent gather tables ONCE, then replay the
+        # plan across DIFFERENT key batches — the heavy-hitters
+        # aggregation shape. Never executed on a TPU before round 5.
+        from distributed_point_functions_tpu.ops import hierarchical
+
+        levels = int(os.environ.get("CHECK_PREP_LEVELS", 16))
+        params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+        dpf = DistributedPointFunction.create_incremental(params)
+        plan = _hh_plan(levels, 200, rng)
+        kh1, _ = dpf.generate_keys_incremental(
+            int(rng.integers(0, 1 << levels)), [31] * levels
+        )
+        kh2, _ = dpf.generate_keys_incremental(
+            int(rng.integers(0, 1 << levels)), [17] * levels
+        )
+        prepared = hierarchical.prepare_levels_fused(
+            hierarchical.BatchedContext.create(dpf, [kh1]),
+            plan,
+            int(os.environ.get("CHECK_PREP_GROUP", 8)),
+        )
         ok = True
-        for i, (h, p) in enumerate(plan):
-            ref = hierarchical.evaluate_until_batch(bch, h, p, engine="host")
-            got = evaluator.values_to_numpy(outs[i][0], 64)
-            if not np.array_equal(got.astype(np.uint64), ref[0].astype(np.uint64)):
+        for key in (kh1, kh2):  # replay ONE plan across key batches
+            bc = hierarchical.BatchedContext.create(dpf, [key])
+            outs = hierarchical.evaluate_levels_fused(
+                bc, prepared, use_pallas=up
+            )
+            if not _fused_matches_host(
+                hierarchical, evaluator, dpf, key, outs, plan
+            ):
                 ok = False
                 break
-        verdict("hierarchy-fused", ok, f"({levels} levels, 500 nonzeros)")
+        verdict(
+            "prepared-replay",
+            ok,
+            f"({levels} levels, 200 nonzeros, 2 key batches, one plan)",
+        )
 
     if "sharded" in want:
         # The shard_map collective PIR program on a REAL 1x1 device mesh —
