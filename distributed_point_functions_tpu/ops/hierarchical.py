@@ -295,8 +295,8 @@ def evaluate_until_batch(
     elif mesh is not None:
         outs, new_seeds, new_control = _expand_batch_sharded(
             batch,
-            jnp.asarray(seeds0).astype(jnp.uint32),
-            jnp.asarray(control0).astype(jnp.uint32),
+            jnp.asarray(seeds0),
+            jnp.asarray(control0),
             start_level, levels, spec, keep_per_block, mesh,
         )
     else:
@@ -1300,6 +1300,41 @@ def _build_sharded_parent_expand(
     return jax.jit(step)
 
 
+@functools.partial(jax.jit, static_argnames=("k", "n_out", "n_state"))
+def _sharded_trim_jit(outs, new_seeds, new_control, k, n_out, n_state):
+    """Key-pad + parent-pad trims of a sharded expansion, one program."""
+    outs = jax.tree.map(lambda o: o[:k, :n_out], outs)
+    return outs, new_seeds[:k, :n_state], new_control[:k, :n_state]
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_entry_pad_for(mesh, pad):
+    """Jitted sharded-expansion entry prep, out-sharded to the step's
+    (keys, domain) layout so the shard_map call needs no eager reshard."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    kd = NamedSharding(mesh, P("keys", "domain"))
+
+    @functools.partial(jax.jit, out_shardings=(kd, kd))
+    def entry_pad(seeds0, control0, idx):
+        seeds0 = seeds0.astype(jnp.uint32)
+        control0 = control0.astype(jnp.uint32)
+        if idx is not None:
+            seeds0 = seeds0[idx]
+            control0 = control0[idx]
+        if pad:
+            kp = seeds0.shape[0]
+            seeds0 = jnp.concatenate(
+                [seeds0, jnp.zeros((kp, pad, 4), jnp.uint32)], axis=1
+            )
+            control0 = jnp.concatenate(
+                [control0, jnp.zeros((kp, pad), jnp.uint32)], axis=1
+            )
+        return seeds0, control0
+
+    return entry_pad
+
+
 def _expand_batch_sharded(
     batch: evaluator.KeyBatch,
     seeds0,
@@ -1322,44 +1357,58 @@ def _expand_batch_sharded(
         idx = np.concatenate(
             [np.arange(k), np.zeros(key_pad, dtype=np.int64)]
         )
-        seeds0 = seeds0[jnp.asarray(idx)]
-        control0 = control0[jnp.asarray(idx)]
         batch = batch.take(idx)
+    else:
+        idx = None
     pad_to = -(-num_parents // (32 * n_domain)) * (32 * n_domain)
     pad = pad_to - num_parents
-    seeds0 = jnp.asarray(seeds0, dtype=jnp.uint32)
-    control0 = jnp.asarray(control0)
-    kp = seeds0.shape[0]  # key axis after key padding
-    if pad:
-        seeds0 = jnp.concatenate(
-            [seeds0, jnp.zeros((kp, pad, 4), jnp.uint32)], axis=1
-        )
-        control0 = jnp.concatenate(
-            [control0, jnp.zeros((kp, pad), control0.dtype)], axis=1
-        )
+    # Key-pad gather + parent pad + casts in ONE program whose outputs are
+    # ALREADY (keys, domain)-sharded: run eagerly these were ~5 separate
+    # dispatches per sharded advance, and the shard_map call then resharded
+    # every input with further eager _multi_slice programs (round-5 program
+    # audit; same storm class _pad_pack_entry_jit cures on the dense path).
+    seeds0, control0 = _sharded_entry_pad_for(mesh, pad)(
+        jnp.asarray(seeds0),
+        jnp.asarray(control0),
+        None if idx is None else jnp.asarray(idx),
+    )
     cw_dev, ccl, ccr = batch.device_cw_arrays(start_level)
     step = _build_sharded_parent_expand(
         mesh, levels, batch.party, spec, keep_per_block, pad_to // n_domain
     )
+    # The correction-word inputs are host arrays: device_put them straight
+    # onto their key shards (a transfer, not a device program) instead of
+    # uploading replicated and letting shard_map reshard eagerly.
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    krep = NamedSharding(mesh, P("keys"))
     outs, new_seeds, new_control = step(
         seeds0,
-        control0.astype(jnp.uint32),
-        jnp.asarray(cw_dev[:, :levels]),
-        jnp.asarray(ccl[:, :levels]),
-        jnp.asarray(ccr[:, :levels]),
-        tuple(jnp.asarray(a) for a in batch.codec_corrections),
+        control0,
+        jax.device_put(np.ascontiguousarray(cw_dev[:, :levels]), krep),
+        jax.device_put(np.ascontiguousarray(ccl[:, :levels]), krep),
+        jax.device_put(np.ascontiguousarray(ccr[:, :levels]), krep),
+        tuple(
+            jax.device_put(np.asarray(a), krep)
+            for a in batch.codec_corrections
+        ),
     )
     # Shards own contiguous parent slices and each emits its leaf order, so
     # the concatenation IS global leaf order: global element base of parent
     # p is p * etp. Padding lanes are all appended after the real parents,
-    # hence land in the trailing shards — trimming is a plain slice.
+    # hence land in the trailing shards — trimming is a plain slice. All
+    # three trims ride ONE jitted program: eagerly, each slice of a
+    # sharded array lowered to ~7 separate dispatches (gather + broadcast
+    # + convert chains; round-5 program audit found 21/advance here).
     etp = (1 << levels) * keep_per_block  # elements per parent
-    outs = tuple(o[:k, : num_parents * etp] for o in outs)
+    outs, new_seeds, new_control = _sharded_trim_jit(
+        outs,
+        new_seeds,
+        new_control,
+        k=k,
+        n_out=num_parents * etp,
+        n_state=num_parents * (1 << levels),
+    )
     if not spec.is_tuple:
         outs = outs[0]
-    blocks_per_parent = 1 << levels
-    return (
-        outs,
-        new_seeds[:k, : num_parents * blocks_per_parent],
-        new_control[:k, : num_parents * blocks_per_parent],
-    )
+    return outs, new_seeds, new_control

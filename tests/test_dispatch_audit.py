@@ -31,6 +31,7 @@ from distributed_point_functions_tpu.core.value_types import Int
 from distributed_point_functions_tpu.dcf import batch as dcf_batch
 from distributed_point_functions_tpu.dcf.dcf import DistributedComparisonFunction
 from distributed_point_functions_tpu.ops import evaluator, hierarchical
+from distributed_point_functions_tpu.parallel import sharded
 
 
 @pytest.fixture
@@ -187,4 +188,33 @@ def test_hierarchical_paths_program_budget(program_counter):
 
     _assert_programs(
         program_counter, fused, "evaluate_levels_fused[prepared]", budget=3
+    )
+
+
+@pytest.mark.slow
+def test_sharded_walk_program_budget(program_counter):
+    # Mesh-sharded 3-advance walk on the virtual 2x4 mesh: entry pad
+    # (out-sharded to the step layout) + shard_map step + fused trim per
+    # advance, plus gathers/selections on the later advances and one
+    # residual reshard each = 16. The round-5 audit found 87 before the
+    # entry/trim/reshard fusions — eager slices of SHARDED arrays lower to
+    # ~7 programs each, so this path regresses catastrophically if the
+    # trims or pads leave the jitted programs.
+    mesh = sharded.make_mesh(2, 4)
+    params = [DpfParameters(d, Int(64)) for d in (4, 8, 12)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    key, _ = dpf.generate_keys_incremental(0xABC, [5, 6, 7])
+
+    def walk():
+        bc = hierarchical.BatchedContext.create(dpf, [key])
+        hierarchical.evaluate_until_batch(bc, 0, mesh=mesh, device_output=True)
+        hierarchical.evaluate_until_batch(
+            bc, 1, list(range(16)), mesh=mesh, device_output=True
+        )
+        hierarchical.evaluate_until_batch(
+            bc, 2, list(range(64)), mesh=mesh, device_output=True
+        )
+
+    _assert_programs(
+        program_counter, walk, "evaluate_until_batch[mesh 2x4]", budget=16
     )
