@@ -354,13 +354,25 @@ def pir_query_batch(
         mesh, batch.num_levels, batch.party, bits=bits, xor_group=xor_group,
         mode=mode, slab_levels=int(slab_levels),
     )
+    # Host inputs go straight onto their shards (a transfer, not a device
+    # program): uploaded single-device, the shard_map call resharded every
+    # argument with its own eager program — 6 extra dispatches per query
+    # batch (round-5 program audit). Device-resident arrays (a prepared
+    # DB) pass through untouched.
+    from jax.sharding import NamedSharding
+
+    ks = NamedSharding(mesh, P("keys"))
+
+    def put(x, s):
+        return x if isinstance(x, jax.Array) else jax.device_put(np.asarray(x), s)
+
     out = step(
-        jnp.asarray(batch.seeds),
-        jnp.asarray(cw_planes),
-        jnp.asarray(ccl),
-        jnp.asarray(ccr),
-        jnp.asarray(corrections),
-        jnp.asarray(db_limbs),
+        put(batch.seeds, ks),
+        put(cw_planes, ks),
+        put(ccl, ks),
+        put(ccr, ks),
+        put(corrections, ks),
+        put(db_limbs, NamedSharding(mesh, P("domain"))),
     )
     return np.asarray(out)[:n_real]
 

@@ -218,3 +218,29 @@ def test_sharded_walk_program_budget(program_counter):
     _assert_programs(
         program_counter, walk, "evaluate_until_batch[mesh 2x4]", budget=16
     )
+
+
+@pytest.mark.slow
+def test_sharded_pir_program_budget(program_counter):
+    # One query batch = ONE device program: host inputs are device_put
+    # straight onto their shards (transfers, not programs). Before the
+    # round-5 fix the shard_map call resharded all six inputs eagerly
+    # (7 programs per batch).
+    from distributed_point_functions_tpu.core.value_types import XorWrapper
+
+    rng = np.random.default_rng(7)
+    lds = 10
+    dpf = DistributedPointFunction.create(DpfParameters(lds, XorWrapper(128)))
+    db = rng.integers(0, 2**32, size=(1 << lds, 4), dtype=np.uint32)
+    keys = []
+    for a in (3, 77, 500):
+        k0, _ = dpf.generate_keys(a, (1 << 128) - 1)
+        keys.append(k0)
+    mesh = sharded.make_mesh(2, 4)
+
+    _assert_programs(
+        program_counter,
+        lambda: np.asarray(sharded.pir_query_batch(dpf, keys, db, mesh)),
+        "pir_query_batch[mesh 2x4]",
+        budget=1,
+    )
