@@ -983,23 +983,39 @@ def evaluate_levels_fused(
         for h in prepared.plan_levels
     ]
 
-    # Entry state.
-    if ctx.previous_hierarchy_level < 0:
-        seeds0 = jnp.asarray(
-            np.broadcast_to(batch.seeds[:, None, :], (k, 1, 4)).copy()
-        )
-        control0 = jnp.asarray(
-            np.full((k, 1), np.uint32(1 if batch.party else 0))
-        )
-    else:
-        seeds0 = jnp.asarray(ctx.seeds).astype(jnp.uint32)
-        control0 = jnp.asarray(ctx.control).astype(jnp.uint32)
+    # Shard-aware uploads (round-5 program audit): with a mesh, host arrays
+    # go straight onto their key shards — uploading single-device and
+    # letting jit/shard_map reshard cost one eager _multi_slice program
+    # PER ARGUMENT per chunk. put_k: key-leading [K, ...]; put_sk:
+    # step-major stacks [S, K, ...] (key axis second).
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec
 
         key_sharding = NamedSharding(mesh, PartitionSpec("keys"))
-        seeds0 = jax.device_put(seeds0, key_sharding)
-        control0 = jax.device_put(control0, key_sharding)
+        _sk_sharding = NamedSharding(mesh, PartitionSpec(None, "keys"))
+
+        def put_k(a):
+            return jax.device_put(np.ascontiguousarray(a), key_sharding)
+
+        def put_sk(a):
+            return jax.device_put(np.ascontiguousarray(a), _sk_sharding)
+    else:
+        put_k = put_sk = jnp.asarray
+
+    # Entry state.
+    if ctx.previous_hierarchy_level < 0:
+        seeds0 = put_k(
+            np.broadcast_to(batch.seeds[:, None, :], (k, 1, 4)).copy()
+        )
+        control0 = put_k(np.full((k, 1), np.uint32(1 if batch.party else 0)))
+    else:
+        # Continuation state comes out of the previous fused program with
+        # its sharding already propagated; the device_put is a no-op then.
+        seeds0 = jnp.asarray(ctx.seeds).astype(jnp.uint32)
+        control0 = jnp.asarray(ctx.control).astype(jnp.uint32)
+        if mesh is not None:
+            seeds0 = jax.device_put(seeds0, key_sharding)
+            control0 = jax.device_put(control0, key_sharding)
 
     emit_state = prepared.emit_state
     outs_all = []
@@ -1015,22 +1031,22 @@ def evaluate_levels_fused(
                 seeds,
                 control,
                 pos_stack_dev,
-                jnp.asarray(
+                put_sk(
                     np.stack(
                         [cw_all[:, s : s + lv] for (_, _, _, s) in chunk]
                     )
                 ),
-                jnp.asarray(
+                put_sk(
                     np.stack(
                         [ccl_all[:, s : s + lv] for (_, _, _, s) in chunk]
                     )
                 ),
-                jnp.asarray(
+                put_sk(
                     np.stack(
                         [ccr_all[:, s : s + lv] for (_, _, _, s) in chunk]
                     )
                 ),
-                jnp.asarray(np.stack([vcs[t] for t in idx])),
+                put_sk(np.stack([vcs[t] for t in idx])),
                 gsel_pad_dev,
                 so,
                 levels=lv,
@@ -1046,10 +1062,10 @@ def evaluate_levels_fused(
         step_args = tuple(
             (
                 pos_dev,
-                jnp.asarray(cw_all[:, start : start + lv]),
-                jnp.asarray(ccl_all[:, start : start + lv]),
-                jnp.asarray(ccr_all[:, start : start + lv]),
-                jnp.asarray(vcs[t]),
+                put_k(cw_all[:, start : start + lv]),
+                put_k(ccl_all[:, start : start + lv]),
+                put_k(ccr_all[:, start : start + lv]),
+                put_k(vcs[t]),
                 gsel_dev,
             )
             for t, (pos_dev, lv, gsel_dev, start) in zip(idx, chunk)
