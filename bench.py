@@ -380,6 +380,53 @@ def _run(
     return result
 
 
+def _latest_onchip_headline():
+    """Most recent dated device-platform full_domain_headline record from
+    benchmarks/results.json, reduced to its load-bearing fields — attached
+    to CPU-fallback output as context (never as the measurement)."""
+    try:
+        path = os.environ.get("BENCH_RESULTS_PATH") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "benchmarks",
+            "results.json",
+        )
+        with open(path) as f:
+            records = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    best = None
+    for r in records:
+        if not isinstance(r, dict) or "error" in r:
+            continue
+        platform = r.get("platform") or ""
+        # The PRIMARY headline slot only (plus its cross-platform rename,
+        # run_all's "<bench>@<platform>") — RECORD_SUFFIX A/B variants
+        # (e.g. the fused last-hash headline) are not "the" headline.
+        if r.get("bench") not in (
+            "full_domain_headline",
+            f"full_domain_headline@{platform}",
+        ):
+            continue
+        if platform.startswith("cpu") or not platform:
+            continue
+        if best is None or str(r.get("date", "")) > str(best.get("date", "")):
+            best = r
+    if best is None:
+        return None
+    out = {
+        k: best[k]
+        for k in ("bench", "value", "unit", "platform", "date", "caveat")
+        if k in best
+    }
+    config = best.get("config")
+    vs = (
+        config.get("vs_baseline") if isinstance(config, dict) else None
+    ) or best.get("vs_baseline")
+    if vs is not None:
+        out["vs_baseline"] = vs
+    return out
+
+
 def _run_cpu_host_engine(
     log_domain: int, num_keys: int, key_chunk: int, reps: int = 1
 ) -> dict:
@@ -696,6 +743,14 @@ def main() -> None:
                 result = _run("cpu", *cpu_cfg, reps=fallback_reps)
                 if claim_failed is not None:
                     result["note"] = f"device attempt skipped: {claim_failed}"
+                onchip = _latest_onchip_headline()
+                if onchip is not None:
+                    # Context, clearly labeled as a PAST record: if the
+                    # watcher-fired session captured an on-chip headline
+                    # earlier in the round and the tunnel died again before
+                    # this run, the driver artifact should still point at
+                    # that evidence (benchmarks/results.json holds it).
+                    result["last_onchip_headline_record"] = onchip
                 if isinstance(parsed, dict):
                     for f in (
                         "device_unverified_evals_per_sec",

@@ -254,15 +254,91 @@ class TestBenchClaimArbitration:
         ]
         watch = _journal(tmp_path, lines)
         lock = str(tmp_path / "claim.lock")
+        # Hermetic results fixture: the fallback must attach the latest
+        # dated device-platform headline as clearly-labeled context.
+        results = tmp_path / "results.json"
+        results.write_text(
+            json.dumps(
+                [
+                    {"bench": "full_domain_headline", "platform": "tpu",
+                     "value": 123, "unit": "evals/s", "date": "2026-07-30"},
+                    {"bench": "full_domain_headline", "platform": "cpu-host-engine",
+                     "value": 9, "date": "2026-08-01"},
+                ]
+            )
+        )
+        env = _bench_env(watch, lock, BENCH_RESULTS_PATH=str(results))
         t0 = time.time()
-        result, stderr = _run_bench(_bench_env(watch, lock))
+        result, stderr = _run_bench(env)
         elapsed = time.time() - t0
         assert result["platform"] == "cpu-host-engine"
         assert "continuously down" in stderr
+        onchip = result.get("last_onchip_headline_record")
+        assert onchip == {
+            "bench": "full_domain_headline",
+            "platform": "tpu",
+            "value": 123,
+            "unit": "evals/s",
+            "date": "2026-07-30",
+        }
         # One short probe + one short device attempt + the tiny CPU run:
         # far under the old 600s-probe + 900s-device ordeal. Generous bound
         # for a loaded box; the configured budgets sum to ~11s + startup.
         assert elapsed < 120, elapsed
+
+
+class TestLatestOnchipHeadline:
+    def _lookup(self, tmp_path, monkeypatch, records):
+        path = tmp_path / "results.json"
+        path.write_text(json.dumps(records))
+        monkeypatch.setenv("BENCH_RESULTS_PATH", str(path))
+        b = _load_bench(monkeypatch, str(tmp_path))
+        return b._latest_onchip_headline()
+
+    def test_picks_latest_device_record(self, tmp_path, monkeypatch):
+        got = self._lookup(
+            tmp_path,
+            monkeypatch,
+            [
+                {"bench": "full_domain_headline", "platform": "tpu",
+                 "value": 1, "date": "2026-07-29"},
+                {"bench": "full_domain_headline@tpu", "platform": "tpu",
+                 "value": 2, "date": "2026-07-31",
+                 "config": {"vs_baseline": 4.5}},
+            ],
+        )
+        assert got["value"] == 2 and got["vs_baseline"] == 4.5
+
+    def test_ignores_cpu_errors_and_ab_variants(self, tmp_path, monkeypatch):
+        got = self._lookup(
+            tmp_path,
+            monkeypatch,
+            [
+                {"bench": "full_domain_headline", "platform": "cpu-host-engine",
+                 "value": 1, "date": "2026-08-01"},
+                {"bench": "full_domain_headline", "platform": "tpu",
+                 "error": "timeout", "date": "2026-08-01"},
+                {"bench": "full_domain_headline_fused_hash", "platform": "tpu",
+                 "value": 7, "date": "2026-08-01"},
+            ],
+        )
+        assert got is None
+
+    def test_null_config_survives(self, tmp_path, monkeypatch):
+        got = self._lookup(
+            tmp_path,
+            monkeypatch,
+            [
+                {"bench": "full_domain_headline", "platform": "tpu",
+                 "value": 3, "date": "2026-07-31", "config": None},
+            ],
+        )
+        assert got["value"] == 3 and "vs_baseline" not in got
+
+    def test_missing_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("BENCH_RESULTS_PATH", str(tmp_path / "nope.json"))
+        b = _load_bench(monkeypatch, str(tmp_path))
+        assert b._latest_onchip_headline() is None
 
 
 class TestRunBenchStage:
