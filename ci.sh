@@ -41,7 +41,10 @@ run_smoke() {
 }
 
 run_device() {
-  python tools/check_device.py
+  # Full differential set: headline shapes + every r3/r4 device path
+  # (DCF Mosaic walk, EvaluateAt Pallas walk, fused hierarchy, prepared
+  # replay, 1x1 shard_map PIR).
+  CHECK_EXTRAS=all python tools/check_device.py
 }
 
 case "$tier" in
