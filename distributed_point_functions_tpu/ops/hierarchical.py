@@ -293,10 +293,11 @@ def evaluate_until_batch(
             levels, keep_per_block, value_type, need_state=need_state,
         )
     elif mesh is not None:
+        # Raw entry state on purpose: the callee passes host arrays to its
+        # jit uncommitted (placement is call-setup transfer, not an eager
+        # reshard program); committing via jnp.asarray here would undo it.
         outs, new_seeds, new_control = _expand_batch_sharded(
-            batch,
-            jnp.asarray(seeds0),
-            jnp.asarray(control0),
+            batch, seeds0, control0,
             start_level, levels, spec, keep_per_block, mesh,
         )
     else:
@@ -1383,10 +1384,13 @@ def _expand_batch_sharded(
     # dispatches per sharded advance, and the shard_map call then resharded
     # every input with further eager _multi_slice programs (round-5 program
     # audit; same storm class _pad_pack_entry_jit cures on the dense path).
+    # Host arrays pass through UNcommitted: the jit places them onto the
+    # mesh at call setup (a transfer); jnp.asarray would commit them to
+    # one device first and cost an eager reshard program.
     seeds0, control0 = _sharded_entry_pad_for(mesh, pad)(
-        jnp.asarray(seeds0),
-        jnp.asarray(control0),
-        None if idx is None else jnp.asarray(idx),
+        seeds0 if isinstance(seeds0, jax.Array) else np.asarray(seeds0),
+        control0 if isinstance(control0, jax.Array) else np.asarray(control0),
+        None if idx is None else np.asarray(idx),
     )
     cw_dev, ccl, ccr = batch.device_cw_arrays(start_level)
     step = _build_sharded_parent_expand(

@@ -195,8 +195,8 @@ def test_hierarchical_paths_program_budget(program_counter):
 def test_sharded_walk_program_budget(program_counter):
     # Mesh-sharded 3-advance walk on the virtual 2x4 mesh: entry pad
     # (out-sharded to the step layout) + shard_map step + fused trim per
-    # advance, plus gathers/selections on the later advances and one
-    # residual reshard each = 16. The round-5 audit found 87 before the
+    # advance, plus gather + block-selection on the later advances = 13,
+    # with ZERO eager reshards. The round-5 audit found 87 before the
     # entry/trim/reshard fusions — eager slices of SHARDED arrays lower to
     # ~7 programs each, so this path regresses catastrophically if the
     # trims or pads leave the jitted programs.
@@ -216,7 +216,7 @@ def test_sharded_walk_program_budget(program_counter):
         )
 
     _assert_programs(
-        program_counter, walk, "evaluate_until_batch[mesh 2x4]", budget=16
+        program_counter, walk, "evaluate_until_batch[mesh 2x4]", budget=13
     )
 
 
