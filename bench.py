@@ -120,6 +120,11 @@ def _watcher_hint():
     except OSError:
         state = ""
     if state == "measuring":
+        if os.environ.get("TPU_CLAIM_HELD") == "1":
+            # WE are (inside) the measurement session holding the claim —
+            # the tunnel answered minutes ago; go straight to the device
+            # attempt at full budget instead of re-probing.
+            return "up"
         return "claimed"
     if state == "done":
         try:

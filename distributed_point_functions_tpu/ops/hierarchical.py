@@ -1384,14 +1384,11 @@ def _expand_batch_sharded(
     # dispatches per sharded advance, and the shard_map call then resharded
     # every input with further eager _multi_slice programs (round-5 program
     # audit; same storm class _pad_pack_entry_jit cures on the dense path).
-    # Host arrays pass through UNcommitted: the jit places them onto the
-    # mesh at call setup (a transfer); jnp.asarray would commit them to
-    # one device first and cost an eager reshard program.
-    seeds0, control0 = _sharded_entry_pad_for(mesh, pad)(
-        seeds0 if isinstance(seeds0, jax.Array) else np.asarray(seeds0),
-        control0 if isinstance(control0, jax.Array) else np.asarray(control0),
-        None if idx is None else np.asarray(idx),
-    )
+    # Entry state passes to the jit as-is — numpy on the first advance,
+    # device arrays (prior trim/gather outputs) afterwards. The jit places
+    # uncommitted host arrays onto the mesh at call setup (a transfer);
+    # pre-committing via jnp.asarray would cost an eager reshard program.
+    seeds0, control0 = _sharded_entry_pad_for(mesh, pad)(seeds0, control0, idx)
     cw_dev, ccl, ccr = batch.device_cw_arrays(start_level)
     step = _build_sharded_parent_expand(
         mesh, levels, batch.party, spec, keep_per_block, pad_to // n_domain

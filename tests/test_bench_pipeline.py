@@ -77,7 +77,16 @@ class TestWatcherHint:
     def test_measuring_state_means_claimed(self, tmp_path, monkeypatch):
         lines = [f"{_ts(-60)}Z attempt=1 probe down (backend=)"]
         b = _load_bench(monkeypatch, _journal(tmp_path, lines, state="measuring"))
+        monkeypatch.delenv("TPU_CLAIM_HELD", raising=False)
         assert b._watcher_hint() == "claimed"
+
+    def test_measuring_inside_own_session_means_up(self, tmp_path, monkeypatch):
+        # bench.py running INSIDE the measure session (claim held by an
+        # ancestor): the tunnel answered minutes ago — skip the probe.
+        lines = [f"{_ts(-60)}Z attempt=1 probe down (backend=)"]
+        b = _load_bench(monkeypatch, _journal(tmp_path, lines, state="measuring"))
+        monkeypatch.setenv("TPU_CLAIM_HELD", "1")
+        assert b._watcher_hint() == "up"
 
     def test_fresh_done_state_means_up(self, tmp_path, monkeypatch):
         b = _load_bench(monkeypatch, _journal(tmp_path, [], state="done"))
