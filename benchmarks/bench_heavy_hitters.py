@@ -185,7 +185,19 @@ def bench(jax, smoke):
         "bench": (
             "heavy_hitters" if engine == "host" else f"heavy_hitters_{engine}"
         ),
-        **({"verified": True} if verified else {}),
+        **(
+            {"verified": True}
+            if verified
+            else {
+                "verification": (
+                    "n/a: the host engine IS the oracle device records "
+                    "verify against (reference-parity path, tested by the "
+                    "suite)"
+                )
+            }
+            if engine == "host"
+            else {}
+        ),
         "metric": (
             f"bit-wise hierarchy, {num_levels} levels, "
             f"{num_nonzeros} uniform nonzeros, 1 key"

@@ -256,6 +256,22 @@ class TestBenchClaimArbitration:
         finally:
             holder.wait(timeout=30)
 
+    def test_up_journal_skips_probe(self, tmp_path):
+        # A fresh PROBE OK in the journal: bench.py goes straight to the
+        # device attempt (no probe subprocess) at the configured budget.
+        lines = [
+            f"{_ts(-400)}Z attempt=1 probe down (backend=)",
+            f"{_ts(-90)}Z attempt=2 PROBE OK backend=tpu -> tpu_measure.sh",
+        ]
+        watch = _journal(tmp_path, lines)
+        lock = str(tmp_path / "claim.lock")
+        result, stderr = _run_bench(_bench_env(watch, lock))
+        # No tunnel on this box: the attempt dies at its timeout and the
+        # host engine reports — but the probe must not have run at all.
+        assert result["platform"] == "cpu-host-engine"
+        assert "skipping the probe" in stderr
+        assert "backend probe" not in stderr
+
     def test_dead_journal_clamps_budgets(self, tmp_path):
         lines = [
             f"{_ts(-600 + i * 150)}Z attempt={i + 1} probe down (backend=)"
