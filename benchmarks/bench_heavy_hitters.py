@@ -178,6 +178,17 @@ def bench(jax, smoke):
         sweep[str(num_levels)] = round(t.elapsed, 4)
         log(f"level sweep: {sweep}")
 
+    if verified:  # only ever set on non-host engines (host is the oracle)
+        verification_fields = {"verified": True}
+    elif engine == "host":
+        verification_fields = {
+            "verification": (
+                "n/a: the host engine IS the oracle device records verify "
+                "against (reference-parity path, tested by the suite)"
+            )
+        }
+    else:
+        verification_fields = {}
     return {
         # Engine-distinct slots: the fused device record must not clobber
         # (or be clobbered by) the host-engine record on the same platform
@@ -185,19 +196,7 @@ def bench(jax, smoke):
         "bench": (
             "heavy_hitters" if engine == "host" else f"heavy_hitters_{engine}"
         ),
-        **(
-            {"verified": True}
-            if verified
-            else {
-                "verification": (
-                    "n/a: the host engine IS the oracle device records "
-                    "verify against (reference-parity path, tested by the "
-                    "suite)"
-                )
-            }
-            if engine == "host"
-            else {}
-        ),
+        **verification_fields,
         "metric": (
             f"bit-wise hierarchy, {num_levels} levels, "
             f"{num_nonzeros} uniform nonzeros, 1 key"
