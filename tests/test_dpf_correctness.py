@@ -192,6 +192,62 @@ def test_context_lifecycle_errors():
         dpf.evaluate_until(1, [0], ctx)
 
 
+def test_context_duplicate_prefix_with_mismatching_state():
+    """FailsIfDuplicatePrefixInCtx (distributed_point_function_test.cc): a
+    context whose partial_evaluations hold the same prefix twice with
+    DIFFERENT seed/control state is corrupt and must be rejected; an exact
+    duplicate is tolerated (the reference dedupes silently)."""
+    dpf = make_dpf([DpfParameters(w, Int(32)) for w in (3, 6, 9)])
+    k0, _ = dpf.generate_keys_incremental(5, [1, 2, 3])
+    ctx = dpf.create_evaluation_context(k0)
+    dpf.evaluate_until(0, [], ctx)
+    # The partial-evaluation cache fills on the first prefixed call
+    # (mirroring ExpandAndUpdateContext's laziness).
+    dpf.evaluate_until(1, [0, 1, 2], ctx)
+    assert ctx.partial_evaluations
+
+    import copy
+
+    # Exact duplicate: harmless — and the deduped evaluation must return
+    # exactly what the untampered context returns.
+    query = [int(ctx.partial_evaluations[0].prefix)]
+    want = dpf.evaluate_until(2, query, copy.deepcopy(ctx))
+    benign = copy.deepcopy(ctx)
+    benign.partial_evaluations.append(
+        copy.deepcopy(benign.partial_evaluations[0])
+    )
+    got = dpf.evaluate_until(2, query, benign)
+    assert list(got) == list(want)
+
+    # Same prefix, different seed: corrupt.
+    bad = copy.deepcopy(ctx)
+    clone = copy.deepcopy(bad.partial_evaluations[0])
+    clone.seed ^= 1
+    bad.partial_evaluations.append(clone)
+    with pytest.raises(InvalidArgumentError, match="Duplicate prefix"):
+        dpf.evaluate_until(2, [bad.partial_evaluations[0].prefix], bad)
+
+
+def test_context_prefix_not_present():
+    """FailsIfPrefixNotPresentInCtx: asking for a prefix whose parent state
+    was never stored (here: removed) must fail with the reference's
+    message, not silently expand garbage."""
+    dpf = make_dpf([DpfParameters(w, Int(32)) for w in (3, 6, 9)])
+    k0, _ = dpf.generate_keys_incremental(5, [1, 2, 3])
+    ctx = dpf.create_evaluation_context(k0)
+    dpf.evaluate_until(0, [], ctx)
+    # Int(32) packs 4 elements/block, so partial evaluations are stored
+    # per TREE index: the 3-bit level's 8 prefixes collapse to tree
+    # entries {0, 1} (prefix >> 2).
+    dpf.evaluate_until(1, list(range(8)), ctx)
+    assert [p.prefix for p in ctx.partial_evaluations] == [0, 1]
+    del ctx.partial_evaluations[1]  # drop tree entry 1
+    # Level-1 domain prefix 32's ancestry: level-0 prefix 32 >> 3 = 4,
+    # tree index 4 >> 2 = 1 — exactly the deleted entry.
+    with pytest.raises(InvalidArgumentError, match="not present"):
+        dpf.evaluate_until(2, [32], ctx)
+
+
 def test_maximum_output_domain_129_levels():
     """The reference's MaximumOutputDomainSize suite: a 129-level hierarchy
     with log domains 0..128, alpha spanning the full 128 bits, evaluated at
