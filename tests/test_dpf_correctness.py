@@ -6,6 +6,7 @@ The workhorse acceptance property from the reference test suite
 zero everywhere else, across value types, hierarchies, and evaluation modes.
 """
 
+import copy
 import random
 
 import pytest
@@ -181,10 +182,21 @@ def test_keygen_validation_errors():
 def test_context_lifecycle_errors():
     dpf = make_dpf([DpfParameters(3, Int(32)), DpfParameters(6, Int(32))])
     k0, _ = dpf.generate_keys_incremental(5, [1, 2])
+    # Hierarchy-level bounds (EvaluationFailsIfHierarchyLevelNegative /
+    # ...TooLarge).
+    fresh = dpf.create_evaluation_context(k0)
+    with pytest.raises(InvalidArgumentError):
+        dpf.evaluate_until(-1, [], fresh)
+    with pytest.raises(InvalidArgumentError):
+        dpf.evaluate_until(2, [], fresh)
     ctx = dpf.create_evaluation_context(k0)
     with pytest.raises(InvalidArgumentError, match="must be empty"):
         dpf.evaluate_until(0, [1], ctx)
     dpf.evaluate_until(0, [], ctx)
+    # Prefixes are domain indices at the PREVIOUS level (3 bits: 0..7) —
+    # EvaluationFailsIfPrefixOutOfRange.
+    with pytest.raises(InvalidArgumentError, match="out of range"):
+        dpf.evaluate_until(1, [8], ctx)
     with pytest.raises(InvalidArgumentError, match="greater than"):
         dpf.evaluate_until(0, [0], ctx)
     dpf.evaluate_until(1, [0, 1], ctx)
@@ -206,7 +218,6 @@ def test_context_duplicate_prefix_with_mismatching_state():
     dpf.evaluate_until(1, [0, 1, 2], ctx)
     assert ctx.partial_evaluations
 
-    import copy
 
     # Exact duplicate: harmless — and the deduped evaluation must return
     # exactly what the untampered context returns.

@@ -75,9 +75,15 @@ def test_mic_gate_validation():
         MultipleIntervalContainmentGate.create(6, [(5, 3)])
     with pytest.raises(InvalidArgumentError):
         MultipleIntervalContainmentGate.create(6, [(0, 64)])
+    # CreateFailsWith128bitGroup: the inner DCF rides Int(128) values, so
+    # the group itself is capped below 128 bits.
+    with pytest.raises(InvalidArgumentError):
+        MultipleIntervalContainmentGate.create(128, [(0, 1)])
     gate = MultipleIntervalContainmentGate.create(6, [(1, 5)])
     with pytest.raises(InvalidArgumentError):
         gate.gen(64, [0])
+    with pytest.raises(InvalidArgumentError):  # output mask outside group
+        gate.gen(0, [64])
     with pytest.raises(InvalidArgumentError):
         gate.gen(0, [0, 1])
     k0, _ = gate.gen(0, [0])
