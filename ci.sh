@@ -9,6 +9,9 @@
 #                      on reduced configs.
 #   ./ci.sh device     hardware tier: on-chip differential checks
 #                      (tools/check_device.py) — requires a reachable TPU.
+#   ./ci.sh faults     integrity tier: the runtime-integrity /
+#                      fault-injection suite (tests marked 'faults'),
+#                      forced onto XLA:CPU.
 #   ./ci.sh all        fast + smoke.
 #
 # Every tier exits nonzero on the first failure. Tests force a virtual
@@ -47,12 +50,22 @@ run_device() {
   CHECK_EXTRAS=all python tools/check_device.py
 }
 
+run_faults() {
+  # Runtime-integrity / fault-injection suite (ISSUE 1): every injected
+  # fault class must be detected by sentinel verification and recovered by
+  # the Pallas->JAX->numpy fallback chain. Forced onto XLA:CPU so the tier
+  # never contends for the TPU claim and detection is exercised against a
+  # known-good backend.
+  JAX_PLATFORMS=cpu python -m pytest tests/ -q -x -m faults
+}
+
 case "$tier" in
   fast) run_fast ;;
   slow) run_slow ;;
   smoke) run_smoke ;;
   device) run_device ;;
+  faults) run_faults ;;
   all) run_fast; run_smoke ;;
-  *) echo "unknown tier: $tier (fast|slow|smoke|device|all)" >&2; exit 2 ;;
+  *) echo "unknown tier: $tier (fast|slow|smoke|device|faults|all)" >&2; exit 2 ;;
 esac
 echo "ci: tier '$tier' passed"

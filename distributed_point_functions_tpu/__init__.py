@@ -15,9 +15,14 @@ from .core.keys import CorrectionWord, DpfKey, EvaluationContext, PartialEvaluat
 from .core.params import DpfParameters, ParameterValidator
 from .core.value_types import Int, IntModN, TupleType, ValueType, XorWrapper
 from .utils.errors import (
+    DataCorruptionError,
+    DataLossError,
     DpfError,
     FailedPreconditionError,
+    InternalError,
     InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
     UnimplementedError,
 )
 
@@ -39,6 +44,11 @@ __all__ = [
     "InvalidArgumentError",
     "FailedPreconditionError",
     "UnimplementedError",
+    "InternalError",
+    "DataLossError",
+    "DataCorruptionError",
+    "UnavailableError",
+    "ResourceExhaustedError",
 ]
 
 __version__ = "0.1.0"

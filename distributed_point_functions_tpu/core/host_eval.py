@@ -125,6 +125,29 @@ def full_domain_evaluate_host(
 
 
 
+def values_to_limbs(vals: np.ndarray, bits: int) -> np.ndarray:
+    """Host-engine values -> the device evaluators' uint32[..., lpe] limb
+    layout (lpe = max(bits // 32, 1)).
+
+    The inverse of ops/evaluator.values_to_numpy for this module's return
+    types (uint64 rows up to 64 bits, uint32[..., 4] limb rows at 128) —
+    the comparison format of the runtime integrity layer's host oracle
+    (utils/integrity.py verifies device limb outputs against it).
+    """
+    vals = np.asarray(vals)
+    if bits == 128:
+        return vals  # already uint32[..., 4] limb rows
+    if bits <= 32:
+        return (vals & np.uint64(0xFFFFFFFF)).astype(np.uint32)[..., None]
+    return np.stack(
+        [
+            (vals & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            (vals >> np.uint64(32)).astype(np.uint32),
+        ],
+        axis=-1,
+    )
+
+
 def pack_vc_wide(vc: np.ndarray) -> np.ndarray:
     """uint32[..., 4] correction limb rows -> uint64[..., 2] (lo, hi) pairs
     (the native fused kernels' correction layout)."""

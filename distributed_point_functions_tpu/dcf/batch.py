@@ -27,6 +27,7 @@ import numpy as np
 
 from ..core import uint128
 from ..ops import aes_jax, backend_jax, evaluator
+from ..utils import errors
 
 
 def _capture_tables(dcf, xs_padded: np.ndarray, num_points: int):
@@ -276,7 +277,9 @@ def _prep_points(dcf, keys: Sequence, xs: Sequence[int], p_pad: int):
     num_points = len(xs)
     for x in xs:
         if x < 0 or (n < 128 and int(x) >= (1 << n)):
-            raise ValueError(f"evaluation point {x} outside the domain")
+            raise errors.InvalidArgumentError(
+                f"evaluation point {x} outside the domain"
+            )
     batch = evaluator.KeyBatch.from_keys(dcf.dpf, [k.key for k in keys])
     xs_padded = np.zeros(p_pad, dtype=object)
     for j, x in enumerate(xs):
@@ -382,7 +385,10 @@ def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
 
     bits, xor_group = evaluator._value_kind(dcf.value_type)
     if not native.available():
-        raise RuntimeError("native AES-NI engine unavailable on this host")
+        raise errors.UnavailableError(
+            "native AES-NI engine unavailable on this host; use the device "
+            "path (engine='device') or build native/dpf_native.cc"
+        )
     num_points = len(xs)
     k = len(keys)
     batch, paths, acc_mask, block_sel, depth_to_hierarchy = _prep_points(

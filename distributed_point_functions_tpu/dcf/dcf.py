@@ -159,5 +159,7 @@ class DistributedComparisonFunction:
         if engine == "host":
             return batch.batch_evaluate_host(self, keys, xs)
         if engine != "device":
-            raise ValueError(f"engine must be 'device' or 'host', got {engine!r}")
+            raise InvalidArgumentError(
+                f"engine must be 'device' or 'host', got {engine!r}"
+            )
         return batch.batch_evaluate(self, keys, xs)

@@ -20,6 +20,7 @@
 
 #if defined(__AES__) && defined(__SSSE3__)
 #include <immintrin.h>
+#include <cpuid.h>
 // VAES intrinsics + the target attribute need gcc >= 9 or clang;
 // older toolchains still build the full 128-bit AES-NI engine.
 #if defined(__x86_64__) && (defined(__clang__) || __GNUC__ >= 9)
@@ -180,9 +181,16 @@ inline bool use_vaes() {
 #else
   static const bool on = [] {
     if (std::getenv("DPF_TPU_NO_VAES") != nullptr) return false;
+    // __builtin_cpu_supports("vaes") only exists from gcc 11 — and a
+    // toolchain that can compile the intrinsics (gcc >= 9) may still lack
+    // the builtin, which used to abort the whole build and silently lose
+    // the native engine to the ~95x-slower numpy path. Read the CPUID bit
+    // (leaf 7, ECX bit 9) directly; AVX-512 state checks (which need
+    // OSXSAVE/XCR0 handling) stay on the builtin, present since gcc 5.
+    unsigned eax = 0, ebx = 0, ecx = 0, edx = 0;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
     return __builtin_cpu_supports("avx512f") &&
-           __builtin_cpu_supports("avx512bw") &&
-           __builtin_cpu_supports("vaes") != 0;
+           __builtin_cpu_supports("avx512bw") && ((ecx >> 9) & 1u) != 0;
   }();
   return on;
 #endif

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import constants
+from ..utils import errors
 from . import aes_jax
 
 _FULL = np.uint32(0xFFFFFFFF)
@@ -66,6 +67,26 @@ def log_backend_once() -> None:
         log.warning("JAX backend unavailable: %r", e)
 
 
+def shard_map(fn, mesh, in_specs, out_specs):
+    """`jax.shard_map` across installed jax versions.
+
+    Newer jax exposes it at the top level (replication checking spelled
+    `check_vma`); older releases (e.g. the 0.4.x on this image) only have
+    `jax.experimental.shard_map` (spelled `check_rep`). Without the shim
+    every sharded path dies at build time with AttributeError on the old
+    runtime — a whole backend lost to an API rename."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def _rk_np(which: str) -> np.ndarray:
     left = aes_jax.round_key_planes(constants.PRG_KEY_LEFT)
@@ -77,7 +98,7 @@ def _rk_np(which: str) -> np.ndarray:
         return aes_jax.round_key_planes(constants.PRG_KEY_VALUE)
     if which == "lr_diff":
         return left ^ aes_jax.round_key_planes(constants.PRG_KEY_RIGHT)
-    raise ValueError(which)
+    raise errors.InternalError(f"unknown PRG round-key table {which!r}")
 
 
 def _rk(which: str) -> jnp.ndarray:

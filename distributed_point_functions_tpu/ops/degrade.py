@@ -1,0 +1,300 @@
+"""Graceful backend degradation: Pallas -> pure-JAX -> numpy host engine.
+
+A two-server FSS deployment must keep answering when a backend goes bad —
+and on this image's hardware "bad" has meant *silently wrong*, not just
+crashed (PERF.md "Platform findings"). This module wraps the bulk
+evaluators (ops/evaluator.py) in a fallback chain driven by the runtime
+integrity layer (utils/integrity.py):
+
+  1. **Mosaic/Pallas row kernels** — the fast path on real TPUs.
+  2. **Pure-JAX XLA bitslice** — same math, no Mosaic lowering; the level
+     a Mosaic-specific miscompile degrades to.
+  3. **Numpy/native host engine** (core/host_eval.py) — the oracle
+     itself; slow but trusted, the level of last resort.
+
+Per level: transient failures (``UnavailableError``) retry with bounded
+exponential backoff; ``ResourceExhaustedError`` halves the key-batch
+chunk down to ``min_key_chunk`` before degrading; detected corruption
+(``DataCorruptionError`` from sentinel verification) degrades
+*immediately* — deterministic wrong answers do not get retried at the
+level that produced them. Every decision emits a structured event through
+``utils.integrity.emit_event`` (kinds "retry", "chunk-halved", "degrade",
+"recovered") so operators can see a server running degraded; see README
+"Running degraded" for the log-line format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import faultinject, integrity
+from ..utils.errors import (
+    DataCorruptionError,
+    DataLossError,
+    DpfError,
+    InternalError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradationPolicy:
+    """Knobs of the fallback walk. The defaults suit a serving loop; tests
+    zero the backoff."""
+
+    max_retries: int = 2  # transient (UnavailableError) retries per level
+    backoff_seconds: float = 0.05  # base of the exponential backoff
+    min_key_chunk: int = 1  # floor of resource-exhaustion chunk halving
+    verify: Optional[bool] = None  # sentinel verification (None = env default)
+
+
+DEFAULT_POLICY = DegradationPolicy()
+
+#: The fallback chain, fastest first. "pallas" is only present when the
+#: platform default would use the Mosaic kernels (real TPUs or a forced
+#: DPF_TPU_PALLAS=1); on CPU the chain starts at "jax".
+BACKEND_LEVELS = ("pallas", "jax", "numpy")
+
+
+def fallback_chain() -> Tuple[str, ...]:
+    from . import evaluator
+
+    if evaluator._pallas_default():
+        return BACKEND_LEVELS
+    return BACKEND_LEVELS[1:]
+
+
+#: Taxonomy categories the chain may retry / degrade around. Everything
+#: else propagates untouched from the first level that raises it:
+#: InvalidArgumentError / FailedPreconditionError are the caller's bug,
+#: and a library-raised InternalError (e.g. the host-oracle AES self-test
+#: failing) means the oracle itself is broken — degrading to the numpy
+#: level would serve answers from the very code whose self-test just
+#: failed. XLA runtime INTERNAL errors are still degradable: they are not
+#: DpfError instances, so classify_exception wraps them via the
+#: string-matching branch below.
+_DEGRADABLE = (
+    DataCorruptionError,
+    DataLossError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+
+def classify_exception(exc: BaseException) -> Optional[DpfError]:
+    """Maps runtime/XLA exceptions onto the library's error taxonomy.
+
+    Returns a taxonomy error (the exception itself if already a degradable
+    one) or None for exceptions that should propagate unclassified
+    (programming errors must not be silently 'degraded' around)."""
+    if isinstance(exc, DpfError):
+        return exc if isinstance(exc, _DEGRADABLE) else None
+    text = f"{type(exc).__name__}: {exc}"
+    upper = text.upper()
+    if "RESOURCE_EXHAUSTED" in upper or "OUT OF MEMORY" in upper:
+        err = ResourceExhaustedError(text)
+    elif "UNAVAILABLE" in upper or "DEADLINE_EXCEEDED" in upper or "FAILED TO CONNECT" in upper:
+        err = UnavailableError(text)
+    elif "INTERNAL" in upper and "XLARUNTIMEERROR" in type(exc).__name__.upper():
+        err = InternalError(text)
+    else:
+        return None
+    err.__cause__ = exc
+    return err
+
+
+def _host_full_domain_limbs(dpf, keys, hierarchy_level, key_chunk):
+    from ..core import host_eval
+
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    bits, _ = _scalar_bits(dpf, hierarchy_level)
+    raw = host_eval.full_domain_evaluate_host(
+        dpf, keys, hierarchy_level, key_chunk=key_chunk
+    )
+    return host_eval.values_to_limbs(raw, bits)
+
+
+def _host_evaluate_at_limbs(dpf, keys, points, hierarchy_level):
+    from ..core import host_eval
+
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    bits, _ = _scalar_bits(dpf, hierarchy_level)
+    raw = host_eval.evaluate_at_host(dpf, keys, points, hierarchy_level)
+    return host_eval.values_to_limbs(raw, bits)
+
+
+def _scalar_bits(dpf, hierarchy_level):
+    from . import evaluator
+
+    value_type = dpf.validator.parameters[hierarchy_level].value_type
+    return evaluator._value_kind(value_type)
+
+
+def _run_chain(op_name: str, policy: DegradationPolicy, attempt_fn):
+    """Walks the fallback chain for one logical operation.
+
+    `attempt_fn(backend, key_chunk)` performs the operation at one level
+    (sentinel-verified for device levels) and returns the result; this
+    driver owns retry / backoff / chunk-halving / degradation and the
+    structured events. Raises the last error when even the host engine
+    fails.
+    """
+    chain = fallback_chain()
+    last_err: Optional[BaseException] = None
+    degraded = False
+    for level_idx, backend in enumerate(chain):
+        chunk = None  # resolved lazily by attempt_fn's default
+        retries = 0
+        while True:
+            try:
+                faultinject.maybe_raise("device_call", backend=backend)
+                result = attempt_fn(backend, chunk)
+                if degraded:
+                    integrity.emit_event(
+                        "recovered",
+                        f"{op_name} served by fallback level {backend!r}",
+                        backend,
+                        op=op_name,
+                    )
+                return result
+            except Exception as exc:  # noqa: BLE001 — classified below
+                err = classify_exception(exc)
+                if err is None:
+                    raise
+                if isinstance(err, ResourceExhaustedError):
+                    new_chunk = _halve(chunk, policy, attempt_fn)
+                    if new_chunk is not None:
+                        integrity.emit_event(
+                            "chunk-halved",
+                            f"{op_name} on {backend!r}: resource exhausted, "
+                            f"key chunk -> {new_chunk}",
+                            backend,
+                            op=op_name,
+                            key_chunk=new_chunk,
+                        )
+                        chunk = new_chunk
+                        continue
+                elif isinstance(err, UnavailableError):
+                    if retries < policy.max_retries:
+                        retries += 1
+                        delay = policy.backoff_seconds * (2 ** (retries - 1))
+                        integrity.emit_event(
+                            "retry",
+                            f"{op_name} on {backend!r} unavailable; retry "
+                            f"{retries}/{policy.max_retries} after {delay:.3f}s",
+                            backend,
+                            op=op_name,
+                            retry=retries,
+                        )
+                        if delay > 0:
+                            time.sleep(delay)
+                        continue
+                # DataCorruptionError (and exhausted retries / chunk floor):
+                # degrade to the next level.
+                last_err = err
+                if level_idx + 1 < len(chain):
+                    detail = f"{op_name}: {backend!r} -> " \
+                        f"{chain[level_idx + 1]!r} after {type(err).__name__}"
+                    if isinstance(err, DataCorruptionError) and err.pattern:
+                        detail += f" ({err.pattern})"
+                    integrity.emit_event(
+                        "degrade", detail, backend, op=op_name,
+                        error=type(err).__name__,
+                    )
+                    degraded = True
+                break
+    assert last_err is not None
+    raise last_err
+
+
+def _halve(chunk, policy: DegradationPolicy, attempt_fn) -> Optional[int]:
+    """Next smaller chunk, or None when the floor is reached. `chunk` is
+    None before the first failure; the operation's own default is exposed
+    by attempt_fn.default_chunk."""
+    current = chunk if chunk is not None else attempt_fn.default_chunk
+    if current <= policy.min_key_chunk:
+        return None
+    return max(policy.min_key_chunk, current // 2)
+
+
+def full_domain_evaluate_robust(
+    dpf,
+    keys: Sequence,
+    hierarchy_level: int = -1,
+    key_chunk: int = 32,
+    host_levels: Optional[int] = None,
+    policy: DegradationPolicy = DEFAULT_POLICY,
+) -> np.ndarray:
+    """`evaluator.full_domain_evaluate` behind the integrity + degradation
+    stack: sentinel-verified on device levels, bit-correct via the host
+    engine when every device level fails. Scalar Int/XorWrapper outputs
+    (the host oracle's scope). Returns uint32[K, domain, lpe] limbs."""
+    from . import evaluator
+
+    _scalar_bits(dpf, hierarchy_level)  # raises early for codec types
+
+    def attempt(backend: str, chunk: Optional[int]):
+        ck = chunk if chunk is not None else key_chunk
+        if backend == "numpy":
+            # The host engine IS the oracle: nothing meaningful to verify
+            # it against, and the fault harness deliberately has no hook
+            # here — injected faults model device-side corruption.
+            return _host_full_domain_limbs(dpf, keys, hierarchy_level, ck)
+        return evaluator.full_domain_evaluate(
+            dpf,
+            keys,
+            hierarchy_level,
+            key_chunk=ck,
+            host_levels=host_levels,
+            use_pallas=(backend == "pallas"),
+            integrity=True if policy.verify is None else policy.verify,
+        )
+
+    attempt.default_chunk = key_chunk
+    return _run_chain("full_domain_evaluate", policy, attempt)
+
+
+def evaluate_at_robust(
+    dpf,
+    keys: Sequence,
+    points: Sequence[int],
+    hierarchy_level: int = -1,
+    policy: DegradationPolicy = DEFAULT_POLICY,
+) -> np.ndarray:
+    """`evaluator.evaluate_at_batch` behind the integrity + degradation
+    stack. Scalar outputs; returns uint32[K, P, lpe] limbs."""
+    from . import evaluator
+
+    _scalar_bits(dpf, hierarchy_level)
+
+    def attempt(backend: str, chunk: Optional[int]):
+        if backend == "numpy":
+            return _host_evaluate_at_limbs(dpf, keys, points, hierarchy_level)
+        # evaluate_at_batch has no chunking of its own (the K x P program
+        # is one dispatch), so resource-exhaustion halving slices the key
+        # batch here; each slice carries its own sentinel probe.
+        ck = chunk if chunk is not None else len(keys)
+        outs = [
+            evaluator.evaluate_at_batch(
+                dpf,
+                keys[i : i + ck],
+                points,
+                hierarchy_level,
+                use_pallas=(backend == "pallas"),
+                integrity=True if policy.verify is None else policy.verify,
+            )
+            for i in range(0, len(keys), ck)
+        ]
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    attempt.default_chunk = len(keys) if keys else 1
+    return _run_chain("evaluate_at_batch", policy, attempt)

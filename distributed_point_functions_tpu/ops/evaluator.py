@@ -40,6 +40,8 @@ from ..core import backend_numpy, uint128
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..core.value_types import Int, XorWrapper
+from ..utils import faultinject
+from ..utils.envflags import env_bool as _env_bool
 from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, value_codec
 
@@ -87,7 +89,9 @@ class KeyBatch:
         )
         for i, key in enumerate(keys):
             if key.party != party:
-                raise ValueError("all keys in a batch must belong to one party")
+                raise InvalidArgumentError(
+                    "all keys in a batch must belong to one party"
+                )
             v.validate_key(key)
             seeds[i] = uint128.to_limbs(key.seed)
             for l in range(stop_level):
@@ -679,6 +683,7 @@ def full_domain_fold_chunks(
 
     if use_pallas is None:
         use_pallas = _pallas_default()
+    _inject_batch_faults(batch, use_pallas)
 
     db_dev = None
     if db_lane is not None:
@@ -729,23 +734,6 @@ def _walk_chunk_codec_jit(
     return jax.vmap(one)(seeds, cw_planes, ccl, ccr, corrections)
 
 
-def _env_bool(name: str, default: bool = False) -> bool:
-    """Boolean env flag with STRICT parsing: unrecognized values raise
-    instead of silently picking a side (a typo in an A/B benchmark flag
-    must not measure the same path twice)."""
-    env = os.environ.get(name)
-    if env is None:
-        return default
-    low = env.strip().lower()
-    if low in ("1", "true", "yes", "on"):
-        return True
-    if low in ("0", "false", "no", "off", ""):
-        return False
-    raise InvalidArgumentError(
-        f"{name} must be a boolean-ish value, got {env!r}"
-    )
-
-
 def _pallas_default() -> bool:
     """Resolves the Mosaic-kernel default: DPF_TPU_PALLAS when set
     (1/true/yes/on vs 0/false/no/off), else ON exactly for real TPU
@@ -754,6 +742,26 @@ def _pallas_default() -> bool:
     if "DPF_TPU_PALLAS" in os.environ:
         return _env_bool("DPF_TPU_PALLAS")
     return jax.default_backend() == "tpu"
+
+
+def _fi_backend(use_pallas: bool) -> str:
+    """Fault-injection backend level of a device call (ops/degrade.py
+    chain names): the Mosaic kernels are "pallas", XLA bitslice is "jax"."""
+    return "pallas" if use_pallas else "jax"
+
+
+def _inject_batch_faults(batch: KeyBatch, use_pallas: bool) -> None:
+    """Applies armed seed/correction-word fault plans to the prepared
+    device batch (utils/faultinject.py). No-op — one truthiness check —
+    when no plan is armed. Deliberately NOT called by the host oracle
+    (core/host_eval.py builds its own KeyBatch): injected faults model
+    device-side corruption, so the oracle and the numpy fallback level
+    always see clean data."""
+    if not faultinject.is_active():
+        return
+    backend = _fi_backend(use_pallas)
+    batch.seeds = faultinject.corrupt_seeds(batch.seeds, backend=backend)
+    batch.cw_seeds = faultinject.corrupt_cw(batch.cw_seeds, backend=backend)
 
 
 def _key_chunks(batch: KeyBatch, num_keys: int, key_chunk: int):
@@ -830,22 +838,24 @@ def full_domain_evaluate_chunks(
     one-yield-per-chunk consumers must opt into knowingly.
     """
     if mode not in ("levels", "fused", "walk"):
-        raise ValueError(
+        raise InvalidArgumentError(
             f"mode must be 'levels', 'fused' or 'walk', got {mode!r}"
         )
     if lane_slab is not None:
         if mode != "fused" or not leaf_order:
-            raise ValueError(
+            raise InvalidArgumentError(
                 "lane_slab requires mode='fused' with leaf_order=True "
                 "(lane-order consumers cannot model the slab structure)"
             )
         if lane_slab % 32 or lane_slab <= 0:
-            raise ValueError(f"lane_slab must be a positive multiple of 32, got {lane_slab}")
+            raise InvalidArgumentError(
+                f"lane_slab must be a positive multiple of 32, got {lane_slab}"
+            )
     if mode == "walk" and (not leaf_order or host_levels is not None):
         # Silent acceptance would corrupt lane-order consumers: walk output
         # is always leaf order, so a caller that permuted its static data
         # with lane_order_map would reduce against wrong domain indices.
-        raise ValueError(
+        raise InvalidArgumentError(
             "mode='walk' always yields leaf order and does no host "
             "pre-expansion; leaf_order=False / host_levels are not "
             "compatible with it"
@@ -894,6 +904,7 @@ def full_domain_evaluate_chunks(
     num_keys = len(keys)
     if use_pallas is None:
         use_pallas = _pallas_default()
+    _inject_batch_faults(batch, use_pallas)
     # (lanes, levels) -> DEVICE-resident leaf-order gather: the index array
     # is ~MBs at serving sizes, and re-uploading it per dispatch would put
     # the host link (megabytes/s through this image's tunnel) on the hot
@@ -1110,6 +1121,8 @@ def full_domain_evaluate(
     hierarchy_level: int = -1,
     key_chunk: int = 32,
     host_levels: Optional[int] = None,
+    use_pallas: Optional[bool] = None,
+    integrity: Optional[bool] = None,
 ) -> np.ndarray:
     """Full-domain evaluation of a key batch, results on the host.
 
@@ -1120,11 +1133,32 @@ def full_domain_evaluate(
     (struct of arrays) — `value_codec.values_to_host` converts either back to
     host values. Keys are processed in chunks of `key_chunk` to bound HBM
     use. For on-device consumption use `full_domain_evaluate_chunks`.
+
+    `integrity` enables sentinel-key verification (None = the
+    DPF_TPU_INTEGRITY env default): one library-generated probe key rides
+    the batch through the same programs at the same shape, and its output
+    is checked against the host oracle — a mismatch raises
+    DataCorruptionError carrying the corrupted lane pattern
+    (utils/integrity.py; PERF.md "Platform findings"). Costs one extra key
+    per batch: free when the final chunk has a padding slot for it, but
+    when len(keys) is an exact multiple of `key_chunk` the probe spills
+    into one extra dispatch of its own (PERF.md "sentinel overhead").
+    Scalar Int/XorWrapper outputs only; codec value types evaluate
+    unverified with an "integrity-skip" event.
     """
+    from ..utils import integrity as _integrity
+
+    if use_pallas is None:
+        use_pallas = _pallas_default()
+    keys, probe = _integrity.setup_probe(
+        dpf, hierarchy_level, keys, integrity, "full_domain_evaluate",
+        backend=_fi_backend(use_pallas),
+    )
     outs = []
     is_tuple = None
     for valid, out in full_domain_evaluate_chunks(
-        dpf, keys, hierarchy_level, key_chunk, host_levels
+        dpf, keys, hierarchy_level, key_chunk, host_levels,
+        use_pallas=use_pallas,
     ):
         if is_tuple is None:
             is_tuple = isinstance(out, tuple)
@@ -1137,7 +1171,15 @@ def full_domain_evaluate(
             np.concatenate([o[c] for o in outs], axis=0)
             for c in range(len(outs[0]))
         )
-    return np.concatenate(outs, axis=0)
+    out = np.concatenate(outs, axis=0)
+    out = faultinject.corrupt_output(out, backend=_fi_backend(use_pallas))
+    if probe is not None:
+        _integrity.verify_probe_values(
+            probe, out[-1], context="full_domain_evaluate",
+            key_index=out.shape[0] - 1,
+        )
+        out = out[:-1]
+    return out
 
 
 def lane_order_map(
@@ -1312,6 +1354,7 @@ def evaluate_at_batch(
     hierarchy_level: int = -1,
     device_output: bool = False,
     use_pallas: Optional[bool] = None,
+    integrity: Optional[bool] = None,
 ):
     """Evaluates every key at every point on device.
 
@@ -1322,13 +1365,26 @@ def evaluate_at_batch(
     outputs, or a tuple of per-component arrays for Tuple outputs — numpy
     by default, device-resident jax arrays with device_output=True (for
     on-device consumers; see PERF.md on the host-link cost).
+
+    `integrity` (None = DPF_TPU_INTEGRITY env default) appends a sentinel
+    probe key verified at these exact points against the host oracle —
+    see `full_domain_evaluate`.
     """
+    from ..utils import integrity as _integrity
+
     v = dpf.validator
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
+    if use_pallas is None:
+        use_pallas = _pallas_default()
+    keys, probe = _integrity.setup_probe(
+        dpf, hierarchy_level, keys, integrity, "evaluate_at_batch",
+        backend=_fi_backend(use_pallas),
+    )
     value_type = v.parameters[hierarchy_level].value_type
     backend_jax.log_backend_once()
     batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    _inject_batch_faults(batch, use_pallas)
     spec = batch.spec
     scalar_fast = spec.is_scalar_direct and spec.blocks_needed == 1
     num_levels = batch.num_levels
@@ -1367,11 +1423,20 @@ def evaluate_at_batch(
             bits=bits,
             party=batch.party,
             xor_group=xor_group,
-            use_pallas=(
-                _pallas_default() if use_pallas is None else use_pallas
-            ),
+            use_pallas=use_pallas,
         )
-        return out[:, :p] if device_output else np.asarray(out)[:, :p]
+        out = out[:, :p]
+        if not device_output:
+            out = faultinject.corrupt_output(
+                np.asarray(out), backend=_fi_backend(use_pallas)
+            )
+        if probe is not None:
+            _integrity.verify_probe_at_points(
+                probe, points, np.asarray(out[-1]),
+                key_index=out.shape[0] - 1,
+            )
+            out = out[:-1]
+        return out
     out = _evaluate_points_codec_jit(
         jnp.asarray(seeds),
         jnp.asarray(control0),

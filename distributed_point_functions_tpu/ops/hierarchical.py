@@ -1296,7 +1296,7 @@ def _build_sharded_parent_expand(
         )
         return outs, new_seeds, new_control
 
-    step = jax.shard_map(
+    step = backend_jax.shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(
@@ -1312,7 +1312,6 @@ def _build_sharded_parent_expand(
             P("keys", "domain"),
             P("keys", "domain"),
         ),
-        check_vma=False,
     )
     return jax.jit(step)
 

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..ops import aes_jax, backend_jax, evaluator
-from ..utils import errors
+from ..utils import errors, faultinject
 
 
 def make_mesh(n_key_shards: int, n_domain_shards: int, devices=None) -> Mesh:
@@ -268,7 +268,7 @@ def build_pir_step(
         gathered = jax.lax.all_gather(partial, "domain")  # [n_domain, Kl, lpe]
         return jnp.bitwise_xor.reduce(gathered, axis=0)
 
-    step = jax.shard_map(
+    step = backend_jax.shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(
@@ -280,9 +280,47 @@ def build_pir_step(
             P("domain"),  # db
         ),
         out_specs=P("keys"),
-        check_vma=False,
     )
     return jax.jit(step)
+
+
+def _pir_probe(dpf, keys, integrity_flag, context: str, backend: str):
+    """PIR-side alias of the shared probe setup (utils/integrity.py).
+    `backend` is the fault-injection level of the call, so backend-scoped
+    wire fault plans keep their scope on the PIR paths."""
+    from ..utils import integrity as _integrity
+
+    return _integrity.setup_probe(
+        dpf, -1, keys, integrity_flag, context, backend=backend
+    )
+
+
+def _pir_verify_fold(
+    probe, responses: np.ndarray, db_natural, context: str, backend: str
+):
+    """Strips and checks the probe's response row: its XOR fold against the
+    natural-order DB is recomputed from the host oracle
+    (utils/integrity.verify_probe_fold). Returns responses without the
+    probe row; raises DataCorruptionError on mismatch. `backend` is the
+    fault-injection level the responses were computed on, so
+    backend-scoped plans keep their scope on the PIR paths (the "bit4"
+    pattern has no position axis here — corrupt PIR responses with
+    pattern="lane")."""
+    from ..utils import integrity as _integrity
+
+    responses = faultinject.corrupt_output(
+        responses[:, None, :], backend=backend
+    )[:, 0, :]
+    if probe is None:
+        return responses
+    _integrity.verify_probe_fold(
+        probe,
+        responses[-1],
+        db_limbs=db_natural,
+        context=context,
+        key_index=responses.shape[0] - 1,
+    )
+    return responses[:-1]
 
 
 def pir_query_batch(
@@ -292,6 +330,7 @@ def pir_query_batch(
     mesh: Mesh,
     mode: str = "expand",
     slab_levels=None,
+    integrity=None,
 ) -> np.ndarray:
     """One server's answers for a batch of PIR queries. Returns uint32[K, lpe].
 
@@ -299,19 +338,35 @@ def pir_query_batch(
     keys, shards them over `mesh`, runs the compiled step. slab_levels=None
     picks the smallest slab count that keeps each device's expansion
     temporaries under ~DPF_TPU_PIR_SLAB_BUDGET bytes (default 2 GB).
+
+    `integrity` (None = DPF_TPU_INTEGRITY env default) appends one sentinel
+    probe key to the batch; its folded response is recomputed on the host
+    oracle and a mismatch raises DataCorruptionError — a silently corrupted
+    PIR answer is a wrong answer handed to a client (utils/integrity.py).
+    With a bare device-resident `db_limbs` the verification fold pulls the
+    DB to the host once per call; a natural-order PreparedPirDatabase
+    (prepare_pir_database(..., order="natural")) caches that host copy, so
+    serving loops pay the pull once at setup.
     """
     import math
     import os
     v = dpf.validator
     hierarchy_level = v.num_hierarchy_levels - 1
+    keys, probe = _pir_probe(dpf, keys, integrity, "pir_query_batch", "jax")
     value_type = v.parameters[hierarchy_level].value_type
     bits, xor_group = evaluator._value_kind(value_type)
     domain = 1 << v.parameters[hierarchy_level].log_domain_size
+    db_prepared = None
     if isinstance(db_limbs, PreparedPirDatabase):
-        raise errors.InvalidArgumentError(
-            "pir_query_batch wants the natural-order DB; PreparedPirDatabase "
-            "is lane-ordered and only pir_query_batch_chunked consumes it"
-        )
+        if db_limbs.order != "natural":
+            raise errors.InvalidArgumentError(
+                "pir_query_batch folds against the natural-order DB; this "
+                "PreparedPirDatabase is lane-ordered (only "
+                "pir_query_batch_chunked consumes that order) — prepare "
+                "with order='natural'"
+            )
+        db_prepared = db_limbs
+        db_limbs = db_prepared.lane_db
     if not isinstance(db_limbs, jax.Array):  # keep device-resident DBs put
         db_limbs = np.asarray(db_limbs)
     if db_limbs.shape[0] != domain:
@@ -374,7 +429,16 @@ def pir_query_batch(
         put(corrections, ks),
         put(db_limbs, NamedSharding(mesh, P("domain"))),
     )
-    return np.asarray(out)[:n_real]
+    res = np.asarray(out)[:n_real]
+    db_nat = None
+    if probe is not None:
+        db_nat = (
+            db_prepared.natural_host(dpf)
+            if db_prepared is not None
+            else np.asarray(db_limbs)
+        )
+    # The shard_map step is an XLA program on every platform: level "jax".
+    return _pir_verify_fold(probe, res, db_nat, "pir_query_batch", "jax")
 
 
 @jax.jit
@@ -402,11 +466,38 @@ class PreparedPirDatabase:
     `pir_query_batch`'s shape check and silently produce XOR inner
     products against a permuted DB."""
 
-    __slots__ = ("lane_db", "order")
+    __slots__ = ("lane_db", "order", "host_levels", "_nat_host")
 
-    def __init__(self, lane_db, order: str = "lane"):
+    def __init__(self, lane_db, order: str = "lane", host_levels=None):
         self.lane_db = lane_db
         self.order = order
+        self.host_levels = host_levels  # the lane permutation's parameter
+        self._nat_host = None
+
+    def natural_host(self, dpf) -> np.ndarray:
+        """Natural-order host copy for sentinel verification: one device
+        pull (plus, for lane order, the inverse of the prepare-time
+        permutation), computed on first use and cached — the DB is
+        immutable, so serving loops pay this once, not per query batch
+        (the host link runs at megabytes/s through this image's tunnel,
+        PERF.md)."""
+        if self._nat_host is None:
+            from ..ops import evaluator as ev
+
+            lane_host = np.asarray(self.lane_db)
+            if self.order == "natural":
+                self._nat_host = lane_host
+            else:
+                # Invert the one-time permutation to recover the
+                # natural-order rows the oracle fold masks against (padded
+                # lane positions hold zeros and map to no domain row).
+                m = ev.lane_order_map(dpf, -1, self.host_levels)
+                domain = 1 << dpf.validator.parameters[-1].log_domain_size
+                nat = np.zeros((domain, lane_host.shape[1]), np.uint32)
+                valid = m >= 0
+                nat[m[valid]] = lane_host[valid]
+                self._nat_host = nat
+        return self._nat_host
 
 
 def prepare_pir_database(
@@ -445,7 +536,9 @@ def prepare_pir_database(
     db_lane = np.zeros((m.shape[0], db_limbs.shape[1]), dtype=np.uint32)
     valid = m >= 0
     db_lane[valid] = db_limbs[m[valid]]
-    return PreparedPirDatabase(jnp.asarray(db_lane), order="lane")
+    return PreparedPirDatabase(
+        jnp.asarray(db_lane), order="lane", host_levels=host_levels
+    )
 
 
 def pir_query_batch_chunked(
@@ -455,6 +548,7 @@ def pir_query_batch_chunked(
     key_chunk: int = 64,
     host_levels=None,
     mode: str = "levels",
+    integrity=None,
 ) -> np.ndarray:
     """Single-device PIR answers via the chunked bulk evaluator.
 
@@ -486,9 +580,22 @@ def pir_query_batch_chunked(
     PreparedPirDatabase from `prepare_pir_database` (upload once, query
     many; its order must match the mode: "lane" for levels, "natural" for
     walk/fused).
+
+    `integrity` (None = DPF_TPU_INTEGRITY env default) appends one
+    sentinel probe key whose folded response is recomputed on the host
+    oracle — see `pir_query_batch`. With a PreparedPirDatabase the
+    verification fold reconstructs a natural-order host copy of the DB
+    once per *database* (cached on the immutable PreparedPirDatabase), so
+    serving loops pay the device pull at setup, not per query batch.
     """
     from ..ops import evaluator as ev
 
+    # The chunk evaluators resolve use_pallas=None to the platform default;
+    # the fault-injection level of this call follows that resolution.
+    fi_backend = ev._fi_backend(ev._pallas_default())
+    keys, probe = _pir_probe(
+        dpf, keys, integrity, "pir_query_batch_chunked", fi_backend
+    )
     want_order = "natural" if mode in ("walk", "fused") else "lane"
     if mode == "fold":
         # In-program inner product (evaluator.full_domain_fold_chunks):
@@ -513,6 +620,12 @@ def pir_query_batch_chunked(
         db_dev = prepare_pir_database(
             dpf, db_limbs, host_levels, order=want_order
         ).lane_db
+    db_nat = None
+    if probe is not None:
+        if isinstance(db_limbs, PreparedPirDatabase):
+            db_nat = db_limbs.natural_host(dpf)
+        else:
+            db_nat = np.asarray(db_limbs)
     if mode == "fold":
         rows = []
         for valid, fold in ev.full_domain_fold_chunks(
@@ -520,7 +633,10 @@ def pir_query_batch_chunked(
             db_lane=db_dev,
         ):
             rows.append(np.asarray(fold)[:valid])
-        return np.concatenate(rows, axis=0)
+        return _pir_verify_fold(
+            probe, np.concatenate(rows, axis=0), db_nat,
+            "pir_query_batch_chunked", fi_backend,
+        )
     if mode == "fused":
         h, slab = ev.plan_slabs(
             dpf,
@@ -540,7 +656,10 @@ def pir_query_batch_chunked(
             if off >= db_dev.shape[0]:  # chunk complete
                 outs.append(np.asarray(acc)[:n_valid])
                 acc, off = None, 0
-        return np.concatenate(outs, axis=0)
+        return _pir_verify_fold(
+            probe, np.concatenate(outs, axis=0), db_nat,
+            "pir_query_batch_chunked", fi_backend,
+        )
     outs = []
     for n_valid, vals in ev.full_domain_evaluate_chunks(
         dpf,
@@ -556,7 +675,10 @@ def pir_query_batch_chunked(
         # pushes past HBM and the runtime starts evicting buffers across the
         # host link — the difference between 0.1 s and 5 s per chunk.
         vals.delete()
-    return np.concatenate(outs, axis=0)
+    return _pir_verify_fold(
+        probe, np.concatenate(outs, axis=0), db_nat,
+        "pir_query_batch_chunked", fi_backend,
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -648,13 +770,12 @@ def build_sharded_expand_step(
         if spec.is_tuple
         else P("keys", "domain")
     )
-    step = jax.shard_map(
+    step = backend_jax.shard_map(
         device_fn,
         mesh=mesh,
         in_specs=(P("keys"), P("keys"), P("keys"), P("keys"),
                   tuple(P("keys") for _ in spec.components)),
         out_specs=out_spec,
-        check_vma=False,
     )
     return jax.jit(step)
 

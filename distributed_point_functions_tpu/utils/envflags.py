@@ -1,0 +1,29 @@
+"""Shared environment-flag parsing.
+
+One canonical parser for the library's boolean env switches
+(DPF_TPU_PALLAS, DPF_TPU_FUSE_LAST_HASH, DPF_TPU_INTEGRITY, ...): two
+copies could drift and silently make two flags parse differently.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .errors import InvalidArgumentError
+
+
+def env_bool(name: str, default: bool = False) -> bool:
+    """Boolean env flag with STRICT parsing: unrecognized values raise
+    instead of silently picking a side (a typo in an A/B benchmark flag
+    must not measure the same path twice)."""
+    env = os.environ.get(name)
+    if env is None:
+        return default
+    low = env.strip().lower()
+    if low in ("1", "true", "yes", "on"):
+        return True
+    if low in ("0", "false", "no", "off", ""):
+        return False
+    raise InvalidArgumentError(
+        f"{name} must be a boolean-ish value, got {env!r}"
+    )

@@ -21,3 +21,55 @@ class FailedPreconditionError(DpfError, RuntimeError):
 
 class UnimplementedError(DpfError, NotImplementedError):
     """Mirrors absl::UnimplementedError."""
+
+
+class InternalError(DpfError, RuntimeError):
+    """Mirrors absl::InternalError: an invariant of the library itself is
+    broken (dispatch-table misses, self-test failures of the host oracle)."""
+
+
+class DataLossError(DpfError, RuntimeError):
+    """Mirrors absl::DataLossError: unrecoverable loss or corruption of
+    data — truncated wire bytes, garbled serialized keys."""
+
+
+class UnavailableError(DpfError, RuntimeError):
+    """Mirrors absl::UnavailableError: a backend or engine is (transiently)
+    unreachable; the operation may succeed on retry or on a fallback."""
+
+
+class ResourceExhaustedError(DpfError, RuntimeError):
+    """Mirrors absl::ResourceExhaustedError: out of device memory or a
+    similar quota; retrying with a smaller batch may succeed."""
+
+
+class DataCorruptionError(DataLossError):
+    """Silent wrong results detected by runtime integrity checks.
+
+    Raised when a sentinel probe key's device output disagrees with the
+    host oracle (utils/integrity.py) — the failure mode PERF.md "Platform
+    findings" documents on this image's TPU tunnel, where batched programs
+    return garbage in specific lanes with no error signal. Carries the
+    diagnostics an operator needs to correlate with a platform bug report:
+
+      key_index  — which row of the batch mismatched (the probe's row)
+      lanes      — corrupted output positions (possibly truncated)
+      pattern    — human-readable structure of the corruption, e.g.
+                   "all corrupted positions have index bit 4 set"
+      backend    — the backend level that produced the bad output
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        key_index=None,
+        lanes=None,
+        pattern: str = "",
+        backend: str = "",
+    ):
+        super().__init__(message)
+        self.key_index = key_index
+        self.lanes = [] if lanes is None else list(lanes)
+        self.pattern = pattern
+        self.backend = backend

@@ -1,0 +1,185 @@
+"""Deterministic fault injection for the runtime integrity layer.
+
+The reference library is tested by differential fuzzing against a scalar
+oracle; this repo additionally runs on hardware that has *demonstrably*
+corrupted results in production-shaped programs (PERF.md "Platform
+findings": a K=64 batched expansion returned garbage in every lane with
+bit 4 set while the identical program was bit-exact on XLA:CPU). The
+integrity layer (utils/integrity.py) exists to detect that class of
+failure at runtime — and a detector that has never seen a fault is
+untested code. This module injects faults *deterministically* at the four
+seams where real corruption has been observed or is conceivable:
+
+  ``seeds``         — flip a bit of one key's root seed in the prepared
+                      device batch (models host-link bit rot / bad DMA).
+  ``cw``            — flip a bit of one correction word (same, but level-
+                      targeted: corruption surfaces only below that level).
+  ``wire``          — truncate or bit-flip serialized key bytes (models a
+                      corrupted RPC payload between the two servers).
+  ``device_output`` — corrupt evaluated values after the device call,
+                      including a replay of the exact upper-16-lane
+                      pattern from PERF.md (``pattern="bit4"``).
+  ``device_call``   — raise an injected exception instead of running the
+                      backend (models UNAVAILABLE / RESOURCE_EXHAUSTED
+                      from the runtime, for degradation-policy tests).
+
+Faults are scoped by a context manager and never active by default; every
+hook is a no-op returning its input unchanged when no plan is armed, so
+production paths pay one truthiness check. Plans are plain data — no
+randomness — so every test failure replays exactly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import FrozenSet, Optional
+
+import numpy as np
+
+#: Recognized injection stages (see module docstring).
+STAGES = ("seeds", "cw", "wire", "device_output", "device_call")
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One deterministic fault. Arm with :func:`inject`.
+
+    ``key_row`` selects the batch row to corrupt (negative = from the end,
+    so ``-1`` hits an appended sentinel probe). ``backends`` restricts the
+    plan to specific backend levels ("pallas" / "jax" / "numpy"); None
+    fires everywhere. ``max_fires`` bounds how many times the plan
+    triggers (e.g. 1 = corrupt the first attempt only, so a retry or a
+    fallback level sees clean data).
+    """
+
+    stage: str
+    # seeds / cw
+    bit: int = 0
+    key_row: int = -1
+    level: int = 0
+    # wire
+    wire_mode: str = "truncate"  # or "flip"
+    wire_arg: int = 1  # bytes to drop (truncate) / byte index (flip)
+    # device_output
+    pattern: str = "bit4"  # or "lane"
+    lane: int = 0
+    xor_mask: int = 0xDEADBEEF
+    # device_call
+    exception: Optional[BaseException] = None
+    # scoping
+    backends: Optional[FrozenSet[str]] = None
+    max_fires: Optional[int] = None
+    fires: int = 0
+
+    def __post_init__(self):
+        if self.stage not in STAGES:
+            raise ValueError(f"unknown fault stage {self.stage!r}; one of {STAGES}")
+
+    def _matches(self, stage: str, backend: Optional[str]) -> bool:
+        if self.stage != stage:
+            return False
+        if self.backends is not None and backend is not None:
+            if backend not in self.backends:
+                return False
+        return self.max_fires is None or self.fires < self.max_fires
+
+
+_active: list = []
+
+
+def is_active() -> bool:
+    """Fast-path guard for the production hooks."""
+    return bool(_active)
+
+
+@contextlib.contextmanager
+def inject(*plans: FaultPlan):
+    """Arms `plans` for the dynamic extent of the with-block."""
+    _active.extend(plans)
+    try:
+        yield plans
+    finally:
+        for p in plans:
+            _active.remove(p)
+
+
+def _take(stage: str, backend: Optional[str]) -> Optional[FaultPlan]:
+    for plan in _active:
+        if plan._matches(stage, backend):
+            plan.fires += 1
+            return plan
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Hooks (called from the library's evaluation paths)
+# ---------------------------------------------------------------------------
+
+
+def corrupt_seeds(seeds: np.ndarray, backend: Optional[str] = None) -> np.ndarray:
+    """uint32[K, 4] root seeds -> possibly one bit flipped in one row."""
+    plan = _take("seeds", backend)
+    if plan is None:
+        return seeds
+    out = np.array(seeds, copy=True)
+    row = plan.key_row % out.shape[0]
+    out[row, (plan.bit // 32) % 4] ^= np.uint32(1 << (plan.bit % 32))
+    return out
+
+
+def corrupt_cw(cw_seeds: np.ndarray, backend: Optional[str] = None) -> np.ndarray:
+    """uint32[K, L, 4] correction-word seeds -> one bit flipped at one
+    (row, level)."""
+    plan = _take("cw", backend)
+    if plan is None:
+        return cw_seeds
+    out = np.array(cw_seeds, copy=True)
+    row = plan.key_row % out.shape[0]
+    level = plan.level % max(out.shape[1], 1)
+    out[row, level, (plan.bit // 32) % 4] ^= np.uint32(1 << (plan.bit % 32))
+    return out
+
+
+def corrupt_wire(blob: bytes, backend: Optional[str] = None) -> bytes:
+    """Serialized key bytes -> truncated or bit-flipped."""
+    plan = _take("wire", backend)
+    if plan is None:
+        return blob
+    if plan.wire_mode == "truncate":
+        return blob[: max(0, len(blob) - plan.wire_arg)]
+    if plan.wire_mode == "flip":
+        b = bytearray(blob)
+        b[plan.wire_arg % len(b)] ^= 1 << (plan.bit % 8)
+        return bytes(b)
+    raise ValueError(f"unknown wire_mode {plan.wire_mode!r}")
+
+
+def corrupt_output(values: np.ndarray, backend: Optional[str] = None) -> np.ndarray:
+    """uint32[K, positions, lpe] evaluated values -> corrupted copy.
+
+    pattern="bit4" replays the PERF.md platform fault: every position
+    whose index has bit 4 set (lanes 16..31 of each packed 32-lane word)
+    is XORed with `xor_mask`, in the selected key row. pattern="lane"
+    corrupts the single position `lane`.
+    """
+    plan = _take("device_output", backend)
+    if plan is None:
+        return values
+    out = np.array(values, copy=True)
+    row = plan.key_row % out.shape[0]
+    if plan.pattern == "bit4":
+        idx = np.nonzero((np.arange(out.shape[1]) >> 4) & 1)[0]
+    elif plan.pattern == "lane":
+        idx = np.array([plan.lane % out.shape[1]])
+    else:
+        raise ValueError(f"unknown output pattern {plan.pattern!r}")
+    out[row, idx] ^= np.uint32(plan.xor_mask)
+    return out
+
+
+def maybe_raise(stage: str = "device_call", backend: Optional[str] = None) -> None:
+    """Raises the armed plan's exception (degradation-policy tests)."""
+    plan = _take(stage, backend)
+    if plan is not None and plan.exception is not None:
+        raise plan.exception

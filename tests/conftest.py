@@ -60,6 +60,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: heavy compile-bound test; excluded unless --runslow"
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / integrity-layer test "
+        "(utils/faultinject.py); ci.sh faults runs this subset",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

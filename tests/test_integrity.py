@@ -1,0 +1,658 @@
+"""Runtime integrity layer (utils/integrity.py, utils/faultinject.py,
+ops/degrade.py): every injected fault class must be *detected* by sentinel
+verification, *recovered* bit-correct by the Pallas->JAX->numpy fallback
+chain, and never reported on clean data (no false positives).
+
+The whole file carries the `faults` marker; `ci.sh faults` runs it under
+JAX_PLATFORMS=cpu so detection is exercised against a known-good backend
+(the injected fault, not the platform, is the only corruption source).
+"""
+
+import numpy as np
+import pytest
+
+import distributed_point_functions_tpu as dpflib
+from distributed_point_functions_tpu.core import host_eval
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, TupleType, XorWrapper
+from distributed_point_functions_tpu.ops import degrade, evaluator
+from distributed_point_functions_tpu.parallel import sharded
+from distributed_point_functions_tpu.utils import faultinject, integrity
+from distributed_point_functions_tpu.utils.errors import (
+    DataCorruptionError,
+    DataLossError,
+    DpfError,
+    InternalError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+pytestmark = pytest.mark.faults
+
+# Zero backoff: the retry/degradation tests exercise decisions, not delays.
+POLICY = degrade.DegradationPolicy(backoff_seconds=0.0)
+
+
+@pytest.fixture()
+def small_dpf():
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([3, 700, 901], [[5, 9, 40]])
+    return dpf, keys
+
+
+def host_limbs(dpf, keys):
+    return host_eval.values_to_limbs(
+        host_eval.full_domain_evaluate_host(dpf, keys), 64
+    )
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy (satellite: absl-mirror categories)
+# ---------------------------------------------------------------------------
+
+
+def test_error_taxonomy_exports():
+    for name in (
+        "InternalError",
+        "DataLossError",
+        "DataCorruptionError",
+        "UnavailableError",
+        "ResourceExhaustedError",
+    ):
+        cls = getattr(dpflib, name)
+        assert issubclass(cls, DpfError), name
+    # DataCorruptionError IS data loss (absl has no better category for
+    # silently wrong results) and carries operator diagnostics.
+    assert issubclass(DataCorruptionError, DataLossError)
+    e = DataCorruptionError(
+        "boom", key_index=7, lanes=[16, 17], pattern="bit 4", backend="tpu"
+    )
+    assert isinstance(e, dpflib.DpfError)
+    assert (e.key_index, e.lanes, e.pattern, e.backend) == (
+        7, [16, 17], "bit 4", "tpu",
+    )
+
+
+def test_existing_raise_sites_use_taxonomy(small_dpf):
+    dpf, keys = small_dpf
+    with pytest.raises(InvalidArgumentError):
+        next(evaluator.full_domain_evaluate_chunks(dpf, keys, mode="bogus"))
+    from distributed_point_functions_tpu.ops import backend_jax
+
+    with pytest.raises(InternalError):
+        backend_jax._rk_np("bogus")
+    # Mixed-party batches are a caller error, not a bare ValueError.
+    k0, k1 = dpf.generate_keys(5, 1)
+    with pytest.raises(InvalidArgumentError):
+        evaluator.KeyBatch.from_keys(dpf, [k0, k1])
+
+
+# ---------------------------------------------------------------------------
+# Configuration: DPF_TPU_INTEGRITY
+# ---------------------------------------------------------------------------
+
+
+def test_env_switch_strict_parsing(monkeypatch):
+    monkeypatch.delenv("DPF_TPU_INTEGRITY", raising=False)
+    assert integrity.enabled() is False
+    assert integrity.enabled(True) is True
+    for val, want in (("1", True), ("true", True), ("ON", True),
+                      ("0", False), ("no", False), ("", False)):
+        monkeypatch.setenv("DPF_TPU_INTEGRITY", val)
+        assert integrity.enabled() is want, val
+    monkeypatch.setenv("DPF_TPU_INTEGRITY", "maybe")
+    with pytest.raises(InvalidArgumentError):
+        integrity.enabled()
+    # The explicit keyword wins without consulting the (invalid) env.
+    assert integrity.enabled(False) is False
+
+
+# ---------------------------------------------------------------------------
+# Known-answer self-test
+# ---------------------------------------------------------------------------
+
+
+def test_kat_table_matches_oracle_rederivation():
+    """The pinned _KAT_EXPECTED constants are re-derived from the
+    reference-parity numpy oracle, so a typo in the table cannot hide: a
+    bad pin would fail here, a bad oracle would fail the reference-parity
+    suite, and they cannot both drift the same way."""
+    from distributed_point_functions_tpu.core import backend_numpy, uint128
+
+    ins = np.zeros((len(integrity._KAT_INPUTS), 4), np.uint32)
+    for i, x in enumerate(integrity._KAT_INPUTS):
+        ins[i] = uint128.to_limbs(x)
+    prgs = {
+        "left": backend_numpy._PRG_LEFT,
+        "right": backend_numpy._PRG_RIGHT,
+        "value": backend_numpy._PRG_VALUE,
+    }
+    for name, prg in prgs.items():
+        out = prg.evaluate_limbs(ins)
+        got = tuple(
+            int(uint128.from_limbs(out[i]))
+            for i in range(len(integrity._KAT_INPUTS))
+        )
+        assert got == integrity._KAT_EXPECTED[name], name
+
+
+def test_selftest_passes_and_is_cached():
+    integrity._selftest_done.clear()
+    with integrity.capture_events() as events:
+        integrity.ensure_selftest()
+        integrity.ensure_selftest()  # second call: cached, no second event
+    assert [e.kind for e in events] == ["selftest-ok"]
+
+
+def test_selftest_detects_miscomputing_device(monkeypatch):
+    """A backend whose AES hash is wrong fails the KAT with a
+    DataCorruptionError naming the mismatching inputs."""
+    from distributed_point_functions_tpu.ops import aes_jax
+
+    real = aes_jax.hash_planes
+
+    def corrupted(planes, rk):
+        return real(planes, rk) ^ 1
+
+    monkeypatch.setattr(aes_jax, "hash_planes", corrupted)
+    integrity._selftest_done.clear()
+    with pytest.raises(DataCorruptionError) as ei:
+        integrity.ensure_selftest()
+    assert ei.value.lanes  # which KAT inputs hashed wrong
+    monkeypatch.undo()
+    integrity._selftest_done.clear()
+    integrity.ensure_selftest()  # clean again
+
+
+def test_selftest_host_drift_is_internal_error(monkeypatch):
+    """Host-oracle drift is the library's own bug (InternalError), not a
+    device problem — nothing can be verified once the oracle is wrong."""
+    bad = dict(integrity._KAT_EXPECTED)
+    bad["left"] = (1, 2, 3)
+    monkeypatch.setattr(integrity, "_KAT_EXPECTED", bad)
+    with pytest.raises(InternalError):
+        integrity.selftest_host()
+
+
+# ---------------------------------------------------------------------------
+# Corruption-pattern diagnosis
+# ---------------------------------------------------------------------------
+
+
+def test_diagnose_lanes_recognizes_bit4_signature():
+    total = 1024
+    bad = np.nonzero((np.arange(total) >> 4) & 1)[0]
+    msg = integrity.diagnose_lanes(bad, total)
+    assert "exactly every position with index bit 4 set" in msg
+    assert "PERF.md" in msg
+
+
+def test_diagnose_lanes_other_patterns():
+    # Exact bit-5 signature: recognized, but not the PERF.md callout.
+    total = 256
+    bad5 = np.nonzero((np.arange(total) >> 5) & 1)[0]
+    msg = integrity.diagnose_lanes(bad5, total)
+    assert "index bit 5 set" in msg and "PERF.md" not in msg
+    # A strict subset of a bit class: reported as a common-bit hint.
+    msg = integrity.diagnose_lanes(np.array([48, 49, 50]), total)
+    assert "bit" in msg
+    # Structureless corruption: falls back to listing positions.
+    msg = integrity.diagnose_lanes(np.array([0, 3]), total)
+    assert "first corrupted positions" in msg
+    assert integrity.diagnose_lanes(np.array([], dtype=int), 64).startswith("0/64")
+
+
+# ---------------------------------------------------------------------------
+# Detection: all four injected fault classes raise DataCorruptionError
+# (or DataLossError for unparseable wire bytes) with lane/key diagnostics
+# ---------------------------------------------------------------------------
+
+
+def test_detects_seed_flip(small_dpf):
+    dpf, keys = small_dpf
+    plan = faultinject.FaultPlan(stage="seeds", bit=7, key_row=-1)
+    with faultinject.inject(plan):
+        with pytest.raises(DataCorruptionError) as ei:
+            evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+    e = ei.value
+    assert e.key_index == len(keys)  # the appended probe row
+    assert e.lanes and e.pattern
+    assert plan.fires == 1
+
+
+def test_detects_cw_flip(small_dpf):
+    dpf, keys = small_dpf
+    with faultinject.inject(
+        faultinject.FaultPlan(stage="cw", bit=3, key_row=-1, level=4)
+    ):
+        with pytest.raises(DataCorruptionError) as ei:
+            evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+    # A level-4 correction-word flip corrupts only the subtree below it —
+    # strictly fewer positions than the domain.
+    assert 0 < len(ei.value.lanes) <= 1 << 10
+
+
+def test_detects_wire_truncation(small_dpf):
+    dpf, keys = small_dpf
+    with faultinject.inject(
+        faultinject.FaultPlan(stage="wire", wire_mode="truncate", wire_arg=3)
+    ):
+        with pytest.raises(DataLossError):
+            evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+
+
+def test_detects_wire_bit_flip(small_dpf):
+    """A flip inside the serialized seed bytes still parses — the sentinel
+    comparison against the pristine key's oracle values catches it."""
+    dpf, keys = small_dpf
+    with faultinject.inject(
+        faultinject.FaultPlan(stage="wire", wire_mode="flip", wire_arg=4, bit=2)
+    ):
+        with pytest.raises(DataCorruptionError):
+            evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+
+
+def test_detects_output_lane_corruption(small_dpf):
+    dpf, keys = small_dpf
+    with faultinject.inject(
+        faultinject.FaultPlan(
+            stage="device_output", pattern="lane", lane=5, key_row=-1
+        )
+    ):
+        with pytest.raises(DataCorruptionError) as ei:
+            evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+    assert ei.value.lanes == [5]
+
+
+def test_detects_perf_md_bit4_replay(small_dpf):
+    """The exact platform fault from PERF.md 'Platform findings': every
+    position with index bit 4 set garbled. Detection must name it."""
+    dpf, keys = small_dpf
+    with faultinject.inject(
+        faultinject.FaultPlan(stage="device_output", pattern="bit4", key_row=-1)
+    ):
+        with pytest.raises(DataCorruptionError) as ei:
+            evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+    assert "index bit 4" in ei.value.pattern
+    assert "PERF.md" in ei.value.pattern
+
+
+def test_detects_on_evaluate_at_path(small_dpf):
+    dpf, keys = small_dpf
+    points = [0, 3, 700, 901, 1023]
+    with faultinject.inject(
+        faultinject.FaultPlan(
+            stage="device_output", pattern="lane", lane=2, key_row=-1
+        )
+    ):
+        with pytest.raises(DataCorruptionError) as ei:
+            evaluator.evaluate_at_batch(dpf, keys, points, integrity=True)
+    assert ei.value.lanes == [2]
+
+
+def test_detects_on_pir_fold_path():
+    dpf = DistributedPointFunction.create(DpfParameters(10, XorWrapper(128)))
+    keys, _ = dpf.generate_keys_batch([5, 77], [[1, 2]])
+    db = np.random.default_rng(0).integers(
+        0, 1 << 32, size=(1024, 4), dtype=np.uint32
+    )
+    clean = sharded.pir_query_batch_chunked(dpf, keys, db, integrity=True)
+    assert clean.shape == (2, 4)
+    with faultinject.inject(
+        faultinject.FaultPlan(
+            stage="device_output", pattern="lane", lane=0, key_row=-1
+        )
+    ):
+        with pytest.raises(DataCorruptionError):
+            sharded.pir_query_batch_chunked(dpf, keys, db, integrity=True)
+
+
+def test_prepared_db_verification_cached():
+    """Sentinel verification against a PreparedPirDatabase reconstructs the
+    natural-order host copy once per *database* (cached on the immutable
+    prepared object), not once per query batch, and pir_query_batch accepts
+    a natural-order prepared DB."""
+    dpf = DistributedPointFunction.create(DpfParameters(10, XorWrapper(128)))
+    keys, _ = dpf.generate_keys_batch([5, 77], [[1, 2]])
+    db = np.random.default_rng(1).integers(
+        0, 1 << 32, size=(1024, 4), dtype=np.uint32
+    )
+    prepared = sharded.prepare_pir_database(dpf, db, order="lane")
+    a = sharded.pir_query_batch_chunked(dpf, keys, prepared, integrity=True)
+    np.testing.assert_array_equal(prepared._nat_host, db)
+    cached = prepared._nat_host
+    b = sharded.pir_query_batch_chunked(dpf, keys, prepared, integrity=True)
+    assert prepared._nat_host is cached
+    np.testing.assert_array_equal(a, b)
+
+    nat = sharded.prepare_pir_database(dpf, db, order="natural")
+    mesh = sharded.make_mesh(1, 1)
+    c = sharded.pir_query_batch(dpf, keys, db, mesh, integrity=True)
+    d = sharded.pir_query_batch(dpf, keys, nat, mesh, integrity=True)
+    np.testing.assert_array_equal(c, d)
+    assert nat._nat_host is not None
+    lane = sharded.prepare_pir_database(dpf, db, order="lane")
+    with pytest.raises(InvalidArgumentError, match="natural"):
+        sharded.pir_query_batch(dpf, keys, lane, mesh, integrity=True)
+
+
+def test_probe_rides_chunked_batches(small_dpf):
+    """key_chunk smaller than the batch: the probe still lands in (and is
+    stripped from) the final chunk, and detection still fires."""
+    dpf, keys = small_dpf
+    want = host_limbs(dpf, keys)
+    out = evaluator.full_domain_evaluate(dpf, keys, key_chunk=2, integrity=True)
+    np.testing.assert_array_equal(out, want)
+    with faultinject.inject(
+        faultinject.FaultPlan(stage="seeds", bit=0, key_row=-1)
+    ):
+        with pytest.raises(DataCorruptionError):
+            evaluator.full_domain_evaluate(
+                dpf, keys, key_chunk=2, integrity=True
+            )
+
+
+def test_codec_value_types_skip_with_event():
+    """Tuple outputs are outside the host bulk oracle's scope: evaluation
+    proceeds unverified and says so via an integrity-skip event."""
+    dpf = DistributedPointFunction.create(
+        DpfParameters(6, TupleType(Int(32), Int(32)))
+    )
+    keys, _ = dpf.generate_keys_batch([5], [[(1, 2)]])
+    with integrity.capture_events() as events:
+        out = evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+    assert isinstance(out, tuple)
+    assert [e.kind for e in events] == ["integrity-skip"]
+
+
+# ---------------------------------------------------------------------------
+# No false positives: 100 clean integrity-on batches
+# ---------------------------------------------------------------------------
+
+
+def test_no_false_positives_100_clean_batches():
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    rng = np.random.default_rng(0xC1EA)
+    with integrity.capture_events() as events:
+        for _ in range(100):
+            alphas = [int(x) for x in rng.integers(0, 256, size=2)]
+            betas = [[int(x) for x in rng.integers(1, 1000, size=2)]]
+            keys, _ = dpf.generate_keys_batch(alphas, betas)
+            out = evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+            assert out.shape == (2, 256, 2)  # probe row stripped
+    kinds = {e.kind for e in events}
+    assert "corruption" not in kinds
+    assert sum(e.kind == "sentinel-ok" for e in events) == 100
+
+
+def test_injection_off_means_no_faults(small_dpf):
+    """Armed-plan bookkeeping: outside any inject() block the hooks are
+    identity functions and plans never fire."""
+    dpf, keys = small_dpf
+    assert not faultinject.is_active()
+    seeds = np.arange(12, dtype=np.uint32).reshape(3, 4)
+    assert faultinject.corrupt_seeds(seeds) is seeds
+    assert faultinject.corrupt_wire(b"abc") == b"abc"
+    plan = faultinject.FaultPlan(stage="seeds")
+    with faultinject.inject(plan):
+        pass
+    assert not faultinject.is_active() and plan.fires == 0
+
+
+# ---------------------------------------------------------------------------
+# Recovery: the fallback chain serves bit-correct results for every class
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "plan",
+    [
+        faultinject.FaultPlan(stage="seeds", bit=9, key_row=-1,
+                              backends=frozenset({"pallas", "jax"})),
+        faultinject.FaultPlan(stage="cw", bit=1, key_row=-1, level=2,
+                              backends=frozenset({"pallas", "jax"})),
+        faultinject.FaultPlan(stage="wire", wire_mode="truncate", wire_arg=2,
+                              backends=frozenset({"pallas", "jax"})),
+        faultinject.FaultPlan(stage="device_output", pattern="bit4",
+                              key_row=-1,
+                              backends=frozenset({"pallas", "jax"})),
+    ],
+    ids=["seed-flip", "cw-flip", "wire-truncation", "output-bit4"],
+)
+def test_fallback_recovers_each_fault_class(small_dpf, plan):
+    """Persistent corruption on every device level: the chain walks to the
+    numpy host engine and the answer equals the oracle bit for bit."""
+    dpf, keys = small_dpf
+    want = host_limbs(dpf, keys)
+    with integrity.capture_events() as events:
+        with faultinject.inject(plan):
+            out = degrade.full_domain_evaluate_robust(dpf, keys, policy=POLICY)
+    np.testing.assert_array_equal(out, want)
+    kinds = [e.kind for e in events]
+    assert "degrade" in kinds and "recovered" in kinds
+    assert events[-1].backend == "numpy"
+
+
+def test_fallback_recovers_evaluate_at(small_dpf):
+    dpf, keys = small_dpf
+    points = [0, 3, 700, 901]
+    want = host_eval.values_to_limbs(
+        host_eval.evaluate_at_host(dpf, keys, points, 0), 64
+    )
+    with faultinject.inject(
+        faultinject.FaultPlan(
+            stage="device_output", pattern="lane", lane=1, key_row=-1,
+            backends=frozenset({"pallas", "jax"}),
+        )
+    ):
+        out = degrade.evaluate_at_robust(dpf, keys, points, policy=POLICY)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_transient_unavailable_retries_same_level(small_dpf):
+    """A fault that fires once (max_fires=1) models a transient runtime
+    blip: one retry at the same level succeeds — no degradation."""
+    dpf, keys = small_dpf
+    want = host_limbs(dpf, keys)
+    with integrity.capture_events() as events:
+        with faultinject.inject(
+            faultinject.FaultPlan(
+                stage="device_call",
+                exception=UnavailableError("UNAVAILABLE: tunnel hiccup"),
+                backends=frozenset({"jax"}),
+                max_fires=1,
+            )
+        ):
+            out = degrade.full_domain_evaluate_robust(dpf, keys, policy=POLICY)
+    np.testing.assert_array_equal(out, want)
+    kinds = [e.kind for e in events]
+    assert "retry" in kinds and "degrade" not in kinds
+
+
+def test_resource_exhaustion_halves_chunk(small_dpf):
+    dpf, keys = small_dpf
+    want = host_limbs(dpf, keys)
+    with integrity.capture_events() as events:
+        with faultinject.inject(
+            faultinject.FaultPlan(
+                stage="device_call",
+                exception=ResourceExhaustedError("RESOURCE_EXHAUSTED: oom"),
+                backends=frozenset({"jax"}),
+                max_fires=2,
+            )
+        ):
+            out = degrade.full_domain_evaluate_robust(
+                dpf, keys, key_chunk=8, policy=POLICY
+            )
+    np.testing.assert_array_equal(out, want)
+    halved = [e.data["key_chunk"] for e in events if e.kind == "chunk-halved"]
+    assert halved == [4, 2]
+
+
+def test_resource_exhaustion_halves_evaluate_at_keys(small_dpf):
+    """The at-path has no internal chunking, so halving must actually
+    slice the key batch (not retry the identical full-size call)."""
+    dpf, keys = small_dpf
+    points = [0, 3, 700, 901]
+    want = host_eval.values_to_limbs(
+        host_eval.evaluate_at_host(dpf, keys, points, 0), 64
+    )
+    calls = []
+    orig = evaluator.evaluate_at_batch
+
+    def spy(dpf_, keys_, *a, **kw):
+        calls.append(len(keys_))
+        return orig(dpf_, keys_, *a, **kw)
+
+    evaluator.evaluate_at_batch, restore = spy, orig
+    try:
+        with faultinject.inject(
+            faultinject.FaultPlan(
+                stage="device_call",
+                exception=ResourceExhaustedError("RESOURCE_EXHAUSTED: oom"),
+                backends=frozenset({"jax"}),
+                max_fires=1,
+            )
+        ):
+            out = degrade.evaluate_at_robust(dpf, keys, points, policy=POLICY)
+    finally:
+        evaluator.evaluate_at_batch = restore
+    np.testing.assert_array_equal(out, want)
+    # 3 keys halve 3 -> 1: the served attempt ran one key per dispatch.
+    assert calls == [1, 1, 1]
+
+
+def test_chunk_floor_degrades(small_dpf):
+    """Exhaustion that persists past the chunk floor degrades rather than
+    looping forever."""
+    dpf, keys = small_dpf
+    want = host_limbs(dpf, keys)
+    with integrity.capture_events() as events:
+        with faultinject.inject(
+            faultinject.FaultPlan(
+                stage="device_call",
+                exception=ResourceExhaustedError("RESOURCE_EXHAUSTED: oom"),
+                backends=frozenset({"jax"}),
+            )
+        ):
+            out = degrade.full_domain_evaluate_robust(
+                dpf, keys, key_chunk=2, policy=POLICY
+            )
+    np.testing.assert_array_equal(out, want)
+    kinds = [e.kind for e in events]
+    assert "chunk-halved" in kinds and "degrade" in kinds
+
+
+def test_chain_exhaustion_raises_last_error(small_dpf):
+    """When even the host engine fails, the last classified error
+    propagates — degradation never invents an answer."""
+    dpf, keys = small_dpf
+    with pytest.raises(UnavailableError):
+        with faultinject.inject(
+            faultinject.FaultPlan(
+                stage="device_call",
+                exception=UnavailableError("UNAVAILABLE: everything is down"),
+            )
+        ):
+            degrade.full_domain_evaluate_robust(dpf, keys, policy=POLICY)
+
+
+def test_unclassified_exceptions_propagate(small_dpf):
+    """Programming errors must not be silently 'degraded' around."""
+    dpf, keys = small_dpf
+    with pytest.raises(ZeroDivisionError):
+        with faultinject.inject(
+            faultinject.FaultPlan(
+                stage="device_call", exception=ZeroDivisionError("bug")
+            )
+        ):
+            degrade.full_domain_evaluate_robust(dpf, keys, policy=POLICY)
+
+
+def test_classify_exception_maps_runtime_strings():
+    assert isinstance(
+        degrade.classify_exception(RuntimeError("RESOURCE_EXHAUSTED: hbm")),
+        ResourceExhaustedError,
+    )
+    assert isinstance(
+        degrade.classify_exception(RuntimeError("UNAVAILABLE: socket closed")),
+        UnavailableError,
+    )
+    assert degrade.classify_exception(KeyError("x")) is None
+    err = DataCorruptionError("already classified")
+    assert degrade.classify_exception(err) is err
+    # Caller bugs are taxonomy errors too, but NOT degradable: re-running
+    # the identical failing call on a slower backend cannot fix them.
+    assert degrade.classify_exception(InvalidArgumentError("bad arg")) is None
+    # A typed InternalError (the host-oracle self-test failing) means the
+    # library itself is broken — degrading to the numpy level would serve
+    # answers from the very code whose self-test just failed.
+    assert degrade.classify_exception(InternalError("oracle broken")) is None
+
+
+def test_caller_errors_do_not_walk_the_chain(small_dpf):
+    """An InvalidArgumentError from the operation itself (here: a
+    mixed-party key batch) propagates from the first level, with no degrade
+    or retry events — the fallback chain is for platform failures, not for
+    retrying the caller's bug on slower backends."""
+    dpf, keys = small_dpf
+    _, other_party = dpf.generate_keys(5, 7)
+    with integrity.capture_events() as events:
+        with pytest.raises(InvalidArgumentError):
+            degrade.evaluate_at_robust(
+                dpf, list(keys) + [other_party], [0, 3], policy=POLICY
+            )
+    assert not [e for e in events if e.kind in ("degrade", "retry")]
+
+
+# ---------------------------------------------------------------------------
+# Structured events
+# ---------------------------------------------------------------------------
+
+
+def test_event_hooks_receive_and_survive_failure(small_dpf):
+    dpf, keys = small_dpf
+    seen = []
+
+    def bad_hook(ev):
+        raise RuntimeError("broken operator hook")
+
+    integrity.add_event_hook(bad_hook)
+    integrity.add_event_hook(seen.append)
+    try:
+        out = evaluator.full_domain_evaluate(dpf, keys, integrity=True)
+    finally:
+        integrity.remove_event_hook(bad_hook)
+        integrity.remove_event_hook(seen.append)
+    assert out.shape == (3, 1024, 2)
+    oks = [e for e in seen if e.kind == "sentinel-ok"]
+    assert len(oks) == 1
+    assert oks[0].backend and oks[0].timestamp > 0
+
+
+# ---------------------------------------------------------------------------
+# Whole-backend device check (the library behind tools/check_device.py)
+# ---------------------------------------------------------------------------
+
+
+def test_run_device_check_clean():
+    lines = []
+    failures = integrity.run_device_check(
+        shapes=((4, 8),), report=lines.append
+    )
+    assert failures == 0
+    assert any("OK" in l for l in lines)
+
+
+def test_run_device_check_detects_injected_corruption():
+    with integrity.capture_events() as events:
+        with faultinject.inject(
+            faultinject.FaultPlan(stage="seeds", bit=11, key_row=1)
+        ):
+            failures = integrity.run_device_check(
+                shapes=((4, 8),), report=lambda s: None, selftest=False
+            )
+    assert failures == 1  # exactly the corrupted key mismatches
+    assert any(e.kind == "corruption" for e in events)

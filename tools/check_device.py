@@ -62,15 +62,11 @@ def _main() -> int:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    import jax.numpy as jnp
-
-    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
-    from distributed_point_functions_tpu.core.host_eval import (
-        full_domain_evaluate_host,
+    from distributed_point_functions_tpu.utils import integrity
+    from distributed_point_functions_tpu.utils.errors import (
+        DataCorruptionError,
+        InternalError,
     )
-    from distributed_point_functions_tpu.core.params import DpfParameters
-    from distributed_point_functions_tpu.core.value_types import Int
-    from distributed_point_functions_tpu.ops import evaluator
 
     try:
         cache = os.path.join(
@@ -83,7 +79,6 @@ def _main() -> int:
         pass
     print(f"backend: {jax.default_backend()}, devices: {jax.devices()}")
     rng = np.random.default_rng(7)
-    failures = 0
     # Default shapes = the headline program family (64-key chunks), the
     # shape observed corrupting on the axon tunnel. Each extra shape costs
     # a full compile of its program family — override via CHECK_SHAPES,
@@ -93,45 +88,25 @@ def _main() -> int:
         for s in os.environ.get("CHECK_SHAPES", "64x20").split(",")
     ]
     # Execution strategy under test: "levels" (per-level dispatch, the
-    # default), "fused" (single program per chunk) or "walk" (leaf-path
-    # walk) — the program shapes fail independently on a broken backend
-    # (PERF.md). This tool measures the RAW platform: auto-slabbing would
-    # hide exactly the over-threshold programs being probed, so it is
-    # force-disabled regardless of the caller's environment.
+    # default), "fused" (single program per chunk), "walk" (leaf-path
+    # walk) or "fold" (in-program consumer) — the program shapes fail
+    # independently on a broken backend (PERF.md). This tool measures the
+    # RAW platform: auto-slabbing would hide exactly the over-threshold
+    # programs being probed, so it is force-disabled regardless of the
+    # caller's environment.
     os.environ["DPF_TPU_MAX_PROGRAM_BYTES"] = "0"
     mode = os.environ.get("CHECK_MODE", "levels")
-    for num_keys, lds in shapes:
-        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
-        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
-        betas = [[int(x) for x in rng.integers(1, 1000, size=num_keys)]]
-        keys, _ = dpf.generate_keys_batch(alphas, betas)
-        host = full_domain_evaluate_host(dpf, keys)
-        want = np.bitwise_xor.reduce(host, axis=1)
-        folds = []
-        if mode == "fold":
-            # In-program consumer path; CHECK_PALLAS=1 forces the Mosaic
-            # row kernels (the TPU default), =0 the XLA bitslice.
-            use_pallas = _check_pallas_env()
-            gen = evaluator.full_domain_fold_chunks(
-                dpf, keys, key_chunk=num_keys, use_pallas=use_pallas
-            )
-            for valid, fold in gen:
-                folds.append(np.asarray(fold)[:valid])
-        else:
-            for valid, out in evaluator.full_domain_evaluate_chunks(
-                dpf, keys, key_chunk=num_keys, mode=mode
-            ):
-                folds.append(
-                    np.asarray(jnp.bitwise_xor.reduce(out, axis=1))[:valid]
-                )
-        got = np.concatenate(folds, axis=0)
-        got64 = got[:, 0].astype(np.uint64) | (
-            got[:, 1].astype(np.uint64) << np.uint64(32)
+    # The differential loop itself lives in the library
+    # (utils/integrity.run_device_check) so this CLI and the runtime
+    # integrity layer cannot drift; CHECK_PALLAS=1 forces the Mosaic row
+    # kernels, =0 the XLA bitslice, unset = platform default.
+    try:
+        failures = integrity.run_device_check(
+            shapes=shapes, mode=mode, use_pallas=_check_pallas_env()
         )
-        bad = int((got64 != want).sum())
-        status = "OK" if bad == 0 else f"MISMATCH ({bad}/{num_keys} keys)"
-        print(f"keys={num_keys:4d} log_domain={lds:3d} mode={mode}: {status}")
-        failures += bad
+    except (DataCorruptionError, InternalError) as e:
+        print(f"SELF-TEST FAILED: {e}")
+        failures = 1
     failures += _run_extras(jax, rng)
     if failures:
         print(
