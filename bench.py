@@ -37,6 +37,11 @@ import traceback
 # 10 minutes so a stuck run shows where it is waiting.
 faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
 
+# Process start, for in-child budget accounting (the pipeline A/B skips
+# itself when the remaining killable-subprocess budget could not absorb a
+# second timed pass).
+_START_TIME = time.time()
+
 import numpy as np
 
 BASELINE_EVALS_PER_SEC = 13e6
@@ -164,10 +169,13 @@ def _metric(log_domain: int, num_keys: int) -> str:
     )
 
 
-def _bench_keys(dpf, log_domain: int, num_keys: int):
+def _bench_keys(dpf, log_domain: int, num_keys: int, seed: int = 7):
     """The benchmark's key batch — ONE definition so the CPU fallback
-    measures exactly the workload the TPU path measures."""
-    rng = np.random.default_rng(7)
+    measures exactly the workload the TPU path measures. `seed` varies
+    the batch for passes that must not replay identical inputs (the
+    2026-07-31 distinct-inputs correction: server-side result caching on
+    this tunnel can fake repeat-call timings, PERF.md)."""
+    rng = np.random.default_rng(seed)
     alphas = [int(x) for x in rng.integers(0, 1 << log_domain, size=num_keys)]
     betas = [int(x) for x in rng.integers(1, 1 << 63, size=num_keys)]
     t0 = time.time()
@@ -293,18 +301,19 @@ def _run(
     # chunk both forcing materialization and standing in for that consumer.
     # Pulling 8 GB of outputs to the host over this chip's tunnel runs at
     # ~5 MB/s and would measure the link, not the framework (PERF.md).
-    def run_once(key_subset, chunk, verbose=False):
+    def run_once(key_subset, chunk, verbose=False, pipeline=None):
         folds = []
         total_valid = 0
         if MODE == "fold":
             gen = evaluator.full_domain_fold_chunks(
-                dpf, key_subset, key_chunk=chunk
+                dpf, key_subset, key_chunk=chunk, pipeline=pipeline
             )
         else:
             gen = (
                 (valid, jnp.bitwise_xor.reduce(out, axis=1))
                 for valid, out in evaluator.full_domain_evaluate_chunks(
-                    dpf, key_subset, key_chunk=chunk, mode=MODE
+                    dpf, key_subset, key_chunk=chunk, mode=MODE,
+                    pipeline=pipeline,
                 )
             )
         for valid, fold in gen:
@@ -336,6 +345,44 @@ def _run(
     evals_per_sec = total_evals / elapsed
     _log(f"{total_evals} evals in {elapsed:.2f}s on {backend} (device-resident)")
 
+    # Pipeline on/off A/B (ISSUE 2): the primary number above runs at the
+    # platform default (pipelined executor ON for device backends); a
+    # second timed pass with the executor forced OFF quantifies how much
+    # wall clock the chunk overlap actually hides on this link. Same
+    # compiled programs, same keys — only the execution schedule differs.
+    # The A/B is context, never the measurement: it only runs when the
+    # remaining killable-subprocess budget (BENCH_TPU_TIMEOUT kills this
+    # child from the parent) can absorb a sync pass at 2x the pipelined
+    # time with slack — otherwise the watchdog would kill the child before
+    # it prints, losing the PRIMARY verified number along with the A/B.
+    sync_elapsed = None
+    if os.environ.get("BENCH_PIPELINE_AB", "1") == "1":
+        budget = float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
+        spent = time.time() - _START_TIME
+        if spent + 2 * elapsed > 0.7 * budget:
+            _log(
+                f"pipeline A/B skipped: {spent:.0f}s spent of {budget:.0f}s "
+                f"budget; a ~{2 * elapsed:.0f}s sync pass could cost the "
+                "primary record"
+            )
+        else:
+            try:
+                # DISTINCT inputs for the second pass (fresh seed): replaying
+                # the identical key batch is the repeat-call pattern whose
+                # timings this tunnel's server-side caching has faked before
+                # (PERF.md 2026-07-31 correction). Keygen runs outside the
+                # timed window; same count/domain = same workload shape.
+                keys_sync = _bench_keys(dpf, log_domain, num_keys, seed=13)
+                t0 = time.time()
+                run_once(keys_sync, key_chunk, pipeline=False)
+                sync_elapsed = time.time() - t0
+                _log(
+                    f"pipeline A/B: sync {sync_elapsed:.2f}s vs pipelined "
+                    f"{elapsed:.2f}s (overlap {sync_elapsed / elapsed:.2f}x)"
+                )
+            except Exception as e:
+                _log(f"pipeline A/B unavailable: {e!r}")
+
     # Verify the device outputs against the native host oracle on a sample
     # of keys — the whole number is worthless if the chip (or the tunnel
     # runtime) mis-executed the program, and that HAS been observed on this
@@ -355,6 +402,12 @@ def _run(
     _log(f"device-vs-host verification: {n_ok}/{len(sample)} sampled keys match")
     result = _result(log_domain, num_keys, evals_per_sec, backend)
     result["verified_keys"] = f"{n_ok}/{len(sample)}"
+    if sync_elapsed is not None:
+        # pipeline_overlap = sync wall-clock / pipelined wall-clock: > 1
+        # means the executor hides real latency; ~1 means this link's
+        # dispatch already overlapped (or the run is compute-bound).
+        result["pipeline_overlap"] = round(sync_elapsed / elapsed, 3)
+        result["sync_evals_per_sec"] = round(total_evals / sync_elapsed)
     if verified:
         # Roofline accounting (VERDICT r4 #4): relate the measured rate to
         # what this chip's VPU can do on the bitsliced AES circuit. Trace-

@@ -23,7 +23,13 @@ cd "$(dirname "$0")"
 tier="${1:-fast}"
 
 run_fast() {
-  python -m pytest tests/ -q -x
+  # The fast tier includes the pipelined-executor suite
+  # (tests/test_pipeline.py, ISSUE 2); pytest collects it with the rest of
+  # tests/ — no separate invocation, which would run it twice.
+  # JAX_PLATFORMS=cpu is pinned explicitly (belt to conftest.py's
+  # in-process suspenders) so the tier can never contend for the
+  # single-process TPU claim.
+  JAX_PLATFORMS=cpu python -m pytest tests/ -q -x
 }
 
 run_slow() {

@@ -233,11 +233,22 @@ def full_domain_evaluate_robust(
     key_chunk: int = 32,
     host_levels: Optional[int] = None,
     policy: DegradationPolicy = DEFAULT_POLICY,
+    pipeline: Optional[bool] = None,
 ) -> np.ndarray:
     """`evaluator.full_domain_evaluate` behind the integrity + degradation
     stack: sentinel-verified on device levels, bit-correct via the host
     engine when every device level fails. Scalar Int/XorWrapper outputs
-    (the host oracle's scope). Returns uint32[K, domain, lpe] limbs."""
+    (the host oracle's scope). Returns uint32[K, domain, lpe] limbs.
+
+    `pipeline` (None = DPF_TPU_PIPELINE env / platform default) runs the
+    device levels through the pipelined chunk executor. The chain is
+    pipeline-aware by construction: a corrupted chunk detected at the
+    pull/verify stage drains every in-flight finalize inside the executor
+    (ops/pipeline.consume) *before* the DataCorruptionError reaches this
+    chain, so the degraded rerun at the next level never races a
+    background pull and chunks already delivered to the caller stay
+    valid. The numpy level of last resort has no device queue and always
+    runs serially."""
     from . import evaluator
 
     _scalar_bits(dpf, hierarchy_level)  # raises early for codec types
@@ -257,6 +268,7 @@ def full_domain_evaluate_robust(
             host_levels=host_levels,
             use_pallas=(backend == "pallas"),
             integrity=True if policy.verify is None else policy.verify,
+            pipeline=pipeline,
         )
 
     attempt.default_chunk = key_chunk
@@ -269,9 +281,12 @@ def evaluate_at_robust(
     points: Sequence[int],
     hierarchy_level: int = -1,
     policy: DegradationPolicy = DEFAULT_POLICY,
+    pipeline: Optional[bool] = None,
 ) -> np.ndarray:
     """`evaluator.evaluate_at_batch` behind the integrity + degradation
-    stack. Scalar outputs; returns uint32[K, P, lpe] limbs."""
+    stack. Scalar outputs; returns uint32[K, P, lpe] limbs. `pipeline`:
+    see `full_domain_evaluate_robust` — the executor drains in-flight work
+    before any error reaches this chain."""
     from . import evaluator
 
     _scalar_bits(dpf, hierarchy_level)
@@ -279,9 +294,9 @@ def evaluate_at_robust(
     def attempt(backend: str, chunk: Optional[int]):
         if backend == "numpy":
             return _host_evaluate_at_limbs(dpf, keys, points, hierarchy_level)
-        # evaluate_at_batch has no chunking of its own (the K x P program
-        # is one dispatch), so resource-exhaustion halving slices the key
-        # batch here; each slice carries its own sentinel probe.
+        # evaluate_at_batch has no default chunking of its own (the K x P
+        # program is one dispatch), so resource-exhaustion halving slices
+        # the key batch here; each slice carries its own sentinel probe.
         ck = chunk if chunk is not None else len(keys)
         outs = [
             evaluator.evaluate_at_batch(
@@ -291,6 +306,7 @@ def evaluate_at_robust(
                 hierarchy_level,
                 use_pallas=(backend == "pallas"),
                 integrity=True if policy.verify is None else policy.verify,
+                pipeline=pipeline,
             )
             for i in range(0, len(keys), ck)
         ]

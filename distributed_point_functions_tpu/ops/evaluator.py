@@ -44,6 +44,7 @@ from ..utils import faultinject
 from ..utils.envflags import env_bool as _env_bool
 from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, value_codec
+from . import pipeline as _pl
 
 # ---------------------------------------------------------------------------
 # Host-side key batch preparation
@@ -304,6 +305,26 @@ def _expand_level_batch_jit(planes, control, cw_plane, ccl, ccr):
     return jax.vmap(backend_jax.expand_one_level)(planes, control, cw_plane, ccl, ccr)
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _expand_level_batch_donated_jit(planes, control, cw_plane, ccl, ccr):
+    """`_expand_level_batch_jit` with the plane/control carry DONATED: the
+    parent planes are dead the moment the children exist, and at serving
+    widths they are the 100+ MB buffer whose per-level reallocation walks
+    HBM toward the RESOURCE_EXHAUSTED cliff (PERF.md). Selected by
+    `_expand_level_batch` on backends that implement donation."""
+    return jax.vmap(backend_jax.expand_one_level)(planes, control, cw_plane, ccl, ccr)
+
+
+def _expand_level_batch(planes, control, cw_plane, ccl, ccr):
+    """One doubling level, donating the carried plane state where the
+    backend supports it (DPF_TPU_DONATE / TPU default — XLA:CPU ignores
+    donation and would warn per program). Every caller rebinds planes and
+    control to the result, so donation never aliases a live buffer."""
+    if _pl.donate_default():
+        return _expand_level_batch_donated_jit(planes, control, cw_plane, ccl, ccr)
+    return _expand_level_batch_jit(planes, control, cw_plane, ccl, ccr)
+
+
 @jax.jit
 def _split_levels_jit(cw_all, ccl_all, ccr_all):
     """Splits the stacked per-level corrections into per-level arrays in
@@ -441,6 +462,19 @@ def _fused_chunk_jit(
     return _finalize_batch_codec_jit(
         planes, control, corrections, order,
         spec=spec, party=party, keep_per_block=keep_per_block, reorder=reorder,
+    )
+
+
+@functools.lru_cache(maxsize=8)  # each entry pins ~MBs on device — keep few
+def _order_on_device(m_order: int, lanes: int, levels: int):
+    """DEVICE-resident leaf-order gather for one (host lanes, padded
+    lanes, device levels) shape: the index array is ~MBs at serving
+    sizes, and re-uploading it per call would put the host link
+    (megabytes/s through this image's tunnel) on the hot path — notably
+    on PreparedKeyBatch replays, whose whole point is upload-once.
+    (expansion_output_order itself is lru_cached host-side.)"""
+    return jnp.asarray(
+        backend_jax.expansion_output_order(m_order, lanes, levels)
     )
 
 
@@ -617,12 +651,13 @@ def _fused_fold_chunk_jit(
 
 def full_domain_fold_chunks(
     dpf: DistributedPointFunction,
-    keys: Sequence[DpfKey],
+    keys,
     hierarchy_level: int = -1,
-    key_chunk: int = 128,
+    key_chunk: Optional[int] = None,  # None = 128 (prepared: its own)
     host_levels: Optional[int] = None,
     db_lane=None,
     use_pallas: Optional[bool] = None,
+    pipeline: Optional[bool] = None,
 ):
     """Full-domain evaluation with the consumer fused INTO each program.
 
@@ -638,79 +673,130 @@ def full_domain_fold_chunks(
     "fold-in-program"). Values never leave the device; use
     `full_domain_evaluate_chunks` when the caller needs them.
 
+    `keys` may be a `PreparedKeyBatch` (packed + uploaded once; the
+    prepared `key_chunk`/`host_levels` then apply). `pipeline` (None =
+    DPF_TPU_PIPELINE env / platform default, see ops/pipeline.py) runs
+    chunk N+1's host pack + upload + dispatch while the consumer still
+    holds chunk N — the double-buffered executor behind the recorded
+    "async chunk overlap" headline (PERF.md §Pallas).
+
     Scalar Int/XorWrapper value types only (the XOR fold of mod-N limb
     shares has no protocol meaning).
     """
     v = dpf.validator
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
-    value_type = v.parameters[hierarchy_level].value_type
     backend_jax.log_backend_once()
-    batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
-    spec = batch.spec
-    if not (spec.is_scalar_direct and spec.blocks_needed == 1):
-        raise NotImplementedError(
-            "full_domain_fold_chunks supports scalar Int/XorWrapper value "
-            "types; evaluate IntModN/Tuple outputs via "
-            "full_domain_evaluate_chunks"
-        )
-    bits, xor_group = _value_kind(value_type)
-    stop_level = batch.num_levels
-    if stop_level < 5:
-        # Below one packed word the expansion pads lanes whose garbage a
-        # plain fold would absorb; domains this small have no use for the
-        # bulk fold path anyway.
-        raise NotImplementedError(
-            "full_domain_fold_chunks requires a tree of depth >= 5; use "
-            "full_domain_evaluate for small domains"
-        )
-    lds = v.parameters[hierarchy_level].log_domain_size
-    keep = 1 << (lds - stop_level)
-    num_keys = len(keys)
-    if host_levels is None:
-        host_levels = 5
-    elif host_levels < 5:
-        # A silent clamp would desynchronize this generator from a
-        # lane_order_map/PIR database the caller built at the smaller
-        # host_levels (mismatched lane counts surface as opaque broadcast
-        # errors inside the jit).
-        raise InvalidArgumentError(
-            f"full_domain_fold_chunks requires host_levels >= 5 (one full "
-            f"packed word), got {host_levels}"
-        )
-    host_levels = min(host_levels, stop_level)
-    device_levels = stop_level - host_levels
-
     if use_pallas is None:
         use_pallas = _pallas_default()
-    _inject_batch_faults(batch, use_pallas)
+    pipe = _pl.resolve(pipeline)
+
+    prepared: Optional[PreparedKeyBatch] = None
+    if isinstance(keys, PreparedKeyBatch):
+        prepared = keys
+        prepared._check_call(
+            dpf, hierarchy_level, key_chunk, host_levels,
+            "full_domain_fold_chunks",
+        )
+        if not prepared.scalar_fast:
+            raise NotImplementedError(
+                "full_domain_fold_chunks supports scalar Int/XorWrapper "
+                "value types; evaluate IntModN/Tuple outputs via "
+                "full_domain_evaluate_chunks"
+            )
+        if prepared.host_levels < 5:
+            raise InvalidArgumentError(
+                "full_domain_fold_chunks requires a PreparedKeyBatch with "
+                "host_levels >= 5 (a tree of depth >= 5)"
+            )
+        bits, xor_group = prepared.bits, prepared.xor_group
+        party = prepared.party
+        keep = prepared.keep_per_block
+        device_levels = prepared.device_levels
+        chunks = prepared.chunks
+    else:
+        value_type = v.parameters[hierarchy_level].value_type
+        batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+        spec = batch.spec
+        if not (spec.is_scalar_direct and spec.blocks_needed == 1):
+            raise NotImplementedError(
+                "full_domain_fold_chunks supports scalar Int/XorWrapper value "
+                "types; evaluate IntModN/Tuple outputs via "
+                "full_domain_evaluate_chunks"
+            )
+        bits, xor_group = _value_kind(value_type)
+        party = batch.party
+        stop_level = batch.num_levels
+        if stop_level < 5:
+            # Below one packed word the expansion pads lanes whose garbage a
+            # plain fold would absorb; domains this small have no use for the
+            # bulk fold path anyway.
+            raise NotImplementedError(
+                "full_domain_fold_chunks requires a tree of depth >= 5; use "
+                "full_domain_evaluate for small domains"
+            )
+        lds = v.parameters[hierarchy_level].log_domain_size
+        keep = 1 << (lds - stop_level)
+        num_keys = len(keys)
+        if key_chunk is None:
+            key_chunk = 128
+        if host_levels is None:
+            host_levels = 5
+        elif host_levels < 5:
+            # A silent clamp would desynchronize this generator from a
+            # lane_order_map/PIR database the caller built at the smaller
+            # host_levels (mismatched lane counts surface as opaque broadcast
+            # errors inside the jit).
+            raise InvalidArgumentError(
+                f"full_domain_fold_chunks requires host_levels >= 5 (one full "
+                f"packed word), got {host_levels}"
+            )
+        host_levels = min(host_levels, stop_level)
+        device_levels = stop_level - host_levels
+        _inject_batch_faults(batch, use_pallas)
+        chunks = None  # prepared lazily, chunk by chunk, inside the thunks
 
     db_dev = None
     if db_lane is not None:
         db_dev = jnp.asarray(db_lane)
 
     fuse_last_hash = _env_bool("DPF_TPU_FUSE_LAST_HASH", default=False)
-    for kb, valid in _key_chunks(batch, num_keys, key_chunk):
-        k = kb.seeds.shape[0]
-        control0 = np.full(k, bool(kb.party), dtype=bool)
-        seeds_h, control_h = _host_expand(kb.seeds, control0, kb, host_levels)
-        cw_dev, ccl, ccr = kb.device_cw_arrays(host_levels)
-        yield valid, _fused_fold_chunk_jit(
-            jnp.asarray(seeds_h),
-            jnp.asarray(aes_jax.pack_bit_mask(control_h)),
-            jnp.asarray(cw_dev),
-            jnp.asarray(ccl),
-            jnp.asarray(ccr),
-            jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
+
+    def _dispatch(ch: _PreparedChunk):
+        return ch.valid, _fused_fold_chunk_jit(
+            ch.seeds,
+            ch.control_mask,
+            ch.cw,
+            ch.ccl,
+            ch.ccr,
+            ch.corr,
             db_dev,
             levels=device_levels,
             bits=bits,
-            party=batch.party,
+            party=party,
             xor_group=xor_group,
             keep=keep,
             use_pallas=use_pallas,
             fuse_last_hash=fuse_last_hash,
         )
+
+    def _thunks():
+        if chunks is not None:  # PreparedKeyBatch: stage 1 already paid
+            for ch in chunks:
+                yield functools.partial(_dispatch, ch)
+            return
+        for kb, valid in _key_chunks(batch, num_keys, key_chunk):
+            yield functools.partial(
+                lambda kb, valid: _dispatch(
+                    _prepare_chunk(kb, valid, host_levels, True, bits)
+                ),
+                kb,
+                valid,
+            )
+
+    yield from _pl.prefetch_thunks(
+        _thunks(), pipe, backend=_fi_backend(use_pallas)
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("spec", "party", "keep"))
@@ -769,25 +855,185 @@ def _key_chunks(batch: KeyBatch, num_keys: int, key_chunk: int):
     the last chunk with key 0 so every chunk compiles to one shape (no pad
     when the whole batch is smaller than key_chunk — smaller programs
     compile on their own). Padded rows are trimmed by the caller."""
-    for start in range(0, num_keys, key_chunk):
-        idx = np.arange(start, min(start + key_chunk, num_keys))
-        valid = idx.shape[0]
-        pad = key_chunk - valid if num_keys > key_chunk else 0
-        if pad:
-            idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
+    for idx, valid in _pl.chunk_indices(num_keys, key_chunk):
         yield batch.take(idx), valid
+
+
+@dataclasses.dataclass
+class _PreparedChunk:
+    """One key chunk's device-resident evaluation inputs: host-expanded
+    seeds, packed control mask, correction-word tables, and value
+    corrections, uploaded once. The unit both the pipelined executor's
+    launch stage and `PreparedKeyBatch` traffic in."""
+
+    valid: int  # real (non-padded) keys in this chunk
+    seeds: jnp.ndarray  # uint32[K, M, 4] host-expanded, lane-padded
+    control_mask: jnp.ndarray  # uint32[K, M // 32]
+    cw: jnp.ndarray  # uint32[K, L, 128]
+    ccl: jnp.ndarray  # uint32[K, L]
+    ccr: jnp.ndarray  # uint32[K, L]
+    corr: object  # uint32[K, epb, lpe] (scalar) or tuple of codec arrays
+    m: int  # real host lanes before the 32-lane pad
+
+
+def _prepare_chunk_host(
+    kb: KeyBatch, host_levels: int, scalar_fast: bool, bits: int
+):
+    """Host-side stage-1 pack for one chunk: host pre-expansion (numpy
+    over the native AES engine), lane pad to one packed word,
+    control-mask pack, correction tables. Returns
+    (seeds, control_mask, cw, ccl, ccr, corr, m) in HOST form —
+    `_prepare_chunk` wraps it with the device uploads; the lane-slab path
+    keeps the host forms so pieces slice before uploading."""
+    k = kb.seeds.shape[0]
+    control0 = np.full(k, bool(kb.party), dtype=bool)
+    seeds_h, control_h = _host_expand(kb.seeds, control0, kb, host_levels)
+    m = seeds_h.shape[1]
+    if m < 32:  # pad lanes to one packed word
+        lane_pad = 32 - m
+        seeds_h = np.concatenate(
+            [seeds_h, np.zeros((k, lane_pad, 4), np.uint32)], axis=1
+        )
+        control_h = np.concatenate(
+            [control_h, np.zeros((k, lane_pad), bool)], axis=1
+        )
+    control_mask = aes_jax.pack_bit_mask(control_h)
+    cw, ccl, ccr = kb.device_cw_arrays(host_levels)
+    if scalar_fast:
+        corr = _correction_limbs(kb.value_corrections, bits)
+    else:
+        corr = kb.codec_corrections
+    return seeds_h, control_mask, cw, ccl, ccr, corr, m
+
+
+def _prepare_chunk(
+    kb: KeyBatch, valid: int, host_levels: int, scalar_fast: bool, bits: int
+) -> _PreparedChunk:
+    """Stage-1 work for one chunk: `_prepare_chunk_host` plus the
+    `jnp.asarray` uploads. Runs on the main thread — under the pipelined
+    executor this overlaps the previous chunk's device program and the
+    chunk before that's D2H pull."""
+    seeds_h, control_mask, cw, ccl, ccr, corr, m = _prepare_chunk_host(
+        kb, host_levels, scalar_fast, bits
+    )
+    return _PreparedChunk(
+        valid=valid,
+        seeds=jnp.asarray(seeds_h),
+        control_mask=jnp.asarray(control_mask),
+        cw=jnp.asarray(cw),
+        ccl=jnp.asarray(ccl),
+        ccr=jnp.asarray(ccr),
+        corr=(
+            jnp.asarray(corr)
+            if scalar_fast
+            else tuple(jnp.asarray(a) for a in corr)
+        ),
+        m=m,
+    )
+
+
+class PreparedKeyBatch:
+    """Key material packed and uploaded ONCE, reusable across bulk calls —
+    the flat-path analog of `PreparedLevelsPlan` (ops/hierarchical.py).
+
+    `full_domain_fold_chunks` and `full_domain_evaluate_chunks` (modes
+    "levels"/"fused", leaf or lane order, no lane_slab) accept an instance
+    in place of `keys` and skip the per-call host pre-expansion AND the
+    re-upload of the correction-word/seed tables over the host link — at
+    serving shapes those tables are ~MBs per call through a ~5 MB/s tunnel
+    (PERF.md), pure setup cost for a key batch that does not change
+    between calls (e.g. the benchmark loop, or a heavy-hitters server
+    re-expanding one key batch against several databases). `key_chunk` and
+    `host_levels` are fixed at prepare time; a consuming call passing a
+    conflicting explicit value raises InvalidArgumentError (leave them at
+    their None defaults to inherit the prepared choice).
+
+    Armed fault-injection plans (seeds/cw) apply at *prepare* time — the
+    prepared material models what actually sits in device memory — and
+    are scoped by the prepare-time backend; the consuming call's
+    `use_pallas` still selects the execution engine (the uploaded tables
+    are engine-independent).
+    """
+
+    def __init__(self, dpf, keys: Sequence[DpfKey], hierarchy_level: int = -1,
+                 key_chunk: int = 128, host_levels: Optional[int] = None,
+                 use_pallas: Optional[bool] = None):
+        v = dpf.validator
+        if hierarchy_level < 0:
+            hierarchy_level = v.num_hierarchy_levels - 1
+        self.dpf = dpf
+        self.hierarchy_level = hierarchy_level
+        self.key_chunk = key_chunk
+        self.num_keys = len(keys)
+        batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+        if use_pallas is None:
+            use_pallas = _pallas_default()
+        _inject_batch_faults(batch, use_pallas)
+        self.party = batch.party
+        self.spec = batch.spec
+        self.scalar_fast = (
+            batch.spec.is_scalar_direct and batch.spec.blocks_needed == 1
+        )
+        value_type = v.parameters[hierarchy_level].value_type
+        self.bits, self.xor_group = (
+            _value_kind(value_type) if self.scalar_fast else (0, False)
+        )
+        stop_level = batch.num_levels
+        lds = v.parameters[hierarchy_level].log_domain_size
+        self.keep_per_block = 1 << (lds - stop_level)
+        self.domain = 1 << lds
+        if host_levels is None:
+            host_levels = min(5, stop_level)
+        elif host_levels < 5 and stop_level >= 5:
+            raise InvalidArgumentError(
+                f"PreparedKeyBatch requires host_levels >= 5 (one full "
+                f"packed word), got {host_levels}"
+            )
+        host_levels = min(host_levels, stop_level)
+        self.host_levels = host_levels
+        self.device_levels = stop_level - host_levels
+        self.chunks = [
+            _prepare_chunk(kb, valid, host_levels, self.scalar_fast, self.bits)
+            for kb, valid in _key_chunks(batch, self.num_keys, key_chunk)
+        ]
+
+    def _check_call(self, dpf, hierarchy_level: int, key_chunk, host_levels,
+                    context: str) -> None:
+        """The prepared tables encode one (parameter set, chunking, split)
+        choice; silently accepting conflicting per-call knobs would run a
+        different program against the wrong tables (or a different chunk
+        grouping than the caller sized its consumers for)."""
+        v = dpf.validator
+        if hierarchy_level < 0:
+            hierarchy_level = v.num_hierarchy_levels - 1
+        if dpf is not self.dpf or hierarchy_level != self.hierarchy_level:
+            raise InvalidArgumentError(
+                f"{context}: PreparedKeyBatch was built for a different DPF "
+                "instance or hierarchy level"
+            )
+        if key_chunk is not None and key_chunk != self.key_chunk:
+            raise InvalidArgumentError(
+                f"{context}: PreparedKeyBatch was prepared at key_chunk="
+                f"{self.key_chunk}, call requested {key_chunk}"
+            )
+        if host_levels is not None and host_levels != self.host_levels:
+            raise InvalidArgumentError(
+                f"{context}: PreparedKeyBatch was prepared at host_levels="
+                f"{self.host_levels}, call requested {host_levels}"
+            )
 
 
 def full_domain_evaluate_chunks(
     dpf: DistributedPointFunction,
-    keys: Sequence[DpfKey],
+    keys,
     hierarchy_level: int = -1,
-    key_chunk: int = 32,
+    key_chunk: Optional[int] = None,  # None = 32 (prepared: its own)
     host_levels: Optional[int] = None,
     leaf_order: bool = True,
     mode: str = "levels",
     lane_slab: Optional[int] = None,
     use_pallas: Optional[bool] = None,
+    pipeline: Optional[bool] = None,
 ):
     """Full-domain evaluation, yielding *device-resident* results per chunk.
 
@@ -836,6 +1082,14 @@ def full_domain_evaluate_chunks(
     image's tunnel threshold). Deliberately NOT on by default: slabbing
     changes the yield structure (several pieces per key chunk), which
     one-yield-per-chunk consumers must opt into knowingly.
+
+    `keys` may be a `PreparedKeyBatch` (modes "levels"/"fused" without
+    lane_slab: packed + uploaded once, reused across calls; the prepared
+    `key_chunk`/`host_levels` apply). `pipeline` (None = DPF_TPU_PIPELINE
+    env / platform default, ops/pipeline.py) launches the next chunk's
+    host pack + upload + dispatch while the consumer holds the current
+    one — one chunk ahead here (depth 1), because each in-flight chunk
+    pins a full [key_chunk, domain, lpe] value buffer in device memory.
     """
     if mode not in ("levels", "fused", "walk"):
         raise InvalidArgumentError(
@@ -865,60 +1119,77 @@ def full_domain_evaluate_chunks(
         hierarchy_level = v.num_hierarchy_levels - 1
     value_type = v.parameters[hierarchy_level].value_type
     backend_jax.log_backend_once()
-    batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
-    spec = batch.spec
-    scalar_fast = spec.is_scalar_direct and spec.blocks_needed == 1
-    if scalar_fast:
-        bits, xor_group = _value_kind(value_type)
-    stop_level = batch.num_levels
-    # Only the first 2^(lds - tree_level) elements of each block are
-    # addressable; fewer than elements_per_block when an earlier hierarchy
-    # level forces the tree deeper (distributed_point_function.h:786-808).
-    lds = v.parameters[hierarchy_level].log_domain_size
-    keep_per_block = 1 << (lds - stop_level)
-    assert keep_per_block <= value_type.elements_per_block()
-    domain = 1 << lds
-
-    # Opt-in auto-slabbing (see docstring). Only in full-auto sizing: an
-    # explicit host_levels may be too shallow for a >= 32-lane slab, so
-    # user-pinned splits keep user control. Sized by the ACTUAL program
-    # key count: chunks() does not pad when the batch is smaller than
-    # key_chunk.
-    budget = int(os.environ.get("DPF_TPU_MAX_PROGRAM_BYTES", "0"))
-    if (
-        budget > 0
-        and mode == "fused"
-        and leaf_order
-        and lane_slab is None
-        and host_levels is None
-    ):
-        auto_h, auto_slab = plan_slabs(
-            dpf,
-            max(1, min(key_chunk, len(keys))),
-            hierarchy_level,
-            max_out_bytes=budget,
-        )
-        if auto_slab is not None:
-            host_levels, lane_slab = auto_h, auto_slab
-
-    num_keys = len(keys)
     if use_pallas is None:
         use_pallas = _pallas_default()
-    _inject_batch_faults(batch, use_pallas)
-    # (lanes, levels) -> DEVICE-resident leaf-order gather: the index array
-    # is ~MBs at serving sizes, and re-uploading it per dispatch would put
-    # the host link (megabytes/s through this image's tunnel) on the hot
-    # path. (expansion_output_order itself is already lru_cached host-side.)
-    _order_dev = {}
+    pipe = _pl.resolve(pipeline)
+    fib = _fi_backend(use_pallas)
 
-    def _order_on_device(m_order, lanes, levels):
-        key = (m_order, lanes, levels)
-        if key not in _order_dev:
-            _order_dev[key] = jnp.asarray(
-                backend_jax.expansion_output_order(m_order, lanes, levels)
+    prepared: Optional[PreparedKeyBatch] = None
+    batch = None
+    if isinstance(keys, PreparedKeyBatch):
+        prepared = keys
+        if mode == "walk" or lane_slab is not None:
+            raise InvalidArgumentError(
+                "PreparedKeyBatch supports mode='levels'/'fused' without "
+                "lane_slab (walk mode and slabbing re-derive their inputs "
+                "per call)"
             )
-        return _order_dev[key]
+        prepared._check_call(
+            dpf, hierarchy_level, key_chunk, host_levels,
+            "full_domain_evaluate_chunks",
+        )
+        spec = prepared.spec
+        scalar_fast = prepared.scalar_fast
+        if scalar_fast:
+            bits, xor_group = prepared.bits, prepared.xor_group
+        party = prepared.party
+        keep_per_block = prepared.keep_per_block
+        domain = prepared.domain
+        host_levels = prepared.host_levels
+        device_levels = prepared.device_levels
+        num_keys = prepared.num_keys
+    else:
+        if key_chunk is None:
+            key_chunk = 32
+        batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
+        spec = batch.spec
+        scalar_fast = spec.is_scalar_direct and spec.blocks_needed == 1
+        if scalar_fast:
+            bits, xor_group = _value_kind(value_type)
+        party = batch.party
+        stop_level = batch.num_levels
+        # Only the first 2^(lds - tree_level) elements of each block are
+        # addressable; fewer than elements_per_block when an earlier hierarchy
+        # level forces the tree deeper (distributed_point_function.h:786-808).
+        lds = v.parameters[hierarchy_level].log_domain_size
+        keep_per_block = 1 << (lds - stop_level)
+        assert keep_per_block <= value_type.elements_per_block()
+        domain = 1 << lds
 
+        # Opt-in auto-slabbing (see docstring). Only in full-auto sizing: an
+        # explicit host_levels may be too shallow for a >= 32-lane slab, so
+        # user-pinned splits keep user control. Sized by the ACTUAL program
+        # key count: chunks() does not pad when the batch is smaller than
+        # key_chunk.
+        budget = int(os.environ.get("DPF_TPU_MAX_PROGRAM_BYTES", "0"))
+        if (
+            budget > 0
+            and mode == "fused"
+            and leaf_order
+            and lane_slab is None
+            and host_levels is None
+        ):
+            auto_h, auto_slab = plan_slabs(
+                dpf,
+                max(1, min(key_chunk, len(keys))),
+                hierarchy_level,
+                max_out_bytes=budget,
+            )
+            if auto_slab is not None:
+                host_levels, lane_slab = auto_h, auto_slab
+
+        num_keys = len(keys)
+        _inject_batch_faults(batch, use_pallas)
     def _trim(out):
         # Trim to the actual domain size (block packing may overshoot) and
         # unwrap single-component codec outputs. Only valid in leaf order —
@@ -937,7 +1208,8 @@ def full_domain_evaluate_chunks(
 
     if mode == "walk":
         path_masks = jnp.asarray(_walk_path_masks(stop_level))
-        for kb, valid in chunks():
+
+        def _walk_thunk(kb, valid):
             cw_dev, ccl, ccr = kb.device_cw_arrays(0)
             if scalar_fast:
                 out = _walk_chunk_jit(
@@ -948,7 +1220,7 @@ def full_domain_evaluate_chunks(
                     jnp.asarray(ccr),
                     jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
                     bits=bits,
-                    party=batch.party,
+                    party=party,
                     xor_group=xor_group,
                     keep=keep_per_block,
                 )
@@ -961,103 +1233,160 @@ def full_domain_evaluate_chunks(
                     jnp.asarray(ccr),
                     tuple(jnp.asarray(a) for a in kb.codec_corrections),
                     spec=spec,
-                    party=batch.party,
+                    party=party,
                     keep=keep_per_block,
                 )
-            yield valid, _trim(out)
+            return valid, _trim(out)
+
+        yield from _pl.prefetch_thunks(
+            (
+                functools.partial(_walk_thunk, kb, valid)
+                for kb, valid in chunks()
+            ),
+            pipe,
+            depth=1,
+            backend=fib,
+        )
         return
 
     # Host expands until one packed word (32 lanes) is full.
-    if host_levels is None:
-        host_levels = min(5, stop_level)
-    host_levels = min(host_levels, stop_level)
-    device_levels = stop_level - host_levels
+    if prepared is None:
+        if host_levels is None:
+            host_levels = min(5, stop_level)
+        host_levels = min(host_levels, stop_level)
+        device_levels = stop_level - host_levels
 
-    for kb, valid in chunks():
-        k = kb.seeds.shape[0]
-        control0 = np.full(k, bool(kb.party), dtype=bool)
-        seeds_h, control_h = _host_expand(kb.seeds, control0, kb, host_levels)
-        m = seeds_h.shape[1]
-        seeds_p, control_p = seeds_h, control_h
-        if m < 32:  # pad lanes to one packed word
-            lane_pad = 32 - m
-            seeds_p = np.concatenate(
-                [seeds_h, np.zeros((k, lane_pad, 4), np.uint32)], axis=1
+    def _prepared_chunks():
+        if prepared is not None:
+            yield from prepared.chunks
+            return
+        for kb, valid in chunks():
+            yield _prepare_chunk(
+                kb, valid, host_levels, scalar_fast,
+                bits if scalar_fast else 0,
             )
-            control_p = np.concatenate(
-                [control_h, np.zeros((k, lane_pad), bool)], axis=1
-            )
-        control_mask = aes_jax.pack_bit_mask(control_p)
-        cw_dev, ccl, ccr = kb.device_cw_arrays(host_levels)
-        order_dev = _order_on_device(m, seeds_p.shape[1], device_levels)
-        cw_dev = jnp.asarray(cw_dev)
-        ccl = jnp.asarray(ccl)
-        ccr = jnp.asarray(ccr)
-        if mode == "fused":
-            if scalar_fast:
-                corr = jnp.asarray(_correction_limbs(kb.value_corrections, bits))
-                kind = dict(bits=bits, xor_group=xor_group)
-            else:
-                corr = tuple(jnp.asarray(a) for a in kb.codec_corrections)
-                kind = dict(spec=spec)
-            m_lanes = seeds_p.shape[1]
-            slab = min(lane_slab, m_lanes) if lane_slab else m_lanes
-            if lane_slab and m < 32:
-                # Host expansion below one packed word was lane-padded to
-                # 32; slicing padded lanes into pieces would emit garbage
-                # pieces. A single full piece is valid slabbing (every
-                # dispatch stays under any size bound a 32-lane program
-                # could violate), so clamp rather than reject (r3 review).
-                slab = m_lanes
-            if slab < m_lanes:
-                # Multi-piece slabbing relies on pieces partitioning the
-                # domain EXACTLY: _trim's per-piece [:, :domain] cannot
-                # repair an overshooting piece (it would silently corrupt
-                # downstream offsets, e.g. the PIR natural-order advance).
-                # With the pad clamp above, m_lanes * 2^device_levels *
-                # keep_per_block == 2^lds holds by construction; raise (not
-                # assert: -O must not revert to silent corruption) if a
-                # future config breaks it.
-                if m_lanes * (1 << device_levels) * keep_per_block != domain:
-                    raise InvalidArgumentError(
-                        "lane_slab pieces would not partition the domain "
-                        f"exactly (lanes={m_lanes}, device_levels="
-                        f"{device_levels}, keep={keep_per_block}, "
-                        f"domain={domain})"
+
+    if mode == "fused" and lane_slab:
+        # Slab path: pieces slice the HOST-side expansion (slicing a
+        # device-resident _PreparedChunk would dispatch a program per
+        # piece), so it keeps its own stage-1 prep. PreparedKeyBatch is
+        # excluded above.
+        def _slab_thunks():
+            for kb, valid in chunks():
+                seeds_p, control_mask, cw_dev, ccl, ccr, corr_h, m = (
+                    _prepare_chunk_host(
+                        kb, host_levels, scalar_fast,
+                        bits if scalar_fast else 0,
                     )
-            for lo in range(0, m_lanes, slab):
-                s = min(slab, m_lanes - lo)
-                if s == m_lanes:
-                    seeds_s, mask_s, order_s = seeds_p, control_mask, order_dev
-                else:
-                    seeds_s = seeds_p[:, lo : lo + s]
-                    mask_s = control_mask[:, lo // 32 : (lo + s) // 32]
-                    order_s = _order_on_device(s, s, device_levels)
-                out = _fused_chunk_jit(
-                    jnp.asarray(seeds_s), jnp.asarray(mask_s),
-                    cw_dev, ccl, ccr, corr, order_s,
-                    levels=device_levels, party=batch.party,
-                    keep_per_block=keep_per_block, reorder=leaf_order,
-                    use_pallas=use_pallas, **kind,
                 )
-                yield valid, _trim(out)
-            continue
-        planes, control = _pack_batch_jit(
-            jnp.asarray(seeds_p), jnp.asarray(control_mask)
+                cw_dev = jnp.asarray(cw_dev)
+                ccl = jnp.asarray(ccl)
+                ccr = jnp.asarray(ccr)
+                if scalar_fast:
+                    corr = jnp.asarray(corr_h)
+                    kind = dict(bits=bits, xor_group=xor_group)
+                else:
+                    corr = tuple(jnp.asarray(a) for a in corr_h)
+                    kind = dict(spec=spec)
+                m_lanes = seeds_p.shape[1]
+                slab = min(lane_slab, m_lanes)
+                if m < 32:
+                    # Host expansion below one packed word was lane-padded
+                    # to 32; slicing padded lanes into pieces would emit
+                    # garbage pieces. A single full piece is valid slabbing
+                    # (every dispatch stays under any size bound a 32-lane
+                    # program could violate), so clamp rather than reject
+                    # (r3 review).
+                    slab = m_lanes
+                if slab < m_lanes:
+                    # Multi-piece slabbing relies on pieces partitioning the
+                    # domain EXACTLY: _trim's per-piece [:, :domain] cannot
+                    # repair an overshooting piece (it would silently corrupt
+                    # downstream offsets, e.g. the PIR natural-order advance).
+                    # With the pad clamp above, m_lanes * 2^device_levels *
+                    # keep_per_block == 2^lds holds by construction; raise
+                    # (not assert: -O must not revert to silent corruption)
+                    # if a future config breaks it.
+                    if m_lanes * (1 << device_levels) * keep_per_block != domain:
+                        raise InvalidArgumentError(
+                            "lane_slab pieces would not partition the domain "
+                            f"exactly (lanes={m_lanes}, device_levels="
+                            f"{device_levels}, keep={keep_per_block}, "
+                            f"domain={domain})"
+                        )
+
+                def _piece(lo, s, seeds_p=seeds_p, control_mask=control_mask,
+                           cw_dev=cw_dev, ccl=ccl, ccr=ccr, corr=corr,
+                           kind=kind, m=m, m_lanes=m_lanes, valid=valid):
+                    if s == m_lanes:
+                        seeds_s, mask_s = seeds_p, control_mask
+                        order_s = _order_on_device(m, m_lanes, device_levels)
+                    else:
+                        seeds_s = seeds_p[:, lo : lo + s]
+                        mask_s = control_mask[:, lo // 32 : (lo + s) // 32]
+                        order_s = _order_on_device(s, s, device_levels)
+                    out = _fused_chunk_jit(
+                        jnp.asarray(seeds_s), jnp.asarray(mask_s),
+                        cw_dev, ccl, ccr, corr, order_s,
+                        levels=device_levels, party=party,
+                        keep_per_block=keep_per_block, reorder=leaf_order,
+                        use_pallas=use_pallas, **kind,
+                    )
+                    return valid, _trim(out)
+
+                for lo in range(0, m_lanes, slab):
+                    yield functools.partial(
+                        _piece, lo, min(slab, m_lanes - lo)
+                    )
+
+        yield from _pl.prefetch_thunks(_slab_thunks(), pipe, depth=1, backend=fib)
+        return
+
+    if mode == "fused":
+        kind = (
+            dict(bits=bits, xor_group=xor_group)
+            if scalar_fast
+            else dict(spec=spec)
         )
-        cw_l, ccl_l, ccr_l = _split_levels_jit(cw_dev, ccl, ccr)
+
+        def _fused_thunk(ch: _PreparedChunk):
+            order_dev = _order_on_device(ch.m, ch.seeds.shape[1], device_levels)
+            out = _fused_chunk_jit(
+                ch.seeds, ch.control_mask, ch.cw, ch.ccl, ch.ccr, ch.corr,
+                order_dev,
+                levels=device_levels, party=party,
+                keep_per_block=keep_per_block, reorder=leaf_order,
+                use_pallas=use_pallas, **kind,
+            )
+            return ch.valid, _trim(out)
+
+        yield from _pl.prefetch_thunks(
+            (
+                functools.partial(_fused_thunk, ch)
+                for ch in _prepared_chunks()
+            ),
+            pipe,
+            depth=1,
+            backend=fib,
+        )
+        return
+
+    def _levels_thunk(ch: _PreparedChunk):
+        planes, control = _pack_batch_jit(ch.seeds, ch.control_mask)
+        cw_l, ccl_l, ccr_l = _split_levels_jit(ch.cw, ch.ccl, ch.ccr)
         for level in range(device_levels):
-            planes, control = _expand_level_batch_jit(
+            planes, control = _expand_level_batch(
                 planes, control, cw_l[level], ccl_l[level], ccr_l[level]
             )
+        order_dev = _order_on_device(ch.m, ch.seeds.shape[1], device_levels)
         if scalar_fast:
             out = _finalize_batch_jit(
                 planes,
                 control,
-                jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
+                ch.corr,
                 order_dev,
                 bits=bits,
-                party=batch.party,
+                party=party,
                 xor_group=xor_group,
                 keep_per_block=keep_per_block,
                 reorder=leaf_order,
@@ -1066,14 +1395,21 @@ def full_domain_evaluate_chunks(
             out = _finalize_batch_codec_jit(
                 planes,
                 control,
-                tuple(jnp.asarray(a) for a in kb.codec_corrections),
+                ch.corr,
                 order_dev,
                 spec=spec,
-                party=batch.party,
+                party=party,
                 keep_per_block=keep_per_block,
                 reorder=leaf_order,
             )
-        yield valid, _trim(out)
+        return ch.valid, _trim(out)
+
+    yield from _pl.prefetch_thunks(
+        (functools.partial(_levels_thunk, ch) for ch in _prepared_chunks()),
+        pipe,
+        depth=1,
+        backend=fib,
+    )
 
 
 def plan_slabs(
@@ -1123,6 +1459,7 @@ def full_domain_evaluate(
     host_levels: Optional[int] = None,
     use_pallas: Optional[bool] = None,
     integrity: Optional[bool] = None,
+    pipeline: Optional[bool] = None,
 ) -> np.ndarray:
     """Full-domain evaluation of a key batch, results on the host.
 
@@ -1145,27 +1482,46 @@ def full_domain_evaluate(
     into one extra dispatch of its own (PERF.md "sentinel overhead").
     Scalar Int/XorWrapper outputs only; codec value types evaluate
     unverified with an "integrity-skip" event.
+
+    `pipeline` (None = DPF_TPU_PIPELINE env / platform default,
+    ops/pipeline.py) keeps three stages in flight: chunk N+1's host pack +
+    upload + dispatch (main thread), chunk N's device program, and chunk
+    N-1's D2H pull (worker thread).
     """
     from ..utils import integrity as _integrity
 
     if use_pallas is None:
         use_pallas = _pallas_default()
+    pipe = _pl.resolve(pipeline)
     keys, probe = _integrity.setup_probe(
         dpf, hierarchy_level, keys, integrity, "full_domain_evaluate",
         backend=_fi_backend(use_pallas),
     )
-    outs = []
-    is_tuple = None
-    for valid, out in full_domain_evaluate_chunks(
-        dpf, keys, hierarchy_level, key_chunk, host_levels,
-        use_pallas=use_pallas,
-    ):
-        if is_tuple is None:
-            is_tuple = isinstance(out, tuple)
-        if is_tuple:
-            outs.append(tuple(np.asarray(o)[:valid] for o in out))
-        else:
-            outs.append(np.asarray(out)[:valid])
+
+    def _pull(item):
+        valid, out = item
+        if isinstance(out, tuple):
+            return tuple(np.asarray(o)[:valid] for o in out)
+        return np.asarray(out)[:valid]
+
+    outs = list(
+        _pl.consume(
+            full_domain_evaluate_chunks(
+                dpf, keys, hierarchy_level, key_chunk, host_levels,
+                use_pallas=use_pallas, pipeline=pipeline,
+            ),
+            _pull,
+            pipe,
+            # depth 1, matching the generator's own launch window: every
+            # un-pulled item pins a full [key_chunk, domain, lpe] value
+            # buffer in device memory, so the default depth would pin ~4
+            # chunks of values and walk HBM toward the eviction cliff the
+            # executor exists to avoid (PERF.md).
+            depth=1,
+            backend=_fi_backend(use_pallas),
+        )
+    )
+    is_tuple = isinstance(outs[0], tuple) if outs else False
     if is_tuple:
         return tuple(
             np.concatenate([o[c] for o in outs], axis=0)
@@ -1355,6 +1711,8 @@ def evaluate_at_batch(
     device_output: bool = False,
     use_pallas: Optional[bool] = None,
     integrity: Optional[bool] = None,
+    key_chunk: Optional[int] = None,
+    pipeline: Optional[bool] = None,
 ):
     """Evaluates every key at every point on device.
 
@@ -1369,6 +1727,13 @@ def evaluate_at_batch(
     `integrity` (None = DPF_TPU_INTEGRITY env default) appends a sentinel
     probe key verified at these exact points against the host oracle —
     see `full_domain_evaluate`.
+
+    `key_chunk` (None = the whole batch in ONE program, the historical
+    shape) splits the key axis into chunks driven through the pipelined
+    executor (ops/pipeline.py): chunk N+1's correction-word upload and
+    dispatch overlap chunk N's program and chunk N-1's D2H pull.
+    `pipeline` (None = DPF_TPU_PIPELINE env / platform default) selects
+    the executor mode; with a single chunk it is a pass-through.
     """
     from ..utils import integrity as _integrity
 
@@ -1377,9 +1742,11 @@ def evaluate_at_batch(
         hierarchy_level = v.num_hierarchy_levels - 1
     if use_pallas is None:
         use_pallas = _pallas_default()
+    pipe = _pl.resolve(pipeline)
+    fib = _fi_backend(use_pallas)
     keys, probe = _integrity.setup_probe(
         dpf, hierarchy_level, keys, integrity, "evaluate_at_batch",
-        backend=_fi_backend(use_pallas),
+        backend=fib,
     )
     value_type = v.parameters[hierarchy_level].value_type
     backend_jax.log_backend_once()
@@ -1403,53 +1770,111 @@ def evaluate_at_batch(
     p_pad = -(-p // 32) * 32
     path_masks = backend_jax._path_bit_masks(paths, num_levels, p_pad)
 
-    cw_planes, ccl, ccr = batch.device_cw_arrays()
-
-    seeds = np.broadcast_to(batch.seeds[:, None, :], (k, p_pad, 4)).copy()
-    control0 = aes_jax.pack_bit_mask(
-        np.full(p_pad, bool(batch.party), dtype=bool)
+    # Point-shared tables upload once; per-chunk key material uploads (and
+    # overlaps) inside each thunk.
+    path_masks_dev = jnp.asarray(path_masks)
+    block_sel_dev = jnp.asarray(block_sel)
+    control0_dev = jnp.asarray(
+        aes_jax.pack_bit_mask(np.full(p_pad, bool(batch.party), dtype=bool))
     )
     if scalar_fast:
         bits, xor_group = _value_kind(value_type)
-        out = _evaluate_points_jit(
-            jnp.asarray(seeds),
-            jnp.asarray(control0),
-            jnp.asarray(path_masks),
-            jnp.asarray(cw_planes),
-            jnp.asarray(ccl),
-            jnp.asarray(ccr),
-            jnp.asarray(_correction_limbs(batch.value_corrections, bits)),
-            jnp.asarray(block_sel),
-            bits=bits,
-            party=batch.party,
-            xor_group=xor_group,
-            use_pallas=use_pallas,
-        )
-        out = out[:, :p]
-        if not device_output:
-            out = faultinject.corrupt_output(
-                np.asarray(out), backend=_fi_backend(use_pallas)
+    ck = k if key_chunk is None else max(1, key_chunk)
+
+    def _chunk_thunk(idx, valid):
+        # Single chunk covering the whole batch (the historical default
+        # key_chunk=None): skip the identity fancy-index copy of every
+        # per-key table.
+        kb = batch if valid == k and idx.shape[0] == k else batch.take(idx)
+        kk = kb.seeds.shape[0]
+        cw_planes, ccl, ccr = kb.device_cw_arrays()
+        seeds = np.broadcast_to(kb.seeds[:, None, :], (kk, p_pad, 4)).copy()
+        if scalar_fast:
+            out = _evaluate_points_jit(
+                jnp.asarray(seeds),
+                control0_dev,
+                path_masks_dev,
+                jnp.asarray(cw_planes),
+                jnp.asarray(ccl),
+                jnp.asarray(ccr),
+                jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
+                block_sel_dev,
+                bits=bits,
+                party=batch.party,
+                xor_group=xor_group,
+                use_pallas=use_pallas,
             )
+        else:
+            out = _evaluate_points_codec_jit(
+                jnp.asarray(seeds),
+                control0_dev,
+                path_masks_dev,
+                jnp.asarray(cw_planes),
+                jnp.asarray(ccl),
+                jnp.asarray(ccr),
+                tuple(jnp.asarray(a) for a in kb.codec_corrections),
+                block_sel_dev,
+                spec=spec,
+                party=batch.party,
+            )
+        return valid, out
+
+    thunks = (
+        functools.partial(_chunk_thunk, idx, valid)
+        for idx, valid in _pl.chunk_indices(k, ck)
+    )
+
+    if device_output:
+        pieces = list(_pl.prefetch_thunks(thunks, pipe, backend=fib))
+        if scalar_fast:
+            outs = [o[:valid, :p] for valid, o in pieces]
+            out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
+            if probe is not None:
+                _integrity.verify_probe_at_points(
+                    probe, points, np.asarray(out[-1]),
+                    key_index=out.shape[0] - 1,
+                )
+                out = out[:-1]
+            return out
+        n_comp = len(pieces[0][1])
+        out = tuple(
+            (
+                pieces[0][1][c][: pieces[0][0], :p]
+                if len(pieces) == 1
+                else jnp.concatenate(
+                    [o[c][:valid, :p] for valid, o in pieces], axis=0
+                )
+            )
+            for c in range(n_comp)
+        )
+        return out if spec.is_tuple else out[0]
+
+    def _pull(item):
+        valid, out = item
+        if isinstance(out, tuple):
+            return tuple(np.asarray(o)[:valid, :p] for o in out)
+        return np.asarray(out)[:valid, :p]
+
+    pieces = list(
+        _pl.consume(
+            _pl.prefetch_thunks(thunks, pipe, backend=fib),
+            _pull,
+            pipe,
+            backend=fib,
+        )
+    )
+    if scalar_fast:
+        out = np.concatenate(pieces, axis=0)
+        out = faultinject.corrupt_output(out, backend=fib)
         if probe is not None:
             _integrity.verify_probe_at_points(
-                probe, points, np.asarray(out[-1]),
-                key_index=out.shape[0] - 1,
+                probe, points, out[-1], key_index=out.shape[0] - 1,
             )
             out = out[:-1]
         return out
-    out = _evaluate_points_codec_jit(
-        jnp.asarray(seeds),
-        jnp.asarray(control0),
-        jnp.asarray(path_masks),
-        jnp.asarray(cw_planes),
-        jnp.asarray(ccl),
-        jnp.asarray(ccr),
-        tuple(jnp.asarray(a) for a in batch.codec_corrections),
-        jnp.asarray(block_sel),
-        spec=spec,
-        party=batch.party,
-    )
+    n_comp = len(pieces[0])
     out = tuple(
-        (o[:, :p] if device_output else np.asarray(o)[:, :p]) for o in out
+        np.concatenate([piece[c] for piece in pieces], axis=0)
+        for c in range(n_comp)
     )
     return out if spec.is_tuple else out[0]

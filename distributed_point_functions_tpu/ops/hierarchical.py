@@ -1217,7 +1217,10 @@ def _expand_batch(
     ccr = jnp.asarray(ccr[:, :levels])
     cw_l, ccl_l, ccr_l = evaluator._split_levels_jit(cw_dev, ccl, ccr)
     for level in range(levels):
-        planes, control_mask = evaluator._expand_level_batch_jit(
+        # Donating dispatcher: the parent planes die as the children are
+        # born, and at serving widths they are the 100+ MB recurring
+        # buffer (ops/pipeline.donate_default gates by backend).
+        planes, control_mask = evaluator._expand_level_batch(
             planes, control_mask, cw_l[level], ccl_l[level], ccr_l[level]
         )
     order = backend_jax.expansion_output_order(num_parents, pad_to, levels)

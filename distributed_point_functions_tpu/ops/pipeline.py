@@ -1,0 +1,228 @@
+"""Pipelined chunk executor: overlap pack/upload, compute, and pull/verify.
+
+PERF.md establishes that the device paths are latency- and transfer-bound,
+not compute-bound (~66 ms per dispatch through this image's tunnel, a
+~5 MB/s host link), yet the bulk entry points historically ran strictly
+synchronously: chunk N's host key pack, H2D upload, device program, and
+D2H pull all completed before chunk N+1 started. This module promotes the
+bench-side hand-rolled "async chunk overlap" (PERF.md §Pallas) into a
+library capability with three stages in flight:
+
+  1. **launch** (main thread) — host-side key pack + ``device_put`` of
+     chunk N+1's correction-word/seed material plus the *async* dispatch
+     of its device program. JAX dispatch returns immediately, so up to
+     ``depth`` chunks queue on the device while…
+  2. **compute** (device) — chunk N's program runs, and…
+  3. **finalize** (worker thread) — chunk N-1's D2H pull, sentinel
+     verification, and consumer fold happen concurrently. Host pulls
+     block the calling thread, hence the single worker; one worker keeps
+     chunk completion strictly ordered.
+
+The same code drives the serial mode (``pipeline=False``): launch and
+finalize run inline on one thread with identical per-chunk fault hooks,
+so a pipeline-on/off A/B (bench.py's ``pipeline_overlap`` field, the
+overlap proxy in tests/test_pipeline.py) compares like for like.
+
+Failure semantics: when any stage raises (e.g. ``DataCorruptionError``
+from sentinel verification at stage 3, or an injected ``chunk_launch``
+fault), every in-flight finalize is **drained** — awaited, not abandoned —
+before the exception propagates. A degradation rerun (ops/degrade.py)
+therefore never races a background pull, and results already yielded to
+the consumer stay valid (completed chunks are not lost).
+
+Enabled per-call via the ``pipeline=`` keyword on every bulk entry point
+or process-wide via ``DPF_TPU_PIPELINE`` (strict boolean). Default: ON
+for device backends, OFF on XLA:CPU (whose compute runs on the same cores
+the stages would overlap on) and never for the numpy host oracle (which
+has no device queue at all). ``DPF_TPU_PIPELINE_DEPTH`` sizes the launch
+window (default 2 chunks ahead).
+
+``DPF_TPU_DONATE`` governs input-buffer donation on the large per-chunk
+fold programs (parallel/sharded.py) and the per-level expansion programs:
+default ON for TPU backends — the 100+ MB value buffers are reused by XLA
+instead of accumulating toward the RESOURCE_EXHAUSTED cliff — and OFF on
+CPU, where XLA does not implement donation and would warn per program.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import wait as _futures_wait
+from typing import Callable, Iterable, Iterator, Optional, TypeVar
+
+import numpy as np
+
+from ..utils import faultinject
+from ..utils.envflags import env_bool as _env_bool
+
+T = TypeVar("T")
+R = TypeVar("R")
+
+
+def pipeline_default() -> bool:
+    """Resolves the executor default: DPF_TPU_PIPELINE when set, else ON
+    exactly for non-CPU JAX backends. XLA:CPU computes on the very cores
+    the launch/finalize stages would overlap on, so pipelining there buys
+    nothing and costs a thread; tests opt in explicitly."""
+    if "DPF_TPU_PIPELINE" in os.environ:
+        return _env_bool("DPF_TPU_PIPELINE")
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
+def resolve(pipeline: Optional[bool]) -> bool:
+    """Explicit keyword wins; None = the platform/env default."""
+    return pipeline_default() if pipeline is None else bool(pipeline)
+
+
+def depth_default() -> int:
+    """Launch-ahead window (chunks in flight beyond the one the consumer
+    holds). DPF_TPU_PIPELINE_DEPTH, floor 1, default 2 (double buffering:
+    one uploading/computing, one computed awaiting pull)."""
+    try:
+        depth = int(os.environ.get("DPF_TPU_PIPELINE_DEPTH", "2"))
+    except ValueError:
+        depth = 2
+    return max(1, depth)
+
+
+def donate_default() -> bool:
+    """Input-buffer donation default: DPF_TPU_DONATE when set, else ON for
+    real TPU backends only (XLA:CPU does not implement donation and warns
+    once per donated program)."""
+    if "DPF_TPU_DONATE" in os.environ:
+        return _env_bool("DPF_TPU_DONATE")
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def chunk_indices(num_items: int, chunk: int) -> Iterator[tuple]:
+    """Yields (idx int64[chunk or fewer], num_valid) index blocks with the
+    shared padding rule of evaluator._key_chunks: the last block pads with
+    row 0 so every dispatched program keeps one shape — except when the
+    whole batch is smaller than `chunk` (small programs compile on their
+    own). Padded rows are trimmed by the caller via num_valid."""
+    for start in range(0, num_items, chunk):
+        idx = np.arange(start, min(start + chunk, num_items))
+        valid = idx.shape[0]
+        pad = chunk - valid if num_items > chunk else 0
+        if pad:
+            idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
+        yield idx, valid
+
+
+def prefetch_thunks(
+    thunks: Iterable[Callable[[], T]],
+    pipeline: bool,
+    depth: Optional[int] = None,
+    backend: Optional[str] = None,
+) -> Iterator[T]:
+    """Stage-1/2 driver. Each thunk performs ONE chunk's host pack +
+    upload + async device-program dispatch and returns the chunk's
+    device-resident result. Pipelined, up to `depth` chunks launch ahead
+    of the one the consumer holds, so chunk N+1's pack/upload overlaps
+    chunk N's device program and the consumer's pull of chunk N-1; serial
+    mode launches and yields strictly one at a time. Results always yield
+    in input order.
+
+    Per chunk, before its launch, the fault-injection hooks fire:
+    ``maybe_raise("chunk_launch")`` (a per-chunk injected failure — how
+    tests corrupt a pipeline mid-flight) and ``chunk_delay("launch")``
+    (the artificial dispatch-latency knob behind the CPU overlap proxy).
+    Both are armed-plan no-ops in production.
+    """
+    if depth is None:
+        depth = depth_default()
+    window: deque = deque()
+    for thunk in thunks:
+        faultinject.maybe_raise("chunk_launch", backend=backend)
+        faultinject.chunk_delay("launch", backend=backend)
+        window.append(thunk())
+        if not pipeline or len(window) > depth:
+            yield window.popleft()
+    while window:
+        yield window.popleft()
+
+
+def consume(
+    results: Iterable[T],
+    finalize: Callable[[T], R],
+    pipeline: bool,
+    depth: Optional[int] = None,
+    backend: Optional[str] = None,
+) -> Iterator[R]:
+    """Stage-3 driver. Pulls each upstream chunk through `finalize` (the
+    blocking D2H transfer + sentinel verification + host-side fold) — on a
+    single worker thread when pipelined, so the pulls overlap the main
+    thread's pack/dispatch of later chunks; inline when serial. One worker
+    by construction: chunk results yield strictly in order either way.
+
+    On any failure (a finalize raising — e.g. sentinel verification
+    detecting a corrupted chunk — or the upstream iterable raising), every
+    in-flight finalize is drained before the exception propagates: the
+    caller can immediately rerun on a fallback backend (ops/degrade.py)
+    without racing a background pull, and chunks already yielded remain
+    valid."""
+    if depth is None:
+        depth = depth_default()
+
+    def _finalize(item: T) -> R:
+        faultinject.chunk_delay("finalize", backend=backend)
+        return finalize(item)
+
+    if not pipeline:
+        for item in results:
+            yield _finalize(item)
+        return
+
+    pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="dpf-pipeline")
+    pending: deque = deque()
+    try:
+        try:
+            for item in results:
+                pending.append(pool.submit(_finalize, item))
+                while len(pending) > depth:
+                    yield pending.popleft().result()
+            while pending:
+                yield pending.popleft().result()
+        except BaseException:
+            drain(pending)
+            raise
+    finally:
+        # Normal exhaustion leaves nothing pending; after drain() the
+        # worker is idle — never block teardown on a wait here.
+        pool.shutdown(wait=False)
+
+
+def drain(pending) -> None:
+    """Cancels what has not started and awaits what has: after drain, no
+    background thread touches device buffers. Bounded wait — a wedged
+    device pull must not hang the error path forever (the exception being
+    propagated is the primary signal; a stuck transfer surfaces in the
+    runtime's own logs)."""
+    for f in pending:
+        f.cancel()
+    if pending:
+        _futures_wait(list(pending), timeout=60)
+
+
+def map_chunks(
+    thunks: Iterable[Callable[[], T]],
+    finalize: Callable[[T], R],
+    pipeline: bool,
+    depth: Optional[int] = None,
+    backend: Optional[str] = None,
+) -> Iterator[R]:
+    """prefetch_thunks + consume composed: the full three-stage executor
+    for entry points that own both the dispatch and the pull."""
+    return consume(
+        prefetch_thunks(thunks, pipeline, depth, backend),
+        finalize,
+        pipeline,
+        depth,
+        backend,
+    )

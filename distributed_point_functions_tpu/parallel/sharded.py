@@ -447,12 +447,57 @@ def _pir_fold_jit(values, db_lane):
     return jnp.bitwise_xor.reduce(values & db_lane[None, :, :], axis=1)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _pir_fold_jit_donated(values, db_lane):
+    """`_pir_fold_jit` DONATING the values buffer: the [chunk, domain, lpe]
+    chunk output (100+ MB at serving shapes) is dead after the fold, and
+    donation lets XLA reuse it instead of accumulating toward the
+    RESOURCE_EXHAUSTED cliff / HBM-eviction stalls (PERF.md). The DB is
+    never donated — it is the long-lived prepared buffer."""
+    return jnp.bitwise_xor.reduce(values & db_lane[None, :, :], axis=1)
+
+
 @jax.jit
 def _pir_fold_slab_jit(values, db, off):
     """XOR inner product of a leaf-contiguous values piece against rows
     [off, off + piece) of a natural-order DB (one compile for any offset)."""
     piece = jax.lax.dynamic_slice_in_dim(db, off, values.shape[1], axis=0)
     return jnp.bitwise_xor.reduce(values & piece[None, :, :], axis=1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _pir_fold_slab_jit_donated(values, db, off):
+    """Donating variant of `_pir_fold_slab_jit` (see _pir_fold_jit_donated)."""
+    piece = jax.lax.dynamic_slice_in_dim(db, off, values.shape[1], axis=0)
+    return jnp.bitwise_xor.reduce(values & piece[None, :, :], axis=1)
+
+
+def _pir_fold(values, db_lane):
+    """Fold + release of a chunk's value buffer: input-buffer donation on
+    backends that implement it (ops/pipeline.donate_default — TPU, or
+    DPF_TPU_DONATE=1), the explicit post-dispatch `delete()` elsewhere.
+    Either way the 100+ MB buffer is reclaimed before the next chunk's
+    expansion temporaries land — a live extra chunk pushes past HBM and
+    the runtime starts evicting buffers across the host link (the
+    difference between 0.1 s and 5 s per chunk, PERF.md)."""
+    from ..ops import pipeline as _pl
+
+    if _pl.donate_default():
+        return _pir_fold_jit_donated(values, db_lane)
+    out = _pir_fold_jit(values, db_lane)
+    values.delete()
+    return out
+
+
+def _pir_fold_slab(values, db, off):
+    """Slab-piece analog of `_pir_fold`."""
+    from ..ops import pipeline as _pl
+
+    if _pl.donate_default():
+        return _pir_fold_slab_jit_donated(values, db, off)
+    out = _pir_fold_slab_jit(values, db, off)
+    values.delete()
+    return out
 
 
 class PreparedPirDatabase:
@@ -549,6 +594,7 @@ def pir_query_batch_chunked(
     host_levels=None,
     mode: str = "levels",
     integrity=None,
+    pipeline=None,
 ) -> np.ndarray:
     """Single-device PIR answers via the chunked bulk evaluator.
 
@@ -587,8 +633,16 @@ def pir_query_batch_chunked(
     verification fold reconstructs a natural-order host copy of the DB
     once per *database* (cached on the immutable PreparedPirDatabase), so
     serving loops pay the device pull at setup, not per query batch.
+
+    `pipeline` (None = DPF_TPU_PIPELINE env / platform default,
+    ops/pipeline.py) runs the chunked evaluation through the pipelined
+    executor: chunk N+1's key pack + upload + dispatch overlap chunk N's
+    device program and chunk N-1's response pull (worker thread). The
+    per-chunk fold dispatches stay on the main thread in chunk order, so
+    answers are deterministic and bit-identical to the serial path.
     """
     from ..ops import evaluator as ev
+    from ..ops import pipeline as _pl
 
     # The chunk evaluators resolve use_pallas=None to the platform default;
     # the fault-injection level of this call follows that resolution.
@@ -626,13 +680,24 @@ def pir_query_batch_chunked(
             db_nat = db_limbs.natural_host(dpf)
         else:
             db_nat = np.asarray(db_limbs)
+    pipe = _pl.resolve(pipeline)
+
+    def _pull(item):
+        n_valid, fold = item
+        return np.asarray(fold)[:n_valid]
+
     if mode == "fold":
-        rows = []
-        for valid, fold in ev.full_domain_fold_chunks(
-            dpf, keys, key_chunk=key_chunk, host_levels=host_levels,
-            db_lane=db_dev,
-        ):
-            rows.append(np.asarray(fold)[:valid])
+        rows = list(
+            _pl.consume(
+                ev.full_domain_fold_chunks(
+                    dpf, keys, key_chunk=key_chunk, host_levels=host_levels,
+                    db_lane=db_dev, pipeline=pipeline,
+                ),
+                _pull,
+                pipe,
+                backend=fi_backend,
+            )
+        )
         return _pir_verify_fold(
             probe, np.concatenate(rows, axis=0), db_nat,
             "pir_query_batch_chunked", fi_backend,
@@ -643,38 +708,51 @@ def pir_query_batch_chunked(
             max(1, min(key_chunk, len(keys))),
             min_host_levels=host_levels or 5,
         )
-        outs = []
-        acc, off = None, 0
-        for n_valid, vals in ev.full_domain_evaluate_chunks(
-            dpf, keys, key_chunk=key_chunk, host_levels=h, mode="fused",
-            lane_slab=slab,
-        ):
-            fold = _pir_fold_slab_jit(vals, db_dev, off)
-            vals.delete()
-            acc = fold if acc is None else acc ^ fold
-            off += vals.shape[1]
-            if off >= db_dev.shape[0]:  # chunk complete
-                outs.append(np.asarray(acc)[:n_valid])
-                acc, off = None, 0
+
+        def _chunk_folds():
+            # Fold dispatches chain on the MAIN thread in piece order (the
+            # per-piece value buffer is donated/deleted by _pir_fold_slab);
+            # only the tiny [chunk, lpe] per-chunk accumulator crosses to
+            # the pull thread.
+            acc, off = None, 0
+            for n_valid, vals in ev.full_domain_evaluate_chunks(
+                dpf, keys, key_chunk=key_chunk, host_levels=h, mode="fused",
+                lane_slab=slab, pipeline=pipeline,
+            ):
+                fold = _pir_fold_slab(vals, db_dev, off)
+                acc = fold if acc is None else acc ^ fold
+                off += vals.shape[1]
+                if off >= db_dev.shape[0]:  # chunk complete
+                    yield n_valid, acc
+                    acc, off = None, 0
+
+        outs = list(
+            _pl.consume(_chunk_folds(), _pull, pipe, backend=fi_backend)
+        )
         return _pir_verify_fold(
             probe, np.concatenate(outs, axis=0), db_nat,
             "pir_query_batch_chunked", fi_backend,
         )
-    outs = []
-    for n_valid, vals in ev.full_domain_evaluate_chunks(
-        dpf,
-        keys,
-        key_chunk=key_chunk,
-        host_levels=host_levels if mode == "levels" else None,
-        leaf_order=(mode == "walk"),
-        mode=mode,
-    ):
-        outs.append(np.asarray(_pir_fold_jit(vals, db_dev))[:n_valid])
-        # Free the chunk's [chunk, domain, lpe] values NOW: at large domains
-        # a live extra chunk (plus the expansion temporaries of the next one)
-        # pushes past HBM and the runtime starts evicting buffers across the
-        # host link — the difference between 0.1 s and 5 s per chunk.
-        vals.delete()
+
+    def _folded():
+        # The fold frees each chunk's [chunk, domain, lpe] values NOW
+        # (donation or explicit delete inside _pir_fold): at large domains
+        # a live extra chunk (plus the expansion temporaries of the next
+        # one) pushes past HBM and the runtime starts evicting buffers
+        # across the host link — the difference between 0.1 s and 5 s per
+        # chunk.
+        for n_valid, vals in ev.full_domain_evaluate_chunks(
+            dpf,
+            keys,
+            key_chunk=key_chunk,
+            host_levels=host_levels if mode == "levels" else None,
+            leaf_order=(mode == "walk"),
+            mode=mode,
+            pipeline=pipeline,
+        ):
+            yield n_valid, _pir_fold(vals, db_dev)
+
+    outs = list(_pl.consume(_folded(), _pull, pipe, backend=fi_backend))
     return _pir_verify_fold(
         probe, np.concatenate(outs, axis=0), db_nat,
         "pir_query_batch_chunked", fi_backend,

@@ -22,6 +22,16 @@ seams where real corruption has been observed or is conceivable:
   ``device_call``   — raise an injected exception instead of running the
                       backend (models UNAVAILABLE / RESOURCE_EXHAUSTED
                       from the runtime, for degradation-policy tests).
+  ``chunk_launch``  — raise an injected exception at ONE chunk's launch
+                      inside the pipelined executor (ops/pipeline.py):
+                      models a failure surfacing mid-pipeline, with other
+                      chunks already in flight.
+  ``chunk_delay``   — sleep ``delay_launch`` / ``delay_finalize`` seconds
+                      at each chunk's launch / finalize stage boundary:
+                      an artificial per-chunk dispatch latency + pull
+                      cost, so overlap is measurable on CPU where the
+                      real ~66 ms tunnel latency does not exist
+                      (tests/test_pipeline.py's overlap proxy).
 
 Faults are scoped by a context manager and never active by default; every
 hook is a no-op returning its input unchanged when no plan is armed, so
@@ -33,12 +43,16 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import time
 from typing import FrozenSet, Optional
 
 import numpy as np
 
 #: Recognized injection stages (see module docstring).
-STAGES = ("seeds", "cw", "wire", "device_output", "device_call")
+STAGES = (
+    "seeds", "cw", "wire", "device_output", "device_call", "chunk_launch",
+    "chunk_delay",
+)
 
 
 @dataclasses.dataclass
@@ -65,8 +79,11 @@ class FaultPlan:
     pattern: str = "bit4"  # or "lane"
     lane: int = 0
     xor_mask: int = 0xDEADBEEF
-    # device_call
+    # device_call / chunk_launch
     exception: Optional[BaseException] = None
+    # chunk_delay (seconds slept per chunk at each pipeline stage)
+    delay_launch: float = 0.0
+    delay_finalize: float = 0.0
     # scoping
     backends: Optional[FrozenSet[str]] = None
     max_fires: Optional[int] = None
@@ -179,7 +196,26 @@ def corrupt_output(values: np.ndarray, backend: Optional[str] = None) -> np.ndar
 
 
 def maybe_raise(stage: str = "device_call", backend: Optional[str] = None) -> None:
-    """Raises the armed plan's exception (degradation-policy tests)."""
+    """Raises the armed plan's exception (degradation-policy tests).
+    stage "device_call" fires once per backend attempt (ops/degrade.py);
+    stage "chunk_launch" fires per chunk inside the pipelined executor."""
     plan = _take(stage, backend)
     if plan is not None and plan.exception is not None:
         raise plan.exception
+
+
+def chunk_delay(point: str, backend: Optional[str] = None) -> None:
+    """Sleeps the armed chunk_delay plan's configured seconds at one
+    pipeline stage boundary (`point` is "launch" or "finalize") — the
+    artificial per-chunk dispatch latency behind the CPU-measurable
+    overlap proxy (ops/pipeline.py; ISSUE 2 acceptance). The serial and
+    pipelined executors both call this once per chunk per point, so the
+    injected cost is identical on the two sides of an A/B."""
+    if not _active:
+        return
+    plan = _take("chunk_delay", backend)
+    if plan is None:
+        return
+    seconds = plan.delay_launch if point == "launch" else plan.delay_finalize
+    if seconds > 0:
+        time.sleep(seconds)

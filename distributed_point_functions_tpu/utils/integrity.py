@@ -621,6 +621,7 @@ def run_device_check(
     seed: int = 7,
     report: Callable[[str], None] = print,
     selftest: bool = True,
+    pipeline: Optional[bool] = None,
 ) -> int:
     """Verifies the active backend against the host oracle at the given
     (num_keys, log_domain) shapes; returns the total number of mismatched
@@ -630,6 +631,15 @@ def run_device_check(
     mode is the execution strategy under test: "levels", "fused", "walk"
     (full_domain_evaluate_chunks) or "fold" (full_domain_fold_chunks) —
     the program shapes fail independently on a broken backend.
+
+    `pipeline` (None = DPF_TPU_PIPELINE env / platform default) drives the
+    chunk generators through the pipelined executor (ops/pipeline.py) —
+    pass both values (CHECK_PIPELINE=0/1 via tools/check_device.py) when
+    qualifying a platform, so the overlapped execution shape is
+    differential-verified exactly like the serial one: the probe keys ride
+    the same programs either way, but buffer donation and the deeper
+    in-flight window are pipeline-only behaviors worth checking on
+    hardware that has miscomputed shape-dependently before (PERF.md).
     """
     import jax.numpy as jnp
 
@@ -654,13 +664,15 @@ def run_device_check(
         folds = []
         if mode == "fold":
             gen = evaluator.full_domain_fold_chunks(
-                dpf, keys, key_chunk=num_keys, use_pallas=use_pallas
+                dpf, keys, key_chunk=num_keys, use_pallas=use_pallas,
+                pipeline=pipeline,
             )
             for valid, fold in gen:
                 folds.append(np.asarray(fold)[:valid])
         else:
             for valid, out in evaluator.full_domain_evaluate_chunks(
-                dpf, keys, key_chunk=num_keys, mode=mode, use_pallas=use_pallas
+                dpf, keys, key_chunk=num_keys, mode=mode,
+                use_pallas=use_pallas, pipeline=pipeline,
             ):
                 folds.append(
                     np.asarray(jnp.bitwise_xor.reduce(out, axis=1))[:valid]

@@ -143,6 +143,102 @@ def test_evaluate_at_and_dcf_program_budget(program_counter):
     )
 
 
+def test_pipelined_chunked_paths_program_budget(program_counter):
+    """ISSUE 2: the pipelined executor (ops/pipeline.py) must never change
+    the device program count — overlap reorders dispatches in time, it
+    must not ADD any (an executor-introduced eager op would multiply by
+    the chunk count). Budgets are pinned per warm call with pipeline OFF
+    and ON on a 2-chunk run of every fast-tier rewired entry point; the
+    slow tier pins DCF and chunked PIR."""
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9, 100, 731], [[1, 2, 3, 4]])
+    pts = [int(x) for x in np.random.default_rng(1).integers(0, 1 << 10, 64)]
+
+    for pipe in (False, True):
+        tag = f"[pipeline={'on' if pipe else 'off'}]"
+        # levels: (pack + split + 4 expand + finalize) = 7 per chunk.
+        _assert_programs(
+            program_counter,
+            lambda: list(
+                evaluator.full_domain_evaluate_chunks(
+                    dpf, keys, key_chunk=2, mode="levels", pipeline=pipe
+                )
+            ),
+            f"full_domain_evaluate_chunks[levels,2chunks]{tag}",
+            budget=14,
+        )
+        # fused / fold: ONE program per chunk, pipelined or not.
+        _assert_programs(
+            program_counter,
+            lambda: list(
+                evaluator.full_domain_evaluate_chunks(
+                    dpf, keys, key_chunk=2, mode="fused", pipeline=pipe
+                )
+            ),
+            f"full_domain_evaluate_chunks[fused,2chunks]{tag}",
+            budget=2,
+        )
+        _assert_programs(
+            program_counter,
+            lambda: list(
+                evaluator.full_domain_fold_chunks(
+                    dpf, keys, key_chunk=2, pipeline=pipe
+                )
+            ),
+            f"full_domain_fold_chunks[2chunks]{tag}",
+            budget=2,
+        )
+        # evaluate_at: one walk program per key chunk; the worker-thread
+        # pulls are transfers, never programs.
+        _assert_programs(
+            program_counter,
+            lambda: evaluator.evaluate_at_batch(
+                dpf, keys, pts, key_chunk=2, pipeline=pipe
+            ),
+            f"evaluate_at_batch[2chunks]{tag}",
+            budget=2,
+        )
+
+
+@pytest.mark.slow
+def test_pipelined_dcf_and_pir_program_budget(program_counter):
+    """Slow-tier half of the ISSUE 2 pipelined budgets: DCF batch walk and
+    single-device chunked PIR (fold mode), pipeline OFF and ON."""
+    from distributed_point_functions_tpu.core.value_types import XorWrapper
+
+    dc = DistributedComparisonFunction.create(8, Int(64))
+    dk, _ = dc.generate_keys_batch([100, 200, 55, 9], [7, 9, 3, 1])
+    xs = [int(x) for x in np.random.default_rng(2).integers(0, 1 << 8, 48)]
+
+    rng = np.random.default_rng(7)
+    lds = 10
+    dpfx = DistributedPointFunction.create(DpfParameters(lds, XorWrapper(128)))
+    db = rng.integers(0, 2**32, size=(1 << lds, 4), dtype=np.uint32)
+    pir_keys = [dpfx.generate_keys(a, (1 << 128) - 1)[0] for a in (3, 77, 500)]
+    pdb = sharded.prepare_pir_database(dpfx, db, order="lane")
+
+    for pipe in (False, True):
+        tag = f"[pipeline={'on' if pipe else 'off'}]"
+        _assert_programs(
+            program_counter,
+            lambda: dcf_batch.batch_evaluate(
+                dc, dk, xs, use_pallas=False, key_chunk=2, pipeline=pipe
+            ),
+            f"dcf.batch_evaluate[2chunks]{tag}",
+            budget=2,
+        )
+        # fold mode: one in-program inner product per chunk (2 chunks of
+        # 2 for 3 keys, last padded).
+        _assert_programs(
+            program_counter,
+            lambda: sharded.pir_query_batch_chunked(
+                dpfx, pir_keys, pdb, key_chunk=2, mode="fold", pipeline=pipe
+            ),
+            f"pir_query_batch_chunked[fold,2chunks]{tag}",
+            budget=2,
+        )
+
+
 def test_hierarchical_paths_program_budget(program_counter):
     params = [DpfParameters(d, Int(32)) for d in (3, 6, 9)]
     dpf = DistributedPointFunction.create_incremental(params)

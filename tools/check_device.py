@@ -21,11 +21,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def _check_pallas_env():
-    """CHECK_PALLAS -> use_pallas (None = platform default). Accepts
+def _tristate_env(name: str):
+    """Env var -> Optional[bool] (None = platform default). Accepts
     1/true/on, 0/false/off, empty/unset; anything else is a clear error
     (a bare dict KeyError aborted the checker in round 3's review)."""
-    raw = os.environ.get("CHECK_PALLAS")
+    raw = os.environ.get(name)
     if raw is None or raw.strip() == "":
         return None
     low = raw.strip().lower()
@@ -33,7 +33,12 @@ def _check_pallas_env():
         return True
     if low in ("0", "false", "no", "off"):
         return False
-    raise SystemExit(f"CHECK_PALLAS must be boolean-ish, got {raw!r}")
+    raise SystemExit(f"{name} must be boolean-ish, got {raw!r}")
+
+
+def _check_pallas_env():
+    """CHECK_PALLAS -> use_pallas (None = platform default)."""
+    return _tristate_env("CHECK_PALLAS")
 
 
 def main() -> int:
@@ -100,9 +105,14 @@ def _main() -> int:
     # (utils/integrity.run_device_check) so this CLI and the runtime
     # integrity layer cannot drift; CHECK_PALLAS=1 forces the Mosaic row
     # kernels, =0 the XLA bitslice, unset = platform default.
+    # CHECK_PIPELINE=1 forces the pipelined chunk executor, =0 the serial
+    # path, unset = platform default (ops/pipeline.py) — qualify a
+    # platform with both, since donation and the in-flight window are
+    # pipeline-only execution shapes.
     try:
         failures = integrity.run_device_check(
-            shapes=shapes, mode=mode, use_pallas=_check_pallas_env()
+            shapes=shapes, mode=mode, use_pallas=_check_pallas_env(),
+            pipeline=_tristate_env("CHECK_PIPELINE"),
         )
     except (DataCorruptionError, InternalError) as e:
         print(f"SELF-TEST FAILED: {e}")

@@ -82,9 +82,21 @@ if ! STAGE_ALWAYS=1 \
 fi
 
 # 2. THE headline (scoreboard number): bench.py through the wrapper so the
-# record lands in results.json through the standard merge.
+# record lands in results.json through the standard merge. The record
+# itself carries the pipeline on/off A/B (pipeline_overlap +
+# sync_evals_per_sec fields — bench.py times a second pass with the chunk
+# executor forced off).
 BENCH_HEADLINE_TIMEOUT=2400 \
   stage headline 2700 python tools/run_bench_stage.py bench_headline.py
+
+# 2b. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
+# the pipelined chunk executor forced OFF land in their own results.json
+# slots, so the on/off pair is a first-class record pair (not just the
+# ratio field) for the scoreboard table.
+DPF_TPU_PIPELINE=0 BENCH_PIPELINE_AB=0 BENCH_HEADLINE_TIMEOUT=2400 \
+  stage headline-syncexec 2700 python tools/run_bench_stage.py bench_headline.py RECORD_SUFFIX=_syncexec
+DPF_TPU_PIPELINE=0 \
+  stage pir-syncexec 1800 python tools/run_bench_stage.py bench_pir.py RECORD_SUFFIX=_syncexec
 
 # 3. Device records for the three host-wins workloads (VERDICT r4 #6).
 stage evalat 1500 python tools/run_bench_stage.py bench_evaluate_at.py
@@ -135,7 +147,8 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
 
 # Sentinel: every resumable stage above is marked done -> the watcher can
 # stop re-firing sessions.
-required="headline evalat dcf hh-device extras fold-128x20 fold-fused-hash \
+required="headline headline-syncexec pir-syncexec evalat dcf hh-device \
+extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
 typed-u8 typed-u32 typed-tuple typed-intmodn headline-fused-hash hh-group32 \
 exp-hier exp-direct"
