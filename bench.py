@@ -30,12 +30,68 @@ import os
 import re
 import subprocess
 import sys
+import threading
 import time
 import traceback
 
-# A hung device call is diagnosable: dump all thread stacks to stderr every
-# 10 minutes so a stuck run shows where it is waiting.
-faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
+
+def _start_watchdog() -> None:
+    """A hung device call is diagnosable: dump all thread stacks to stderr
+    periodically so a stuck run shows where it is waiting.
+
+    The interval scales with the ACTIVE subprocess budget (the parent
+    exports BENCH_WATCHDOG_BUDGET = the attempt timeout it will kill this
+    child at; standalone runs fall back to BENCH_TPU_TIMEOUT): the old
+    fixed dump_traceback_later(600) fired twice inside a 900 s budget and
+    its bare "Timeout (0:10:00)!" headers read as failures in the logs
+    (BENCH_r04.json tail). Dumps are banner-prefixed as "periodic
+    watchdog, not a timeout".
+
+    The dump itself stays on faulthandler.dump_traceback_later — its C
+    watchdog thread needs no GIL, so stacks still appear when a native
+    device call hangs WHILE holding the GIL (the exact failure this
+    diagnostic exists for). The banner rides a best-effort Python thread
+    that wakes just before each dump; when the GIL is wedged the banner
+    is missing but the startup notice below still explains the bare
+    "Timeout" headers."""
+    try:
+        budget = float(os.environ.get("BENCH_WATCHDOG_BUDGET", "") or 0)
+    except ValueError:
+        budget = 0.0
+    if budget <= 0:
+        try:
+            budget = float(os.environ.get("BENCH_TPU_TIMEOUT", 1500))
+        except ValueError:
+            budget = 1500.0
+    interval = max(600.0, 0.75 * budget)
+    print(
+        f"# [watchdog] periodic stack dumps every {interval:.0f}s (scaled "
+        f"to the {budget:.0f}s subprocess budget); any 'Timeout "
+        "(h:mm:ss)!' stack dump below is the periodic watchdog, NOT a "
+        "timeout — the run continues",
+        file=sys.stderr,
+        flush=True,
+    )
+    faulthandler.dump_traceback_later(interval, repeat=True, file=sys.stderr)
+
+    def banner():
+        n = 0
+        while True:
+            # Wake ~2 s before each C-side dump so the banner precedes it.
+            time.sleep(max(1.0, interval - 2.0) if n == 0 else interval)
+            n += 1
+            print(
+                f"# [watchdog] periodic stack dump #{n} due in ~2s — "
+                "periodic watchdog, NOT a timeout; the run continues "
+                f"(subprocess budget {budget:.0f}s)",
+                file=sys.stderr,
+                flush=True,
+            )
+
+    threading.Thread(target=banner, daemon=True, name="bench-watchdog").start()
+
+
+_start_watchdog()
 
 # Process start, for in-child budget accounting (the pipeline A/B skips
 # itself when the remaining killable-subprocess budget could not absorb a
@@ -57,7 +113,8 @@ _VALUES_CHUNK = max(1, (14 << 20) >> LOG_DOMAIN)
 KEY_CHUNK = int(
     os.environ.get(
         "BENCH_KEY_CHUNK",
-        _FOLD_CHUNK if os.environ.get("BENCH_MODE", "fold") == "fold"
+        _FOLD_CHUNK
+        if os.environ.get("BENCH_MODE", "fold") in ("fold", "megakernel")
         else _VALUES_CHUNK,
     )
 )
@@ -68,6 +125,10 @@ CPU_KEY_CHUNK = int(os.environ.get("BENCH_CPU_KEY_CHUNK", 64))
 # materializes every value in HBM behind an optimization_barrier and
 # XOR-folds it in-program — output is a tiny [chunk, lpe], so the tunnel's
 # large-output miscompute threshold never binds and chunks scale to 128+),
+# "megakernel" (ISSUE 3: ONE pallas_call per chunk expanding every level
+# inside VMEM slabs with the fold accumulated in-kernel — no per-level HBM
+# round trips and no value buffer at all; A/B against fold via
+# BENCH_MODE=megakernel / tools/tpu_measure.sh headline_megakernel),
 # "fused" (per-chunk program emitting full values, 14-key output cap),
 # "levels" (per-level dispatch) or "walk" (root-to-leaf walk per lane).
 # Measured on the v5e tunnel 2026-07-31 (PERF.md): fold 63.8 M evals/s
@@ -304,9 +365,10 @@ def _run(
     def run_once(key_subset, chunk, verbose=False, pipeline=None):
         folds = []
         total_valid = 0
-        if MODE == "fold":
+        if MODE in ("fold", "megakernel"):
             gen = evaluator.full_domain_fold_chunks(
-                dpf, key_subset, key_chunk=chunk, pipeline=pipeline
+                dpf, key_subset, key_chunk=chunk, pipeline=pipeline,
+                mode=MODE,
             )
         else:
             gen = (
@@ -413,11 +475,25 @@ def _run(
         # what this chip's VPU can do on the bitsliced AES circuit. Trace-
         # only arithmetic — no extra device programs.
         try:
-            from distributed_point_functions_tpu.utils.roofline import mfu_fields
+            from distributed_point_functions_tpu.utils.roofline import (
+                hbm_fields,
+                mfu_fields,
+            )
 
             result.update(mfu_fields(evals_per_sec, log_domain))
+            # HBM-bandwidth roofline next to the VPU one (ISSUE 3): which
+            # wall this record sits against, per the strategy's traffic
+            # model (megakernel leaves ~nothing on HBM; the doubling
+            # strategies round-trip planes + values per level). Only the
+            # modeled strategies get the fields — "walk" has a different
+            # traffic shape the model does not cover.
+            if MODE in ("levels", "fused", "fold", "megakernel"):
+                result.update(
+                    hbm_fields(evals_per_sec, log_domain, strategy=MODE)
+                )
             _log(
                 f"roofline: mfu_estimate={result.get('mfu_estimate')} "
+                f"binding_wall={result.get('binding_wall')} "
                 f"({result.get('mfu_detail', '')})"
             )
         except Exception as e:
@@ -554,6 +630,9 @@ def _run_device_subprocess(platform: str, timeout: float):
     env = dict(os.environ)
     env["BENCH_INNER"] = "1"
     env["BENCH_PLATFORM"] = platform
+    # The child's periodic-stack-dump watchdog scales to the budget this
+    # parent will actually kill it at (see _start_watchdog).
+    env["BENCH_WATCHDOG_BUDGET"] = str(timeout)
     # The parent holds the TPU claim across this attempt; the child (and
     # anything it spawns) must not re-acquire it against its own parent.
     env["TPU_CLAIM_HELD"] = "1"
@@ -614,6 +693,7 @@ def _run_cpu_comparison_subprocess(timeout: float):
     env["BENCH_INNER"] = "1"
     env["BENCH_PLATFORM"] = "cpu"
     env["BENCH_COMPARE"] = "1"
+    env["BENCH_WATCHDOG_BUDGET"] = str(timeout)
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)],
         stdout=subprocess.PIPE,

@@ -68,14 +68,16 @@ def bench(jax, smoke):
     mode = os.environ.get("BENCH_PIR_MODE", "fold")
     # The DB is the server's static state: permute/upload once at setup
     # (prepare_pir_database) — per-query upload would measure the host
-    # link, not the query engine.
+    # link, not the query engine. Each mode consumes its own row order:
+    # "megakernel" takes the in-kernel streaming layout (ISSUE 3).
+    db_order = {
+        "walk": "natural", "fused": "natural", "megakernel": "megakernel",
+    }.get(mode, "lane")
     import jax.numpy as jnp
 
     with Timer() as tdb:
         db_dev = (
-            sharded.prepare_pir_database(
-                dpf, db, order="natural" if mode in ("walk", "fused") else "lane"
-            )
+            sharded.prepare_pir_database(dpf, db, order=db_order)
             if single_chip
             else jnp.asarray(db)
         )

@@ -26,8 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from . import aes_jax, backend_jax
+from . import aes_jax, backend_jax, value_codec
 
 
 def _divisor_block_w(w: int, block_w: int) -> int:
@@ -591,3 +592,348 @@ def walk_levels_pallas_batched(
             cc[:, level, :][:, None, :],
         )
     return planes[:k, :, :w], ctrl[:k, 0, :w]
+
+
+# ---------------------------------------------------------------------------
+# Multi-level slab megakernel: VMEM-resident tree slabs with in-kernel
+# fold / PIR accumulate (ISSUE 3)
+# ---------------------------------------------------------------------------
+#
+# The shipped Pallas path (above) still round-trips every doubling level's
+# full plane set through HBM, and the fold path materializes a ~1 GB value
+# buffer before the XOR fold / PIR inner product consumes it (PERF.md).
+# This kernel keeps the whole subtree expansion resident in VMEM: one
+# pallas_call whose grid is (keys, domain slabs); each grid step expands
+# ALL remaining doubling levels of its slab in-register/VMEM (per-level
+# correction words are small and stay resident for the whole call), runs
+# the value hash, converts planes to u32 element limbs with an in-register
+# 32x32 bit transpose, applies value correction in-kernel
+# (value_codec.rows_correct_element), and accumulates the consumer — the
+# XOR fold, optionally AND-masked against a database tile streamed from
+# HBM per grid step (BlockSpec index map => Pallas double-buffers the DMA)
+# — directly into the tiny [1, lpe, fold_w] output block. The leaves never
+# touch HBM at all: the only HBM traffic is the level-h entry seeds, the
+# correction-word tables, the (optional) DB stream, and the fold output.
+#
+# Structure per key (grid order is slab-inner, so j==0 runs first):
+#   phase A (j == 0): expand entry planes (width w5) `levels_a` levels to
+#     the mid state (width mid_words), park it in VMEM scratch — it
+#     persists across the key's slab steps;
+#   phase B (every j): slice slab j (slab_words) from the scratch, expand
+#     `levels_b` more levels, value-hash, unpack, correct, fold.
+#
+# Both children of a level are produced by ONE AES instantiation: the
+# parent rows are concatenated with themselves ([left slot | right slot])
+# and the per-lane key select rides the rk_diff mask, so the traced
+# circuit count stays at levels + 1 (value hash), not 2*levels. Lane order
+# is the same block-concat recursion as expand_one_level, applied per
+# phase/slab — evaluator.megakernel_leaf_map reproduces it on the host for
+# the PIR database permutation; the XOR fold itself is order-invariant.
+#
+# NOTE on Mosaic portability: like the row kernels, the body uses only
+# elementwise vector ops, static row loads/stores, scalar ref reads and
+# static slices — plus 1-D `jnp.concatenate` (the child doubling) and
+# `broadcasted_iota` (the child key mask), which interpret mode accepts;
+# they are the first things to check when the tunnel compiles this for
+# real (the [128,w]<->[16,8,w] reshape/stack rejection did NOT extend to
+# 1-D concatenation in the Mosaic versions probed so far).
+
+
+def _transpose32_rows(rows):
+    """In-register 32x32 bit transpose over a list of 32 uint32 rows:
+    out[j] word w bit i == in[i] word w bit j. Row-kernel twin of
+    aes_jax._bit_transpose32 (same masked-shift butterfly, the 32-word
+    axis realized as the Python list) — applied to hashed plane rows
+    [32l, 32l+32) it yields limb-l value rows: out[j][w] = limb l of
+    block 32w+j, i.e. the in-kernel form of aes_jax.unpack_from_planes."""
+    a = list(rows[::-1])
+    for j, m in zip(aes_jax._TSHIFTS, aes_jax._TMASKS):
+        mm = jnp.uint32(m)
+        out = [None] * 32
+        for base in range(0, 32, 2 * j):
+            for i in range(j):
+                a0 = a[base + i]
+                a1 = a[base + j + i]
+                t = (a0 ^ (a1 >> jnp.uint32(j))) & mm
+                out[base + i] = a0 ^ t
+                out[base + j + i] = a1 ^ (t << jnp.uint32(j))
+        a = out
+    return a[::-1]
+
+
+def _expand_rows_double(rows, c, cw_scalars, ccl, ccr, rk_base, rk_diff):
+    """One doubling level with BOTH children in one AES instantiation:
+    parent rows are concatenated with themselves ([left | right] block
+    layout, the expand_one_level recursion) and the right half selects the
+    right PRG key via the rk_diff mask. Returns (child rows of width 2w,
+    child control row)."""
+    w = rows[0].shape[0]
+    x = [jnp.concatenate([r, r], axis=0) for r in rows]
+    c2 = jnp.concatenate([c, c], axis=0)
+    pos = jax.lax.broadcasted_iota(jnp.uint32, (1, 2 * w), 1)[0]
+    key_mask = jnp.where(
+        pos >= jnp.uint32(w), jnp.uint32(0xFFFFFFFF), jnp.uint32(0)
+    )
+    sig = [x[64 + p] for p in range(64)] + [x[64 + p] ^ x[p] for p in range(64)]
+    enc = _aes_rows(sig, rk_base, rk_diff, key_mask)
+    h = [enc[p] ^ sig[p] ^ (cw_scalars[p] & c2) for p in range(128)]
+    cc = (ccl & ~key_mask) | (ccr & key_mask)
+    new_c = h[0] ^ (c2 & cc)
+    h[0] = jnp.zeros_like(h[0])
+    return h, new_c
+
+
+def _megakernel_slab_tail(
+    rows, c, corr_scalars, db_slab, bits, party, xor_group, keep, rk_value
+):
+    """Shared phase-B tail: value hash, in-register unpack, correction,
+    optional DB mask, XOR fold over rows/elements. `rows`/`c` are the
+    leaf-level plane rows / control row of one slab; `db_slab` indexes
+    like the kernel's db_ref block ([keep*lpe*32, final_words] rows).
+    Returns the slab's lpe fold vectors (width = final slab words). Used
+    verbatim by BOTH the kernel body and `megakernel_reference_rows`, so
+    the interpret-mode plumbing tests and the eager real-circuit oracle
+    replay exercise the same code."""
+    lpe = bits // 32
+    sig = [rows[64 + p] for p in range(64)] + [
+        rows[64 + p] ^ rows[p] for p in range(64)
+    ]
+    enc = _aes_rows(sig, rk_value, None, None)
+    h = [enc[p] ^ sig[p] for p in range(128)]
+    vrows = [_transpose32_rows(h[32 * l : 32 * l + 32]) for l in range(4)]
+    acc = [None] * lpe
+    for i in range(32):
+        # Control bit of block 32w+i is bit i of control word w.
+        ctrl_mask = jnp.uint32(0) - ((c >> jnp.uint32(i)) & jnp.uint32(1))
+        for e in range(keep):
+            limbs = [vrows[e * lpe + l][i] for l in range(lpe)]
+            corr = [corr_scalars(e, l) for l in range(lpe)]
+            vals = value_codec.rows_correct_element(
+                limbs, ctrl_mask, corr, bits, party, xor_group
+            )
+            if db_slab is not None:
+                vals = [
+                    vals[l] & db_slab((e * lpe + l) * 32 + i)
+                    for l in range(lpe)
+                ]
+            for l in range(lpe):
+                acc[l] = vals[l] if acc[l] is None else acc[l] ^ vals[l]
+    return acc
+
+
+def megakernel_reference_rows(
+    planes,  # uint32[128, entry_words] one key's level-h seed planes
+    control,  # uint32[entry_words]
+    cw_planes,  # uint32[L, 128]
+    ccl,  # uint32[L]
+    ccr,  # uint32[L]
+    corrections,  # uint32[epb, lpe]
+    db_rows=None,  # uint32[keep*lpe*32, total_words]
+    *,
+    plan,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+):
+    """Pure-array replay of ONE key's megakernel computation — the same
+    row functions (`_expand_rows_double`, `_aes_rows`,
+    `_transpose32_rows`, `rows_correct_element` via the shared slab tail)
+    on plain jnp arrays, no pallas_call. Two jobs (mirroring the
+    test_rows_circuit / _CheapRows split the row kernels established):
+    run eagerly (jax.disable_jit) with the REAL circuit it is bit-exact
+    against the host oracle in CI time; run with the cheap `_aes_rows`
+    stand-in it is the reference the interpret-mode pallas plumbing tests
+    compare against. Returns the [lpe] fold limbs (fold over the whole
+    domain, db-masked when given)."""
+    lpe = bits // 32
+    levels = plan.levels_a + plan.levels_b
+    rows = [planes[p] for p in range(128)]
+    c = control
+    for lvl in range(plan.levels_a):
+        rows, c = _expand_rows_double(
+            rows, c,
+            [cw_planes[lvl, p] for p in range(128)],
+            ccl[lvl], ccr[lvl],
+            backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff"),
+        )
+    sw = plan.slab_words
+    total = [None] * lpe
+    for j in range(plan.num_slabs):
+        srows = [r[j * sw : (j + 1) * sw] for r in rows]
+        sc = c[j * sw : (j + 1) * sw]
+        for lvl in range(plan.levels_a, levels):
+            srows, sc = _expand_rows_double(
+                srows, sc,
+                [cw_planes[lvl, p] for p in range(128)],
+                ccl[lvl], ccr[lvl],
+                backend_jax._rk_np("left"), backend_jax._rk_np("lr_diff"),
+            )
+        db_slab = None
+        if db_rows is not None:
+            lo = j * plan.final_words
+            db_slab = lambda r, lo=lo: db_rows[r, lo : lo + plan.final_words]
+        acc = _megakernel_slab_tail(
+            srows, sc,
+            lambda e, l: corrections[e, l],
+            db_slab, bits, party, xor_group, keep,
+            backend_jax._rk_np("value"),
+        )
+        for l in range(lpe):
+            total[l] = acc[l] if total[l] is None else total[l] ^ acc[l]
+    out = []
+    for l in range(lpe):
+        v = total[l][0]
+        for wd in range(1, total[l].shape[0]):
+            v = v ^ total[l][wd]
+        out.append(v)
+    return jnp.stack(out)
+
+
+def _megakernel_body(
+    rk_base, rk_diff, rk_value, plan, bits, party, xor_group, keep, use_db
+):
+    """Builds the megakernel kernel fn for one (plan, value-kind) config."""
+    lpe = bits // 32
+    levels = plan.levels_a + plan.levels_b
+    sw, w_f, fold_w = plan.slab_words, plan.final_words, plan.fold_words
+
+    def kernel(planes_ref, ctrl_ref, cw_ref, cc_ref, corr_ref, *refs):
+        if use_db:
+            db_ref, out_ref, mid_planes, mid_ctrl = refs
+        else:
+            (out_ref, mid_planes, mid_ctrl) = refs
+        j = pl.program_id(1)
+
+        def _level(rows, c, lvl):
+            return _expand_rows_double(
+                rows,
+                c,
+                [cw_ref[0, lvl, p] for p in range(128)],
+                cc_ref[0, lvl, 0],
+                cc_ref[0, lvl, 1],
+                rk_base,
+                rk_diff,
+            )
+
+        # Phase A: entry -> mid state, parked in scratch for this key's
+        # slab steps (grid is slab-inner, so j==0 runs before them all).
+        @pl.when(j == 0)
+        def _phase_a():
+            rows = [planes_ref[0, p, :] for p in range(128)]
+            c = ctrl_ref[0, 0, :]
+            for lvl in range(plan.levels_a):
+                rows, c = _level(rows, c, lvl)
+            for p in range(128):
+                mid_planes[p, :] = rows[p]
+            mid_ctrl[0, :] = c
+
+        # Phase B: slab j of the mid state -> leaves -> values -> fold
+        # (value hash + in-register unpack + correction + accumulate live
+        # in the shared `_megakernel_slab_tail`).
+        off = j * sw
+        rows = [mid_planes[p, pl.ds(off, sw)] for p in range(128)]
+        c = mid_ctrl[0, pl.ds(off, sw)]
+        for lvl in range(plan.levels_a, levels):
+            rows, c = _level(rows, c, lvl)
+        acc = _megakernel_slab_tail(
+            rows,
+            c,
+            lambda e, l: corr_ref[0, e, l],
+            (lambda r: db_ref[r, :]) if use_db else None,
+            bits,
+            party,
+            xor_group,
+            keep,
+            rk_value,
+        )
+        # Width-reduce each limb accumulator from w_f to fold_w words so
+        # the output block stays tiny at any slab size.
+        red = []
+        for l in range(lpe):
+            r = acc[l][0:fold_w]
+            for s in range(1, w_f // fold_w):
+                r = r ^ acc[l][s * fold_w : (s + 1) * fold_w]
+            red.append(r)
+
+        @pl.when(j == 0)
+        def _init():
+            for l in range(lpe):
+                out_ref[0, l, :] = red[l]
+
+        @pl.when(j != 0)
+        def _accumulate():
+            for l in range(lpe):
+                out_ref[0, l, :] = out_ref[0, l, :] ^ red[l]
+
+    return kernel
+
+
+def megakernel_fold_pallas_batched(
+    planes: jnp.ndarray,  # uint32[K, 128, entry_words] level-h seed planes
+    control: jnp.ndarray,  # uint32[K, entry_words] packed control masks
+    cw_planes: jnp.ndarray,  # uint32[K, L, 128]
+    ccl: jnp.ndarray,  # uint32[K, L]
+    ccr: jnp.ndarray,  # uint32[K, L]
+    corrections: jnp.ndarray,  # uint32[K, epb, lpe]
+    db_rows=None,  # uint32[keep*lpe*32, total_words] megakernel-order DB
+    *,
+    plan,  # evaluator.MegakernelPlan (static)
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    interpret: bool = False,
+):
+    """The slab megakernel: one pallas_call per key chunk expanding every
+    device level in VMEM and XOR-folding the corrected values in-kernel
+    (AND-masked against `db_rows` when given — the PIR inner product).
+    Returns uint32[K, lpe, fold_w] per-key partial folds; XOR-reduce the
+    last axis for the [K, lpe] answer (kept outside the kernel so the
+    final cross-word reduction is one trivial XLA op)."""
+    k = planes.shape[0]
+    lpe = bits // 32
+    levels = plan.levels_a + plan.levels_b
+    assert cw_planes.shape[1] == levels, (cw_planes.shape, plan)
+    kernel = _megakernel_body(
+        backend_jax._rk_np("left"),
+        backend_jax._rk_np("lr_diff"),
+        backend_jax._rk_np("value"),
+        plan,
+        bits,
+        party,
+        xor_group,
+        keep,
+        db_rows is not None,
+    )
+    cc = jnp.stack([ccl, ccr], axis=-1).astype(jnp.uint32)  # [K, L, 2]
+    in_specs = [
+        pl.BlockSpec((1, 128, plan.entry_words), lambda kk, j: (kk, 0, 0)),
+        pl.BlockSpec((1, 1, plan.entry_words), lambda kk, j: (kk, 0, 0)),
+        pl.BlockSpec((1, levels, 128), lambda kk, j: (kk, 0, 0)),
+        pl.BlockSpec((1, levels, 2), lambda kk, j: (kk, 0, 0)),
+        pl.BlockSpec((1, corrections.shape[1], lpe), lambda kk, j: (kk, 0, 0)),
+    ]
+    args = [planes, control[:, None, :], cw_planes, cc, corrections]
+    if db_rows is not None:
+        # DB tile per slab, streamed from HBM: the index map advances with
+        # j, so Pallas double-buffers the next slab's DMA behind this
+        # slab's compute (the emit_pipeline behavior of blocked inputs).
+        in_specs.append(
+            pl.BlockSpec((keep * lpe * 32, plan.final_words), lambda kk, j: (0, j))
+        )
+        args.append(db_rows)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((k, lpe, plan.fold_words), jnp.uint32),
+        grid=(k, plan.num_slabs),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, lpe, plan.fold_words), lambda kk, j: (kk, 0, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((128, plan.mid_words), jnp.uint32),
+            pltpu.VMEM((1, plan.mid_words), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(*args)

@@ -30,7 +30,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
-from typing import Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -397,6 +397,26 @@ def _finalize_batch_codec_jit(
     vals = jax.vmap(one)(planes, control, corrections)
     outs = []
     for v in vals:  # [K, lanes, epb, lpe_c]
+        k, n_blocks, epb, lpe = v.shape
+        if epb == 1:
+            # IntModN / sampled components (epb == 1, so keep_per_block is
+            # 1 and the epb slice is a no-op): fold lpe into the lane
+            # dimension IMMEDIATELY, so the gather temporary is
+            # [K, lanes*lpe] (one large trailing dim) instead of
+            # [K, lanes, 1, lpe] — whose (1, lpe) trailing dims pad ~2.5x
+            # against the 8x128 tiles (PERF.md IntModN-finalize open item;
+            # pinned by tests via value_codec.tile_padded_bytes). The
+            # element-limb order interleaves each lane's limbs in place,
+            # so the final reshape back to [K, lanes, lpe] is a view.
+            vf = v.reshape(k, n_blocks * lpe)
+            if reorder:
+                # The gather may SELECT a subset (padded parents emit
+                # garbage lanes the leaf order skips), so the lane count
+                # after it is len(order), not n_blocks.
+                o2 = (order[:, None] * lpe + jnp.arange(lpe)).reshape(-1)
+                vf = vf[:, o2]
+            outs.append(vf.reshape(k, -1, lpe))
+            continue
         if reorder:
             v = v[:, order]
         v = v[:, :, :keep_per_block]
@@ -658,27 +678,52 @@ def full_domain_fold_chunks(
     db_lane=None,
     use_pallas: Optional[bool] = None,
     pipeline: Optional[bool] = None,
+    mode: Optional[str] = None,  # None = DPF_TPU_MEGAKERNEL env -> "fold"
 ):
     """Full-domain evaluation with the consumer fused INTO each program.
 
     Yields (num_valid_keys, fold) where fold is uint32[key_chunk, lpe]: the
     XOR fold of every (lane-order) domain value of each key — AND-masked
-    against `db_lane` first when given (the FLAT uint32[positions, lpe]
-    lane-order array from `prepare_pir_database(order="lane").lane_db`,
-    i.e. `lane_order_map` applied to the natural-order rows: the
-    two-server-PIR inner product).
+    against `db_lane` first when given (the two-server-PIR inner product).
     One dispatch per key chunk, output bytes ~nothing: both the fastest
     shape through a high-dispatch-latency link and the only one whose
     per-program output stays small at any domain/chunk size (PERF.md
     "fold-in-program"). Values never leave the device; use
     `full_domain_evaluate_chunks` when the caller needs them.
 
+    mode selects the execution strategy (None = "megakernel" when the
+    DPF_TPU_MEGAKERNEL env is truthy, else "fold" — the A/B knob):
+
+    * "fold" — the shipped shape: per-level doubling expansion (Mosaic row
+      kernels / XLA bitslice per `use_pallas`), values materialized in HBM
+      behind an optimization_barrier and folded in-program. `db_lane` is
+      the FLAT uint32[positions, lpe] lane-order array from
+      `prepare_pir_database(order="lane").lane_db`.
+    * "megakernel" — the slab megakernel (aes_pallas.
+      megakernel_fold_pallas_batched): ONE pallas_call expands every
+      device level inside VMEM slabs, applies the value hash + correction
+      in-kernel and accumulates the fold/inner product directly — no
+      per-level HBM round trips and no value buffer at all; the program
+      output is exactly [key_chunk, lpe]. `db_lane` is then the streaming
+      row layout from `prepare_pir_database(order="megakernel")` /
+      `megakernel_db_rows`, and it MUST be built under the same
+      MegakernelPlan this call resolves (same host_levels and
+      DPF_TPU_MEGAKERNEL_VMEM): the row permutation encodes the plan's
+      slab geometry, and the shape check below cannot distinguish plans
+      that agree on total width (e.g. differing only in host_levels) —
+      `pir_query_batch_chunked` enforces plan equality on the prepared
+      database and is the recommended PIR entry point. Requires a
+      real-TPU or interpret-capable backend (the kernel runs interpreted
+      off-TPU), scalar value widths that are 32-bit multiples, and at
+      least one device level.
+
     `keys` may be a `PreparedKeyBatch` (packed + uploaded once; the
-    prepared `key_chunk`/`host_levels` then apply). `pipeline` (None =
-    DPF_TPU_PIPELINE env / platform default, see ops/pipeline.py) runs
-    chunk N+1's host pack + upload + dispatch while the consumer still
-    holds chunk N — the double-buffered executor behind the recorded
-    "async chunk overlap" headline (PERF.md §Pallas).
+    prepared `key_chunk`/`host_levels` then apply — both modes consume the
+    same prepared chunks). `pipeline` (None = DPF_TPU_PIPELINE env /
+    platform default, see ops/pipeline.py) runs chunk N+1's host pack +
+    upload + dispatch while the consumer still holds chunk N — the
+    double-buffered executor behind the recorded "async chunk overlap"
+    headline (PERF.md §Pallas).
 
     Scalar Int/XorWrapper value types only (the XOR fold of mod-N limb
     shares has no protocol meaning).
@@ -687,8 +732,20 @@ def full_domain_fold_chunks(
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
     backend_jax.log_backend_once()
+    if mode is None:
+        mode = _fold_mode_default()
+    if mode not in ("fold", "megakernel"):
+        raise InvalidArgumentError(
+            f"mode must be 'fold' or 'megakernel', got {mode!r}"
+        )
     if use_pallas is None:
         use_pallas = _pallas_default()
+    if mode == "megakernel":
+        # The megakernel IS a Mosaic program regardless of the use_pallas
+        # knob: keep the fault-injection scoping (_fi_backend ->
+        # "pallas" for _inject_batch_faults and the executor hooks)
+        # consistent with pir_query_batch_chunked's.
+        use_pallas = True
     pipe = _pl.resolve(pipeline)
 
     prepared: Optional[PreparedKeyBatch] = None
@@ -762,7 +819,49 @@ def full_domain_fold_chunks(
 
     fuse_last_hash = _env_bool("DPF_TPU_FUSE_LAST_HASH", default=False)
 
+    mk_plan = None
+    mk_interpret = False
+    if mode == "megakernel":
+        if bits % 32:
+            raise NotImplementedError(
+                f"megakernel value correction handles 32-bit-multiple "
+                f"widths (Int/XorWrapper 32/64/128), got {bits}-bit values; "
+                "use mode='fold'"
+            )
+        hl = prepared.host_levels if prepared is not None else host_levels
+        mk_plan = plan_megakernel(dpf, hierarchy_level, host_levels=hl)
+        # Off-TPU the Mosaic kernel runs through the Pallas interpreter —
+        # bit-exact (tests/test_megakernel.py), minus the performance.
+        mk_interpret = jax.default_backend() != "tpu"
+        if db_dev is not None:
+            expect = (
+                keep * (bits // 32) * 32,
+                mk_plan.num_slabs * mk_plan.final_words,
+            )
+            if tuple(db_dev.shape) != expect:
+                raise InvalidArgumentError(
+                    f"mode='megakernel' needs the streaming DB row layout "
+                    f"{expect} (prepare_pir_database(order='megakernel') / "
+                    f"megakernel_db_rows), got {tuple(db_dev.shape)}"
+                )
+
     def _dispatch(ch: _PreparedChunk):
+        if mk_plan is not None:
+            return ch.valid, _megakernel_fold_chunk_jit(
+                ch.seeds,
+                ch.control_mask,
+                ch.cw,
+                ch.ccl,
+                ch.ccr,
+                ch.corr,
+                db_dev,
+                plan=mk_plan,
+                bits=bits,
+                party=party,
+                xor_group=xor_group,
+                keep=keep,
+                interpret=mk_interpret,
+            )
         return ch.valid, _fused_fold_chunk_jit(
             ch.seeds,
             ch.control_mask,
@@ -1449,6 +1548,247 @@ def plan_slabs(
     leaves_per_lane = 1 << (stop_level - h)
     slab = max(32, (budget_leaves // leaves_per_lane) // 32 * 32)
     return h, slab
+
+
+# ---------------------------------------------------------------------------
+# Megakernel strategy: VMEM-resident tree slabs with in-kernel consumers
+# ---------------------------------------------------------------------------
+
+
+class MegakernelPlan(NamedTuple):
+    """Static shape plan for the slab megakernel (aes_pallas.
+    megakernel_fold_pallas_batched): hashable, used as a jit static arg.
+    All widths are in packed 32-lane words; every field is a power of two.
+
+      entry_words  width of the level-host_levels seed tile (2^(h-5))
+      levels_a     in-kernel levels from entry to the mid state
+      mid_words    mid-state width, parked in VMEM scratch (= entry <<
+                   levels_a = num_slabs * slab_words)
+      num_slabs    domain slabs per key (the second grid axis)
+      slab_words   slab slice width at the mid level
+      levels_b     in-kernel levels from slab slice to leaves
+      final_words  leaf-level slab width (slab_words << levels_b)
+      fold_words   width the in-kernel fold reduces to (<= 128), i.e. the
+                   per-key output is [lpe, fold_words] regardless of domain
+    """
+
+    host_levels: int
+    levels_a: int
+    levels_b: int
+    entry_words: int
+    mid_words: int
+    slab_words: int
+    final_words: int
+    fold_words: int
+    num_slabs: int
+
+
+def _floor_pow2(x: int) -> int:
+    return 1 << max(0, int(x).bit_length() - 1)
+
+
+def _fold_mode_default() -> str:
+    """Resolves the fold-strategy default: "megakernel" when
+    DPF_TPU_MEGAKERNEL is truthy, else the shipped "fold" shape — the A/B
+    knob bench.py / tools/tpu_measure.sh flip without code changes."""
+    return "megakernel" if _env_bool("DPF_TPU_MEGAKERNEL", default=False) else "fold"
+
+
+def plan_megakernel(
+    dpf: DistributedPointFunction,
+    hierarchy_level: int = -1,
+    host_levels: Optional[int] = None,
+    vmem_budget: Optional[int] = None,
+) -> MegakernelPlan:
+    """Sizes the megakernel's slab geometry from a VMEM budget, analogous
+    to `plan_slabs` sizing HBM output slabs.
+
+    The budget (DPF_TPU_MEGAKERNEL_VMEM env, default 8 MB of the v5e's
+    ~16 MB/core) splits between the leaf-level working slab (128 plane
+    rows x final_words x 4 B, plus AES temporaries — slack 4x) and the
+    mid-state scratch (129 rows x mid_words x 4 B). The kernel's OUTPUT is
+    [K, lpe, fold_words <= 128] no matter what this chooses: unlike
+    `plan_slabs`, there is no output-size wall to plan around — the
+    >= 16M-leaf materialization threshold is structurally unreachable
+    (pinned by tests/test_megakernel.py)."""
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    stop = v.hierarchy_to_tree[hierarchy_level]
+    if host_levels is None:
+        host_levels = 5
+    if host_levels < 5:
+        raise InvalidArgumentError(
+            f"megakernel requires host_levels >= 5 (one packed word), got "
+            f"{host_levels}"
+        )
+    if stop < host_levels + 1:
+        raise InvalidArgumentError(
+            f"megakernel needs at least one device level (tree depth {stop} "
+            f"<= host_levels {host_levels}); use mode='fold' for tiny domains"
+        )
+    if vmem_budget is None:
+        vmem_budget = int(
+            os.environ.get("DPF_TPU_MEGAKERNEL_VMEM", str(8 << 20))
+        )
+    # Leaf-level slab: 128 rows x w_f x 4 B, ~4x live temporaries in the
+    # traced AES circuit; mid scratch: 129 rows x w_v x 4 B.
+    w_f_max = _floor_pow2(max(1, (vmem_budget // 2) // (128 * 4 * 4)))
+    w_v_max = _floor_pow2(max(1, (vmem_budget // 4) // (129 * 4)))
+    entry_words = 1 << (host_levels - 5)
+    total_words = 1 << (stop - 5)
+    final_words = min(total_words, w_f_max)
+    num_slabs = total_words // final_words
+    if num_slabs > (1 << 20):
+        raise InvalidArgumentError(
+            f"megakernel plan would need {num_slabs} slabs at tree depth "
+            f"{stop}; raise DPF_TPU_MEGAKERNEL_VMEM or use mode='fold'"
+        )
+    slab_words = min(final_words, max(1, _floor_pow2(w_v_max // num_slabs)))
+    if num_slabs * slab_words < entry_words:
+        slab_words = entry_words // num_slabs if num_slabs <= entry_words else 1
+    mid_words = num_slabs * slab_words
+    levels_a = (mid_words // entry_words).bit_length() - 1
+    levels_b = (final_words // slab_words).bit_length() - 1
+    assert levels_a + levels_b == stop - host_levels, (
+        levels_a, levels_b, stop, host_levels,
+    )
+    return MegakernelPlan(
+        host_levels=host_levels,
+        levels_a=levels_a,
+        levels_b=levels_b,
+        entry_words=entry_words,
+        mid_words=mid_words,
+        slab_words=slab_words,
+        final_words=final_words,
+        fold_words=min(128, final_words),
+        num_slabs=num_slabs,
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _megakernel_block_leaves(plan: MegakernelPlan) -> np.ndarray:
+    """int64[total_blocks]: tree-leaf index of the megakernel's output
+    block at global position g = slab * final_words * 32 + local_lane —
+    the host replay of the kernel's two block-concat recursions (phase A
+    over the whole row, phase B within each slab slice). Element e of
+    block g is domain index leaves[g] * keep + e."""
+    prefix = np.arange(plan.entry_words * 32, dtype=np.int64)
+    for _ in range(plan.levels_a):
+        prefix = np.concatenate([2 * prefix, 2 * prefix + 1])
+    swl = plan.slab_words * 32
+    fwl = plan.final_words * 32
+    out = np.empty(plan.num_slabs * fwl, dtype=np.int64)
+    for j in range(plan.num_slabs):
+        base = prefix[j * swl : (j + 1) * swl]
+        for _ in range(plan.levels_b):
+            base = np.concatenate([2 * base, 2 * base + 1])
+        out[j * fwl : (j + 1) * fwl] = base
+    return out
+
+
+def megakernel_order_map(
+    dpf: DistributedPointFunction,
+    hierarchy_level: int = -1,
+    host_levels: Optional[int] = None,
+    plan: Optional[MegakernelPlan] = None,
+) -> np.ndarray:
+    """int64[domain]: domain index of each megakernel output position
+    (position g * keep + e is the value at domain index map[g*keep+e]) —
+    the megakernel analog of `lane_order_map`, exact (no -1 padding: the
+    kernel's lane set is the domain). The XOR fold itself is
+    order-invariant; this map exists for the PIR database permutation
+    (parallel/sharded.prepare_pir_database(order="megakernel"))."""
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    if plan is None:
+        plan = plan_megakernel(dpf, hierarchy_level, host_levels)
+    stop = v.hierarchy_to_tree[hierarchy_level]
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep = 1 << (lds - stop)
+    leaves = _megakernel_block_leaves(plan)
+    return (leaves[:, None] * keep + np.arange(keep, dtype=np.int64)).reshape(-1)
+
+
+def megakernel_db_rows(
+    dpf: DistributedPointFunction,
+    db_limbs: np.ndarray,  # uint32[domain, lpe]
+    plan: MegakernelPlan,
+    hierarchy_level: int = -1,
+) -> np.ndarray:
+    """Permutes a natural-order PIR database into the megakernel's
+    streaming row layout uint32[keep*lpe*32, total_words]: row
+    (e*lpe + l)*32 + i at word w holds limb l of the database value for
+    element e of the block the kernel computes at lane 32w+i — exactly
+    what the kernel ANDs against after its in-register unpack. The slab-j
+    tile is columns [j*final_words, (j+1)*final_words): contiguous, so the
+    BlockSpec index map streams it with double-buffered DMA."""
+    v = dpf.validator
+    if hierarchy_level < 0:
+        hierarchy_level = v.num_hierarchy_levels - 1
+    stop = v.hierarchy_to_tree[hierarchy_level]
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep = 1 << (lds - stop)
+    db_limbs = np.asarray(db_limbs)
+    lpe = db_limbs.shape[1]
+    leaves = _megakernel_block_leaves(plan)
+    blocks = leaves.reshape(-1, 32)  # [W_total, 32]
+    out = np.empty((keep * lpe * 32, blocks.shape[0]), dtype=np.uint32)
+    for e in range(keep):
+        rows = blocks * keep + e  # [W_total, 32] domain indices
+        for l in range(lpe):
+            out[(e * lpe + l) * 32 : (e * lpe + l + 1) * 32, :] = db_limbs[
+                rows, l
+            ].T
+    return out
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("plan", "bits", "party", "xor_group", "keep", "interpret"),
+)
+def _megakernel_fold_chunk_jit(
+    seeds,  # uint32[K, M, 4]
+    control_mask,  # uint32[K, M//32]
+    cw_planes,  # uint32[K, L, 128]
+    ccl,  # uint32[K, L]
+    ccr,  # uint32[K, L]
+    corrections,  # uint32[K, epb, lpe]
+    db_rows,  # uint32[keep*lpe*32, total_words] or None
+    plan: MegakernelPlan,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    interpret: bool = False,
+):
+    """ONE program per chunk, megakernel edition: pack + the slab
+    megakernel (every device level, value hash, correction and the
+    fold/PIR accumulate all inside one pallas_call, leaves never in HBM) +
+    a trivial cross-word XOR of the [K, lpe, fold_w] partials. The
+    program's output is [K, lpe] — there is no domain-sized buffer
+    anywhere, internal or output, so neither the ~117 MB output-miscompute
+    threshold nor the RESOURCE_EXHAUSTED cliff can bind at any domain."""
+    from . import aes_pallas
+
+    planes, control = _pack_batch_jit(seeds, control_mask)
+    folds = aes_pallas.megakernel_fold_pallas_batched(
+        planes,
+        control,
+        cw_planes,
+        ccl,
+        ccr,
+        corrections,
+        db_rows,
+        plan=plan,
+        bits=bits,
+        party=party,
+        xor_group=xor_group,
+        keep=keep,
+        interpret=interpret,
+    )
+    return jnp.bitwise_xor.reduce(folds, axis=2)
 
 
 def full_domain_evaluate(

@@ -710,6 +710,95 @@ def correct_values(
 
 
 # ---------------------------------------------------------------------------
+# In-kernel (Mosaic row) correction — the megakernel's value codec
+# ---------------------------------------------------------------------------
+
+
+def rows_correct_element(
+    limbs, ctrl_mask, corr, bits: int, party: int, xor_group: bool
+):
+    """Value correction for ONE element of a hashed block, in Mosaic row
+    form: every operand is a uint32 vector row (or a scalar broadcast), so
+    the whole computation stays elementwise inside a Pallas kernel — the
+    in-kernel twin of `_correct_values`/`correct_values` for the direct
+    power-of-two codecs (Int(64)/Int(32)/u128 and their Xor wrappers; the
+    multi-limb carry chain mirrors `limb_add_pow2`/`limb_neg_pow2`).
+
+    Args:
+      limbs: list of bits//32 uint32 rows — the element's hash limbs, one
+        vector per limb (lane = one evaluation).
+      ctrl_mask: uint32 row, 0 / ~0 per lane (1 = apply correction).
+      corr: list of bits//32 uint32 scalars — this key's correction limbs.
+      bits: element width; must be a multiple of 32 (sub-word codecs keep
+        to the XLA paths).
+      party: 0 or 1 (party 1 negates additive groups).
+      xor_group: XOR group (XorWrapper) vs additive (Int).
+    Returns the corrected limb rows (list of bits//32 uint32 rows).
+    """
+    if bits % 32:
+        raise NotImplementedError(
+            f"rows_correct_element handles 32-bit-multiple widths, got {bits}"
+        )
+    lpe = bits // 32
+    gated = [corr[l] & ctrl_mask for l in range(lpe)]
+    if xor_group:
+        return [limbs[l] ^ gated[l] for l in range(lpe)]
+    out = []
+    carry = None
+    for l in range(lpe):
+        s = limbs[l] + gated[l]
+        c1 = (s < limbs[l]).astype(_U32)
+        if carry is None:
+            carry = c1
+        else:
+            s2 = s + carry
+            c2 = (s2 < s).astype(_U32)
+            s, carry = s2, c1 | c2
+        out.append(s)
+    if party == 1:
+        neg = []
+        carry = _U32(1)  # ~a + 1
+        for l in range(lpe):
+            s = (~out[l]) + carry
+            carry = jnp.where((s == 0) & (carry == 1), _U32(1), _U32(0))
+            neg.append(s)
+        out = neg
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tile-padding accounting (host-side)
+# ---------------------------------------------------------------------------
+
+
+def tile_padded_bytes(
+    shape, itemsize: int = 4, sublane: int = 8, lane: int = 128
+) -> int:
+    """(sublane, lane)-tile-padded byte size of an array shape — the
+    host-side accounting behind the PERF.md IntModN-finalize open item
+    (small trailing dims vs 8x128 tiles). TPU tiles the LAST TWO dims to
+    (8, 128); every leading dim multiplies whole tiles. Used by the layout
+    tests to pin that folding `lpe` into the lane dimension actually
+    shrinks the padded footprint (the device's real layout choice is
+    XLA's, but the logical trailing dims are what it tiles)."""
+    shape = tuple(int(s) for s in shape)
+    if not shape:
+        return itemsize
+    if len(shape) == 1:
+        shape = (1,) + shape
+    lead = 1
+    for s in shape[:-2]:
+        lead *= s
+    s, l = shape[-2], shape[-1]
+    return (
+        lead
+        * (-(-s // sublane) * sublane)
+        * (-(-l // lane) * lane)
+        * itemsize
+    )
+
+
+# ---------------------------------------------------------------------------
 # Host-side views
 # ---------------------------------------------------------------------------
 

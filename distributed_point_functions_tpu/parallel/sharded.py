@@ -503,25 +503,29 @@ def _pir_fold_slab(values, db, off):
 class PreparedPirDatabase:
     """Device-resident PIR database (prepare_pir_database), in the row
     order of the evaluation mode that will consume it: "lane" (expansion
-    lane order, for the per-level mode's gather-free fold) or "natural"
-    (domain order, for walk mode whose lane i IS leaf i).
+    lane order, for the per-level mode's gather-free fold), "natural"
+    (domain order, for walk mode whose lane i IS leaf i), or "megakernel"
+    (the streaming row layout the slab megakernel's in-kernel inner
+    product ANDs against — evaluator.megakernel_db_rows).
 
     A distinct type on purpose: for epb=1 value types the lane-ordered
     array has exactly `domain` rows, so a bare device array would pass
     `pir_query_batch`'s shape check and silently produce XOR inner
     products against a permuted DB."""
 
-    __slots__ = ("lane_db", "order", "host_levels", "_nat_host")
+    __slots__ = ("lane_db", "order", "host_levels", "plan", "_nat_host")
 
-    def __init__(self, lane_db, order: str = "lane", host_levels=None):
+    def __init__(self, lane_db, order: str = "lane", host_levels=None,
+                 plan=None):
         self.lane_db = lane_db
         self.order = order
         self.host_levels = host_levels  # the lane permutation's parameter
+        self.plan = plan  # megakernel order: the MegakernelPlan it encodes
         self._nat_host = None
 
     def natural_host(self, dpf) -> np.ndarray:
         """Natural-order host copy for sentinel verification: one device
-        pull (plus, for lane order, the inverse of the prepare-time
+        pull (plus, for permuted orders, the inverse of the prepare-time
         permutation), computed on first use and cached — the DB is
         immutable, so serving loops pay this once, not per query batch
         (the host link runs at megabytes/s through this image's tunnel,
@@ -532,6 +536,25 @@ class PreparedPirDatabase:
             lane_host = np.asarray(self.lane_db)
             if self.order == "natural":
                 self._nat_host = lane_host
+            elif self.order == "megakernel":
+                # Invert megakernel_db_rows: row (e*lpe + l)*32 + i at
+                # word w holds limb l of element e of the block at global
+                # lane 32w+i, whose domain row is leaves[g]*keep + e.
+                v = dpf.validator
+                stop = v.hierarchy_to_tree[-1]
+                lds = v.parameters[-1].log_domain_size
+                keep = 1 << (lds - stop)
+                lpe = lane_host.shape[0] // (keep * 32)
+                leaves = ev._megakernel_block_leaves(self.plan)
+                blocks = leaves.reshape(-1, 32)  # [W_total, 32]
+                nat = np.zeros(((1 << lds), lpe), np.uint32)
+                for e in range(keep):
+                    rows = blocks * keep + e
+                    for l in range(lpe):
+                        nat[rows, l] = lane_host[
+                            (e * lpe + l) * 32 : (e * lpe + l + 1) * 32, :
+                        ].T
+                self._nat_host = nat
             else:
                 # Invert the one-time permutation to recover the
                 # natural-order rows the oracle fold masks against (padded
@@ -555,9 +578,13 @@ def prepare_pir_database(
     order="lane" (default) permutes into the per-level expansion's lane
     order so the fold needs no gather; order="natural" uploads domain order
     as-is (walk-mode output is domain-trimmed) for `pir_query_batch_chunked`
-    mode="walk". A PIR server's DB is static: re-uploading it per query
-    batch would put the host link (megabytes/s through this image's tunnel)
-    on the query path — prepare at setup, query forever after."""
+    mode="walk"; order="megakernel" builds the streaming row layout the
+    slab megakernel's in-kernel inner product consumes (one contiguous
+    [keep*lpe*32, final_words] tile per domain slab, DMA'd into VMEM per
+    grid step — evaluator.megakernel_db_rows). A PIR server's DB is
+    static: re-uploading it per query batch would put the host link
+    (megabytes/s through this image's tunnel) on the query path — prepare
+    at setup, query forever after."""
     from ..ops import evaluator as ev
 
     v = dpf.validator
@@ -573,9 +600,16 @@ def prepare_pir_database(
         # Walk-mode output is already trimmed to the domain, so the natural
         # DB uploads as-is.
         return PreparedPirDatabase(jnp.asarray(db_limbs), order="natural")
+    if order == "megakernel":
+        plan = ev.plan_megakernel(dpf, hierarchy_level, host_levels)
+        rows = ev.megakernel_db_rows(dpf, db_limbs, plan, hierarchy_level)
+        return PreparedPirDatabase(
+            jnp.asarray(rows), order="megakernel",
+            host_levels=plan.host_levels, plan=plan,
+        )
     if order != "lane":
         raise errors.InvalidArgumentError(
-            f"order must be 'lane' or 'natural', got {order!r}"
+            f"order must be 'lane', 'natural' or 'megakernel', got {order!r}"
         )
     m = ev.lane_order_map(dpf, hierarchy_level, host_levels)
     db_lane = np.zeros((m.shape[0], db_limbs.shape[1]), dtype=np.uint32)
@@ -618,8 +652,14 @@ def pir_query_batch_chunked(
     computes correctly (this image's tunnel corrupts >= ~128 MB programs,
     PERF.md) — each leaf-contiguous piece folds against the matching
     NATURAL-order DB rows and pieces XOR into the running answer. This is
-    the only correct single-chip mode at 2^24+ domains on the tunnel. For
-    multi-chip domain sharding use `pir_query_batch`.
+    the only correct single-chip mode at 2^24+ domains on the tunnel.
+    mode="megakernel": the slab megakernel (evaluator.
+    full_domain_fold_chunks mode="megakernel") — the inner product runs
+    INSIDE the expansion kernel against database tiles streamed from HBM
+    with double-buffered DMA, so the DB is read once per key per batch and
+    the expansion itself never touches HBM at all; takes the "megakernel"-
+    order PreparedPirDatabase. For multi-chip domain sharding use
+    `pir_query_batch`.
 
     `db_limbs` may be a host uint32[D, lpe] array (permuted + uploaded on
     every call — fine for tests, wrong for serving) or the
@@ -645,8 +685,12 @@ def pir_query_batch_chunked(
     from ..ops import pipeline as _pl
 
     # The chunk evaluators resolve use_pallas=None to the platform default;
-    # the fault-injection level of this call follows that resolution.
-    fi_backend = ev._fi_backend(ev._pallas_default())
+    # the fault-injection level of this call follows that resolution (the
+    # megakernel is a Mosaic program regardless of the use_pallas knob).
+    fi_backend = (
+        "pallas" if mode == "megakernel"
+        else ev._fi_backend(ev._pallas_default())
+    )
     keys, probe = _pir_probe(
         dpf, keys, integrity, "pir_query_batch_chunked", fi_backend
     )
@@ -658,12 +702,30 @@ def pir_query_batch_chunked(
         # tunnel's large-output miscompute at ANY domain size — the fastest
         # AND always-correct single-chip mode (PERF.md "fold-in-program").
         want_order = "lane"
+    if mode == "megakernel":
+        # In-KERNEL inner product: the megakernel streams DB tiles from
+        # HBM into VMEM per slab and accumulates there (ISSUE 3).
+        want_order = "megakernel"
     if isinstance(db_limbs, PreparedPirDatabase):
         if db_limbs.order != want_order:
             raise errors.InvalidArgumentError(
                 f"mode={mode!r} needs a {want_order!r}-order "
                 f"PreparedPirDatabase, got {db_limbs.order!r}"
             )
+        if mode == "megakernel":
+            # The row layout encodes one slab plan; a budget/host_levels
+            # change between prepare and query would silently AND against
+            # mis-ordered tiles.
+            current = ev.plan_megakernel(
+                dpf, -1, host_levels or db_limbs.plan.host_levels
+            )
+            if current != db_limbs.plan:
+                raise errors.InvalidArgumentError(
+                    "megakernel plan changed since the database was "
+                    f"prepared ({db_limbs.plan} -> {current}); re-run "
+                    "prepare_pir_database(order='megakernel')"
+                )
+            host_levels = db_limbs.plan.host_levels
         db_dev = db_limbs.lane_db
     elif isinstance(db_limbs, jax.Array):
         raise errors.InvalidArgumentError(
@@ -686,12 +748,12 @@ def pir_query_batch_chunked(
         n_valid, fold = item
         return np.asarray(fold)[:n_valid]
 
-    if mode == "fold":
+    if mode in ("fold", "megakernel"):
         rows = list(
             _pl.consume(
                 ev.full_domain_fold_chunks(
                     dpf, keys, key_chunk=key_chunk, host_levels=host_levels,
-                    db_lane=db_dev, pipeline=pipeline,
+                    db_lane=db_dev, pipeline=pipeline, mode=mode,
                 ),
                 _pull,
                 pipe,

@@ -629,8 +629,11 @@ def run_device_check(
     this function so the CLI and the library cannot drift.
 
     mode is the execution strategy under test: "levels", "fused", "walk"
-    (full_domain_evaluate_chunks) or "fold" (full_domain_fold_chunks) —
-    the program shapes fail independently on a broken backend.
+    (full_domain_evaluate_chunks), "fold" or "megakernel"
+    (full_domain_fold_chunks — "megakernel" is the slab Mosaic kernel,
+    CHECK_MODE=megakernel from tools/check_device.py; off-TPU it runs the
+    Pallas interpreter, which is only CI-practical at toy shapes) — the
+    program shapes fail independently on a broken backend.
 
     `pipeline` (None = DPF_TPU_PIPELINE env / platform default) drives the
     chunk generators through the pipelined executor (ops/pipeline.py) —
@@ -662,10 +665,10 @@ def run_device_check(
         host = full_domain_evaluate_host(dpf, keys)
         want = np.bitwise_xor.reduce(host, axis=1)
         folds = []
-        if mode == "fold":
+        if mode in ("fold", "megakernel"):
             gen = evaluator.full_domain_fold_chunks(
                 dpf, keys, key_chunk=num_keys, use_pallas=use_pallas,
-                pipeline=pipeline,
+                pipeline=pipeline, mode=mode,
             )
             for valid, fold in gen:
                 folds.append(np.asarray(fold)[:valid])

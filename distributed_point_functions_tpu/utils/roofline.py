@@ -40,6 +40,12 @@ import numpy as np
 # v5e VPU: (8 sublanes, 128 lanes) x 4 ALUs x ~940 MHz. 32-bit ops.
 V5E_VPU_OPS_PER_SEC = 8 * 128 * 4 * 0.94e9
 
+# v5e HBM2: 16 GB at ~819 GB/s per chip (public "How to Scale Your Model"
+# hardware chapter) — the second wall next to the VPU one. A bitsliced
+# cipher is compute-dense, so which wall binds depends on how much plane /
+# value state a strategy round-trips through HBM per evaluation.
+V5E_HBM_BYTES_PER_SEC = 819e9
+
 # Primitives counted as one u32 element op per output element. Everything
 # else in the traced circuit is data movement (reshape/transpose/
 # concatenate/slice/broadcast), which XLA largely folds into the compute
@@ -141,6 +147,80 @@ def mfu_fields(evals_per_sec: float, log_domain: int) -> dict:
     }
 
 
+def hbm_bytes_per_eval(
+    log_domain: int,
+    strategy: str = "fold",
+    lpe: int = 2,
+    keep: int = 2,
+    pir: bool = False,
+) -> float:
+    """Modeled HBM bytes moved per domain evaluation, by strategy.
+
+    A traffic MODEL (counted from the data each strategy provably
+    round-trips), not a measurement — labeled as such everywhere it is
+    reported. Per leaf, a doubling expansion creates ~2/keep tree nodes
+    (16 B of packed seed planes each); the strategies differ in how many
+    of those cross HBM:
+
+    * "levels"/"fused"/"fold": every level's child planes are written to
+      HBM and read back by the next level (or the value hash) — XLA does
+      not keep a full level's planes in VMEM at serving widths. That is
+      2 passes x 16 B x 2/keep nodes, plus the hashed planes' write+read
+      (2 x 16/keep), plus the value buffer's write+read (2 x 4*lpe; in
+      "fold" it sits behind the optimization_barrier, in "fused"/"levels"
+      it is the program output).
+    * "megakernel": the expansion never leaves VMEM — per-eval traffic is
+      the level-h entry seeds amortized over 2^(log_domain - h) leaves
+      (~0) plus the output fold (~0); with `pir`, one streaming read of
+      the database row (4*lpe B).
+    """
+    if strategy not in ("levels", "fused", "fold", "megakernel"):
+        raise ValueError(
+            f"no HBM traffic model for strategy {strategy!r} (modeled: "
+            "levels/fused/fold/megakernel)"
+        )
+    if strategy == "megakernel":
+        entry = 16.0 * 32 / (1 << log_domain)  # level-5 seeds, amortized
+        return entry + (4.0 * lpe if pir else 0.0)
+    nodes_per_eval = 2.0 / keep
+    planes = 2 * 16.0 * nodes_per_eval  # per-level child write + read
+    hashed = 2 * 16.0 / keep  # value-hash planes write + read
+    values = 2 * 4.0 * lpe  # value buffer write + consumer read
+    db = 4.0 * lpe if pir else 0.0
+    return planes + hashed + values + db
+
+
+def hbm_fields(
+    evals_per_sec: float,
+    log_domain: int,
+    strategy: str = "fold",
+    lpe: int = 2,
+    keep: int = 2,
+    pir: bool = False,
+) -> dict:
+    """HBM-bandwidth roofline fields for a measured record, next to the
+    VPU ones (`mfu_fields`): which wall — VPU arithmetic or HBM traffic —
+    the record sits against, per the traffic model above."""
+    bpe = hbm_bytes_per_eval(log_domain, strategy, lpe, keep, pir)
+    vpu = mfu_fields(evals_per_sec, log_domain)
+    vpu_ceiling = vpu["roofline_ceiling_evals_per_sec"]
+    if bpe <= 0:
+        hbm_ceiling = float("inf")
+    else:
+        hbm_ceiling = V5E_HBM_BYTES_PER_SEC / bpe
+    binding = "hbm" if hbm_ceiling < vpu_ceiling else "vpu"
+    out = {
+        "hbm_bytes_per_eval_model": round(bpe, 2),
+        "hbm_bw_utilization_model": (
+            round(evals_per_sec * bpe / V5E_HBM_BYTES_PER_SEC, 4)
+        ),
+        "binding_wall": binding,
+    }
+    if hbm_ceiling != float("inf"):
+        out["hbm_ceiling_evals_per_sec"] = round(hbm_ceiling)
+    return out
+
+
 def _native_anchor() -> str:
     """Sanity anchor: the same arithmetic for the AES-NI/VAES host engine.
 
@@ -184,6 +264,22 @@ def main(argv) -> int:
         print(f"{name:38s} {rate:12.3e} {mfu:8.2%}")
     print(f"\nroofline ceiling at 100% VPU: {rows[0][3]:.3e} evals/s")
     print(_native_anchor())
+    print("\n# HBM-bandwidth roofline (traffic model, v5e ~819 GB/s)")
+    print(
+        f"{'strategy':14s} {'B/eval':>8s} {'HBM ceiling ev/s':>18s} "
+        f"{'binding wall':>13s}"
+    )
+    vpu_ceiling = mfu_fields(1.0, 20)["roofline_ceiling_evals_per_sec"]
+    for strat, pir in (
+        ("levels", False), ("fused", False), ("fold", False), ("fold", True),
+        ("megakernel", False), ("megakernel", True),
+    ):
+        bpe = hbm_bytes_per_eval(20, strat, pir=pir)
+        ceil = V5E_HBM_BYTES_PER_SEC / bpe if bpe else float("inf")
+        name = strat + ("+pir" if pir else "")
+        binding = "hbm" if ceil < vpu_ceiling else "vpu"
+        ceil_s = f"{ceil:18.3e}" if ceil != float("inf") else f"{'—':>18s}"
+        print(f"{name:14s} {bpe:8.2f} {ceil_s} {binding:>13s}")
     return 0
 
 

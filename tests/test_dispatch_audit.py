@@ -200,6 +200,45 @@ def test_pipelined_chunked_paths_program_budget(program_counter):
         )
 
 
+def test_megakernel_program_budget(program_counter, monkeypatch):
+    """ISSUE 3: mode='megakernel' is EXACTLY one device program per chunk
+    — pack + the slab pallas_call + the fold-width reduction are one jit —
+    with the pipelined executor on AND off (overlap must never add
+    programs). The cheap `_aes_rows` stand-in keeps the kernel's XLA-CPU
+    compile tractable (the real row circuit is hardware-only, PERF.md);
+    the program COUNT is circuit-independent."""
+    import jax
+
+    from distributed_point_functions_tpu.ops import aes_pallas
+    from test_aes_pallas import _CheapRows
+
+    jax.clear_caches()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9, 100, 201], [[1, 2, 3, 4]])
+
+    def run(pipe):
+        return list(
+            evaluator.full_domain_fold_chunks(
+                dpf, keys, key_chunk=2, mode="megakernel", pipeline=pipe
+            )
+        )
+
+    try:
+        for pipe in (False, True):
+            run(pipe)  # warm: compiles allowed
+            program_counter["programs"] = 0
+            run(pipe)
+            got = program_counter["programs"]
+            assert got == 2, (
+                f"mode='megakernel'[pipeline={pipe}]: {got} device programs "
+                "for 2 chunks (pinned at EXACTLY 1 per chunk — the whole "
+                "point of the megakernel is one fused program per chunk)"
+            )
+    finally:
+        jax.clear_caches()  # drop cheap-circuit traces
+
+
 @pytest.mark.slow
 def test_pipelined_dcf_and_pir_program_budget(program_counter):
     """Slow-tier half of the ISSUE 2 pipelined budgets: DCF batch walk and

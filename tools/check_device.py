@@ -94,11 +94,15 @@ def _main() -> int:
     ]
     # Execution strategy under test: "levels" (per-level dispatch, the
     # default), "fused" (single program per chunk), "walk" (leaf-path
-    # walk) or "fold" (in-program consumer) — the program shapes fail
-    # independently on a broken backend (PERF.md). This tool measures the
-    # RAW platform: auto-slabbing would hide exactly the over-threshold
-    # programs being probed, so it is force-disabled regardless of the
-    # caller's environment.
+    # walk), "fold" (in-program consumer) or "megakernel" (the slab
+    # Mosaic kernel with the fold accumulated in-kernel, ISSUE 3 —
+    # CHECK_MODE=megakernel is the hardware gate for the whole megakernel
+    # family, since interpret mode cannot execute the real row circuit in
+    # CI time) — the program shapes fail independently on a broken
+    # backend (PERF.md). This tool measures the RAW platform:
+    # auto-slabbing would hide exactly the over-threshold programs being
+    # probed, so it is force-disabled regardless of the caller's
+    # environment.
     os.environ["DPF_TPU_MAX_PROGRAM_BYTES"] = "0"
     mode = os.environ.get("CHECK_MODE", "levels")
     # The differential loop itself lives in the library

@@ -22,6 +22,13 @@ Special env overrides handled HERE (not passed to the bench):
                       lets A/B variants (e.g. the fused last-hash headline)
                       land in their own results.json slot instead of
                       clobbering the primary record.
+    SUPERSEDES=name   when THIS record is a verified device measurement
+                      whose value beats the stored record `name` (same
+                      platform), the stored record is marked
+                      superseded (not deleted: "superseded": true + a
+                      caveat naming the winner) — how a verified
+                      megakernel headline retires the fold-mode record it
+                      beats while keeping the provenance trail (ISSUE 3).
 """
 
 import json
@@ -41,6 +48,60 @@ sys.path.insert(0, os.path.join(ROOT, "benchmarks"))
 import run_all  # noqa: E402  (benchmarks/run_all.py — the merge)
 
 
+def _maybe_supersede(rec, target_bench, results_path):
+    """SUPERSEDES handling: when `rec` is a *verified device* record whose
+    value beats the stored record `target_bench` on the same platform,
+    mark the beaten record superseded IN PLACE (never delete — the
+    provenance trail is the point). No-op when the new record is
+    unverified, CPU, errored, or slower."""
+    platform = rec.get("platform") or ""
+    cfg = rec.get("config") or {}
+    verified = (
+        bool(rec.get("verified"))
+        or "verified_keys" in rec
+        or "verified_keys" in cfg
+    )
+    if (
+        "error" in rec
+        or rec.get("smoke")
+        or not platform
+        or platform.startswith("cpu")
+        or not verified
+    ):
+        return
+    try:
+        with open(results_path) as f:
+            stored = json.load(f)
+    except Exception:
+        return
+    changed = False
+    for e in stored:
+        if not isinstance(e, dict) or e.get("bench") != target_bench:
+            continue
+        if e.get("platform") != platform or e.get("superseded"):
+            continue
+        try:
+            if float(rec.get("value", 0)) <= float(e.get("value", 0)):
+                continue
+        except (TypeError, ValueError):
+            continue
+        e["superseded"] = True
+        e["caveat"] = (
+            (e.get("caveat", "") + "; " if e.get("caveat") else "")
+            + f"superseded by the verified {rec.get('bench')} record of "
+            f"{rec.get('date')} ({rec.get('value')} {rec.get('unit', '')})"
+        )
+        changed = True
+        print(
+            f"# superseded stored record {target_bench}@{platform} "
+            f"({e.get('value')}) by {rec.get('bench')} ({rec.get('value')})",
+            file=sys.stderr,
+        )
+    if changed:
+        with open(results_path, "w") as f:
+            json.dump(stored, f, indent=2)  # match run_all.merge_records
+
+
 def main(argv):
     if not argv:
         print(__doc__, file=sys.stderr)
@@ -48,10 +109,13 @@ def main(argv):
     script = argv[0]
     env = dict(os.environ)
     suffix = ""
+    supersedes = ""
     for kv in argv[1:]:
         k, _, v = kv.partition("=")
         if k == "RECORD_SUFFIX":
             suffix = v
+        elif k == "SUPERSEDES":
+            supersedes = v
         else:
             env[k] = v
     print(f"# stage bench: {script} {argv[1:]}", file=sys.stderr, flush=True)
@@ -75,7 +139,10 @@ def main(argv):
     if suffix and rec.get("bench"):
         rec["bench"] = rec["bench"] + suffix
     rec.setdefault("date", time.strftime("%Y-%m-%d"))
-    run_all.merge_records([rec], os.path.join(BENCH_DIR, "results.json"))
+    results_path = os.path.join(BENCH_DIR, "results.json")
+    run_all.merge_records([rec], results_path)
+    if supersedes:
+        _maybe_supersede(rec, supersedes, results_path)
     print(json.dumps(rec), flush=True)
     platform = rec.get("platform") or ""
     device_ok = (

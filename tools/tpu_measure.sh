@@ -89,7 +89,23 @@ fi
 BENCH_HEADLINE_TIMEOUT=2400 \
   stage headline 2700 python tools/run_bench_stage.py bench_headline.py
 
-# 2b. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
+# 2b. Megakernel A/B records (ISSUE 3), ordered AFTER the primary
+# headline so they can never cost it: a fast in-kernel gate first (small
+# shapes, validates the Mosaic compile of the slab kernel + bit-exactness
+# on-chip), then the headline and PIR benches on the megakernel strategy
+# in their own results.json slots. SUPERSEDES marks the fold-mode record
+# superseded in place (never deleted) when the verified megakernel run
+# beats it.
+CHECK_MODE=megakernel CHECK_SHAPES=16x14,64x18 \
+  stage gate-megakernel 900 python tools/check_device.py
+BENCH_MODE=megakernel BENCH_HEADLINE_TIMEOUT=2400 \
+  stage headline_megakernel 2700 python tools/run_bench_stage.py bench_headline.py \
+  RECORD_SUFFIX=_megakernel SUPERSEDES=full_domain_headline
+BENCH_PIR_MODE=megakernel \
+  stage pir_megakernel 1800 python tools/run_bench_stage.py bench_pir.py \
+  RECORD_SUFFIX=_megakernel SUPERSEDES=pir
+
+# 2c. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
 # the pipelined chunk executor forced OFF land in their own results.json
 # slots, so the on/off pair is a first-class record pair (not just the
 # ratio field) for the scoreboard table.
@@ -147,7 +163,8 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
 
 # Sentinel: every resumable stage above is marked done -> the watcher can
 # stop re-firing sessions.
-required="headline headline-syncexec pir-syncexec evalat dcf hh-device \
+required="headline gate-megakernel headline_megakernel pir_megakernel \
+headline-syncexec pir-syncexec evalat dcf hh-device \
 extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
 typed-u8 typed-u32 typed-tuple typed-intmodn headline-fused-hash hh-group32 \
