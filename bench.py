@@ -484,12 +484,31 @@ def _run(
             # HBM-bandwidth roofline next to the VPU one (ISSUE 3): which
             # wall this record sits against, per the strategy's traffic
             # model (megakernel leaves ~nothing on HBM; the doubling
-            # strategies round-trip planes + values per level). Only the
-            # modeled strategies get the fields — "walk" has a different
-            # traffic shape the model does not cover.
+            # strategies round-trip planes + values per level). "walk"
+            # (every leaf lane walks its root-to-leaf path) uses the
+            # point-walk traffic model (ISSUE 4): per-level plane round
+            # trips at full width, one leaf capture.
             if MODE in ("levels", "fused", "fold", "megakernel"):
                 result.update(
                     hbm_fields(evals_per_sec, log_domain, strategy=MODE)
+                )
+            elif MODE == "walk":
+                from distributed_point_functions_tpu.utils.roofline import (
+                    walk_hbm_fields,
+                )
+
+                # The model is per WALK (lane): the full-domain walk runs
+                # hierarchy_to_tree[-1] tree levels and each lane yields
+                # keep elements (2 for Int(64)), so convert the
+                # element-eval rate to walks/s — same units as the
+                # evaluate_at/dcf walk records (bench_evaluate_at.py).
+                tree_levels = dpf.validator.hierarchy_to_tree[-1]
+                keep = 1 << (log_domain - tree_levels)
+                result.update(
+                    walk_hbm_fields(
+                        evals_per_sec / keep, tree_levels, "walk",
+                        captures=1,
+                    )
                 )
             _log(
                 f"roofline: mfu_estimate={result.get('mfu_estimate')} "

@@ -30,6 +30,13 @@ def bench(jax, smoke):
     # too small to amortize the device's walk program; the device engine
     # still wins for XOR groups/128-bit values and huge point batches.
     engine = os.environ.get("BENCH_DCF_ENGINE", "host")
+    # "walk" = the shipped per-level device walk; "walkkernel" = the
+    # single-program walk megakernel (ISSUE 4). walkkernel is a device
+    # strategy, so it forces engine=device (tools/tpu_measure.sh
+    # dcf_walkkernel stage records the A/B in its own results.json slot).
+    mode = os.environ.get("BENCH_DCF_MODE", "walk")
+    if mode == "walkkernel":
+        engine = "device"
 
     dcf = DistributedComparisonFunction.create(log_domain, Int(64))
     rng = np.random.default_rng(11)
@@ -44,11 +51,13 @@ def bench(jax, smoke):
 
     if engine == "host" and not native.available():
         engine = "device"
-    run = (
-        dcf_batch.batch_evaluate_host if engine == "host"
-        else dcf_batch.batch_evaluate
-    )
-    log(f"engine: {engine}")
+    if engine == "host":
+        run = dcf_batch.batch_evaluate_host
+    else:
+        import functools
+
+        run = functools.partial(dcf_batch.batch_evaluate, mode=mode)
+    log(f"engine: {engine} mode: {mode}")
     # Distinct point sets per rep + host-pulled outputs: on the device
     # engine, identical repeated programs time as ~0 through this image's
     # tunnel (server-side result caching, PERF.md); harmless on the host.
@@ -71,6 +80,31 @@ def bench(jax, smoke):
         out = np.asarray(run(dcf, keys, xs))  # full pull: shape check only
     assert out.shape[:2] == (num_keys, num_points)
     log(f"warmup (compile + run): {warm.elapsed:.1f}s")
+    # Host-oracle spot verification of THE warmed output (4 keys x 8
+    # points vs the reference-parity per-point path): the `verified` flag
+    # is what lets run_bench_stage.py SUPERSEDES retire a beaten record —
+    # an unverified walkkernel number must never supersede anything.
+    sample_k = list(range(0, num_keys, max(1, num_keys // 4)))[:4]
+    ok = True
+    for i in sample_k:
+        want = np.array(
+            [dcf.evaluate(keys[i], x) for x in xs[:8]], dtype=np.uint64
+        )
+        if engine == "host":
+            got = out[i, :8].astype(np.uint64)
+        else:
+            from distributed_point_functions_tpu.ops import evaluator
+
+            got = (
+                evaluator.values_to_numpy(out[i : i + 1, :8], 64)[0]
+                .astype(np.uint64)
+            )
+        if not np.array_equal(got, want):
+            ok = False
+    log(
+        f"host-oracle spot verification ({len(sample_k)} keys x 8 pts): "
+        f"{'OK' if ok else 'MISMATCH'}"
+    )
     if engine != "host":
         timed_pull(run(dcf, keys, xs))  # warm the fold program
     with Timer() as t:
@@ -97,19 +131,39 @@ def bench(jax, smoke):
             dev_fold(xs2)
         device_rate = round(num_keys * num_points / td.elapsed)
         log(f"device engine: {device_rate} comparisons/s")
+    walk_fields = {}
+    if engine == "device":
+        # Walk traffic model next to the measured rate (per-level walk vs
+        # the in-register walk megakernel). The DCF walk runs T tree
+        # levels (T = hierarchy_to_tree[-1], log_domain - 2 for Int(64):
+        # points are x >> 1 and blocks hold two elements) with a capture
+        # at each of the T+1 depths.
+        from distributed_point_functions_tpu.utils import roofline
+
+        T = dcf.dpf.validator.hierarchy_to_tree[-1]
+        walk_fields = roofline.walk_hbm_fields(
+            evals / t.elapsed, T, mode, lpe=2, captures=T + 1,
+        )
     return {
+        **({} if ok else {
+            "error": "device output failed host-oracle spot verification"
+        }),
         "bench": "dcf_batch",
         "metric": (
             f"DCF BatchEvaluate, {num_keys} keys x {num_points} points, "
             f"log_domain={log_domain}, uint64"
+            + (f", mode={mode}" if engine == "device" else "")
         ),
         "value": round(evals / t.elapsed),
         "unit": "comparisons/s",
+        "verified": bool(ok),
         "config": {
             "log_domain": log_domain,
             "num_keys": num_keys,
             "num_points": num_points,
             "engine": engine,
+            **({"mode": mode} if engine == "device" else {}),
+            **walk_fields,
             **(
                 {"device_engine_comparisons_per_s": device_rate}
                 if device_rate

@@ -24,6 +24,10 @@ def bench(jax, smoke):
     num_keys = int(os.environ.get("BENCH_KEYS", 16 if smoke else 1024))
     num_points = int(os.environ.get("BENCH_POINTS", 256 if smoke else 4096))
     reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
+    # "walk" = the shipped per-level walk; "walkkernel" = the single-program
+    # walk megakernel (ISSUE 4; tools/tpu_measure.sh evaluate_at_walkkernel
+    # stage records the A/B in its own results.json slot).
+    mode = os.environ.get("BENCH_EVALAT_MODE", "walk")
 
     dpf = DistributedPointFunction.create(DpfParameters(log_domain, Int(64)))
     rng = np.random.default_rng(5)
@@ -43,7 +47,9 @@ def bench(jax, smoke):
         # device-resident outputs + tiny fold PULLED to the host — block_
         # until_ready alone is not trustworthy timing through this image's
         # tunnel (PERF.md "Platform findings").
-        out = evaluator.evaluate_at_batch(dpf, keys, points, device_output=True)
+        out = evaluator.evaluate_at_batch(
+            dpf, keys, points, device_output=True, mode=mode
+        )
         import jax.numpy as jnp
 
         return np.asarray(jnp.bitwise_xor.reduce(out, axis=1))
@@ -84,7 +90,7 @@ def bench(jax, smoke):
     else:  # numpy-oracle fallback verified only a point subset
         dev = evaluator.values_to_numpy(
             evaluator.evaluate_at_batch(
-                dpf, [keys[i] for i in sample], point_sets[0][:64]
+                dpf, [keys[i] for i in sample], point_sets[0][:64], mode=mode
             ),
             64,
         ).astype(np.uint64)
@@ -116,12 +122,22 @@ def bench(jax, smoke):
     result_extra = {} if ok else {
         "error": "device output failed host-oracle spot verification"
     }
+    # Walk traffic model next to the measured rate (per-level walk vs the
+    # in-register walk megakernel), the point-walk twin of the headline's
+    # hbm roofline fields. The walk runs TREE levels (log_domain - 1 for
+    # Int(64): two elements per block), not log_domain.
+    from distributed_point_functions_tpu.utils import roofline
+
+    tree_levels = dpf.validator.hierarchy_to_tree[-1]
+    walk_fields = roofline.walk_hbm_fields(
+        evals / t.elapsed, tree_levels, mode, lpe=2, captures=1
+    )
     return {
         **result_extra,
         "bench": "evaluate_at",
         "metric": (
             f"batched EvaluateAt, {num_keys} keys x {num_points} points, "
-            f"log_domain={log_domain}, uint64"
+            f"log_domain={log_domain}, uint64, mode={mode}"
         ),
         "value": round(evals / t.elapsed),
         "unit": "point-evals/s",
@@ -130,6 +146,8 @@ def bench(jax, smoke):
             "log_domain": log_domain,
             "num_keys": num_keys,
             "num_points": num_points,
+            "mode": mode,
+            **walk_fields,
             **(
                 {"host_engine_point_evals_per_s": host_rate}
                 if host_rate

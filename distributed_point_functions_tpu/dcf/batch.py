@@ -27,7 +27,7 @@ import numpy as np
 
 from ..core import uint128
 from ..ops import aes_jax, backend_jax, evaluator
-from ..utils import errors
+from ..utils import errors, integrity
 
 
 def _capture_tables(dcf, xs_padded: np.ndarray, num_points: int):
@@ -297,7 +297,7 @@ def _prep_points(dcf, keys: Sequence, xs: Sequence[int], p_pad: int):
 
 def batch_evaluate(
     dcf, keys: Sequence, xs: Sequence[int], use_pallas=None, interpret=False,
-    key_chunk=None, pipeline=None,
+    key_chunk=None, pipeline=None, mode=None,
 ) -> np.ndarray:
     """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe].
 
@@ -309,12 +309,37 @@ def batch_evaluate(
     shape) splits the key axis into chunks driven through the pipelined
     executor (ops/pipeline.py, `pipeline` = None for the DPF_TPU_PIPELINE
     env / platform default): chunk N+1's per-key table upload and dispatch
-    overlap chunk N's walk program and chunk N-1's D2H pull."""
+    overlap chunk N's walk program and chunk N-1's D2H pull.
+
+    `mode` selects the walk strategy (None = "walkkernel" when the
+    DPF_TPU_WALKKERNEL env is truthy, else "walk"). "walk" is the shipped
+    shape above (XLA scan or per-level Mosaic walk per `use_pallas`).
+    "walkkernel" runs the walk megakernel
+    (aes_pallas.walk_megakernel_pallas_batched): ONE pallas_call per key
+    chunk walking all T tree levels in-register, with every depth's value
+    capture — hash, block-element select, correction, accumulate-iff-bit-0
+    mask, and the additive/XOR accumulation itself (party 1 negated once
+    at the end) — executed in-kernel; only the [K, P, lpe] result leaves
+    the device program. Scalar 32-bit-multiple widths only (an explicit
+    mode="walkkernel" on sub-word values raises; the env default quietly
+    keeps "walk"); off-TPU it runs through the Pallas interpreter."""
     from ..ops import pipeline as _pl
 
     bits, xor_group = evaluator._value_kind(dcf.value_type)
     num_points = len(xs)
     k = len(keys)
+
+    v = dcf.dpf.validator
+    mode = evaluator._resolve_walk_mode(
+        mode, True, bits, v.hierarchy_to_tree[v.num_hierarchy_levels - 1],
+        use_pallas,
+    )
+    if mode == "walkkernel":
+        return _batch_evaluate_walkkernel(
+            dcf, keys, xs, bits, xor_group,
+            key_chunk=key_chunk, pipeline=pipeline, interpret=interpret,
+        )
+
     p_pad = max(32, -(-num_points // 32) * 32)
     batch, paths, acc_mask, block_sel, depth_to_hierarchy = _prep_points(
         dcf, keys, xs, p_pad
@@ -339,6 +364,22 @@ def batch_evaluate(
         # downgraded — an explicit use_pallas=True (e.g. CHECK_PALLAS=1
         # verifying the Mosaic driver) must actually run the kernel it
         # claims to verify (ADVICE r3).
+        if use_pallas:
+            # Structured note (ISSUE 4 satellite): device A/B runs must be
+            # able to tell "kernel lost" from "kernel never ran" — a
+            # silent downgrade made narrow-batch Pallas A/Bs read as
+            # kernel measurements when they were really the XLA scan.
+            integrity.emit_event(
+                "engine-downgrade",
+                f"dcf.batch_evaluate: narrow point batch ({num_points} "
+                f"points -> {p_pad // 32} lane words < 8) auto-downgraded "
+                "from the Pallas walk to the XLA scan; pass "
+                "use_pallas=True to force the kernel",
+                "pallas",
+                num_points=num_points,
+                lane_words=p_pad // 32,
+                downgraded_to="jax",
+            )
         use_pallas = False
 
     pipe = _pl.resolve(pipeline)
@@ -402,6 +443,72 @@ def batch_evaluate(
             lambda item: np.asarray(item[1])[: item[0], :num_points],
             pipe,
             backend=fib,
+        )
+    )
+    return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
+
+
+def _batch_evaluate_walkkernel(
+    dcf, keys: Sequence, xs: Sequence[int], bits: int, xor_group: bool,
+    key_chunk=None, pipeline=None, interpret=False,
+) -> np.ndarray:
+    """mode="walkkernel" body of `batch_evaluate`: one walk-megakernel
+    program per key chunk. Host prep mirrors the per-level path, but the
+    capture tables become packed per-(depth, element) select bitmasks with
+    the accumulate mask pre-ANDed in — in-kernel, block-element selection
+    and the "accumulate iff the point's bit is 0" gate are one AND."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import pipeline as _pl
+
+    num_points = len(xs)
+    k = len(keys)
+    v = dcf.dpf.validator
+    T = v.hierarchy_to_tree[v.num_hierarchy_levels - 1]
+    lpe = max(bits // 32, 1)
+    epb = dcf.value_type.elements_per_block()
+    plan = evaluator.plan_walkkernel(num_points, T, lpe, captures=True)
+    p_pad = plan.padded_words * 32
+    batch, paths, acc_mask, block_sel, depth_to_hierarchy = _prep_points(
+        dcf, keys, xs, p_pad
+    )
+    path_masks = backend_jax._path_bit_masks(paths, T, p_pad)
+    captures = tuple(i >= 0 for i in depth_to_hierarchy)
+    vc_full = _value_corrections_all(dcf, keys, depth_to_hierarchy)
+    # Correction rows flattened to (depth, element): row d*epb + e.
+    vc = np.ascontiguousarray(
+        evaluator._correction_limbs(
+            vc_full.reshape(k * (T + 1), -1, 4), bits
+        ).reshape(k, (T + 1) * epb, lpe)
+    )
+    # Select bitmask rows: bit j of row d*epb+e = [point j addresses
+    # element e at depth d] AND [depth d's accumulate mask] — padded
+    # points (and non-capture depths) select nothing and contribute zero.
+    sel_bool = np.zeros((T + 1, epb, p_pad), dtype=bool)
+    pts = np.arange(num_points)
+    for d in range(T + 1):
+        if captures[d]:
+            sel_bool[d, block_sel[d, :num_points], pts] = acc_mask[
+                d, :num_points
+            ].astype(bool)
+    sel_bits = aes_jax.pack_bit_mask(sel_bool.reshape((T + 1) * epb, p_pad))
+
+    pipe = _pl.resolve(pipeline)
+    ck = k if key_chunk is None else max(1, key_chunk)
+    pieces = list(
+        _pl.map_chunks(
+            evaluator._walk_megakernel_thunks(
+                batch, k, ck, vc,
+                jnp.asarray(path_masks),
+                jnp.asarray(sel_bits),
+                plan, bits, batch.party, xor_group, epb,
+                captures=captures,
+                interpret=interpret or jax.default_backend() != "tpu",
+            ),
+            lambda item: np.asarray(item[1])[: item[0], :num_points],
+            pipe,
+            backend="pallas",
         )
     )
     return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)

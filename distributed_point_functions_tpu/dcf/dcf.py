@@ -145,7 +145,8 @@ class DistributedComparisonFunction:
         return result
 
     def batch_evaluate(
-        self, keys: Sequence[DcfKey], xs: Sequence[int], engine: str = "device"
+        self, keys: Sequence[DcfKey], xs: Sequence[int], engine: str = "device",
+        **device_kwargs,
     ) -> np.ndarray:
         """Fused evaluation of every key at every point (one tree walk per
         point instead of the reference's walk-per-bit).
@@ -153,13 +154,22 @@ class DistributedComparisonFunction:
         engine="device" returns uint32[K, P, lpe] limb values;
         engine="host" runs the native AES-NI kernels and returns uint64[K, P]
         (bits <= 64) or uint64[K, P, 2] (lo, hi) pairs (see dcf/batch.py).
+        `device_kwargs` pass through to `batch.batch_evaluate` (mode=,
+        use_pallas=, key_chunk=, pipeline=, interpret= — e.g.
+        mode="walkkernel" for the single-program walk megakernel); they
+        have no host-engine meaning, so engine="host" rejects them.
         """
         from . import batch
 
         if engine == "host":
+            if device_kwargs:
+                raise InvalidArgumentError(
+                    "engine='host' takes no device kwargs, got "
+                    f"{sorted(device_kwargs)}"
+                )
             return batch.batch_evaluate_host(self, keys, xs)
         if engine != "device":
             raise InvalidArgumentError(
                 f"engine must be 'device' or 'host', got {engine!r}"
             )
-        return batch.batch_evaluate(self, keys, xs)
+        return batch.batch_evaluate(self, keys, xs, **device_kwargs)

@@ -161,7 +161,8 @@ class MultipleIntervalContainmentGate:
         return res
 
     def batch_eval(
-        self, key: MicKey, xs: Sequence[int], engine: str = "device"
+        self, key: MicKey, xs: Sequence[int], engine: str = "device",
+        **device_kwargs,
     ) -> np.ndarray:
         """Fused evaluation of all intervals for a batch of masked inputs.
 
@@ -169,6 +170,9 @@ class MultipleIntervalContainmentGate:
         (engine="device") or the native AES-NI host engine (engine="host";
         the gate's Int(128) values ride the two-word wide kernel). Returns
         an object ndarray [len(xs), m] of share values mod N.
+        `device_kwargs` pass through to the DCF device path (notably
+        mode="walkkernel": the whole gate evaluation — every interval's
+        two comparison walks — becomes ONE walk-megakernel program).
         """
         n = 1 << self.log_group_size
         for x in xs:
@@ -179,7 +183,9 @@ class MultipleIntervalContainmentGate:
         all_points: List[int] = []
         for x in xs:
             all_points.extend(self._eval_points(int(x)))
-        evals = self._dcf.batch_evaluate([key.dcf_key], all_points, engine=engine)
+        evals = self._dcf.batch_evaluate(
+            [key.dcf_key], all_points, engine=engine, **device_kwargs
+        )
         if engine == "host":  # uint64[1, P, 2] (lo, hi) pairs
             values = (
                 evals[0, :, 0].astype(object)

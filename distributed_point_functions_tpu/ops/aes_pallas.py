@@ -937,3 +937,330 @@ def megakernel_fold_pallas_batched(
         ],
         interpret=interpret,
     )(*args)
+
+
+# ---------------------------------------------------------------------------
+# Walk megakernel: single-program in-register tree walks for EvaluateAt,
+# DCF BatchEvaluate and the walk-driven gates (ISSUE 4)
+# ---------------------------------------------------------------------------
+#
+# The point-walk paths (evaluate_at_batch, dcf.batch_evaluate and MIC's
+# batch_eval riding it) still run `walk_levels_pallas_batched` one
+# pallas_call PER LEVEL: every one of the 20-128 tree levels pays a kernel
+# boundary plus the full [K, P] seed-plane HBM round trip, on a link where
+# each dispatch costs ~66 ms — exactly why the engine table's point-walk
+# rows lose to one shared CPU core (PERF.md). This kernel is the walk twin
+# of the slab megakernel above: ONE pallas_call per key chunk whose grid is
+# (keys, point tiles); each grid step walks its tile of points down ALL
+# tree levels in-register — the seed-plane rows and the control row live in
+# VMEM/vregs for the whole level loop, never touching HBM between levels —
+# with the per-level correction-word tables (small: levels x 128 plane
+# masks + 2 control masks per key) VMEM-resident for the whole call.
+#
+# Per-lane child selection rides the rk_diff key mask exactly like the
+# per-level walk kernel (lane = point, mask bit = that point's path bit at
+# this level), so the traced circuit count stays levels + captures — one
+# masked AES instantiation per level plus one value-hash instantiation per
+# capture depth (EvaluateAt captures once at the leaves; DCF captures at
+# every output depth and accumulates in-register).
+#
+# The capture tail reuses the megakernel's machinery: value hash, the
+# in-register 32x32 bit transpose (`_transpose32_rows`) to element-limb
+# rows, `value_codec.rows_correct_element` for the Int32/64/u128 codecs,
+# and a packed-bit select mask per (depth, element) that folds the DCF
+# accumulate mask in on the host — so block-element selection and the
+# "accumulate iff the point's bit is 0" gate are a single AND. The DCF
+# accumulate itself is `value_codec.rows_limb_add` (carry chain identical
+# to the XLA scan's `_limb_add`), with the party-1 negation applied once
+# after the last capture (`rows_limb_neg`).
+#
+# Output is [K, lpe*32, Wp] "value rows": row l*32+i at word w holds limb
+# l of point 32w+i — the transpose back to [K, P, lpe] is one cheap XLA
+# reshape/transpose in the same jit (evaluator._walk_megakernel_chunk_jit).
+# Emitting rows keeps the kernel store pattern static (128 row writes max)
+# and the output tiny: K * P * lpe * 4 bytes, no domain term anywhere.
+#
+# Mosaic portability: the body is the row kernels' op set (elementwise
+# vector ops, static row loads/stores, scalar ref reads) plus the scalar
+# broadcast of the per-key seed columns — NO 1-D concatenate, iota, or
+# cross-grid-step scratch (each (key, tile) step is self-contained), so it
+# sits strictly inside the op set the per-level walk kernel already proved
+# on hardware; the watch-list items the slab megakernel added do not apply
+# here.
+
+
+def _walk_megakernel_core(
+    rows,  # list of 128 uint32 rows: replicated root-seed planes
+    c,  # uint32 row: initial control mask (party)
+    path_row,  # path_row(lvl) -> uint32 row of this level's packed path bits
+    cw_scalar,  # cw_scalar(lvl, p) -> uint32 scalar
+    cc_scalar,  # cc_scalar(lvl, side) -> uint32 scalar (0=left, 1=right)
+    corr_scalar,  # corr_scalar(row_idx, l) -> uint32 scalar
+    sel_mask,  # sel_mask(row_idx, i) -> uint32 0/~0 row (select gate)
+    *,
+    levels: int,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures,  # None = EvaluateAt (one leaf capture); tuple[bool] = DCF
+    rk_base,
+    rk_diff,
+    rk_value,
+):
+    """The whole walk+capture computation on indexable operands — used
+    VERBATIM by the kernel body (reading refs) and by
+    `walk_megakernel_reference_rows` (reading plain arrays), the same
+    sharing contract `_megakernel_slab_tail` established: the interpret
+    plumbing tests and the eager real-circuit oracle replay exercise this
+    exact code. Returns vals[l][i]: uint32 row — limb l of points 32w+i.
+
+    `corr_scalar`/`sel_mask` row indices: EvaluateAt indexes elements
+    directly (row_idx = e in [0, keep)); DCF indexes (depth, element)
+    flattened as d * keep + e, with the accumulate mask pre-ANDed into
+    `sel_mask` on the host."""
+    lpe = bits // 32
+
+    def _level(rows, c, lvl):
+        pmask = path_row(lvl)
+        sig = [rows[64 + p] for p in range(64)] + [
+            rows[64 + p] ^ rows[p] for p in range(64)
+        ]
+        enc = _aes_rows(sig, rk_base, rk_diff, pmask)
+        h = [enc[p] ^ sig[p] ^ (cw_scalar(lvl, p) & c) for p in range(128)]
+        cc = (cc_scalar(lvl, 0) & ~pmask) | (cc_scalar(lvl, 1) & pmask)
+        new_c = h[0] ^ (c & cc)
+        h[0] = jnp.zeros_like(h[0])
+        return h, new_c
+
+    def _capture(rows, c, base, cap_party):
+        sig = [rows[64 + p] for p in range(64)] + [
+            rows[64 + p] ^ rows[p] for p in range(64)
+        ]
+        enc = _aes_rows(sig, rk_value, None, None)
+        h = [enc[p] ^ sig[p] for p in range(128)]
+        vrows = [_transpose32_rows(h[32 * l : 32 * l + 32]) for l in range(4)]
+        out = [[None] * 32 for _ in range(lpe)]
+        for i in range(32):
+            ctrl_mask = jnp.uint32(0) - ((c >> jnp.uint32(i)) & jnp.uint32(1))
+            for e in range(keep):
+                limbs = [vrows[e * lpe + l][i] for l in range(lpe)]
+                corr = [corr_scalar(base + e, l) for l in range(lpe)]
+                vals = value_codec.rows_correct_element(
+                    limbs, ctrl_mask, corr, bits, cap_party, xor_group
+                )
+                sel = sel_mask(base + e, i)
+                vals = [v & sel for v in vals]
+                for l in range(lpe):
+                    out[l][i] = (
+                        vals[l] if out[l][i] is None else out[l][i] ^ vals[l]
+                    )
+        return out
+
+    if captures is None:
+        for lvl in range(levels):
+            rows, c = _level(rows, c, lvl)
+        return _capture(rows, c, 0, party)
+
+    assert len(captures) == levels + 1, (len(captures), levels)
+    acc = None
+    for d in range(levels + 1):
+        if captures[d]:
+            # Per-depth corrections apply WITHOUT the party negation (the
+            # XLA scan's shape); party 1 negates the accumulator once at
+            # the end.
+            vals = _capture(rows, c, d * keep, 0)
+            if acc is None:
+                acc = vals
+            elif xor_group:
+                acc = [
+                    [acc[l][i] ^ vals[l][i] for i in range(32)]
+                    for l in range(lpe)
+                ]
+            else:
+                for i in range(32):
+                    s = value_codec.rows_limb_add(
+                        [acc[l][i] for l in range(lpe)],
+                        [vals[l][i] for l in range(lpe)],
+                        bits,
+                    )
+                    for l in range(lpe):
+                        acc[l][i] = s[l]
+        if d < levels:
+            rows, c = _level(rows, c, d)
+    if party == 1 and not xor_group:
+        for i in range(32):
+            s = value_codec.rows_limb_neg(
+                [acc[l][i] for l in range(lpe)], bits
+            )
+            for l in range(lpe):
+                acc[l][i] = s[l]
+    return acc
+
+
+def walk_megakernel_reference_rows(
+    seed_planes,  # uint32[128] one key's root-seed plane masks (0/~0)
+    path_masks,  # uint32[L, W] packed per-point path bits
+    cw_planes,  # uint32[L, 128]
+    ccl,  # uint32[L]
+    ccr,  # uint32[L]
+    corrections,  # uint32[n_rows, lpe] (EvaluateAt: n_rows=epb; DCF: (L+1)*epb)
+    sel_bits,  # uint32[n_rows, W] packed per-point select bits
+    *,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures=None,
+):
+    """Pure-array replay of ONE key's walk-megakernel computation — the
+    same row functions on plain jnp arrays, no pallas_call (the
+    `megakernel_reference_rows` twin for the walk paths). Two jobs: run
+    eagerly (jax.disable_jit) with the REAL circuit it is bit-exact
+    against the host oracle in CI time; run with the cheap `_aes_rows`
+    stand-in it is the reference the interpret-mode pallas plumbing tests
+    compare against. Tiling is pure lane slicing (every op is
+    lane-local), so the untiled replay covers any plan. Returns
+    uint32[lpe*32, W] value rows: row l*32+i word w = limb l of point
+    32w+i."""
+    w = path_masks.shape[1]
+    levels = path_masks.shape[0]
+    rows = [jnp.broadcast_to(seed_planes[p], (w,)) for p in range(128)]
+    c = jnp.full(
+        (w,), jnp.uint32(0xFFFFFFFF) if party else jnp.uint32(0), jnp.uint32
+    )
+    vals = _walk_megakernel_core(
+        rows,
+        c,
+        lambda lvl: path_masks[lvl],
+        lambda lvl, p: cw_planes[lvl, p],
+        lambda lvl, side: (ccl, ccr)[side][lvl],
+        lambda r, l: corrections[r, l],
+        lambda r, i: jnp.uint32(0)
+        - ((sel_bits[r] >> jnp.uint32(i)) & jnp.uint32(1)),
+        levels=levels,
+        bits=bits,
+        party=party,
+        xor_group=xor_group,
+        keep=keep,
+        captures=captures,
+        rk_base=backend_jax._rk_np("left"),
+        rk_diff=backend_jax._rk_np("lr_diff"),
+        rk_value=backend_jax._rk_np("value"),
+    )
+    lpe = bits // 32
+    return jnp.stack([vals[l][i] for l in range(lpe) for i in range(32)])
+
+
+def _walk_megakernel_body(
+    rk_base, rk_diff, rk_value, plan, bits, party, xor_group, keep, captures
+):
+    """Builds the walk-megakernel kernel fn for one (plan, workload)
+    config. The body reads refs and delegates every computation to
+    `_walk_megakernel_core` (shared with the replay)."""
+    lpe = bits // 32
+    tw = plan.tile_words
+
+    def kernel(seed_ref, path_ref, cw_ref, cc_ref, corr_ref, sel_ref, out_ref):
+        rows = [jnp.broadcast_to(seed_ref[0, p], (tw,)) for p in range(128)]
+        c = jnp.full(
+            (tw,),
+            jnp.uint32(0xFFFFFFFF) if party else jnp.uint32(0),
+            jnp.uint32,
+        )
+        vals = _walk_megakernel_core(
+            rows,
+            c,
+            lambda lvl: path_ref[lvl, :],
+            lambda lvl, p: cw_ref[0, lvl, p],
+            lambda lvl, side: cc_ref[0, lvl, side],
+            lambda r, l: corr_ref[0, r, l],
+            lambda r, i: jnp.uint32(0)
+            - ((sel_ref[r, :] >> jnp.uint32(i)) & jnp.uint32(1)),
+            levels=plan.levels,
+            bits=bits,
+            party=party,
+            xor_group=xor_group,
+            keep=keep,
+            captures=captures,
+            rk_base=rk_base,
+            rk_diff=rk_diff,
+            rk_value=rk_value,
+        )
+        for l in range(lpe):
+            for i in range(32):
+                out_ref[0, l * 32 + i, :] = vals[l][i]
+
+    return kernel
+
+
+def walk_megakernel_pallas_batched(
+    seed_planes: jnp.ndarray,  # uint32[K, 128] root-seed plane masks
+    path_masks: jnp.ndarray,  # uint32[L, Wp] shared across keys
+    cw_planes: jnp.ndarray,  # uint32[K, L, 128]
+    ccl: jnp.ndarray,  # uint32[K, L]
+    ccr: jnp.ndarray,  # uint32[K, L]
+    corrections: jnp.ndarray,  # uint32[K, n_rows, lpe]
+    sel_bits: jnp.ndarray,  # uint32[n_rows, Wp] packed select bits
+    *,
+    plan,  # evaluator.WalkkernelPlan (static)
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures=None,  # None = EvaluateAt; tuple[bool, L+1] = DCF depths
+    interpret: bool = False,
+):
+    """The walk megakernel: ONE pallas_call per key chunk walking every
+    tree level in-register, grid (keys, point tiles). Returns
+    uint32[K, lpe*32, Wp] value rows (row l*32+i word w = limb l of point
+    32w+i); the caller transposes to [K, P, lpe] in the same jit.
+
+    EvaluateAt form (captures=None): walk all L levels, one leaf capture
+    with the party correction applied per element; `sel_bits` row e
+    selects the points whose addressed block element is e (all-ones when
+    keep == 1). DCF form (captures = tuple of L+1 bools): capture at every
+    flagged depth with corrections row d*keep+e, accumulate in-register
+    (additive carry chain or XOR), negate once for party 1; `sel_bits`
+    rows carry the block select AND the DCF accumulate mask, pre-combined
+    on the host."""
+    k = seed_planes.shape[0]
+    lpe = bits // 32
+    levels = plan.levels
+    assert path_masks.shape == (levels, plan.padded_words), (
+        path_masks.shape,
+        plan,
+    )
+    assert sel_bits.shape[1] == plan.padded_words, (sel_bits.shape, plan)
+    kernel = _walk_megakernel_body(
+        backend_jax._rk_np("left"),
+        backend_jax._rk_np("lr_diff"),
+        backend_jax._rk_np("value"),
+        plan,
+        bits,
+        party,
+        xor_group,
+        keep,
+        captures,
+    )
+    cc = jnp.stack([ccl, ccr], axis=-1).astype(jnp.uint32)  # [K, L, 2]
+    n_rows = corrections.shape[1]
+    n_sel = sel_bits.shape[0]
+    tw = plan.tile_words
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(
+            (k, lpe * 32, plan.padded_words), jnp.uint32
+        ),
+        grid=(k, plan.num_tiles),
+        in_specs=[
+            pl.BlockSpec((1, 128), lambda kk, j: (kk, 0)),
+            pl.BlockSpec((levels, tw), lambda kk, j: (0, j)),
+            pl.BlockSpec((1, levels, 128), lambda kk, j: (kk, 0, 0)),
+            pl.BlockSpec((1, levels, 2), lambda kk, j: (kk, 0, 0)),
+            pl.BlockSpec((1, n_rows, lpe), lambda kk, j: (kk, 0, 0)),
+            pl.BlockSpec((n_sel, tw), lambda kk, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, lpe * 32, tw), lambda kk, j: (kk, 0, j)),
+        interpret=interpret,
+    )(seed_planes, path_masks, cw_planes, cc, corrections, sel_bits)

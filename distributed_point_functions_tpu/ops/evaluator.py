@@ -733,7 +733,11 @@ def full_domain_fold_chunks(
         hierarchy_level = v.num_hierarchy_levels - 1
     backend_jax.log_backend_once()
     if mode is None:
-        mode = _fold_mode_default()
+        # The env-driven A/B default yields to an explicit use_pallas=False:
+        # a caller qualifying the XLA engine (CHECK_PALLAS=0) must not
+        # silently get the Mosaic megakernel — the mirror of the r3
+        # explicit-use_pallas=True rule (same policy as _resolve_walk_mode).
+        mode = "fold" if use_pallas is False else _fold_mode_default()
     if mode not in ("fold", "megakernel"):
         raise InvalidArgumentError(
             f"mode must be 'fold' or 'megakernel', got {mode!r}"
@@ -1791,6 +1795,231 @@ def _megakernel_fold_chunk_jit(
     return jnp.bitwise_xor.reduce(folds, axis=2)
 
 
+# ---------------------------------------------------------------------------
+# Walk-megakernel strategy: single-program in-register point walks
+# ---------------------------------------------------------------------------
+
+
+class WalkkernelPlan(NamedTuple):
+    """Static shape plan for the walk megakernel (aes_pallas.
+    walk_megakernel_pallas_batched): hashable, used as a jit static arg —
+    the `plan_megakernel` analog for the point-walk paths.
+
+      levels        tree levels walked in-kernel (= the whole tree: the
+                    walk paths do no host pre-expansion)
+      tile_words    point-tile width in packed 32-lane words (the second
+                    grid axis steps tiles of 32 * tile_words points)
+      num_tiles     point tiles per key
+      padded_words  num_tiles * tile_words — the kernel's lane-word width;
+                    callers pad points up to padded_words * 32 and trim
+    """
+
+    levels: int
+    tile_words: int
+    num_tiles: int
+    padded_words: int
+
+
+def _walk_mode_default() -> str:
+    """Resolves the point-walk strategy default: "walkkernel" when
+    DPF_TPU_WALKKERNEL is truthy, else the shipped per-level "walk" shape
+    — the A/B knob bench scripts / tools/tpu_measure.sh flip without code
+    changes (the DPF_TPU_MEGAKERNEL analog for EvaluateAt/DCF/MIC)."""
+    return (
+        "walkkernel"
+        if _env_bool("DPF_TPU_WALKKERNEL", default=False)
+        else "walk"
+    )
+
+
+def _resolve_walk_mode(
+    mode: Optional[str], scalar_fast: bool, bits: int, levels: int,
+    use_pallas: Optional[bool] = None,
+) -> str:
+    """Resolves the point-walk strategy for one call — ONE policy shared
+    by `evaluate_at_batch` and `dcf.batch.batch_evaluate` so it cannot
+    drift. An explicit mode wins (configs the walk megakernel cannot
+    handle raise); the env-driven default quietly keeps "walk" for them —
+    DPF_TPU_WALKKERNEL is a process-wide A/B knob and must never turn a
+    previously working call into an error. `bits` is only read when
+    `scalar_fast` is set. `use_pallas` is the caller's RAW knob (pre
+    platform-default resolution): an explicit False also pins the env
+    default to "walk" — a call qualifying the XLA engine (CHECK_PALLAS=0)
+    must not silently get a Mosaic kernel, the mirror of the r3
+    explicit-True rule."""
+    explicit = mode is not None
+    if mode is None:
+        if use_pallas is False:
+            return "walk"
+        mode = _walk_mode_default()
+    if mode not in ("walk", "walkkernel"):
+        raise InvalidArgumentError(
+            f"mode must be 'walk' or 'walkkernel', got {mode!r}"
+        )
+    if mode == "walkkernel":
+        if not (scalar_fast and bits % 32 == 0):
+            if explicit:
+                raise NotImplementedError(
+                    "mode='walkkernel' handles scalar Int/XorWrapper values "
+                    "with 32-bit-multiple widths; use mode='walk' for codec "
+                    "(IntModN/Tuple) or sub-word outputs"
+                )
+            return "walk"
+        if levels < 1:
+            if explicit:
+                raise InvalidArgumentError(
+                    "mode='walkkernel' needs at least one tree level (got "
+                    f"{levels}); use mode='walk' for trivial domains"
+                )
+            return "walk"
+    return mode
+
+
+def plan_walkkernel(
+    num_points: int,
+    levels: int,
+    lpe: int,
+    captures: bool = False,
+    vmem_budget: Optional[int] = None,
+) -> WalkkernelPlan:
+    """Sizes the walk megakernel's point-tile width from a VMEM budget —
+    the `plan_megakernel` analog for the walk paths.
+
+    The budget (DPF_TPU_WALKKERNEL_VMEM env, default 8 MB of the v5e's
+    ~16 MB/core) covers, per lane word: the 128 seed-plane rows with ~4x
+    live AES temporaries, the lpe*32 value rows (doubled-plus-one when a
+    DCF accumulator is carried across depths), and the per-level path
+    rows. The resulting tile is a power of two >= 128 words for multi-tile
+    plans — 1024+ words at the default budget, so every row fills whole
+    (8, 128) vregs; point counts below one tile round up to 8-word
+    (sublane) granularity instead of paying a full tile of padding."""
+    if levels < 1:
+        raise InvalidArgumentError(
+            f"walk megakernel needs at least one tree level, got {levels}"
+        )
+    if vmem_budget is None:
+        vmem_budget = int(
+            os.environ.get("DPF_TPU_WALKKERNEL_VMEM", str(8 << 20))
+        )
+    w = -(-max(1, num_points) // 32)
+    per_word = 4 * (128 * 4 + 32 * max(1, lpe) * (3 if captures else 2) + levels)
+    cap = _floor_pow2(max(128, vmem_budget // per_word))
+    if w <= cap:
+        tile = max(8, -(-w // 8) * 8)
+        return WalkkernelPlan(levels, tile, 1, tile)
+    num_tiles = -(-w // cap)
+    return WalkkernelPlan(levels, cap, num_tiles, num_tiles * cap)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "plan", "bits", "party", "xor_group", "keep", "captures", "interpret",
+    ),
+)
+def _walk_megakernel_chunk_jit(
+    seed_planes,  # uint32[K, 128] root-seed plane masks
+    path_masks,  # uint32[L, Wp]
+    cw_planes,  # uint32[K, L, 128]
+    ccl,  # uint32[K, L]
+    ccr,  # uint32[K, L]
+    corrections,  # uint32[K, n_rows, lpe]
+    sel_bits,  # uint32[n_rows, Wp]
+    plan: WalkkernelPlan,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures=None,
+    interpret: bool = False,
+):
+    """ONE program per chunk, walk-megakernel edition: the single
+    pallas_call walking every tree level in-register (EvaluateAt's leaf
+    capture or DCF's per-depth capture/accumulate in-kernel) plus the
+    trivial value-row transpose back to [K, P_pad, lpe]. No per-level
+    dispatch, no per-level [K, P] seed-plane HBM round trip."""
+    from . import aes_pallas
+
+    out = aes_pallas.walk_megakernel_pallas_batched(
+        seed_planes,
+        path_masks,
+        cw_planes,
+        ccl,
+        ccr,
+        corrections,
+        sel_bits,
+        plan=plan,
+        bits=bits,
+        party=party,
+        xor_group=xor_group,
+        keep=keep,
+        captures=captures,
+        interpret=interpret,
+    )
+    k = out.shape[0]
+    lpe = bits // 32
+    # Value rows -> [K, P_pad, lpe]: row l*32+i word w = limb l of point
+    # 32w+i, so the point axis factors as (word, bit-in-word).
+    return (
+        out.reshape(k, lpe, 32, plan.padded_words)
+        .transpose(0, 3, 2, 1)
+        .reshape(k, plan.padded_words * 32, lpe)
+    )
+
+
+def _walk_megakernel_thunks(
+    batch: KeyBatch,
+    num_keys: int,
+    key_chunk: int,
+    corr_rows: np.ndarray,  # uint32[K, n_rows, lpe] per-key correction rows
+    path_masks_dev,  # uint32[L, Wp] device-resident, point-shared
+    sel_dev,  # uint32[n_sel, Wp] device-resident, point-shared
+    plan: WalkkernelPlan,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures,
+    interpret: bool,
+):
+    """Shared chunk-thunk driver for the walk-megakernel entry points
+    (evaluate_at_batch and dcf.batch._batch_evaluate_walkkernel): yields
+    one thunk per key chunk — whole-batch calls skip the identity
+    fancy-index copies; per-chunk key tables upload inside the thunk so
+    the pipelined executor overlaps them — returning (valid, out) with
+    out uint32[K, P_pad, lpe]. The two call sites differ only in the
+    capture-table inputs (corr_rows/sel/captures/keep), so the executor
+    scaffolding lives once here."""
+
+    def _thunk(idx, valid):
+        whole = valid == num_keys and idx.shape[0] == num_keys
+        kb = batch if whole else batch.take(idx)
+        corr_c = corr_rows if whole else corr_rows[idx]
+        cw_planes, ccl, ccr = kb.device_cw_arrays()
+        out = _walk_megakernel_chunk_jit(
+            jnp.asarray(backend_jax.cw_seed_planes(kb.seeds)),
+            path_masks_dev,
+            jnp.asarray(cw_planes),
+            jnp.asarray(ccl),
+            jnp.asarray(ccr),
+            jnp.asarray(corr_c),
+            sel_dev,
+            plan=plan,
+            bits=bits,
+            party=party,
+            xor_group=xor_group,
+            keep=keep,
+            captures=captures,
+            interpret=interpret,
+        )
+        return valid, out
+
+    return (
+        functools.partial(_thunk, idx, valid)
+        for idx, valid in _pl.chunk_indices(num_keys, key_chunk)
+    )
+
+
 def full_domain_evaluate(
     dpf: DistributedPointFunction,
     keys: Sequence[DpfKey],
@@ -2053,6 +2282,7 @@ def evaluate_at_batch(
     integrity: Optional[bool] = None,
     key_chunk: Optional[int] = None,
     pipeline: Optional[bool] = None,
+    mode: Optional[str] = None,
 ):
     """Evaluates every key at every point on device.
 
@@ -2074,26 +2304,61 @@ def evaluate_at_batch(
     dispatch overlap chunk N's program and chunk N-1's D2H pull.
     `pipeline` (None = DPF_TPU_PIPELINE env / platform default) selects
     the executor mode; with a single chunk it is a pass-through.
+
+    `mode` selects the walk strategy (None = "walkkernel" when the
+    DPF_TPU_WALKKERNEL env is truthy, else "walk" — the A/B knob):
+
+    * "walk" — the shipped shape: one program per chunk whose tree walk
+      runs the per-level engines (`lax.scan` over the XLA bitslice, or
+      `aes_pallas.walk_levels_pallas_batched` one pallas_call per level
+      under `use_pallas`).
+    * "walkkernel" — the walk megakernel
+      (aes_pallas.walk_megakernel_pallas_batched): ONE pallas_call per
+      chunk, grid (keys, point tiles), walking ALL tree levels
+      in-register — no per-level kernel boundary and no per-level [K, P]
+      seed-plane HBM round trip (PERF.md "Walk megakernel"); the leaf
+      capture (value hash + correction + block-element select) runs
+      in-kernel too. Point tiles come from `plan_walkkernel`
+      (DPF_TPU_WALKKERNEL_VMEM). Scalar Int/XorWrapper widths that are
+      32-bit multiples only; an explicit mode="walkkernel" on other value
+      types raises, the env default quietly keeps "walk". Off-TPU the
+      kernel runs through the Pallas interpreter (correctness only).
     """
     from ..utils import integrity as _integrity
 
     v = dpf.validator
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
+    use_pallas_raw = use_pallas
     if use_pallas is None:
         use_pallas = _pallas_default()
     pipe = _pl.resolve(pipeline)
-    fib = _fi_backend(use_pallas)
+
+    # Resolve the walk strategy BEFORE the probe/fault setup: the walk
+    # megakernel IS a Mosaic program regardless of the use_pallas knob, so
+    # the integrity probe and any armed fault plans must be scoped to the
+    # engine that will actually execute (the full_domain_fold_chunks
+    # discipline — it forces use_pallas=True before any probe runs).
+    # Everything the validity check needs is derivable from the validator,
+    # no key batch required.
+    value_type = v.parameters[hierarchy_level].value_type
+    spec = value_codec.build_spec(value_type, v.blocks_needed[hierarchy_level])
+    scalar_fast = spec.is_scalar_direct and spec.blocks_needed == 1
+    if scalar_fast:
+        bits, xor_group = _value_kind(value_type)
+    mode = _resolve_walk_mode(
+        mode, scalar_fast, bits if scalar_fast else 0,
+        v.hierarchy_to_tree[hierarchy_level], use_pallas_raw,
+    )
+    fib = "pallas" if mode == "walkkernel" else _fi_backend(use_pallas)
+
     keys, probe = _integrity.setup_probe(
         dpf, hierarchy_level, keys, integrity, "evaluate_at_batch",
         backend=fib,
     )
-    value_type = v.parameters[hierarchy_level].value_type
     backend_jax.log_backend_once()
     batch = KeyBatch.from_keys(dpf, keys, hierarchy_level)
-    _inject_batch_faults(batch, use_pallas)
-    spec = batch.spec
-    scalar_fast = spec.is_scalar_direct and spec.blocks_needed == 1
+    _inject_batch_faults(batch, use_pallas or mode == "walkkernel")
     num_levels = batch.num_levels
     k = batch.seeds.shape[0]
     p = len(points)
@@ -2107,62 +2372,83 @@ def evaluate_at_batch(
         dtype=np.int32,
     )
     paths = uint128.array_to_limbs([int(t) for t in tree_indices])
-    p_pad = -(-p // 32) * 32
-    path_masks = backend_jax._path_bit_masks(paths, num_levels, p_pad)
-
-    # Point-shared tables upload once; per-chunk key material uploads (and
-    # overlaps) inside each thunk.
-    path_masks_dev = jnp.asarray(path_masks)
-    block_sel_dev = jnp.asarray(block_sel)
-    control0_dev = jnp.asarray(
-        aes_jax.pack_bit_mask(np.full(p_pad, bool(batch.party), dtype=bool))
-    )
-    if scalar_fast:
-        bits, xor_group = _value_kind(value_type)
     ck = k if key_chunk is None else max(1, key_chunk)
 
-    def _chunk_thunk(idx, valid):
-        # Single chunk covering the whole batch (the historical default
-        # key_chunk=None): skip the identity fancy-index copy of every
-        # per-key table.
-        kb = batch if valid == k and idx.shape[0] == k else batch.take(idx)
-        kk = kb.seeds.shape[0]
-        cw_planes, ccl, ccr = kb.device_cw_arrays()
-        seeds = np.broadcast_to(kb.seeds[:, None, :], (kk, p_pad, 4)).copy()
-        if scalar_fast:
-            out = _evaluate_points_jit(
-                jnp.asarray(seeds),
-                control0_dev,
-                path_masks_dev,
-                jnp.asarray(cw_planes),
-                jnp.asarray(ccl),
-                jnp.asarray(ccr),
-                jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
-                block_sel_dev,
-                bits=bits,
-                party=batch.party,
-                xor_group=xor_group,
-                use_pallas=use_pallas,
-            )
-        else:
-            out = _evaluate_points_codec_jit(
-                jnp.asarray(seeds),
-                control0_dev,
-                path_masks_dev,
-                jnp.asarray(cw_planes),
-                jnp.asarray(ccl),
-                jnp.asarray(ccr),
-                tuple(jnp.asarray(a) for a in kb.codec_corrections),
-                block_sel_dev,
-                spec=spec,
-                party=batch.party,
-            )
-        return valid, out
+    if mode == "walkkernel":
+        lds = v.parameters[hierarchy_level].log_domain_size
+        keep = 1 << (lds - num_levels)
+        plan = plan_walkkernel(p, num_levels, bits // 32)
+        p_pad = plan.padded_words * 32
+        path_masks = backend_jax._path_bit_masks(paths, num_levels, p_pad)
+        # Select mask per block element: bit j of row e = [point j's
+        # addressed element is e]; padded points select nothing.
+        sel_bool = np.zeros((keep, p_pad), dtype=bool)
+        sel_bool[block_sel, np.arange(p)] = True
+        # Off-TPU the Mosaic kernel runs through the Pallas interpreter —
+        # bit-exact (tests/test_walkkernel.py), minus the performance.
+        thunks = _walk_megakernel_thunks(
+            batch, k, ck,
+            _correction_limbs(batch.value_corrections, bits),
+            jnp.asarray(path_masks),
+            jnp.asarray(aes_jax.pack_bit_mask(sel_bool)),
+            plan, bits, batch.party, xor_group, keep,
+            captures=None,
+            interpret=jax.default_backend() != "tpu",
+        )
+    else:
+        p_pad = -(-p // 32) * 32
+        path_masks = backend_jax._path_bit_masks(paths, num_levels, p_pad)
 
-    thunks = (
-        functools.partial(_chunk_thunk, idx, valid)
-        for idx, valid in _pl.chunk_indices(k, ck)
-    )
+        # Point-shared tables upload once; per-chunk key material uploads
+        # (and overlaps) inside each thunk.
+        path_masks_dev = jnp.asarray(path_masks)
+        block_sel_dev = jnp.asarray(block_sel)
+        control0_dev = jnp.asarray(
+            aes_jax.pack_bit_mask(np.full(p_pad, bool(batch.party), dtype=bool))
+        )
+
+        def _chunk_thunk(idx, valid):
+            # Single chunk covering the whole batch (the historical
+            # default key_chunk=None): skip the identity fancy-index copy
+            # of every per-key table.
+            kb = batch if valid == k and idx.shape[0] == k else batch.take(idx)
+            kk = kb.seeds.shape[0]
+            cw_planes, ccl, ccr = kb.device_cw_arrays()
+            seeds = np.broadcast_to(kb.seeds[:, None, :], (kk, p_pad, 4)).copy()
+            if scalar_fast:
+                out = _evaluate_points_jit(
+                    jnp.asarray(seeds),
+                    control0_dev,
+                    path_masks_dev,
+                    jnp.asarray(cw_planes),
+                    jnp.asarray(ccl),
+                    jnp.asarray(ccr),
+                    jnp.asarray(_correction_limbs(kb.value_corrections, bits)),
+                    block_sel_dev,
+                    bits=bits,
+                    party=batch.party,
+                    xor_group=xor_group,
+                    use_pallas=use_pallas,
+                )
+            else:
+                out = _evaluate_points_codec_jit(
+                    jnp.asarray(seeds),
+                    control0_dev,
+                    path_masks_dev,
+                    jnp.asarray(cw_planes),
+                    jnp.asarray(ccl),
+                    jnp.asarray(ccr),
+                    tuple(jnp.asarray(a) for a in kb.codec_corrections),
+                    block_sel_dev,
+                    spec=spec,
+                    party=batch.party,
+                )
+            return valid, out
+
+        thunks = (
+            functools.partial(_chunk_thunk, idx, valid)
+            for idx, valid in _pl.chunk_indices(k, ck)
+        )
 
     if device_output:
         pieces = list(_pl.prefetch_thunks(thunks, pipe, backend=fib))

@@ -743,11 +743,27 @@ def rows_correct_element(
     gated = [corr[l] & ctrl_mask for l in range(lpe)]
     if xor_group:
         return [limbs[l] ^ gated[l] for l in range(lpe)]
+    out = rows_limb_add(limbs, gated, bits)
+    if party == 1:
+        out = rows_limb_neg(out, bits)
+    return out
+
+
+def rows_limb_add(a, b, bits: int):
+    """Addition mod 2^bits on two lpe-limb row lists (uint32 rows, lane =
+    one evaluation) — the Mosaic-row twin of `limb_add_pow2` /
+    `evaluator._limb_add`, shared by `rows_correct_element` and the walk
+    megakernel's per-depth DCF accumulate (the carry chain must match the
+    XLA paths bit-for-bit or the accumulated comparison shares drift)."""
+    if bits % 32:
+        raise NotImplementedError(
+            f"rows_limb_add handles 32-bit-multiple widths, got {bits}"
+        )
     out = []
     carry = None
-    for l in range(lpe):
-        s = limbs[l] + gated[l]
-        c1 = (s < limbs[l]).astype(_U32)
+    for l in range(bits // 32):
+        s = a[l] + b[l]
+        c1 = (s < a[l]).astype(_U32)
         if carry is None:
             carry = c1
         else:
@@ -755,14 +771,23 @@ def rows_correct_element(
             c2 = (s2 < s).astype(_U32)
             s, carry = s2, c1 | c2
         out.append(s)
-    if party == 1:
-        neg = []
-        carry = _U32(1)  # ~a + 1
-        for l in range(lpe):
-            s = (~out[l]) + carry
-            carry = jnp.where((s == 0) & (carry == 1), _U32(1), _U32(0))
-            neg.append(s)
-        out = neg
+    return out
+
+
+def rows_limb_neg(a, bits: int):
+    """Two's-complement negation mod 2^bits on an lpe-limb row list — the
+    Mosaic-row twin of `limb_neg_pow2` / `evaluator._limb_neg` (party-1
+    negation of additive shares, applied once at the end of a DCF walk)."""
+    if bits % 32:
+        raise NotImplementedError(
+            f"rows_limb_neg handles 32-bit-multiple widths, got {bits}"
+        )
+    out = []
+    carry = _U32(1)  # ~a + 1
+    for l in range(bits // 32):
+        s = (~a[l]) + carry
+        carry = jnp.where((s == 0) & (carry == 1), _U32(1), _U32(0))
+        out.append(s)
     return out
 
 

@@ -81,7 +81,8 @@ class IntegrityEvent:
     """One integrity / degradation event, as handed to event hooks."""
 
     kind: str  # "selftest-ok" | "sentinel-ok" | "corruption" | "degrade" |
-    #            "retry" | "chunk-halved" | "recovered" | "integrity-skip"
+    #            "retry" | "chunk-halved" | "recovered" | "integrity-skip" |
+    #            "engine-downgrade"
     backend: str
     detail: str
     data: dict
@@ -99,6 +100,11 @@ _EVENT_LEVELS = {
     "integrity-skip": logging.INFO,
     "selftest-ok": logging.DEBUG,
     "sentinel-ok": logging.DEBUG,
+    # Auto-downgrades that silently pick a different execution engine
+    # (e.g. dcf.batch_evaluate's narrow-batch Pallas -> XLA-scan fallback):
+    # debug-level, but structured so A/B harnesses can tell "kernel lost"
+    # from "kernel never ran".
+    "engine-downgrade": logging.DEBUG,
 }
 
 
@@ -632,8 +638,13 @@ def run_device_check(
     (full_domain_evaluate_chunks), "fold" or "megakernel"
     (full_domain_fold_chunks — "megakernel" is the slab Mosaic kernel,
     CHECK_MODE=megakernel from tools/check_device.py; off-TPU it runs the
-    Pallas interpreter, which is only CI-practical at toy shapes) — the
-    program shapes fail independently on a broken backend.
+    Pallas interpreter, which is only CI-practical at toy shapes), or
+    "walkkernel" (the walk megakernel, ISSUE 4: per shape, a
+    `evaluate_at_batch(mode="walkkernel")` point batch plus one DCF
+    `batch_evaluate(mode="walkkernel")` pass are differential-verified
+    against the host oracle — the hardware gate for the single-program
+    point-walk family, CHECK_MODE=walkkernel) — the program shapes fail
+    independently on a broken backend.
 
     `pipeline` (None = DPF_TPU_PIPELINE env / platform default) drives the
     chunk generators through the pipelined executor (ops/pipeline.py) —
@@ -657,6 +668,10 @@ def run_device_check(
         report(f"selftest: fixed-key AES KAT OK on backend {_backend_name()!r}")
     rng = np.random.default_rng(seed)
     failures = 0
+    if mode == "walkkernel":
+        return failures + _run_walkkernel_check(
+            shapes, rng, report, pipeline=pipeline
+        )
     for num_keys, lds in shapes:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
         alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
@@ -699,3 +714,94 @@ def run_device_check(
             )
         failures += bad
     return failures
+
+
+def _run_walkkernel_check(shapes, rng, report, pipeline=None) -> int:
+    """CHECK_MODE=walkkernel body of `run_device_check`: per shape, a
+    `evaluate_at_batch(mode="walkkernel")` point batch is verified
+    key-by-key against the host oracle (the native engine over every
+    point when available, else the reference path over the first 32),
+    plus ONE DCF `batch_evaluate(mode="walkkernel")` differential — the
+    hardware gate for the single-program point-walk family (the real row
+    circuit cannot execute through interpret mode in CI time, so only
+    this check exercises the Mosaic codegen)."""
+    from .. import native
+    from ..core.dpf import DistributedPointFunction
+    from ..core.params import DpfParameters
+    from ..core.value_types import Int
+    from ..dcf import batch as dcf_batch
+    from ..dcf.dcf import DistributedComparisonFunction
+    from ..ops import evaluator
+
+    failures = 0
+    for num_keys, lds in shapes:
+        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
+        betas = [[int(x) for x in rng.integers(1, 1000, size=num_keys)]]
+        keys, _ = dpf.generate_keys_batch(alphas, betas)
+        num_points = 256
+        pts = [alphas[0]] + [
+            int(x) for x in rng.integers(0, 1 << lds, size=num_points - 1)
+        ]
+        dev = evaluator.values_to_numpy(
+            evaluator.evaluate_at_batch(
+                dpf, keys, pts, key_chunk=num_keys, pipeline=pipeline,
+                mode="walkkernel",
+            ),
+            64,
+        ).astype(np.uint64)
+        if native.available():
+            from ..core.host_eval import evaluate_at_host
+
+            want = evaluate_at_host(
+                dpf, keys, np.asarray(pts, dtype=np.uint64)
+            ).astype(np.uint64)
+            checked = num_points
+        else:
+            want = np.asarray(
+                [dpf.evaluate_at(k, 0, pts[:32]) for k in keys],
+                dtype=np.uint64,
+            )
+            dev = dev[:, :32]
+            checked = 32
+        bad = int((dev != want).any(axis=1).sum())
+        status = "OK" if bad == 0 else f"MISMATCH ({bad}/{num_keys} keys)"
+        report(
+            f"keys={num_keys:4d} log_domain={lds:3d} mode=walkkernel "
+            f"evaluate_at ({checked} pts): {status}"
+        )
+        if bad:
+            emit_event(
+                "corruption",
+                f"device check: {bad}/{num_keys} keys mismatch at "
+                f"log_domain={lds} mode=walkkernel (evaluate_at)",
+                _backend_name(),
+                num_keys=num_keys,
+                log_domain=lds,
+                mode="walkkernel",
+            )
+        failures += bad
+    # One DCF pass through the same kernel family (per-depth captures +
+    # in-register accumulate are DCF-only code paths).
+    lds = min(16, max(l for _, l in shapes))
+    dc = DistributedComparisonFunction.create(lds, Int(64))
+    ka, _ = dc.generate_keys(int(rng.integers(0, 1 << lds)), 4242)
+    xs = [int(x) for x in rng.integers(0, 1 << lds, size=128)]
+    dev = evaluator.values_to_numpy(
+        dcf_batch.batch_evaluate(dc, [ka], xs, mode="walkkernel"), 64
+    )[0].astype(np.uint64)
+    want = np.array([dc.evaluate(ka, x) for x in xs[:16]], dtype=np.uint64)
+    bad = 0 if np.array_equal(dev[:16], want) else 1
+    report(
+        f"keys=   1 log_domain={lds:3d} mode=walkkernel dcf (128 pts, "
+        f"16 host-checked): {'OK' if bad == 0 else 'MISMATCH'}"
+    )
+    if bad:
+        emit_event(
+            "corruption",
+            f"device check: DCF walkkernel mismatch at log_domain={lds}",
+            _backend_name(),
+            log_domain=lds,
+            mode="walkkernel",
+        )
+    return failures + bad

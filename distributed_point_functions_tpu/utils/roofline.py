@@ -221,6 +221,79 @@ def hbm_fields(
     return out
 
 
+def walk_hashes_per_point(levels: int, captures: int = 1) -> float:
+    """AES hashes per point-evaluation of a tree walk: one masked child
+    hash per level plus one value hash per capture depth (EvaluateAt
+    captures once at the leaves; a DCF captures at every output depth —
+    pass captures=levels+1 for the dense-capture worst case)."""
+    return float(levels + captures)
+
+
+def walk_hbm_bytes_per_point(
+    levels: int, strategy: str = "walk", lpe: int = 2, captures: int = 1
+) -> float:
+    """Modeled HBM bytes moved per POINT-evaluation of the walk paths
+    (evaluate_at_batch / dcf.batch_evaluate / MIC) — the walk twin of
+    `hbm_bytes_per_eval`. A traffic MODEL, counted from the data each
+    strategy provably round-trips, not a measurement:
+
+    * "walk" — the per-level engines (`walk_levels_pallas_batched` one
+      pallas_call per level, or the XLA scan whose per-level carry XLA
+      materializes the same way at serving widths): every level writes
+      the [K, 128, W] child planes to HBM and reads them back — 16 B per
+      point per level, twice — plus the capture's hashed planes
+      (write + read per capture) and the [K, P, lpe] value output.
+    * "walkkernel" — the walk megakernel: seed planes, control and the
+      whole level loop stay in VMEM/registers; per-point traffic is the
+      value-row output write (4*lpe B) plus the per-point share of the
+      packed path/select masks (levels+captures bits ~= bytes/8, kept in
+      the model for honesty at very deep trees).
+    """
+    if strategy not in ("walk", "walkkernel"):
+        raise ValueError(
+            f"no walk HBM traffic model for strategy {strategy!r} "
+            "(modeled: walk/walkkernel)"
+        )
+    masks = (levels + captures) / 8.0  # packed path + select bits, read once
+    if strategy == "walkkernel":
+        return 4.0 * lpe + masks
+    planes = 2 * 16.0 * levels  # per-level child planes write + read
+    hashed = 2 * 16.0 * captures  # value-hash planes write + read
+    values = 2 * 4.0 * lpe  # value buffer write + consumer read
+    return planes + hashed + values + masks
+
+
+def walk_hbm_fields(
+    points_per_sec: float,
+    levels: int,
+    strategy: str = "walk",
+    lpe: int = 2,
+    captures: int = 1,
+) -> dict:
+    """Roofline fields for a measured point-walk record (the walk twin of
+    `hbm_fields`): the HBM traffic model above next to the VPU ceiling at
+    the walk's hashes-per-point cost, and which wall binds."""
+    ops = hash_ops_per_block()
+    per_point = walk_hashes_per_point(levels, captures) * ops[
+        "element_ops_per_block"
+    ]
+    vpu_ceiling = V5E_VPU_OPS_PER_SEC / per_point
+    bpe = walk_hbm_bytes_per_point(levels, strategy, lpe, captures)
+    hbm_ceiling = V5E_HBM_BYTES_PER_SEC / bpe
+    return {
+        "walk_hbm_bytes_per_point_model": round(bpe, 2),
+        "walk_vpu_ceiling_points_per_sec": round(vpu_ceiling),
+        "walk_hbm_ceiling_points_per_sec": round(hbm_ceiling),
+        "walk_mfu_estimate": round(
+            points_per_sec * per_point / V5E_VPU_OPS_PER_SEC, 4
+        ),
+        # "walk_"-prefixed like every other key: a record may carry BOTH
+        # models (bench.py merges mfu/hbm fields at top level), and the
+        # full-domain `hbm_fields` already owns the bare "binding_wall".
+        "walk_binding_wall": "hbm" if hbm_ceiling < vpu_ceiling else "vpu",
+    }
+
+
 def _native_anchor() -> str:
     """Sanity anchor: the same arithmetic for the AES-NI/VAES host engine.
 
@@ -280,6 +353,27 @@ def main(argv) -> int:
         binding = "hbm" if ceil < vpu_ceiling else "vpu"
         ceil_s = f"{ceil:18.3e}" if ceil != float("inf") else f"{'—':>18s}"
         print(f"{name:14s} {bpe:8.2f} {ceil_s} {binding:>13s}")
+    print(
+        "\n# Point-walk traffic model (per point-eval; 32-level walk, "
+        "u64, EvaluateAt captures=1 / DCF captures=33)"
+    )
+    print(
+        f"{'strategy':22s} {'B/pt':>8s} {'HBM ceiling pt/s':>18s} "
+        f"{'VPU ceiling pt/s':>18s} {'binding wall':>13s}"
+    )
+    for strat, caps, label in (
+        ("walk", 1, "walk (evaluate_at)"),
+        ("walkkernel", 1, "walkkernel (eval_at)"),
+        ("walk", 33, "walk (dcf)"),
+        ("walkkernel", 33, "walkkernel (dcf)"),
+    ):
+        f = walk_hbm_fields(1.0, 32, strat, lpe=2, captures=caps)
+        print(
+            f"{label:22s} {f['walk_hbm_bytes_per_point_model']:8.2f} "
+            f"{f['walk_hbm_ceiling_points_per_sec']:18.3e} "
+            f"{f['walk_vpu_ceiling_points_per_sec']:18.3e} "
+            f"{f['walk_binding_wall']:>13s}"
+        )
     return 0
 
 

@@ -240,6 +240,76 @@ def test_megakernel_program_budget(program_counter, monkeypatch):
 
 
 @pytest.mark.slow
+def test_walkkernel_program_budget(program_counter, monkeypatch):
+    """ISSUE 4: mode='walkkernel' is EXACTLY one device program per chunk
+    on all three point-walk entry points — evaluate_at_batch, DCF
+    batch_evaluate, and MIC batch_eval (which rides the DCF path) — with
+    the pipelined executor on AND off. The whole point of the walk
+    megakernel is collapsing the per-level dispatch train (one program
+    per tree level, 20-128 levels) into one program per chunk; the cheap
+    `_aes_rows` stand-in keeps the interpret compile tractable — the
+    program COUNT is circuit-independent."""
+    import jax
+
+    from distributed_point_functions_tpu.gates.mic import (
+        MultipleIntervalContainmentGate,
+    )
+    from distributed_point_functions_tpu.ops import aes_pallas
+    from test_aes_pallas import _CheapRows
+
+    jax.clear_caches()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    try:
+        dpf = DistributedPointFunction.create(DpfParameters(6, Int(64)))
+        keys, _ = dpf.generate_keys_batch([5, 9, 30, 51], [[1, 2, 3, 4]])
+        pts = [int(x) for x in np.random.default_rng(1).integers(0, 1 << 6, 48)]
+        dc = DistributedComparisonFunction.create(4, Int(64))
+        dk, _ = dc.generate_keys_batch([7, 9, 3, 1], [4, 5, 6, 7])
+        xs = [int(x) for x in np.random.default_rng(2).integers(0, 1 << 4, 24)]
+        gate = MultipleIntervalContainmentGate.create(3, [(1, 5)])
+        mk, _ = gate.gen(2, [3])
+
+        for pipe in (False, True):
+            tag = f"[pipeline={'on' if pipe else 'off'}]"
+            for name, fn, want in (
+                (
+                    f"evaluate_at_batch[walkkernel,2chunks]{tag}",
+                    lambda: evaluator.evaluate_at_batch(
+                        dpf, keys, pts, key_chunk=2, pipeline=pipe,
+                        mode="walkkernel",
+                    ),
+                    2,
+                ),
+                (
+                    f"dcf.batch_evaluate[walkkernel,2chunks]{tag}",
+                    lambda: dcf_batch.batch_evaluate(
+                        dc, dk, xs, key_chunk=2, pipeline=pipe,
+                        mode="walkkernel",
+                    ),
+                    2,
+                ),
+                (
+                    f"mic.batch_eval[walkkernel]{tag}",
+                    lambda: gate.batch_eval(
+                        mk, [0, 4, 7], mode="walkkernel", pipeline=pipe
+                    ),
+                    1,
+                ),
+            ):
+                fn()  # warm: compiles + constant uploads are allowed
+                program_counter["programs"] = 0
+                fn()
+                got = program_counter["programs"]
+                assert got == want, (
+                    f"{name}: {got} device programs (pinned at EXACTLY "
+                    f"{want} — one per chunk; the walk megakernel exists to "
+                    "collapse the per-level dispatch train)"
+                )
+    finally:
+        jax.clear_caches()  # drop cheap-circuit traces
+
+
+@pytest.mark.slow
 def test_pipelined_dcf_and_pir_program_budget(program_counter):
     """Slow-tier half of the ISSUE 2 pipelined budgets: DCF batch walk and
     single-device chunked PIR (fold mode), pipeline OFF and ON."""

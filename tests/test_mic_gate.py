@@ -4,6 +4,7 @@ plaintext interval predicate.
 Mirrors /root/reference/dcf/fss_gates/multiple_interval_containment_test.cc:37-208.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -37,6 +38,93 @@ def test_mic_gate_share_sum(log_group_size):
             # reconstructed output is predicate + r_out; remove the mask
             got = (res0[i] + res1[i] - r_outs[i]) % n
             assert got == want[i], (i, x_real)
+
+
+def test_mic_gate_walkkernel_replay_matches_host():
+    """ISSUE 4 satellite: MIC through the Pallas walk path, fast-tier
+    host-oracle differential. The eager REAL-circuit replay of the gate's
+    single walk-megakernel DCF pass (`walk_megakernel_reference_rows`
+    runs the exact `_walk_megakernel_core` the pallas kernel executes —
+    the test split tests/test_walkkernel.py documents) followed by the
+    gate's combine must reproduce `gate.eval`'s host shares for BOTH
+    parties — the gate's Int(128) additive codec (lpe=4 carry chains,
+    party-1 negation) is walk-megakernel code no other suite touches."""
+    from test_walkkernel import _dcf_inputs, _replay_points
+
+    log_group_size = 3
+    n = 1 << log_group_size
+    intervals = [(1, 5), (0, n - 1)]
+    m = len(intervals)
+    gate = MultipleIntervalContainmentGate.create(log_group_size, intervals)
+    k0, k1 = gate.gen(2, [3, 6])
+    xs = [0, 3, 5, n - 1]
+    all_points = []
+    for x in xs:
+        all_points.extend(gate._eval_points(int(x)))
+    for key in (k0, k1):
+        (batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc,
+         epb, captures) = _dcf_inputs(gate.dcf, [key.dcf_key], all_points, 128)
+        with jax.disable_jit():
+            vals = _replay_points(
+                path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc, 0,
+                plan, 128, batch.party, False, epb, captures=captures,
+            )[: len(all_points)]
+        values = [
+            int(v[0]) | int(v[1]) << 32 | int(v[2]) << 64 | int(v[3]) << 96
+            for v in vals
+        ]
+        for xi, x in enumerate(xs):
+            host = gate.eval(key, x)
+            for i in range(m):
+                s_p = values[2 * m * xi + 2 * i] % n
+                s_q_prime = values[2 * m * xi + 2 * i + 1] % n
+                got = gate._combine(key, int(x), s_p, s_q_prime, i)
+                assert got == host[i], (batch.party, x, i)
+
+
+@pytest.mark.slow
+def test_mic_gate_batch_eval_walkkernel_wiring(monkeypatch):
+    """mic.batch_eval(engine='device', mode='walkkernel') end to end with
+    the cheap circuit: the kwargs pass-through (mic -> dcf.batch_evaluate
+    -> the walk megakernel) must produce exactly the shares the
+    cheap-circuit replay pipeline produces (the real-circuit math is
+    pinned by test_mic_gate_walkkernel_replay_matches_host; composition
+    per the test_walkkernel.py split)."""
+    from distributed_point_functions_tpu.ops import aes_pallas, evaluator
+    from test_aes_pallas import _CheapRows
+    from test_walkkernel import _dcf_inputs, _replay_points
+
+    jax.clear_caches()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    try:
+        log_group_size = 3
+        n = 1 << log_group_size
+        intervals = [(1, 5)]
+        gate = MultipleIntervalContainmentGate.create(log_group_size, intervals)
+        k0, _ = gate.gen(2, [3])
+        xs = [0, 4, 7]
+        out = gate.batch_eval(k0, xs, mode="walkkernel")
+        all_points = []
+        for x in xs:
+            all_points.extend(gate._eval_points(int(x)))
+        (batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc,
+         epb, captures) = _dcf_inputs(gate.dcf, [k0.dcf_key], all_points, 128)
+        with jax.disable_jit():
+            vals = _replay_points(
+                path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc, 0,
+                plan, 128, batch.party, False, epb, captures=captures,
+            )[: len(all_points)]
+        values = [
+            int(v[0]) | int(v[1]) << 32 | int(v[2]) << 64 | int(v[3]) << 96
+            for v in vals
+        ]
+        for xi, x in enumerate(xs):
+            s_p = values[2 * xi] % n
+            s_q_prime = values[2 * xi + 1] % n
+            want = gate._combine(k0, int(x), s_p, s_q_prime, 0)
+            assert out[xi, 0] == want, (x, out[xi, 0], want)
+    finally:
+        jax.clear_caches()  # drop cheap-circuit traces
 
 
 @pytest.mark.slow

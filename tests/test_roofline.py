@@ -59,3 +59,41 @@ class TestMfu:
         assert f["roofline_ceiling_evals_per_sec"] * per_eval == pytest.approx(
             roofline.V5E_VPU_OPS_PER_SEC, rel=1e-3
         )
+
+
+class TestWalkTrafficModel:
+    """ISSUE 4: the point-walk HBM traffic model behind the walkkernel
+    A/B records (bench_evaluate_at / bench_dcf / bench.py mode="walk")."""
+
+    def test_walkkernel_eliminates_per_level_traffic(self):
+        # The per-level walk round-trips plane state per level; the walk
+        # megakernel's traffic is level-count-independent (output + masks
+        # only), so the ratio grows with tree depth.
+        for levels in (8, 32, 128):
+            walk = roofline.walk_hbm_bytes_per_point(levels, "walk")
+            wk = roofline.walk_hbm_bytes_per_point(levels, "walkkernel")
+            assert walk > 30 * levels  # dominated by 32 B/level plane trips
+            assert wk < 32  # output write + packed masks, no plane state
+        with pytest.raises(ValueError):
+            roofline.walk_hbm_bytes_per_point(32, "fold")
+
+    def test_walk_fields_shape(self):
+        f = roofline.walk_hbm_fields(5.9e6, 32, "walk", captures=1)
+        g = roofline.walk_hbm_fields(5.9e6, 32, "walkkernel", captures=33)
+        for d in (f, g):
+            assert d["walk_hbm_bytes_per_point_model"] > 0
+            assert d["walk_vpu_ceiling_points_per_sec"] > 0
+            assert d["walk_binding_wall"] in ("vpu", "hbm")
+            assert 0 < d["walk_mfu_estimate"] < 1
+            # every key is walk_-prefixed: records can carry this model
+            # next to the full-domain one without key collisions
+            assert all(key.startswith("walk_") for key in d)
+        # hashes/point scale with captures -> DCF ceiling is lower
+        assert (
+            g["walk_vpu_ceiling_points_per_sec"]
+            < f["walk_vpu_ceiling_points_per_sec"]
+        )
+
+    def test_walk_hashes_per_point(self):
+        assert roofline.walk_hashes_per_point(32) == 33.0
+        assert roofline.walk_hashes_per_point(32, captures=33) == 65.0

@@ -1,0 +1,509 @@
+"""Walk megakernel (ISSUE 4): single-program in-register tree walks for
+EvaluateAt, DCF BatchEvaluate and the walk-driven gates.
+
+Testing strategy follows the row kernels' / slab megakernel's established
+split (tests/test_megakernel.py): the REAL row AES circuit cannot execute
+through an interpret-mode pallas_call in CI time, so
+
+* the walk-megakernel MATH — in-register walk with path-bit key select,
+  leaf capture, DCF per-depth capture/accumulate with the additive carry
+  chain and party-1 negation, block-element selection — is pinned
+  bit-exact against the HOST ORACLE through
+  `walk_megakernel_reference_rows`, the pure-array replay running the
+  SAME `_walk_megakernel_core` eagerly (jax.disable_jit);
+* the pallas_call PLUMBING — (keys, point-tiles) grid, BlockSpec tiling
+  of the path/select masks, the value-row output layout, the jit
+  transpose back to [K, P, lpe], chunking and the pipelined executor —
+  runs in interpret mode with the cheap `_aes_rows` stand-in through the
+  REAL entry points and must match the replay under the same stand-in.
+
+Compile budget: every distinct interpret-pallas config costs ~1 min of
+XLA-CPU compile, so the fast tier runs ONE compiled config per entry
+point (multi-tile plans forced through DPF_TPU_WALKKERNEL_VMEM) with all
+equivalence variants (pipeline, env default, device_output) sharing that
+compile; the program-count audits live in test_dispatch_audit.py's slow
+tier with the other point-path audits.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_point_functions_tpu.core import uint128
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, IntModN, XorWrapper
+from distributed_point_functions_tpu.dcf import batch as dcf_batch
+from distributed_point_functions_tpu.dcf.dcf import DistributedComparisonFunction
+from distributed_point_functions_tpu.ops import (
+    aes_jax,
+    aes_pallas,
+    backend_jax,
+    evaluator,
+    value_codec,
+)
+from distributed_point_functions_tpu.utils import integrity
+from test_aes_pallas import _CheapRows
+
+RNG = np.random.default_rng(0x3A1F)
+
+# Forces multi-tile plans at toy point counts (tile_words >= 8 floor, so
+# ~256+ points split into several tiles) — the interesting grid structure.
+TINY_VMEM = 200_000
+
+
+@pytest.fixture
+def cheap_rows(monkeypatch):
+    jax.clear_caches()  # jitted wrappers may hold real-circuit traces
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    yield
+    jax.clear_caches()  # drop cheap-circuit traces before the next test
+
+
+def _evalat_inputs(dpf, keys, pts, bits, vmem_budget=None):
+    """Host prep mirroring evaluate_at_batch's walkkernel path for a
+    direct replay drive: returns (batch, plan, path_masks, sel_bits,
+    seed_cols, cw, ccl, ccr, corr, keep)."""
+    v = dpf.validator
+    hl = v.num_hierarchy_levels - 1
+    batch = evaluator.KeyBatch.from_keys(dpf, keys)
+    num_levels = batch.num_levels
+    lds = v.parameters[hl].log_domain_size
+    keep = 1 << (lds - num_levels)
+    bsel = np.array(
+        [v.domain_to_block_index(int(pt), hl) for pt in pts], np.int32
+    )
+    paths = uint128.array_to_limbs(
+        [v.domain_to_tree_index(int(pt), hl) for pt in pts]
+    )
+    plan = evaluator.plan_walkkernel(
+        len(pts), num_levels, bits // 32, vmem_budget=vmem_budget
+    )
+    p_pad = plan.padded_words * 32
+    path_masks = backend_jax._path_bit_masks(paths, num_levels, p_pad)
+    sel_bool = np.zeros((keep, p_pad), dtype=bool)
+    sel_bool[bsel, np.arange(len(pts))] = True
+    sel_bits = aes_jax.pack_bit_mask(sel_bool)
+    seed_cols = backend_jax.cw_seed_planes(batch.seeds)
+    cw, ccl, ccr = batch.device_cw_arrays()
+    corr = evaluator._correction_limbs(batch.value_corrections, bits)
+    return batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr, keep
+
+
+def _replay_points(path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr, i,
+                   plan, bits, party, xor_group, keep, captures=None):
+    """walk_megakernel_reference_rows for key i -> [P_pad, lpe] limbs."""
+    out = np.asarray(
+        aes_pallas.walk_megakernel_reference_rows(
+            jnp.asarray(seed_cols[i]),
+            jnp.asarray(path_masks),
+            jnp.asarray(cw[i]),
+            jnp.asarray(ccl[i]),
+            jnp.asarray(ccr[i]),
+            jnp.asarray(corr[i]),
+            jnp.asarray(sel_bits),
+            bits=bits,
+            party=party,
+            xor_group=xor_group,
+            keep=keep,
+            captures=captures,
+        )
+    )
+    lpe = bits // 32
+    return (
+        out.reshape(lpe, 32, plan.padded_words)
+        .transpose(2, 1, 0)
+        .reshape(plan.padded_words * 32, lpe)
+    )
+
+
+def _dcf_inputs(dcf, keys, xs, bits, vmem_budget=None):
+    """Host prep mirroring _batch_evaluate_walkkernel for a replay drive."""
+    v = dcf.dpf.validator
+    T = v.hierarchy_to_tree[v.num_hierarchy_levels - 1]
+    lpe = bits // 32
+    epb = dcf.value_type.elements_per_block()
+    plan = evaluator.plan_walkkernel(
+        len(xs), T, lpe, captures=True, vmem_budget=vmem_budget
+    )
+    p_pad = plan.padded_words * 32
+    batch, paths, acc_mask, block_sel, d2h = dcf_batch._prep_points(
+        dcf, keys, xs, p_pad
+    )
+    path_masks = backend_jax._path_bit_masks(paths, T, p_pad)
+    captures = tuple(i >= 0 for i in d2h)
+    vc_full = dcf_batch._value_corrections_all(dcf, keys, d2h)
+    vc = evaluator._correction_limbs(
+        vc_full.reshape(len(keys) * (T + 1), -1, 4), bits
+    ).reshape(len(keys), (T + 1) * epb, lpe)
+    sel_bool = np.zeros((T + 1, epb, p_pad), dtype=bool)
+    pts = np.arange(len(xs))
+    for d in range(T + 1):
+        if captures[d]:
+            sel_bool[d, block_sel[d, : len(xs)], pts] = acc_mask[
+                d, : len(xs)
+            ].astype(bool)
+    sel_bits = aes_jax.pack_bit_mask(sel_bool.reshape((T + 1) * epb, p_pad))
+    seed_cols = backend_jax.cw_seed_planes(batch.seeds)
+    cw, ccl, ccr = batch.device_cw_arrays()
+    return batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc, epb, captures
+
+
+def _u64(vals):
+    return vals[:, 0].astype(np.uint64) | (
+        vals[:, 1].astype(np.uint64) << np.uint64(32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Component pins (plain arrays, fast)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bits", [32, 64, 128])
+def test_rows_limb_helpers_match_xla(bits):
+    """rows_limb_add / rows_limb_neg (the walk megakernel's DCF
+    accumulate) carry-chain-match the XLA `_limb_add`/`_limb_neg`."""
+    lpe = bits // 32
+    n = 64
+    a = RNG.integers(0, 2**32, size=(n, lpe), dtype=np.uint32)
+    b = RNG.integers(0, 2**32, size=(n, lpe), dtype=np.uint32)
+    want_add = np.asarray(
+        evaluator._limb_add(jnp.asarray(a), jnp.asarray(b), bits)
+    ).reshape(n, lpe)
+    got_add = np.stack(
+        [
+            np.asarray(r)
+            for r in value_codec.rows_limb_add(
+                [jnp.asarray(a[:, l]) for l in range(lpe)],
+                [jnp.asarray(b[:, l]) for l in range(lpe)],
+                bits,
+            )
+        ],
+        axis=-1,
+    )
+    np.testing.assert_array_equal(got_add, want_add)
+    want_neg = np.asarray(
+        evaluator._limb_neg(jnp.asarray(a), bits)
+    ).reshape(n, lpe)
+    got_neg = np.stack(
+        [
+            np.asarray(r)
+            for r in value_codec.rows_limb_neg(
+                [jnp.asarray(a[:, l]) for l in range(lpe)], bits
+            )
+        ],
+        axis=-1,
+    )
+    np.testing.assert_array_equal(got_neg, want_neg)
+    with pytest.raises(NotImplementedError):
+        value_codec.rows_limb_add([], [], 8)
+    with pytest.raises(NotImplementedError):
+        value_codec.rows_limb_neg([], 48)
+
+
+def test_plan_walkkernel_bounds():
+    """Planner pins: 8-word (sublane) granularity for small point counts,
+    power-of-two >= 128-word tiles for multi-tile plans, full coverage,
+    vreg-filling tiles (>= 1024 words) at the default budget for large
+    point batches, and the no-level rejection."""
+    for p in (1, 20, 256, 4096, 100_000):
+        for lpe, caps in ((2, False), (4, True)):
+            plan = evaluator.plan_walkkernel(p, 24, lpe, captures=caps)
+            w = -(-p // 32)
+            assert plan.padded_words >= w
+            assert plan.tile_words * plan.num_tiles == plan.padded_words
+            assert plan.levels == 24
+            if plan.num_tiles > 1:
+                assert plan.tile_words >= 128
+                assert plan.tile_words & (plan.tile_words - 1) == 0
+            else:
+                assert plan.tile_words % 8 == 0
+                assert plan.padded_words - w < 8  # minimal padding
+    # default budget fills (8, 128) vregs for large point batches
+    plan = evaluator.plan_walkkernel(1_000_000, 32, 2)
+    assert plan.tile_words >= 1024
+    # tiny budgets split into multiple tiles (tile floor is 128 words)
+    plan = evaluator.plan_walkkernel(8192, 8, 2, vmem_budget=TINY_VMEM)
+    assert plan.num_tiles >= 2
+    with pytest.raises(Exception):
+        evaluator.plan_walkkernel(64, 0, 2)
+
+
+# ---------------------------------------------------------------------------
+# Real circuit vs the host oracle (eager replay)
+# ---------------------------------------------------------------------------
+
+
+def test_walkkernel_replay_matches_host_oracle_evaluate_at_u64():
+    """EvaluateAt form, Int(64) (keep=2: block-element selection live),
+    REAL circuit, both parties — the replay == the reference host
+    evaluator at every point, including alpha."""
+    lds = 5
+    dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+    alpha = 21
+    ka, kb = dpf.generate_keys(alpha, 0x1234567890ABCDEF)
+    pts = [alpha, (alpha + 1) % (1 << lds)] + [
+        int(x) for x in RNG.integers(0, 1 << lds, size=20)
+    ]
+    for key, party in ((ka, 0), (kb, 1)):
+        (batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr,
+         keep) = _evalat_inputs(dpf, [key], pts, 64)
+        assert keep == 2  # the element-select masks are exercised
+        with jax.disable_jit():
+            vals = _replay_points(
+                path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr, 0,
+                plan, 64, party, False, keep,
+            )[: len(pts)]
+        want = np.array(dpf.evaluate_at(key, 0, pts), dtype=np.uint64)
+        np.testing.assert_array_equal(_u64(vals), want)
+
+
+def test_walkkernel_replay_matches_host_oracle_evaluate_at_u128():
+    """EvaluateAt form, XorWrapper(128) (keep=1, XOR codec, lpe=4), REAL
+    circuit."""
+    lds = 4
+    dpf = DistributedPointFunction.create(DpfParameters(lds, XorWrapper(128)))
+    alpha, beta = 11, (1 << 128) - 0xDEADBEEF
+    ka, _ = dpf.generate_keys(alpha, beta)
+    pts = [alpha] + [int(x) for x in RNG.integers(0, 1 << lds, size=15)]
+    (batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr,
+     keep) = _evalat_inputs(dpf, [ka], pts, 128)
+    assert keep == 1
+    with jax.disable_jit():
+        vals = _replay_points(
+            path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr, 0,
+            plan, 128, 0, True, keep,
+        )[: len(pts)]
+    want = dpf.evaluate_at(ka, 0, pts)
+    got = [
+        int(v[0]) | int(v[1]) << 32 | int(v[2]) << 64 | int(v[3]) << 96
+        for v in vals
+    ]
+    assert got == [int(w) for w in want]
+
+
+def test_walkkernel_replay_matches_host_oracle_dcf():
+    """DCF form, Int(64), REAL circuit, both parties: per-depth value
+    capture, the in-register additive accumulate across depths, and the
+    party-1 negation — the replay == the reference per-point DCF
+    evaluator (boundary points around alpha included)."""
+    lds = 4
+    dcf = DistributedComparisonFunction.create(lds, Int(64))
+    alpha = 9
+    ka, kb = dcf.generate_keys(alpha, 4242)
+    xs = [0, alpha - 1, alpha, alpha + 1, (1 << lds) - 1] + [
+        int(x) for x in RNG.integers(0, 1 << lds, size=8)
+    ]
+    for key, party in ((ka, 0), (kb, 1)):
+        (batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc,
+         epb, captures) = _dcf_inputs(dcf, [key], xs, 64)
+        with jax.disable_jit():
+            vals = _replay_points(
+                path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc, 0,
+                plan, 64, party, False, epb, captures=captures,
+            )[: len(xs)]
+        want = np.array([dcf.evaluate(key, x) for x in xs], dtype=np.uint64)
+        np.testing.assert_array_equal(_u64(vals), want)
+
+
+# ---------------------------------------------------------------------------
+# Interpret-mode pallas plumbing (cheap circuit) through the REAL entry
+# points, one compiled config each — every variant shares the compile
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_at_batch_walkkernel_entry_interpret(cheap_rows, monkeypatch):
+    """evaluate_at_batch(mode='walkkernel') on a forced multi-tile plan:
+    the pallas grid/BlockSpec plumbing, value-row transpose, chunk
+    padding, pipelined executor, device_output and the DPF_TPU_WALKKERNEL
+    env default are all bit-exact vs the eager cheap replay (one compiled
+    program; equivalence variants reuse it)."""
+    monkeypatch.setenv("DPF_TPU_WALKKERNEL_VMEM", str(TINY_VMEM))
+    lds = 5
+    dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+    keys, _ = dpf.generate_keys_batch([3, 14, 27], [[5, 9, 3]])
+    # > 4096 points so the 128-word tile floor still splits into 2 tiles
+    # under the tiny budget (interpret executes the padded lanes
+    # vectorized — cheap).
+    pts = [int(x) for x in RNG.integers(0, 1 << lds, size=4400)]
+
+    (batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr,
+     keep) = _evalat_inputs(dpf, keys, pts, 64, vmem_budget=TINY_VMEM)
+    assert plan.num_tiles >= 2, plan  # the tiny budget must split tiles
+
+    base = evaluator.evaluate_at_batch(
+        dpf, keys, pts, mode="walkkernel", key_chunk=2, pipeline=False
+    )
+    assert base.shape == (3, len(pts), 2)
+    with jax.disable_jit():
+        for i in range(len(keys)):
+            ref = _replay_points(
+                path_masks, sel_bits, seed_cols, cw, ccl, ccr, corr, i,
+                plan, 64, batch.party, False, keep,
+            )[: len(pts)]
+            np.testing.assert_array_equal(base[i], ref)
+    # pipelined executor must not change results (same compiled program)
+    np.testing.assert_array_equal(
+        evaluator.evaluate_at_batch(
+            dpf, keys, pts, mode="walkkernel", key_chunk=2, pipeline=True
+        ),
+        base,
+    )
+    # device-resident output variant
+    dev = evaluator.evaluate_at_batch(
+        dpf, keys, pts, mode="walkkernel", key_chunk=2, pipeline=False,
+        device_output=True,
+    )
+    np.testing.assert_array_equal(np.asarray(dev), base)
+    # env default: DPF_TPU_WALKKERNEL=1 + mode=None resolves to walkkernel
+    monkeypatch.setenv("DPF_TPU_WALKKERNEL", "1")
+    np.testing.assert_array_equal(
+        evaluator.evaluate_at_batch(
+            dpf, keys, pts, key_chunk=2, pipeline=False
+        ),
+        base,
+    )
+    monkeypatch.delenv("DPF_TPU_WALKKERNEL")
+
+
+def test_dcf_batch_evaluate_walkkernel_entry_interpret(cheap_rows, monkeypatch):
+    """dcf.batch_evaluate(mode='walkkernel') on a forced multi-tile plan:
+    per-depth capture plumbing (flattened correction/select rows, the
+    captures static tuple), chunking, the pipelined executor and the env
+    default — bit-exact vs the eager cheap replay (one compiled
+    program)."""
+    monkeypatch.setenv("DPF_TPU_WALKKERNEL_VMEM", str(TINY_VMEM))
+    lds = 3
+    dcf = DistributedComparisonFunction.create(lds, Int(64))
+    ka, kb = dcf.generate_keys(5, 777)
+    xs = [int(x) for x in RNG.integers(0, 1 << lds, size=4400)]
+
+    (batch, plan, path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc, epb,
+     captures) = _dcf_inputs(dcf, [ka], xs, 64, vmem_budget=TINY_VMEM)
+    assert plan.num_tiles >= 2, plan
+
+    base = dcf_batch.batch_evaluate(dcf, [ka], xs, mode="walkkernel")
+    assert base.shape == (1, len(xs), 2)
+    with jax.disable_jit():
+        ref = _replay_points(
+            path_masks, sel_bits, seed_cols, cw, ccl, ccr, vc, 0,
+            plan, 64, batch.party, False, epb, captures=captures,
+        )[: len(xs)]
+    np.testing.assert_array_equal(base[0], ref)
+    # chunked + pipelined (same program: one key per chunk)
+    np.testing.assert_array_equal(
+        dcf_batch.batch_evaluate(
+            dcf, [ka], xs, mode="walkkernel", key_chunk=1, pipeline=True
+        ),
+        base,
+    )
+    # env default
+    monkeypatch.setenv("DPF_TPU_WALKKERNEL", "1")
+    np.testing.assert_array_equal(
+        dcf_batch.batch_evaluate(dcf, [ka], xs), base
+    )
+    monkeypatch.delenv("DPF_TPU_WALKKERNEL")
+
+
+# ---------------------------------------------------------------------------
+# Mode plumbing and guards (no kernel execution — fast)
+# ---------------------------------------------------------------------------
+
+
+def test_walkkernel_mode_guards():
+    dpf = DistributedPointFunction.create(DpfParameters(6, Int(64)))
+    keys, _ = dpf.generate_keys_batch([3], [[5]])
+    with pytest.raises(Exception):
+        evaluator.evaluate_at_batch(dpf, keys, [1, 2], mode="nope")
+    # explicit walkkernel on codec value types raises...
+    dpfn = DistributedPointFunction.create(
+        DpfParameters(6, IntModN(32, (1 << 32) - 5))
+    )
+    kn, _ = dpfn.generate_keys(3, 7)
+    with pytest.raises(NotImplementedError):
+        evaluator.evaluate_at_batch(dpfn, [kn], [1, 2], mode="walkkernel")
+    # ...but the env-driven default falls back to the walk path quietly
+    os.environ["DPF_TPU_WALKKERNEL"] = "1"
+    try:
+        out = evaluator.evaluate_at_batch(dpfn, [kn], [1, 2])
+        assert np.asarray(out).shape[0] == 1
+    finally:
+        del os.environ["DPF_TPU_WALKKERNEL"]
+    # sub-word DCF values: explicit raises, env default falls back
+    dc8 = DistributedComparisonFunction.create(4, Int(8))
+    k8, _ = dc8.generate_keys(3, 1)
+    with pytest.raises(NotImplementedError):
+        dcf_batch.batch_evaluate(dc8, [k8], [1], mode="walkkernel")
+    os.environ["DPF_TPU_WALKKERNEL"] = "1"
+    try:
+        out = dcf_batch.batch_evaluate(dc8, [k8], [1, 2])
+        assert out.shape == (1, 2, 1)
+    finally:
+        del os.environ["DPF_TPU_WALKKERNEL"]
+    # host engine rejects device kwargs instead of ignoring them
+    with pytest.raises(Exception):
+        dc8.batch_evaluate([k8], [1], engine="host", mode="walkkernel")
+    # zero-level trees: the walk megakernel needs >= 1 level — explicit
+    # raises, the env-driven A/B default must never turn a previously
+    # working call into an error (quiet "walk" fallback).
+    dpf1 = DistributedPointFunction.create(DpfParameters(1, Int(64)))
+    k1a, _ = dpf1.generate_keys(1, 5)
+    assert dpf1.validator.hierarchy_to_tree[-1] == 0  # the trivial tree
+    with pytest.raises(Exception):
+        evaluator.evaluate_at_batch(dpf1, [k1a], [0, 1], mode="walkkernel")
+    os.environ["DPF_TPU_WALKKERNEL"] = "1"
+    try:
+        out = evaluator.evaluate_at_batch(dpf1, [k1a], [0, 1])
+        assert np.asarray(out).shape[:2] == (1, 2)
+    finally:
+        del os.environ["DPF_TPU_WALKKERNEL"]
+    # the env A/B default also yields to an explicit use_pallas=False: a
+    # caller qualifying the XLA engine (CHECK_PALLAS=0) must not silently
+    # get the Mosaic walk kernel.
+    assert evaluator._resolve_walk_mode(None, True, 64, 5) == "walk"
+    os.environ["DPF_TPU_WALKKERNEL"] = "1"
+    try:
+        assert (
+            evaluator._resolve_walk_mode(None, True, 64, 5) == "walkkernel"
+        )
+        assert (
+            evaluator._resolve_walk_mode(None, True, 64, 5, use_pallas=False)
+            == "walk"
+        )
+        # an EXPLICIT mode still wins over the explicit engine knob
+        assert (
+            evaluator._resolve_walk_mode(
+                "walkkernel", True, 64, 5, use_pallas=False
+            )
+            == "walkkernel"
+        )
+    finally:
+        del os.environ["DPF_TPU_WALKKERNEL"]
+
+
+def test_dcf_narrow_batch_downgrade_emits_event(monkeypatch):
+    """ISSUE 4 satellite: the p_pad//32 < 8 auto-downgrade from the
+    Pallas walk to the XLA scan now emits a structured IntegrityEvent, so
+    device A/B runs can tell "kernel lost" from "kernel never ran"."""
+    dc = DistributedComparisonFunction.create(6, Int(64))
+    ka, _ = dc.generate_keys(9, 11)
+    xs = [1, 2, 3]  # 3 points -> 1 lane word, far under the 8-word gate
+    # Platform default says Pallas (as on a real TPU) -> downgrade fires.
+    monkeypatch.setattr(evaluator, "_pallas_default", lambda: True)
+    with integrity.capture_events() as events:
+        out = dcf_batch.batch_evaluate(dc, [ka], xs, mode="walk")
+    assert out.shape == (1, 3, 2)
+    kinds = [e.kind for e in events]
+    assert "engine-downgrade" in kinds, kinds
+    ev = events[kinds.index("engine-downgrade")]
+    assert ev.data["lane_words"] == 1
+    assert ev.data["downgraded_to"] == "jax"
+    # CPU platform default (no Pallas) -> nothing to downgrade, no event.
+    monkeypatch.setattr(evaluator, "_pallas_default", lambda: False)
+    with integrity.capture_events() as events:
+        dcf_batch.batch_evaluate(dc, [ka], xs, mode="walk")
+    assert "engine-downgrade" not in [e.kind for e in events]

@@ -94,11 +94,15 @@ def _main() -> int:
     ]
     # Execution strategy under test: "levels" (per-level dispatch, the
     # default), "fused" (single program per chunk), "walk" (leaf-path
-    # walk), "fold" (in-program consumer) or "megakernel" (the slab
+    # walk), "fold" (in-program consumer), "megakernel" (the slab
     # Mosaic kernel with the fold accumulated in-kernel, ISSUE 3 —
     # CHECK_MODE=megakernel is the hardware gate for the whole megakernel
     # family, since interpret mode cannot execute the real row circuit in
-    # CI time) — the program shapes fail independently on a broken
+    # CI time) or "walkkernel" (the single-program point-walk megakernel,
+    # ISSUE 4: evaluate_at_batch + DCF batch_evaluate differentials vs
+    # the host oracle — the hardware gate for the walk-megakernel family,
+    # CHECK_MODE=walkkernel from tools/tpu_measure.sh's gate-walkkernel
+    # stage) — the program shapes fail independently on a broken
     # backend (PERF.md). This tool measures the RAW platform:
     # auto-slabbing would hide exactly the over-threshold programs being
     # probed, so it is force-disabled regardless of the caller's

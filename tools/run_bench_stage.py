@@ -50,10 +50,14 @@ import run_all  # noqa: E402  (benchmarks/run_all.py — the merge)
 
 def _maybe_supersede(rec, target_bench, results_path):
     """SUPERSEDES handling: when `rec` is a *verified device* record whose
-    value beats the stored record `target_bench` on the same platform,
-    mark the beaten record superseded IN PLACE (never delete — the
-    provenance trail is the point). No-op when the new record is
-    unverified, CPU, errored, or slower."""
+    value beats the stored record `target_bench`, mark the beaten record
+    superseded IN PLACE (never delete — the provenance trail is the
+    point). No-op when the new record is unverified, CPU, errored, or
+    slower. Same-platform records supersede silently; a verified device
+    record may also supersede a stored cpu/host-engine record — that is
+    an ENGINE-TABLE FLIP (ISSUE 4: a walkkernel device record beating the
+    dcf_batch host headline), recorded with an explicit cross-engine
+    caveat rather than blocked."""
     platform = rec.get("platform") or ""
     cfg = rec.get("config") or {}
     verified = (
@@ -78,7 +82,11 @@ def _maybe_supersede(rec, target_bench, results_path):
     for e in stored:
         if not isinstance(e, dict) or e.get("bench") != target_bench:
             continue
-        if e.get("platform") != platform or e.get("superseded"):
+        stored_platform = e.get("platform") or ""
+        cross_engine = stored_platform.startswith("cpu")
+        if (stored_platform != platform and not cross_engine) or e.get(
+            "superseded"
+        ):
             continue
         try:
             if float(rec.get("value", 0)) <= float(e.get("value", 0)):
@@ -90,11 +98,18 @@ def _maybe_supersede(rec, target_bench, results_path):
             (e.get("caveat", "") + "; " if e.get("caveat") else "")
             + f"superseded by the verified {rec.get('bench')} record of "
             f"{rec.get('date')} ({rec.get('value')} {rec.get('unit', '')})"
+            + (
+                f" — engine flip: device record beats this {stored_platform}"
+                " host-engine record"
+                if cross_engine
+                else ""
+            )
         )
         changed = True
         print(
-            f"# superseded stored record {target_bench}@{platform} "
-            f"({e.get('value')}) by {rec.get('bench')} ({rec.get('value')})",
+            f"# superseded stored record {target_bench}@{stored_platform} "
+            f"({e.get('value')}) by {rec.get('bench')} ({rec.get('value')})"
+            + (" [engine flip]" if cross_engine else ""),
             file=sys.stderr,
         )
     if changed:

@@ -105,6 +105,26 @@ BENCH_PIR_MODE=megakernel \
   stage pir_megakernel 1800 python tools/run_bench_stage.py bench_pir.py \
   RECORD_SUFFIX=_megakernel SUPERSEDES=pir
 
+# 2b'. Walk-megakernel A/B records (ISSUE 4), same discipline: the
+# correctness gate first (CHECK_MODE=walkkernel differential-verifies
+# evaluate_at + DCF through the single-program walk kernel on-chip —
+# interpret mode cannot execute the real row circuit in CI time), then
+# the EvaluateAt and DCF benches on the walkkernel strategy in their own
+# results.json slots. SUPERSEDES retires the beaten evaluate_at /
+# dcf_batch records in place when the walkkernel record is a verified
+# device measurement that beats them (for dcf_batch the stored headline
+# is the HOST engine — a verified faster device record flips that
+# engine-table row, which run_bench_stage's cross-engine supersede
+# records explicitly).
+CHECK_MODE=walkkernel CHECK_SHAPES=16x14,64x18 \
+  stage gate-walkkernel 900 python tools/check_device.py
+BENCH_EVALAT_MODE=walkkernel \
+  stage evaluate_at_walkkernel 1500 python tools/run_bench_stage.py bench_evaluate_at.py \
+  RECORD_SUFFIX=_walkkernel SUPERSEDES=evaluate_at
+BENCH_DCF_MODE=walkkernel \
+  stage dcf_walkkernel 1500 python tools/run_bench_stage.py bench_dcf.py \
+  RECORD_SUFFIX=_walkkernel SUPERSEDES=dcf_batch
+
 # 2c. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
 # the pipelined chunk executor forced OFF land in their own results.json
 # slots, so the on/off pair is a first-class record pair (not just the
@@ -164,6 +184,7 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
 # Sentinel: every resumable stage above is marked done -> the watcher can
 # stop re-firing sessions.
 required="headline gate-megakernel headline_megakernel pir_megakernel \
+gate-walkkernel evaluate_at_walkkernel dcf_walkkernel \
 headline-syncexec pir-syncexec evalat dcf hh-device \
 extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
