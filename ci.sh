@@ -25,11 +25,14 @@ tier="${1:-fast}"
 run_fast() {
   # The fast tier includes the pipelined-executor suite
   # (tests/test_pipeline.py, ISSUE 2), the interpret-mode megakernel
-  # suite (tests/test_megakernel.py, ISSUE 3) and the interpret
+  # suite (tests/test_megakernel.py, ISSUE 3), the interpret
   # walk-kernel suite (tests/test_walkkernel.py + the MIC replay
-  # differential in tests/test_mic_gate.py, ISSUE 4 — cheap-circuit
-  # pallas plumbing through the real entry points + eager real-circuit
-  # oracle replays); pytest collects them with the rest of tests/ — no
+  # differential in tests/test_mic_gate.py, ISSUE 4) and the hierkernel
+  # suite (tests/test_hierkernel.py, ISSUE 5 — ONE compiled interpret
+  # config on a shape-uniform window plan, every equivalence variant
+  # sharing it per the ~40-115 s/config compile budget; eager
+  # real-circuit coverage goes through the replays, never pallas_call);
+  # pytest collects them with the rest of tests/ — no
   # separate invocation, which would run them twice. JAX_PLATFORMS=cpu
   # is pinned explicitly (belt to conftest.py's in-process suspenders)
   # so the tier can never contend for the single-process TPU claim.

@@ -1152,6 +1152,327 @@ def walk_megakernel_reference_rows(
     return jnp.stack([vals[l][i] for l in range(lpe) for i in range(32)])
 
 
+# ---------------------------------------------------------------------------
+# Hierarchical megakernel: single-program prefix-window advances for the
+# heavy-hitters path (ISSUE 5)
+# ---------------------------------------------------------------------------
+#
+# The heavy-hitters hierarchical walk (hierarchical.evaluate_levels_fused)
+# is the last workload where the device loses to one CPU core: the grouped
+# fused advance already minimized the program count (~8 programs for the
+# 128-level plan), so the residual gap is per-dispatch latency times the
+# window count. This kernel is the walk-megakernel treatment of the
+# hierarchy: ONE pallas_call per (key chunk x prefix window) advancing a
+# whole window of W tree levels in-register.
+#
+# The data-dependent per-level prefix gathers — the reason ISSUE 4 could
+# not cover this path — dissolve under one observation: every advance's
+# prefix set is known on the host before the call (prepare_levels_fused
+# composes the index tables today), so each level's "gather" can be
+# compiled into the walk itself. Every (hierarchy level, expanded tree
+# node) pair in the window gets its own LANE; the host composes, per lane,
+# its window-entry ancestor (an outside-kernel XLA gather in the same jit)
+# and its packed path bits from that ancestor — so the in-kernel walk is
+# the walk kernel's lockstep level loop with per-lane path-bit key select,
+# and the per-level prefix selection is packed one-hot select-mask rows
+# (pre-ANDed on the host, padded to the window's max prefix width): each
+# level's value capture is gated by a mask row that is hot exactly on that
+# level's lanes, and the cross-level combine is a mask-AND-XOR placement
+# (lanes are one-hot across capture slots) instead of a dynamic index.
+#
+# Per capture slot the tail runs in-kernel: value hash, the in-register
+# 32x32 bit transpose, value_codec.rows_correct_element with the FULL
+# per-level correction (party negation included — unlike the DCF form,
+# each hierarchy level's output is a finished value, not a summand), and
+# the masked XOR placement into per-(element, limb) accumulator rows. The
+# kernel also exports the end-of-walk seed planes + control row: the last
+# slot's lanes are exactly the final level's full child-block expansion in
+# leaf order — the resumable BatchedContext state — and the next window's
+# entry gather reads it (outside the kernel, in the next program).
+#
+# Mosaic portability: the body stays strictly inside the hardware-proven
+# walk-kernel vocabulary — elementwise vector ops, static row
+# loads/stores, scalar ref reads, per-lane masks; NO 1-D concatenate, no
+# iota, no cross-grid-step scratch (each (key, tile) grid step is
+# self-contained). Trace depth is levels + capture slots chained AES
+# circuits per window (<= ~2*group), the same risk class the walk
+# megakernel already carries on the watch-list.
+
+
+def _hier_megakernel_core(
+    rows,  # list of 128 uint32 rows: gathered window-entry seed planes
+    c,  # uint32 row: gathered window-entry control mask
+    path_row,  # path_row(lvl) -> uint32 row of this level's packed path bits
+    cw_scalar,  # cw_scalar(lvl, p) -> uint32 scalar
+    cc_scalar,  # cc_scalar(lvl, side) -> uint32 scalar (0=left, 1=right)
+    corr_scalar,  # corr_scalar(row_idx, l) -> uint32 scalar
+    sel_mask,  # sel_mask(row_idx, i) -> uint32 0/~0 row (slot-lane gate)
+    *,
+    levels: int,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures,  # tuple[levels + 1] of int: capture-slot index at each
+    #            depth, -1 = no capture at that depth
+    rk_base,
+    rk_diff,
+    rk_value,
+):
+    """The whole window computation on indexable operands — used VERBATIM
+    by the kernel body (reading refs) and by
+    `hier_megakernel_reference_rows` (reading plain arrays), the sharing
+    contract `_megakernel_slab_tail` / `_walk_megakernel_core`
+    established: the interpret plumbing tests and the eager real-circuit
+    oracle replay exercise this exact code.
+
+    Row indices of `corr_scalar`/`sel_mask`: slot s element e flattens to
+    s * keep + e. Unlike the DCF walk core, every capture applies the
+    FULL party correction (each hierarchy level emits finished values)
+    and slots combine by masked XOR placement — each lane is hot in at
+    most one slot, so the XOR is pure placement in any value group.
+
+    Returns (acc, rows, c): acc[e][l][i] uint32 rows — limb l of element
+    e of lanes 32w+i — plus the end-of-walk seed rows and control row
+    (the exit state the next window / the resumable context gathers)."""
+    lpe = bits // 32
+
+    def _level(rows, c, lvl):
+        pmask = path_row(lvl)
+        sig = [rows[64 + p] for p in range(64)] + [
+            rows[64 + p] ^ rows[p] for p in range(64)
+        ]
+        enc = _aes_rows(sig, rk_base, rk_diff, pmask)
+        h = [enc[p] ^ sig[p] ^ (cw_scalar(lvl, p) & c) for p in range(128)]
+        cc = (cc_scalar(lvl, 0) & ~pmask) | (cc_scalar(lvl, 1) & pmask)
+        new_c = h[0] ^ (c & cc)
+        h[0] = jnp.zeros_like(h[0])
+        return h, new_c
+
+    acc = [[[None] * 32 for _ in range(lpe)] for _ in range(keep)]
+
+    def _capture(rows, c, slot):
+        sig = [rows[64 + p] for p in range(64)] + [
+            rows[64 + p] ^ rows[p] for p in range(64)
+        ]
+        enc = _aes_rows(sig, rk_value, None, None)
+        h = [enc[p] ^ sig[p] for p in range(128)]
+        vrows = [_transpose32_rows(h[32 * l : 32 * l + 32]) for l in range(4)]
+        for i in range(32):
+            ctrl_mask = jnp.uint32(0) - ((c >> jnp.uint32(i)) & jnp.uint32(1))
+            for e in range(keep):
+                limbs = [vrows[e * lpe + l][i] for l in range(lpe)]
+                corr = [corr_scalar(slot * keep + e, l) for l in range(lpe)]
+                vals = value_codec.rows_correct_element(
+                    limbs, ctrl_mask, corr, bits, party, xor_group
+                )
+                sel = sel_mask(slot * keep + e, i)
+                for l in range(lpe):
+                    v = vals[l] & sel
+                    acc[e][l][i] = (
+                        v if acc[e][l][i] is None else acc[e][l][i] ^ v
+                    )
+
+    assert len(captures) == levels + 1, (len(captures), levels)
+    assert any(s >= 0 for s in captures), captures
+    for d in range(levels + 1):
+        if captures[d] >= 0:
+            _capture(rows, c, captures[d])
+        if d < levels:
+            rows, c = _level(rows, c, d)
+    return acc, rows, c
+
+
+def hier_megakernel_reference_rows(
+    entry_planes,  # uint32[128, W] one key's gathered window-entry planes
+    entry_control,  # uint32[W] packed entry control masks
+    path_masks,  # uint32[L, W] packed per-lane path bits from the entry
+    cw_planes,  # uint32[L, 128]
+    ccl,  # uint32[L]
+    ccr,  # uint32[L]
+    corrections,  # uint32[n_rows, lpe] per-(slot, element) correction limbs
+    sel_bits,  # uint32[n_rows, W] packed per-lane slot-membership bits
+    *,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures,
+):
+    """Pure-array replay of ONE key's hier-megakernel window — the same
+    row functions on plain jnp arrays, no pallas_call (the
+    `walk_megakernel_reference_rows` twin for the hierarchical path). Two
+    jobs: run eagerly (jax.disable_jit) with the REAL circuit it is
+    bit-exact against the host oracle in CI time; run with the cheap
+    `_aes_rows` stand-in it is the reference the interpret-mode pallas
+    plumbing tests compare against. Returns (value rows
+    uint32[keep*lpe*32, W] — row (e*lpe+l)*32+i word w = limb l of
+    element e of lane 32w+i — exit seed planes uint32[128, W], exit
+    control row uint32[W])."""
+    levels = path_masks.shape[0]
+    rows = [entry_planes[p] for p in range(128)]
+    c = entry_control
+    acc, xrows, xc = _hier_megakernel_core(
+        rows,
+        c,
+        lambda lvl: path_masks[lvl],
+        lambda lvl, p: cw_planes[lvl, p],
+        lambda lvl, side: (ccl, ccr)[side][lvl],
+        lambda r, l: corrections[r, l],
+        lambda r, i: jnp.uint32(0)
+        - ((sel_bits[r] >> jnp.uint32(i)) & jnp.uint32(1)),
+        levels=levels,
+        bits=bits,
+        party=party,
+        xor_group=xor_group,
+        keep=keep,
+        captures=captures,
+        rk_base=backend_jax._rk_np("left"),
+        rk_diff=backend_jax._rk_np("lr_diff"),
+        rk_value=backend_jax._rk_np("value"),
+    )
+    lpe = bits // 32
+    vals = jnp.stack(
+        [acc[e][l][i] for e in range(keep) for l in range(lpe) for i in range(32)]
+    )
+    return vals, jnp.stack(xrows), xc
+
+
+def _hier_megakernel_body(
+    rk_base, rk_diff, rk_value, plan, bits, party, xor_group, keep, captures
+):
+    """Builds the hier-megakernel kernel fn for one (plan, window-shape)
+    config. The body reads refs and delegates every computation to
+    `_hier_megakernel_core` (shared with the replay)."""
+    lpe = bits // 32
+
+    def kernel(
+        planes_ref,  # uint32[1, 128, tw] gathered entry planes
+        ctrl_ref,  # uint32[1, 1, tw] entry control masks
+        path_ref,  # uint32[L, tw]
+        cw_ref,  # uint32[1, L, 128]
+        cc_ref,  # uint32[1, L, 2]
+        corr_ref,  # uint32[1, n_rows, lpe]
+        sel_ref,  # uint32[n_rows, tw]
+        out_ref,  # uint32[1, keep*lpe*32, tw] value rows
+        xplanes_ref,  # uint32[1, 128, tw] exit seed planes
+        xctrl_ref,  # uint32[1, 1, tw] exit control masks
+    ):
+        rows = [planes_ref[0, p, :] for p in range(128)]
+        c = ctrl_ref[0, 0, :]
+        acc, xrows, xc = _hier_megakernel_core(
+            rows,
+            c,
+            lambda lvl: path_ref[lvl, :],
+            lambda lvl, p: cw_ref[0, lvl, p],
+            lambda lvl, side: cc_ref[0, lvl, side],
+            lambda r, l: corr_ref[0, r, l],
+            lambda r, i: jnp.uint32(0)
+            - ((sel_ref[r, :] >> jnp.uint32(i)) & jnp.uint32(1)),
+            levels=plan.levels,
+            bits=bits,
+            party=party,
+            xor_group=xor_group,
+            keep=keep,
+            captures=captures,
+            rk_base=rk_base,
+            rk_diff=rk_diff,
+            rk_value=rk_value,
+        )
+        for e in range(keep):
+            for l in range(lpe):
+                for i in range(32):
+                    out_ref[0, (e * lpe + l) * 32 + i, :] = acc[e][l][i]
+        for p in range(128):
+            xplanes_ref[0, p, :] = xrows[p]
+        xctrl_ref[0, 0, :] = xc
+
+    return kernel
+
+
+def hier_megakernel_pallas_batched(
+    entry_planes: jnp.ndarray,  # uint32[K, 128, Wp] gathered entry planes
+    entry_control: jnp.ndarray,  # uint32[K, Wp] packed entry control masks
+    path_masks: jnp.ndarray,  # uint32[L, Wp] shared across keys
+    cw_planes: jnp.ndarray,  # uint32[K, L, 128]
+    ccl: jnp.ndarray,  # uint32[K, L]
+    ccr: jnp.ndarray,  # uint32[K, L]
+    corrections: jnp.ndarray,  # uint32[K, n_rows, lpe]
+    sel_bits: jnp.ndarray,  # uint32[n_rows, Wp] packed slot-lane bits
+    *,
+    plan,  # evaluator.HierkernelPlan (static)
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures,  # tuple[levels + 1] of slot index / -1 (static)
+    interpret: bool = False,
+):
+    """The hierarchical megakernel: ONE pallas_call per (key chunk x
+    prefix window), grid (keys, lane tiles). Each grid step walks its
+    tile of (level, tree-node) lanes down the whole window in-register
+    and captures every hierarchy level's values through the pre-ANDed
+    select-mask rows. Returns (value rows uint32[K, keep*lpe*32, Wp],
+    exit seed planes uint32[K, 128, Wp], exit control uint32[K, Wp]);
+    the caller transposes/gathers per level in the same jit."""
+    k = entry_planes.shape[0]
+    lpe = bits // 32
+    levels = plan.levels
+    assert path_masks.shape == (levels, plan.padded_words), (
+        path_masks.shape,
+        plan,
+    )
+    assert sel_bits.shape[1] == plan.padded_words, (sel_bits.shape, plan)
+    kernel = _hier_megakernel_body(
+        backend_jax._rk_np("left"),
+        backend_jax._rk_np("lr_diff"),
+        backend_jax._rk_np("value"),
+        plan,
+        bits,
+        party,
+        xor_group,
+        keep,
+        captures,
+    )
+    cc = jnp.stack([ccl, ccr], axis=-1).astype(jnp.uint32)  # [K, L, 2]
+    n_rows = corrections.shape[1]
+    n_sel = sel_bits.shape[0]
+    tw = plan.tile_words
+    out, xplanes, xctrl = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((k, keep * lpe * 32, plan.padded_words), jnp.uint32),
+            jax.ShapeDtypeStruct((k, 128, plan.padded_words), jnp.uint32),
+            jax.ShapeDtypeStruct((k, 1, plan.padded_words), jnp.uint32),
+        ),
+        grid=(k, plan.num_tiles),
+        in_specs=[
+            pl.BlockSpec((1, 128, tw), lambda kk, j: (kk, 0, j)),
+            pl.BlockSpec((1, 1, tw), lambda kk, j: (kk, 0, j)),
+            pl.BlockSpec((levels, tw), lambda kk, j: (0, j)),
+            pl.BlockSpec((1, levels, 128), lambda kk, j: (kk, 0, 0)),
+            pl.BlockSpec((1, levels, 2), lambda kk, j: (kk, 0, 0)),
+            pl.BlockSpec((1, n_rows, lpe), lambda kk, j: (kk, 0, 0)),
+            pl.BlockSpec((n_sel, tw), lambda kk, j: (0, j)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, keep * lpe * 32, tw), lambda kk, j: (kk, 0, j)),
+            pl.BlockSpec((1, 128, tw), lambda kk, j: (kk, 0, j)),
+            pl.BlockSpec((1, 1, tw), lambda kk, j: (kk, 0, j)),
+        ),
+        interpret=interpret,
+    )(
+        entry_planes,
+        entry_control[:, None, :],
+        path_masks,
+        cw_planes,
+        cc,
+        corrections,
+        sel_bits,
+    )
+    return out, xplanes, xctrl[:, 0, :]
+
+
 def _walk_megakernel_body(
     rk_base, rk_diff, rk_value, plan, bits, party, xor_group, keep, captures
 ):

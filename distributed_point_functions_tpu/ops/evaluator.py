@@ -1911,6 +1911,88 @@ def plan_walkkernel(
     return WalkkernelPlan(levels, cap, num_tiles, num_tiles * cap)
 
 
+# ---------------------------------------------------------------------------
+# Hierarchical-megakernel strategy: single-program prefix-window advances
+# ---------------------------------------------------------------------------
+
+
+class HierkernelPlan(NamedTuple):
+    """Static shape plan for the hierarchical megakernel (aes_pallas.
+    hier_megakernel_pallas_batched): hashable, used as a jit static arg —
+    the `plan_walkkernel` analog for the heavy-hitters prefix windows.
+
+      levels        tree levels the window walks in-kernel (the window's
+                    cumulative advance depth)
+      tile_words    lane-tile width in packed 32-lane words (the second
+                    grid axis steps tiles of 32 * tile_words lanes)
+      num_tiles     lane tiles per key
+      padded_words  num_tiles * tile_words — the kernel's lane-word width;
+                    the plan composition pads the window's lane set (one
+                    lane per (hierarchy level, expanded tree node) pair)
+                    up to padded_words * 32
+    """
+
+    levels: int
+    tile_words: int
+    num_tiles: int
+    padded_words: int
+
+
+def _hier_mode_default() -> str:
+    """Resolves the hierarchical-advance strategy default: "hierkernel"
+    when DPF_TPU_HIERKERNEL is truthy, else the shipped grouped "fused"
+    shape — the A/B knob bench_heavy_hitters / tools/tpu_measure.sh flip
+    without code changes (the DPF_TPU_WALKKERNEL analog for
+    evaluate_levels_fused)."""
+    return (
+        "hierkernel"
+        if _env_bool("DPF_TPU_HIERKERNEL", default=False)
+        else "fused"
+    )
+
+
+def plan_hierkernel(
+    num_lanes: int,
+    levels: int,
+    n_rows: int,
+    lpe: int,
+    keep: int = 1,
+    vmem_budget: Optional[int] = None,
+) -> HierkernelPlan:
+    """Sizes the hierarchical megakernel's lane-tile width from a VMEM
+    budget — the `plan_walkkernel` analog for the prefix windows.
+
+    The budget (DPF_TPU_HIERKERNEL_VMEM env, default 8 MB of the v5e's
+    ~16 MB/core) covers, per lane word: the 128 seed-plane rows with ~4x
+    live AES temporaries plus the exit-state write, the keep*lpe*32 value
+    accumulator rows (doubled: capture temporaries + the placement
+    accumulator), the per-level path rows and the n_rows select-mask
+    rows. Tile geometry follows plan_walkkernel's: a power of two >= 128
+    words for multi-tile plans, 8-word (sublane) granularity below one
+    tile. `num_lanes` is the window's (padded-uniform) lane count — the
+    plan composition passes the max across the plan's windows so equal-
+    depth windows share one compiled config."""
+    if levels < 1:
+        raise InvalidArgumentError(
+            f"hier megakernel needs at least one tree level per window, "
+            f"got {levels}"
+        )
+    if vmem_budget is None:
+        vmem_budget = int(
+            os.environ.get("DPF_TPU_HIERKERNEL_VMEM", str(8 << 20))
+        )
+    w = -(-max(1, num_lanes) // 32)
+    per_word = 4 * (
+        128 * 5 + 32 * max(1, lpe) * max(1, keep) * 2 + levels + n_rows + 8
+    )
+    cap = _floor_pow2(max(128, vmem_budget // per_word))
+    if w <= cap:
+        tile = max(8, -(-w // 8) * 8)
+        return HierkernelPlan(levels, tile, 1, tile)
+    num_tiles = -(-w // cap)
+    return HierkernelPlan(levels, cap, num_tiles, num_tiles * cap)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(

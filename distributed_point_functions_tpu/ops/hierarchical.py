@@ -35,8 +35,10 @@ import numpy as np
 from ..core import uint128
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey, EvaluationContext, PartialEvaluation
+from ..utils import integrity
 from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, evaluator, value_codec
+from . import pipeline as _pl
 
 
 @dataclasses.dataclass
@@ -661,21 +663,398 @@ class PreparedLevelsPlan:
     # None for "unroll" chunks.
     chunks: list
     final_order_dev: Optional[jnp.ndarray]  # state reorder for emit
+    # Execution strategy the plan was composed for: "fused" (the grouped
+    # scan/unroll chunks above) or "hierkernel" (the single-program prefix
+    # windows below; `steps`/`chunks` are then empty).
+    mode: str = "fused"
+    hier_windows: Optional[list] = None  # list[_HierWindow]
+    hier_keep: int = 1  # uniform per-slot element count across windows
+
+
+def bitwise_hierarchy_plan(levels: int, finals) -> list:
+    """`evaluate_levels_fused` plan for the heavy-hitters access pattern:
+    one hierarchy level per bit, entry i evaluating the unique i-bit
+    prefixes of the final-level leaf set `finals` (python ints) —
+    [(0, []), (1, P_1), ..., (levels-1, P_{levels-1})] with P_i the
+    sorted unique `{f >> (levels - i)}`. Prefix arrays go u128 above the
+    63-bit bookkeeping boundary. ONE implementation for the bench-shaped
+    plans the device check (utils/integrity), tools/check_device.py and
+    the test suites all build — the plan convention (prefixes at the
+    PREVIOUS entry's domain) must not drift between them."""
+    finals = sorted({int(f) for f in finals})
+    plan = [(0, [])]
+    for i in range(1, levels):
+        p = sorted({f >> (levels - i) for f in finals})
+        if i >= 64:
+            # p is already sorted-unique; u128_array preserves order
+            # (U128's (hi, lo) field order sorts numerically).
+            plan.append((i, uint128.u128_array(p)))
+        else:
+            plan.append((i, np.array(p, dtype=np.uint64)))
+    return plan
+
+
+def draw_random_finals(levels: int, n: int, rng) -> list:
+    """`n` uniform `levels`-bit leaf indices (python ints) for a
+    heavy-hitters workload — composed from 32-bit words above the int64
+    range, so the device check and the test suites draw the same leaf
+    distribution at any depth (feeds `bitwise_hierarchy_plan`)."""
+    if levels <= 63:
+        return [int(x) for x in rng.integers(0, 1 << levels, size=n)]
+    nwords = -(-levels // 32)
+    words = rng.integers(0, 1 << 32, size=(n, nwords), dtype=np.uint64)
+    mask = (1 << levels) - 1
+    return [
+        sum(int(w) << (32 * j) for j, w in enumerate(row)) & mask
+        for row in words
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical megakernel windows (mode="hierkernel", ISSUE 5)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _HierWindow:
+    """One prefix window of a hierkernel plan: the key-independent tables
+    of ONE pallas_call (aes_pallas.hier_megakernel_pallas_batched),
+    composed on the host and held device-resident by the prepared plan.
+
+    Lane layout: the window's plan steps become consecutive SEGMENTS; the
+    segment of step t holds one lane per tree node of that step's full
+    child-block expansion, in leaf (sorted tree index) order — so the
+    last segment IS the resumable context state and the next window's
+    entry gather indexes it directly. Each lane carries its window-entry
+    ancestor position (`entry_pos`, gathered outside the kernel in the
+    same jit) and its packed path bits from that ancestor; each step's
+    value capture is gated by the pre-ANDed one-hot select-mask rows."""
+
+    plan: "evaluator.HierkernelPlan"
+    captures: tuple  # [depth + 1] capture-slot index per depth / -1
+    depth: int  # tree levels this window walks
+    start_level: int  # absolute tree level of the window entry state
+    entry_pos_dev: jnp.ndarray  # int64[Wp * 32] entry-state lane gather
+    path_dev: jnp.ndarray  # uint32[depth, Wp] packed per-lane path bits
+    sel_dev: jnp.ndarray  # uint32[n_rows, Wp] packed slot-lane bits
+    gsels_dev: tuple  # per step: int64[n_outputs] output gathers
+    slot_steps: tuple  # per slot: global plan-step index (vc lookup)
+    slot_keeps: tuple  # per slot: that level's elements per block
+    state_base: int  # exit-state lane offset (last segment)
+    state_len: int  # REAL exit-state lane count (the context width)
+    state_cap: int  # uniform exit-slice width — every window of a plan
+    #                 emits [K, state_cap, 4] so equal-shape windows share
+    #                 ONE compiled program even when prefix counts drift
+
+
+def _compose_hier_windows(raw, group: int, bits: int, entry_width: int):
+    """Partitions the raw virtual-walk steps into prefix windows of up to
+    `group` consecutive advances and composes each window's kernel
+    tables. Raises NotImplementedError for plan shapes the hierkernel
+    cannot express (the env-default caller falls back to "fused" with an
+    engine-downgrade event; an explicit mode="hierkernel" propagates)."""
+    lpe = bits // 32
+    keep_g = max(r[4] for r in raw)
+    if keep_g * lpe > 4:
+        raise NotImplementedError(
+            "hierkernel capture rows exceed one 128-bit block "
+            f"(keep={keep_g} x lpe={lpe})"
+        )
+    idx_windows = [
+        list(range(i, min(i + group, len(raw))))
+        for i in range(0, len(raw), group)
+    ]
+    # Pass A — per-window lane bookkeeping: chain each step's leaf-order
+    # expansion back to its window-entry ancestor + relative path bits.
+    win_host = []
+    for idx in idx_windows:
+        depth = sum(raw[t][2] for t in idx)
+        if depth < 1:
+            raise NotImplementedError(
+                "hierkernel window advances zero tree levels (hierarchy "
+                "levels sharing one tree depth); use mode='fused'"
+            )
+        if depth > 62:
+            raise NotImplementedError(
+                f"hierkernel window depth {depth} exceeds 62 relative path "
+                "bits; lower `group`"
+            )
+        prev = None
+        cum_d = 0
+        base = 0
+        segs = []  # (base, n_t, D_t, entry_pos, rel_path, step index)
+        for s, t in enumerate(idx):
+            positions, num_parents, levels_d, _sel, _keep, _epb, _start, _h = raw[t]
+            if levels_d == 0 and s > 0:
+                raise NotImplementedError(
+                    "hierkernel requires every advance after a window's "
+                    "first to deepen the tree (two hierarchy levels share "
+                    "a capture depth); use mode='fused'"
+                )
+            if prev is None:
+                par_entry = positions.astype(np.int64)
+                par_path = np.zeros(num_parents, dtype=np.uint64)
+            else:
+                pe, pp = prev
+                par_entry = pe[positions]
+                par_path = pp[positions]
+            cum_d += levels_d
+            nleaf = 1 << levels_d
+            ent = np.repeat(par_entry, nleaf)
+            pth = (np.repeat(par_path, nleaf) << np.uint64(levels_d)) | np.tile(
+                np.arange(nleaf, dtype=np.uint64), num_parents
+            )
+            n_t = num_parents * nleaf
+            segs.append((base, n_t, cum_d, ent, pth, t))
+            base += n_t
+            prev = (ent, pth)
+        win_host.append((idx, depth, segs, base))
+    # Uniform widths across windows: equal-shape windows then share ONE
+    # compiled kernel config (the compile-budget discipline the walk
+    # megakernel established) — early windows pay padded lanes, which
+    # compute garbage on entry lane 0 and are never selected. The exit
+    # state is emitted at one uniform `state_cap` width for the same
+    # reason (real prefix counts drift per level; the executor pads the
+    # plan's entry state up to state_cap on the host, and the resumable
+    # context tolerates trailing pad lanes — every consumer indexes
+    # through parent_tree, which stays exact).
+    state_cap = max(
+        [entry_width] + [wh[2][-1][1] for wh in win_host]
+    )
+    max_lanes = max(
+        max(wh[3], wh[2][-1][0] + state_cap) for wh in win_host
+    )
+    windows = []
+    for (idx, depth, segs, n_win) in win_host:
+        n_rows = len(idx) * keep_g
+        kplan = evaluator.plan_hierkernel(max_lanes, depth, n_rows, lpe, keep_g)
+        wl = kplan.padded_words * 32
+        entry_pos = np.zeros(wl, dtype=np.int64)
+        rel_path = np.zeros(wl, dtype=np.uint64)
+        lane_depth = np.zeros(wl, dtype=np.int64)
+        captures = [-1] * (depth + 1)
+        sel_bool = np.zeros((n_rows, wl), dtype=bool)
+        gsels = []
+        for s, (b, n_t, d_t, ent, pth, t) in enumerate(segs):
+            entry_pos[b : b + n_t] = ent
+            rel_path[b : b + n_t] = pth
+            lane_depth[b : b + n_t] = d_t
+            assert captures[d_t] == -1, (captures, d_t)
+            captures[d_t] = s
+            keep_t = raw[t][4]
+            sel_bool[s * keep_g : s * keep_g + keep_t, b : b + n_t] = True
+            sel = raw[t][3]
+            gsels.append(
+                jnp.asarray((b + sel // keep_t) * keep_g + sel % keep_t)
+            )
+        path_bits = np.zeros((depth, wl), dtype=bool)
+        for lvl in range(depth):
+            sh = lane_depth - 1 - lvl
+            valid = sh >= 0
+            path_bits[lvl, valid] = (
+                (rel_path[valid] >> sh[valid].astype(np.uint64)) & 1
+            ).astype(bool)
+        last_b, last_n = segs[-1][0], segs[-1][1]
+        windows.append(
+            _HierWindow(
+                plan=kplan,
+                captures=tuple(captures),
+                depth=depth,
+                start_level=raw[idx[0]][6],
+                entry_pos_dev=jnp.asarray(entry_pos),
+                path_dev=jnp.asarray(aes_jax.pack_bit_mask(path_bits)),
+                sel_dev=jnp.asarray(aes_jax.pack_bit_mask(sel_bool)),
+                gsels_dev=tuple(gsels),
+                slot_steps=tuple(idx),
+                slot_keeps=tuple(raw[t][4] for t in idx),
+                state_base=int(last_b),
+                state_len=int(last_n),
+                state_cap=int(state_cap),
+            )
+        )
+    return windows, keep_g
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "plan", "bits", "party", "xor_group", "keep", "captures",
+        "state_base", "state_cap", "interpret",
+    ),
+)
+def _hier_window_jit(
+    seeds,  # uint32[K, M, 4] window-entry state (leaf order)
+    control,  # uint32[K, M] 0/1
+    entry_pos,  # int64[Wp * 32] per-lane ancestor gather (pad -> 0)
+    path_masks,  # uint32[depth, Wp]
+    cw,  # uint32[K, depth, 128]
+    ccl,  # uint32[K, depth]
+    ccr,  # uint32[K, depth]
+    corr,  # uint32[K, n_rows, lpe]
+    sel_bits,  # uint32[n_rows, Wp]
+    gsels,  # tuple of int64[n_outputs] per plan step
+    plan,
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    captures,
+    state_base: int,
+    state_cap: int,
+    interpret: bool,
+):
+    """ONE program per (key chunk x prefix window): the entry-ancestor
+    gather + plane pack, the hier megakernel pallas_call (every level of
+    the window walked in-register, every level's values captured through
+    the select-mask rows), the value-row transpose, the per-step output
+    gathers, and the leaf-ordered exit-state unpack — no per-level
+    dispatch, no per-level HBM round trip of the prefix state."""
+    from . import aes_pallas
+
+    k = seeds.shape[0]
+    lpe = bits // 32
+    s = seeds.astype(jnp.uint32)[:, entry_pos]  # [K, Wp*32, 4]
+    c = control.astype(jnp.uint32)[:, entry_pos]
+    planes = jax.vmap(aes_jax.pack_to_planes)(s)
+    mask = _pack_mask_device(c)
+    vals, xplanes, xctrl = aes_pallas.hier_megakernel_pallas_batched(
+        planes,
+        mask,
+        path_masks,
+        cw,
+        ccl,
+        ccr,
+        corr,
+        sel_bits,
+        plan=plan,
+        bits=bits,
+        party=party,
+        xor_group=xor_group,
+        keep=keep,
+        captures=captures,
+        interpret=interpret,
+    )
+    wp = plan.padded_words
+    # Value rows -> flat [K, Wp*32*keep, lpe]: row (e*lpe+l)*32+i word w
+    # holds limb l of element e of lane 32w+i, so the flat element index
+    # factors as lane * keep + e — the space the gsel tables index.
+    flat = (
+        vals.reshape(k, keep, lpe, 32, wp)
+        .transpose(0, 4, 3, 1, 2)
+        .reshape(k, wp * 32 * keep, lpe)
+    )
+    outs = tuple(flat[:, g] for g in gsels)
+    # Exit state at the plan-uniform state_cap width (trailing pad lanes
+    # are garbage the next gather / the context never indexes): ALWAYS
+    # emitted, so the final window shares the middle windows' compiled
+    # program instead of tracing its own state-free variant.
+    xseeds = jax.vmap(aes_jax.unpack_from_planes)(xplanes)[
+        :, state_base : state_base + state_cap
+    ]
+    xc = jax.vmap(backend_jax.unpack_mask_device)(xctrl)[
+        :, state_base : state_base + state_cap
+    ]
+    return outs, xseeds, xc
+
+
+def _hier_corr_rows(win: _HierWindow, vcs, k: int, keep_g: int, lpe: int):
+    """uint32[K, n_rows, lpe] per-(slot, element) correction limbs of one
+    window — the per-call key material next to the prepared tables."""
+    n_rows = len(win.slot_steps) * keep_g
+    corr = np.zeros((k, n_rows, lpe), dtype=np.uint32)
+    for s, (t, keep_t) in enumerate(zip(win.slot_steps, win.slot_keeps)):
+        corr[:, s * keep_g : s * keep_g + keep_t] = vcs[t][:, :keep_t]
+    return corr
+
+
+def _emit_hier_downgrade(frm: str, to: str, reason: str, **data) -> None:
+    """Structured engine-downgrade event for the hierarchical path's
+    silent fallbacks (hierkernel -> fused, the fused path's narrow-width
+    pallas -> XLA) — the dcf narrow-batch pattern: device A/B runs must
+    be able to tell "kernel lost" from "kernel never ran"."""
+    integrity.emit_event(
+        "engine-downgrade",
+        f"hierarchical.evaluate_levels_fused: {frm} -> {to}: {reason}",
+        "pallas",  # every edge here downgrades away from a Pallas engine
+        path="hierarchical",
+        reason=reason,
+        downgraded_to=to,
+        **{"from": frm},
+        **data,
+    )
+
+
+def _resolve_hier_prepare(ctx, plan, group, mode, mesh, use_pallas):
+    """Resolves the hierarchical-advance strategy for one call and builds
+    the prepared plan — an explicit mode wins (configs the hierkernel
+    cannot handle raise); the DPF_TPU_HIERKERNEL env default quietly
+    keeps "fused" for them with an engine-downgrade event, because a
+    process-wide A/B knob must never turn a previously working call into
+    an error (the _resolve_walk_mode contract)."""
+    explicit = mode is not None
+    if mode is None:
+        mode = evaluator._hier_mode_default()
+    if mode not in ("fused", "hierkernel"):
+        raise InvalidArgumentError(
+            f"mode must be 'fused' or 'hierkernel', got {mode!r}"
+        )
+    if mode == "hierkernel":
+        reason = None
+        if mesh is not None:
+            if explicit:
+                raise InvalidArgumentError(
+                    "mode='hierkernel' does not support mesh sharding; "
+                    "use mode='fused'"
+                )
+            reason = "mesh sharding is fused-only"
+        elif use_pallas is False and not explicit:
+            # The env A/B default yields to an explicit engine knob (a
+            # call qualifying the XLA engine must not silently get a
+            # Mosaic kernel); an EXPLICIT mode still wins over it.
+            reason = "use_pallas=False pins the XLA engine"
+        if reason is None:
+            try:
+                return "hierkernel", prepare_levels_fused(
+                    ctx, plan, group, mode="hierkernel"
+                )
+            except NotImplementedError as e:
+                if explicit:
+                    raise
+                reason = str(e)
+        _emit_hier_downgrade(
+            "hierkernel", "fused", reason, plan_steps=len(plan)
+        )
+    return "fused", prepare_levels_fused(ctx, plan, group)
 
 
 def prepare_levels_fused(
     ctx: BatchedContext,
     plan: Sequence[Tuple[int, Sequence[int]]],
     group: int = 16,
+    mode: Optional[str] = None,
 ) -> PreparedLevelsPlan:
     """Builds the key-independent part of `evaluate_levels_fused` for
     `plan` against ctx's CURRENT state (the context is not advanced).
     The returned plan replays against any context of the same DPF
     parameters in the same state — pass it to `evaluate_levels_fused` in
-    place of `plan`."""
+    place of `plan`.
+
+    `mode` selects the execution strategy the plan is composed for:
+    "fused" (default — the grouped scan/unroll advance chunks) or
+    "hierkernel" (the single-program prefix windows of the hierarchical
+    megakernel, ISSUE 5: up to `group` consecutive advances per
+    pallas_call; raises NotImplementedError for plan shapes the kernel
+    cannot express — sub-32-bit value widths, hierarchy levels sharing
+    one tree depth past a window's first step, window depths over 62)."""
     from ..core.value_types import Int, XorWrapper
 
     v = ctx.dpf.validator
+    if mode is None:
+        mode = "fused"
+    if mode not in ("fused", "hierkernel"):
+        raise InvalidArgumentError(
+            f"mode must be 'fused' or 'hierkernel', got {mode!r}"
+        )
     if group < 1:
         # group feeds the greedy chunking loop below; 0 would make it spin
         # forever (BENCH_HH_GROUP / CHECK_HH_GROUP env vars reach here).
@@ -695,6 +1074,14 @@ def prepare_levels_fused(
                 "outputs; use evaluate_until_batch for codec value types"
             )
     bits, xor_group = evaluator._value_kind(v.parameters[plan[-1][0]].value_type)
+    if mode == "hierkernel" and bits % 32:
+        # Decidable before the O(levels x prefixes) pass-1 walk: the
+        # env-default fallback path must not pay the whole bookkeeping
+        # twice for the common sub-word-value case.
+        raise NotImplementedError(
+            "hierkernel handles 32-bit-multiple value widths, got "
+            f"{bits}; use mode='fused' for sub-word outputs"
+        )
 
     # Pass 1 — virtual context walk (host): raw per-step tables, BEFORE
     # lane-order composition (which depends on each step's padded width,
@@ -775,6 +1162,35 @@ def prepare_levels_fused(
             tree if tree is not None else np.zeros(1, dtype=np.uint64)
         )
         child_levels = levels_d
+
+    if mode == "hierkernel":
+        final_level = plan[-1][0]
+        emit_state = final_level < v.num_hierarchy_levels - 1
+        entry_width = (
+            1
+            if start_parent_tree is None
+            else len(start_parent_tree) << start_child_levels
+        )
+        windows, keep_g = _compose_hier_windows(raw, group, bits, entry_width)
+        return PreparedLevelsPlan(
+            parameters=tuple(v.parameters),
+            plan_levels=tuple(h for (*_, h) in raw),
+            bits=bits,
+            xor_group=xor_group,
+            final_level=final_level,
+            emit_state=emit_state,
+            start_prev_level=start_prev_level,
+            start_parent_tree=start_parent_tree,
+            start_child_levels=start_child_levels,
+            end_parent_tree=parent_tree if emit_state else None,
+            end_child_levels=child_levels if emit_state else 0,
+            steps=[],
+            chunks=[],
+            final_order_dev=None,
+            mode="hierkernel",
+            hier_windows=windows,
+            hier_keep=keep_g,
+        )
 
     # Grouping: greedy runs capped at `group`. A run of >= 4 steps with one
     # common levels_d becomes a SCAN chunk — padded to one width so the AES
@@ -900,6 +1316,163 @@ def prepare_levels_fused(
     )
 
 
+def _evaluate_hierkernel(
+    ctx: BatchedContext,
+    prepared: PreparedLevelsPlan,
+    device_output: bool,
+    key_chunk: Optional[int],
+    pipeline: Optional[bool],
+) -> list:
+    """Executes a hierkernel-mode prepared plan: per key chunk, ONE
+    program per prefix window (`_hier_window_jit` — the entry gather,
+    the hier megakernel pallas_call and every per-level output selection
+    fused), windows chained through the leaf-ordered exit state, chunks
+    driven through the pipelined executor (ops/pipeline.py) so chunk
+    N+1's key-table pack/upload overlaps chunk N's windows."""
+    import jax
+
+    dpf, v = ctx.dpf, ctx.dpf.validator
+    k = len(ctx.keys)
+    bits, xor_group = prepared.bits, prepared.xor_group
+    lpe = bits // 32
+    keep_g = prepared.hier_keep
+    windows = prepared.hier_windows
+    emit_state = prepared.emit_state
+    n_steps = len(prepared.plan_levels)
+    batch = evaluator.KeyBatch.from_keys(dpf, ctx.keys, prepared.final_level)
+    cw_all, ccl_all, ccr_all = batch.device_cw_arrays(0)
+    vcs = [
+        _level_value_corrections(ctx.keys, v, h, bits)
+        for h in prepared.plan_levels
+    ]
+    corrs = [_hier_corr_rows(win, vcs, k, keep_g, lpe) for win in windows]
+    interpret = jax.default_backend() != "tpu"
+
+    # Entry state (the evaluate_levels_fused convention), padded on the
+    # HOST up to the plan's uniform state_cap width so every window —
+    # including the first — runs the same compiled program shape.
+    if ctx.previous_hierarchy_level < 0:
+        seeds0 = np.broadcast_to(batch.seeds[:, None, :], (k, 1, 4)).copy()
+        control0 = np.full((k, 1), np.uint32(1 if batch.party else 0))
+    else:
+        seeds0 = ctx.seeds
+        control0 = ctx.control
+    s_cap = windows[0].state_cap
+    if seeds0.shape[1] < s_cap:
+        seeds0 = np.asarray(seeds0)
+        control0 = np.asarray(control0).astype(np.uint32)
+        pad = s_cap - seeds0.shape[1]
+        seeds0 = np.concatenate(
+            [seeds0, np.zeros((k, pad, 4), np.uint32)], axis=1
+        )
+        control0 = np.concatenate(
+            [control0, np.zeros((k, pad), np.uint32)], axis=1
+        )
+
+    chunk = k if key_chunk is None else max(1, int(key_chunk))
+    multi = chunk < k
+    pipe = _pl.resolve(pipeline)
+    if multi:
+        # Chunk slicing happens on the host (an eager device fancy-index
+        # would dispatch extra programs per chunk).
+        seeds0 = np.asarray(seeds0)
+        control0 = np.asarray(control0)
+
+    def make_thunk(idx, valid):
+        def thunk():
+            whole = valid == k and idx.shape[0] == k
+            if whole:
+                s0 = jnp.asarray(seeds0).astype(jnp.uint32)
+                c0 = jnp.asarray(control0).astype(jnp.uint32)
+                cw_c, ccl_c, ccr_c = cw_all, ccl_all, ccr_all
+                corrs_c = corrs
+            else:
+                s0 = jnp.asarray(
+                    np.ascontiguousarray(seeds0[idx]).astype(np.uint32)
+                )
+                c0 = jnp.asarray(
+                    np.ascontiguousarray(control0[idx]).astype(np.uint32)
+                )
+                cw_c, ccl_c, ccr_c = cw_all[idx], ccl_all[idx], ccr_all[idx]
+                corrs_c = [c[idx] for c in corrs]
+            outs_steps = []
+            seeds_c, control_c = s0, c0
+            for w, win in enumerate(windows):
+                lo, hi = win.start_level, win.start_level + win.depth
+                outs, seeds_c, control_c = _hier_window_jit(
+                    seeds_c,
+                    control_c,
+                    win.entry_pos_dev,
+                    win.path_dev,
+                    jnp.asarray(np.ascontiguousarray(cw_c[:, lo:hi])),
+                    jnp.asarray(np.ascontiguousarray(ccl_c[:, lo:hi])),
+                    jnp.asarray(np.ascontiguousarray(ccr_c[:, lo:hi])),
+                    jnp.asarray(corrs_c[w]),
+                    win.sel_dev,
+                    win.gsels_dev,
+                    plan=win.plan,
+                    bits=bits,
+                    party=batch.party,
+                    xor_group=xor_group,
+                    keep=keep_g,
+                    captures=win.captures,
+                    state_base=win.state_base,
+                    state_cap=win.state_cap,
+                    interpret=interpret,
+                )
+                outs_steps.extend(outs)
+            return valid, outs_steps, seeds_c, control_c
+
+        return thunk
+
+    keep_device = device_output and not multi
+    def finalize(item):
+        valid, outs_steps, xs, xc = item
+        if keep_device:
+            return item
+        return (
+            valid,
+            [np.asarray(o)[:valid] for o in outs_steps],
+            np.asarray(xs)[:valid] if emit_state else None,
+            np.asarray(xc)[:valid] if emit_state else None,
+        )
+
+    thunks = (
+        make_thunk(idx, valid)
+        for idx, valid in _pl.chunk_indices(k, chunk)
+    )
+    per_chunk = list(_pl.map_chunks(thunks, finalize, pipe))
+
+    if keep_device:
+        _, outs_final, xs, xc = per_chunk[0]
+        outs_final = list(outs_final)
+    else:
+        outs_final = [
+            np.concatenate([pc[1][i] for pc in per_chunk], axis=0)
+            for i in range(n_steps)
+        ]
+        xs = xc = None
+        if emit_state:
+            xs = np.concatenate([pc[2] for pc in per_chunk], axis=0)
+            xc = np.concatenate([pc[3] for pc in per_chunk], axis=0)
+
+    # Context update (same contract as the fused path; the hierkernel's
+    # exit state is inherently leaf-ordered — the last segment of the
+    # last window IS the final level's full child-block expansion).
+    if emit_state:
+        ctx.parent_tree = prepared.end_parent_tree
+        ctx.child_levels = prepared.end_child_levels
+        ctx.seeds = xs
+        ctx.control = xc
+    else:
+        ctx.parent_tree = None
+        ctx.child_levels = 0
+        ctx.seeds = None
+        ctx.control = None
+    ctx.previous_hierarchy_level = prepared.final_level
+    return outs_final
+
+
 def evaluate_levels_fused(
     ctx: BatchedContext,
     plan,
@@ -907,6 +1480,9 @@ def evaluate_levels_fused(
     device_output: bool = False,
     use_pallas: Optional[bool] = None,
     mesh=None,
+    mode: Optional[str] = None,
+    key_chunk: Optional[int] = None,
+    pipeline: Optional[bool] = None,
 ) -> list:
     """Advances through MANY hierarchy levels with the per-level prefix sets
     known upfront — the heavy-hitters / experiments access pattern
@@ -932,8 +1508,21 @@ def evaluate_levels_fused(
     state with zero collectives; gather tables replicate). The key count
     must divide evenly over the 'keys' axis.
 
+    `mode` selects the execution strategy: "fused" (the grouped
+    scan/unroll chunks) or "hierkernel" (the hierarchical megakernel,
+    ISSUE 5: ONE pallas_call per key chunk per `group`-advance prefix
+    window). None resolves the DPF_TPU_HIERKERNEL env default, which
+    quietly keeps "fused" for configurations the kernel cannot express
+    (with a structured engine-downgrade event) — an explicit
+    mode="hierkernel" raises instead. `key_chunk`/`pipeline` are
+    hierkernel-mode execution knobs (keys per kernel chunk, the
+    pipelined chunk executor); the fused path evaluates the whole batch
+    in one pass and ignores them.
+
     Returns the per-entry value arrays: uint32[K, n_outputs, lpe] each
-    (numpy unless device_output).
+    (numpy unless device_output; hierkernel mode with an explicit
+    key_chunk below the batch size assembles outputs on the host and
+    returns numpy regardless).
     """
     dpf, v = ctx.dpf, ctx.dpf.validator
     k = len(ctx.keys)
@@ -968,12 +1557,67 @@ def evaluate_levels_fused(
                 f"{prepared.start_prev_level}, the context is at "
                 f"{ctx.previous_hierarchy_level})"
             )
+        if mode is not None and mode != prepared.mode:
+            raise InvalidArgumentError(
+                f"prepared plan was composed for mode={prepared.mode!r}; "
+                f"it cannot execute as mode={mode!r} — re-prepare"
+            )
+        mode = prepared.mode
     else:
         if not plan:
             return []
-        prepared = prepare_levels_fused(ctx, plan, group)
+        mode, prepared = _resolve_hier_prepare(
+            ctx, plan, group, mode, mesh, use_pallas
+        )
+    if mode == "hierkernel":
+        if mesh is not None:
+            raise InvalidArgumentError(
+                "mode='hierkernel' does not support mesh sharding; use "
+                "mode='fused'"
+            )
+        return _evaluate_hierkernel(
+            ctx, prepared, device_output, key_chunk, pipeline
+        )
     if use_pallas is None:
         use_pallas = evaluator._pallas_default()
+    if use_pallas:
+        # The per-step Pallas row kernels silently keep the XLA bitslice
+        # below one vreg row of lanes (planes.shape[2] < 8 in
+        # _advance_one_step) — surface the downgrade structurally so an
+        # A/B run can tell "kernel lost" from "kernel never ran" (the
+        # dcf narrow-batch pattern). Checked AFTER the platform-default
+        # resolution, like dcf: on a real TPU the default is Pallas, and
+        # that is exactly the measurement path that must not read as a
+        # kernel record when the kernel never ran.
+        # A step is flagged only when EVERY one of its expansion levels
+        # runs under one vreg row (the widest level is the entry width
+        # doubled levels-1 times) — multi-level steps whose later levels
+        # reach kernel width keep the Pallas engine for most of their
+        # work and must not read as "kernel never ran". Zero-level steps
+        # expand nothing and are skipped.
+        def _fully_narrow(entry_lanes, lv):
+            return lv > 0 and (entry_lanes << (lv - 1)) < 256
+
+        narrow = [
+            t
+            for t, (pos, lv, _gsel, _start) in enumerate(prepared.steps)
+            if pos is not None and _fully_narrow(pos.shape[0], lv)
+        ]
+        for kind, idx, extras in prepared.chunks:
+            if kind == "scan" and _fully_narrow(
+                extras[0].shape[1], extras[3]
+            ):
+                narrow.extend(idx)
+        if narrow:
+            _emit_hier_downgrade(
+                "fused-pallas",
+                "fused-xla",
+                f"{len(narrow)}/{len(prepared.steps)} advance steps stay "
+                "under one vreg row (256 lanes) at every expansion level; "
+                "they run the XLA bitslice",
+                narrow_steps=len(narrow),
+                plan_steps=len(prepared.steps),
+            )
 
     bits, xor_group = prepared.bits, prepared.xor_group
     batch = evaluator.KeyBatch.from_keys(dpf, ctx.keys, prepared.final_level)

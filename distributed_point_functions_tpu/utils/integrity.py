@@ -643,8 +643,13 @@ def run_device_check(
     `evaluate_at_batch(mode="walkkernel")` point batch plus one DCF
     `batch_evaluate(mode="walkkernel")` pass are differential-verified
     against the host oracle — the hardware gate for the single-program
-    point-walk family, CHECK_MODE=walkkernel) — the program shapes fail
-    independently on a broken backend.
+    point-walk family, CHECK_MODE=walkkernel), or "hierkernel" (the
+    hierarchical megakernel, ISSUE 5: per shape, a heavy-hitters-shaped
+    `evaluate_levels_fused(mode="hierkernel")` multi-window advance is
+    verified at EVERY hierarchy level against the host engine —
+    CHECK_MODE=hierkernel, the hardware gate for the prefix-window
+    family; num_keys drives the key batch, log_domain the level count)
+    — the program shapes fail independently on a broken backend.
 
     `pipeline` (None = DPF_TPU_PIPELINE env / platform default) drives the
     chunk generators through the pipelined executor (ops/pipeline.py) —
@@ -670,6 +675,10 @@ def run_device_check(
     failures = 0
     if mode == "walkkernel":
         return failures + _run_walkkernel_check(
+            shapes, rng, report, pipeline=pipeline
+        )
+    if mode == "hierkernel":
+        return failures + _run_hierkernel_check(
             shapes, rng, report, pipeline=pipeline
         )
     for num_keys, lds in shapes:
@@ -711,6 +720,71 @@ def run_device_check(
                 num_keys=num_keys,
                 log_domain=lds,
                 mode=mode,
+            )
+        failures += bad
+    return failures
+
+
+def _run_hierkernel_check(shapes, rng, report, pipeline=None) -> int:
+    """CHECK_MODE=hierkernel body of `run_device_check`: per
+    (num_keys, log_domain) shape, a heavy-hitters-shaped bit-wise
+    hierarchy (one level per bit, log_domain levels) is advanced through
+    `evaluate_levels_fused(mode="hierkernel")` — the single-program
+    prefix-window megakernel, ISSUE 5 — and EVERY hierarchy level's
+    outputs are verified per key against the host engine. This is the
+    hardware gate for the hier-megakernel family (the real row circuit
+    cannot execute through interpret mode in CI time, so only this check
+    exercises the Mosaic codegen); off-TPU it runs the Pallas
+    interpreter and is CI-practical only at toy shapes. CHECK_HH_GROUP
+    sizes the prefix window (levels per pallas_call),
+    CHECK_HH_NONZEROS the leaf count."""
+    from ..core.dpf import DistributedPointFunction
+    from ..core.params import DpfParameters
+    from ..core.value_types import Int
+    from ..ops import hierarchical
+
+    group = int(os.environ.get("CHECK_HH_GROUP", 16))
+    nonzeros = int(os.environ.get("CHECK_HH_NONZEROS", 200))
+    failures = 0
+    for num_keys, levels in shapes:
+        params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+        dpf = DistributedPointFunction.create_incremental(params)
+        keys = [
+            dpf.generate_keys_incremental(alpha, [23] * levels)[0]
+            for alpha in hierarchical.draw_random_finals(levels, num_keys, rng)
+        ]
+        plan = hierarchical.bitwise_hierarchy_plan(
+            levels, hierarchical.draw_random_finals(levels, nonzeros, rng)
+        )
+        bc = hierarchical.BatchedContext.create(dpf, keys)
+        outs = hierarchical.evaluate_levels_fused(
+            bc, plan, group=group, mode="hierkernel", pipeline=pipeline
+        )
+        bad = 0
+        bch = hierarchical.BatchedContext.create(dpf, keys)
+        for i, (h, p) in enumerate(plan):
+            ref = hierarchical.evaluate_until_batch(bch, h, p, engine="host")
+            got = np.asarray(outs[i])
+            got64 = got[..., 0].astype(np.uint64) | (
+                got[..., 1].astype(np.uint64) << np.uint64(32)
+            )
+            bad_keys = (got64 != np.asarray(ref).astype(np.uint64)).any(axis=1)
+            bad = max(bad, int(bad_keys.sum()))
+        status = "OK" if bad == 0 else f"MISMATCH ({bad}/{num_keys} keys)"
+        report(
+            f"keys={num_keys:4d} levels={levels:3d} mode=hierkernel "
+            f"({len(plan[-1][1])} unique deepest prefixes, "
+            f"group={group}): {status}"
+        )
+        if bad:
+            emit_event(
+                "corruption",
+                f"device check: {bad}/{num_keys} keys mismatch on the "
+                f"{levels}-level hierkernel advance",
+                _backend_name(),
+                num_keys=num_keys,
+                levels=levels,
+                mode="hierkernel",
             )
         failures += bad
     return failures

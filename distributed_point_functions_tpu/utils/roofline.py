@@ -294,6 +294,88 @@ def walk_hbm_fields(
     }
 
 
+def hier_hbm_bytes_per_prefix_level(
+    strategy: str = "fused",
+    lpe: int = 2,
+    keep: int = 2,
+    group: int = 16,
+) -> float:
+    """Modeled HBM bytes moved per (prefix x hierarchy-level) advance of
+    the heavy-hitters hierarchical walk — the hierarchical twin of
+    `hbm_bytes_per_eval` / `walk_hbm_bytes_per_point`. A traffic MODEL,
+    counted from the data each strategy provably round-trips, not a
+    measurement:
+
+    * "fused" — the grouped fused advance (`evaluate_levels_fused`,
+      mode="fused"): per prefix per level the expansion state round-trips
+      HBM between the gather and the expand (2 x 16 B of packed seed
+      planes for the 2/keep tree nodes), the value-hash planes round-trip
+      (2 x 16/keep), the [K, n, lpe] output is written and read (2 x
+      4*lpe), and the precomposed index tables stream in (8 B pos + 8 B
+      gsel per lane) — ~100 B per prefix-level, ~13 KB per prefix across
+      a 128-level hierarchy.
+    * "hierkernel" — the hierarchical megakernel: the whole window's
+      walk lives in VMEM/vregs; per prefix per level the traffic is the
+      value output write (4*lpe*keep B for the full block), the packed
+      path/select mask reads (~(1 + keep)/8 B), and the per-window entry
+      gather + exit state amortized over `group` levels (2 x (16 + 8) /
+      group) — tens of bytes. The hierkernel trades this for ~group/2 x
+      more AES compute (every lane walks the whole window), which the
+      VPU headroom absorbs; the win it buys is dispatch count, not
+      bandwidth — both strategies sit far under either wall.
+    """
+    if strategy not in ("fused", "hierkernel"):
+        raise ValueError(
+            f"no hierarchical HBM traffic model for strategy {strategy!r} "
+            "(modeled: fused/hierkernel)"
+        )
+    if strategy == "fused":
+        planes = 2 * 16.0 * (2.0 / keep)  # gathered state + expansion
+        hashed = 2 * 16.0 / keep  # value-hash planes write + read
+        values = 2 * 4.0 * lpe  # output write + consumer read
+        tables = 8.0 + 8.0  # int64 pos + gsel rows per lane
+        return planes + hashed + values + tables
+    values = 4.0 * lpe * keep  # value-row block write
+    masks = (1.0 + keep) / 8.0  # packed path + select bits, read once
+    window = 2 * (16.0 + 8.0) / max(1, group)  # entry gather + exit state
+    return values + masks + window
+
+
+def hier_hbm_fields(
+    prefix_levels_per_sec: float,
+    strategy: str = "fused",
+    lpe: int = 2,
+    keep: int = 2,
+    group: int = 16,
+) -> dict:
+    """Roofline fields for a measured hierarchical-advance record (the
+    `walk_hbm_fields` twin): the traffic model above next to the VPU
+    ceiling at the walk's per-(prefix, level) hash cost — ~2/keep child
+    hashes plus 1/keep value hash for "fused"; the hierkernel multiplies
+    the child hashes by ~group/2 (every lane walks its whole window) and
+    adds a value hash per capture slot."""
+    ops = hash_ops_per_block()
+    if strategy == "fused":
+        hashes = (2.0 + 1.0) / keep
+    else:
+        hashes = (2.0 / keep) * (max(1, group) / 2.0) + (
+            max(1, group) / 2.0
+        ) / keep
+    per_pl = hashes * ops["element_ops_per_block"]
+    vpu_ceiling = V5E_VPU_OPS_PER_SEC / per_pl
+    bpe = hier_hbm_bytes_per_prefix_level(strategy, lpe, keep, group)
+    hbm_ceiling = V5E_HBM_BYTES_PER_SEC / bpe
+    return {
+        "hier_hbm_bytes_per_prefix_level_model": round(bpe, 2),
+        "hier_vpu_ceiling_prefix_levels_per_sec": round(vpu_ceiling),
+        "hier_hbm_ceiling_prefix_levels_per_sec": round(hbm_ceiling),
+        "hier_mfu_estimate": round(
+            prefix_levels_per_sec * per_pl / V5E_VPU_OPS_PER_SEC, 4
+        ),
+        "hier_binding_wall": "hbm" if hbm_ceiling < vpu_ceiling else "vpu",
+    }
+
+
 def _native_anchor() -> str:
     """Sanity anchor: the same arithmetic for the AES-NI/VAES host engine.
 
@@ -373,6 +455,27 @@ def main(argv) -> int:
             f"{f['walk_hbm_ceiling_points_per_sec']:18.3e} "
             f"{f['walk_vpu_ceiling_points_per_sec']:18.3e} "
             f"{f['walk_binding_wall']:>13s}"
+        )
+    print(
+        "\n# Hierarchical-advance traffic model (per prefix x level; "
+        "u64, keep=2 — the heavy-hitters walk)"
+    )
+    print(
+        f"{'strategy':22s} {'B/pfx-lvl':>10s} {'HBM ceiling':>14s} "
+        f"{'VPU ceiling':>14s} {'binding wall':>13s}"
+    )
+    for strat, grp, label in (
+        ("fused", 16, "fused (group=16)"),
+        ("hierkernel", 16, "hierkernel (g=16)"),
+        ("hierkernel", 32, "hierkernel (g=32)"),
+    ):
+        f = hier_hbm_fields(1.0, strat, lpe=2, keep=2, group=grp)
+        print(
+            f"{label:22s} "
+            f"{f['hier_hbm_bytes_per_prefix_level_model']:10.2f} "
+            f"{f['hier_hbm_ceiling_prefix_levels_per_sec']:14.3e} "
+            f"{f['hier_vpu_ceiling_prefix_levels_per_sec']:14.3e} "
+            f"{f['hier_binding_wall']:>13s}"
         )
     return 0
 

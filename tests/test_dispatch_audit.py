@@ -310,6 +310,65 @@ def test_walkkernel_program_budget(program_counter, monkeypatch):
 
 
 @pytest.mark.slow
+def test_hierkernel_program_budget(program_counter, monkeypatch):
+    """ISSUE 5: mode='hierkernel' is EXACTLY ceil(levels / W) device
+    programs per key chunk for a 128-level heavy-hitters advance — one
+    program per prefix window (the entry gather, the hier megakernel
+    pallas_call and every per-level output selection are one jit) — with
+    the pipelined executor on AND off. W = group = 8 here, so the whole
+    128-level hierarchy is 16 window programs per chunk where the
+    grouped fused path runs ~16 and the per-level path ~1000+; the cheap
+    `_aes_rows` stand-in keeps the interpret compile tractable (2 window
+    shapes: the depth-0-capture first window + the shape-uniform rest) —
+    the program COUNT is circuit-independent."""
+    import jax
+
+    from distributed_point_functions_tpu.ops import aes_pallas
+    from test_aes_pallas import _CheapRows
+    from test_hierkernel import _bitwise_plan
+
+    jax.clear_caches()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    try:
+        levels, group = 128, 8
+        params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+        dpf = DistributedPointFunction.create_incremental(params)
+        keys = [
+            dpf.generate_keys_incremental(a, [23] * levels)[0]
+            for a in (1, 3 << 120, 5, 1 << 127)
+        ]
+        plan = _bitwise_plan(levels, 2, np.random.default_rng(2))
+        proto = hierarchical.BatchedContext.create(dpf, keys)
+        prepared = hierarchical.prepare_levels_fused(
+            proto, plan, group=group, mode="hierkernel"
+        )
+        n_windows = len(prepared.hier_windows)
+        assert n_windows == -(-levels // group)  # ceil(levels / W)
+
+        def run(pipe):
+            bc = hierarchical.BatchedContext.create(dpf, keys)
+            hierarchical.evaluate_levels_fused(
+                bc, prepared, key_chunk=2, pipeline=pipe
+            )
+
+        for pipe in (False, True):
+            run(pipe)  # warm: compiles + constant uploads are allowed
+            program_counter["programs"] = 0
+            run(pipe)
+            got = program_counter["programs"]
+            want = 2 * n_windows  # 4 keys in 2 chunks
+            assert got == want, (
+                f"mode='hierkernel'[pipeline={pipe}]: {got} device programs "
+                f"for 2 chunks of a {levels}-level advance (pinned at "
+                f"EXACTLY ceil(levels/W) = {n_windows} per chunk — the "
+                "whole point of the hier megakernel is one program per "
+                "prefix window)"
+            )
+    finally:
+        jax.clear_caches()  # drop cheap-circuit traces
+
+
+@pytest.mark.slow
 def test_pipelined_dcf_and_pir_program_budget(program_counter):
     """Slow-tier half of the ISSUE 2 pipelined budgets: DCF batch walk and
     single-device chunked PIR (fold mode), pipeline OFF and ON."""

@@ -389,6 +389,66 @@ def test_intmodn_share_sum():
         assert (va[x] + vb[x]) % n == (betas[1] if x == alpha else 0), x
 
 
+def test_positions_for_prefixes_edge_cases():
+    """ISSUE 5 satellite: direct pins of the `_positions_for_prefixes`
+    bookkeeping shared by evaluate_until_batch, the fused plan walk and
+    the hierkernel window composition — empty/single/duplicate prefix
+    sets and the u64 -> U128 regimes around the level-63 crossing."""
+    from distributed_point_functions_tpu.core import uint128
+
+    parent = np.array([3, 7], dtype=np.uint64)
+    # Empty prefix set: empty positions, no raise.
+    pos, tree, tpos = hierarchical._positions_for_prefixes(
+        parent, 2, 4, 3, np.array([], dtype=np.uint64), 1
+    )
+    assert pos.shape == (0,) and tree.shape == (0,)
+    # Single prefix (shift=0: prefixes ARE tree indices).
+    pos, tree, tpos = hierarchical._positions_for_prefixes(
+        parent, 2, 4, 4, np.array([13], dtype=np.uint64), 1
+    )
+    np.testing.assert_array_equal(pos, [0 * 4 + 1])  # 13 = (3 << 2) + 1
+    assert tpos is None
+    # Duplicate prefixes are tolerated AT THIS LAYER (uniqueness is
+    # `_as_prefix_array`'s contract above it): duplicated positions out.
+    pos, tree, _ = hierarchical._positions_for_prefixes(
+        parent, 2, 4, 4, np.array([13, 13], dtype=np.uint64), 1
+    )
+    np.testing.assert_array_equal(pos, [1, 1])
+    # A prefix whose parent is absent raises.
+    with pytest.raises(InvalidArgumentError, match="not present"):
+        hierarchical._positions_for_prefixes(
+            parent, 2, 4, 4, np.array([8], dtype=np.uint64), 1
+        )
+    # u64 -> U128 crossing (level 63): uint64 parent tree, U128 prefixes
+    # — the tp64 branch, including the hi-word alias rejection.
+    pos, tree, _ = hierarchical._positions_for_prefixes(
+        np.array([2, 4], dtype=np.uint64), 1, 64, 64,
+        uint128.u128_array([4, 5, 8]), 1,
+    )
+    np.testing.assert_array_equal(pos, [0, 1, 2])
+    with pytest.raises(InvalidArgumentError, match="not present"):
+        # Shifted low word matches parent 4 but hi != 0: must NOT alias.
+        hierarchical._positions_for_prefixes(
+            np.array([2, 4], dtype=np.uint64), 1, 64, 64,
+            uint128.u128_array([(1 << 65) + 8]), 1,
+        )
+    # Full-U128 regime: U128 parent tree + U128 prefixes.
+    big = 1 << 100
+    pos, tree, _ = hierarchical._positions_for_prefixes(
+        uint128.u128_array([big + 2, big + 4]), 1, 110, 110,
+        uint128.u128_array([2 * (big + 2), 2 * (big + 4) + 1]), 1,
+    )
+    np.testing.assert_array_equal(pos, [0, 3])
+    # Block-bit sharing across the crossing: shift > 0 with U128
+    # prefixes collapsing onto shared tree indices.
+    pos, tree, tpos = hierarchical._positions_for_prefixes(
+        np.array([5], dtype=np.uint64), 1, 64, 63,
+        uint128.u128_array([20, 21, 22]), 1,
+    )
+    np.testing.assert_array_equal(tpos, [0, 0, 1])
+    np.testing.assert_array_equal(pos, [0, 1])  # trees {10, 11} under 5
+
+
 def test_rejects_bad_prefix_sets():
     params = [DpfParameters(d, Int(32)) for d in (3, 6)]
     dpf = DistributedPointFunction.create_incremental(params)

@@ -1,0 +1,641 @@
+"""Hierarchical megakernel (ISSUE 5): single-program prefix-window
+advances for the heavy-hitters path.
+
+Testing strategy follows the megakernel family's established split
+(tests/test_megakernel.py, tests/test_walkkernel.py): the REAL row AES
+circuit cannot execute through an interpret-mode pallas_call in CI time,
+so
+
+* the hier-megakernel MATH — per-lane path walks composed from the
+  host-side prefix bookkeeping, per-level value capture with the FULL
+  party correction, the one-hot select-mask placement across capture
+  slots, the exit-state export and the window chaining — is pinned
+  bit-exact against the HOST ORACLE through
+  `hier_megakernel_reference_rows`, the pure-array replay running the
+  SAME `_hier_megakernel_core` eagerly (jax.disable_jit);
+* the pallas_call PLUMBING — (keys, lane-tiles) grid, BlockSpec tiling,
+  the value-row output layout, per-step output gathers, key chunking and
+  the pipelined executor — runs in interpret mode with the cheap
+  `_aes_rows` stand-in through the REAL entry point and must match the
+  replay under the same stand-in.
+
+Compile budget: every distinct interpret-pallas config costs ~40-115 s
+of XLA-CPU compile, so the fast tier runs ONE compiled config — a
+continuation plan whose windows are shape-uniform (the state_cap /
+uniform-lane-width machinery exists exactly for this), with every
+equivalence variant (key chunking, pipeline on/off, env default,
+prepared replay) sharing that compile; the multi-window multi-tile
+interpret differential and the 128-level real-circuit oracle replay live
+in the slow tier, and the program-count audit in test_dispatch_audit.py's
+slow tier with the other megakernel audits.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, IntModN
+from distributed_point_functions_tpu.ops import (
+    aes_jax,
+    aes_pallas,
+    backend_jax,
+    evaluator,
+    hierarchical,
+)
+from distributed_point_functions_tpu.utils import integrity
+from distributed_point_functions_tpu.utils.errors import InvalidArgumentError
+from test_aes_pallas import _CheapRows
+
+RNG = np.random.default_rng(0x51E7)
+
+# Forces multi-tile plans at toy lane counts (the 128-word tile floor
+# splits > 4096-lane windows) — the interesting grid structure.
+TINY_VMEM = 200_000
+
+
+@pytest.fixture
+def cheap_rows(monkeypatch):
+    jax.clear_caches()  # jitted wrappers may hold real-circuit traces
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    yield
+    jax.clear_caches()  # drop cheap-circuit traces before the next test
+
+
+def _bitwise_plan(levels, num_nonzeros, rng):
+    """Heavy-hitters-shaped plan: one hierarchy level per bit, the unique
+    prefixes of `num_nonzeros` uniform final-level leaves at every bit
+    (the bench_heavy_hitters workload, u128 prefix regime at >= 64).
+    Leaf drawing AND plan construction shared with the device check /
+    check_device via the hierarchical-module helpers."""
+    return hierarchical.bitwise_hierarchy_plan(
+        levels, hierarchical.draw_random_finals(levels, num_nonzeros, rng)
+    )
+
+
+def _hier_replay_all(dpf, keys, prepared, key_index=0):
+    """Drives `hier_megakernel_reference_rows` window by window for ONE
+    key — the pure-array mirror of `_evaluate_hierkernel` (entry gather,
+    flat transpose, per-step gsel selection, exit-state chaining) used
+    by both the eager real-circuit oracle tests and the interpret
+    comparisons. Returns the per-step [n_outputs, lpe] arrays."""
+    v = dpf.validator
+    bits, keep_g = prepared.bits, prepared.hier_keep
+    lpe = bits // 32
+    batch = evaluator.KeyBatch.from_keys(dpf, keys, prepared.final_level)
+    vcs = [
+        hierarchical._level_value_corrections(keys, v, h, bits)
+        for h in prepared.plan_levels
+    ]
+    k = len(keys)
+    corrs = [
+        hierarchical._hier_corr_rows(win, vcs, k, keep_g, lpe)
+        for win in prepared.hier_windows
+    ]
+    i = key_index
+    if prepared.start_prev_level < 0:
+        seeds = np.broadcast_to(batch.seeds[:, None, :], (k, 1, 4)).copy()
+        control = np.full((k, 1), np.uint32(1 if batch.party else 0))
+    else:
+        raise AssertionError("replay helper expects a fresh-context plan")
+    cw_all, ccl_all, ccr_all = batch.device_cw_arrays(0)
+    outs = []
+    for w, win in enumerate(prepared.hier_windows):
+        ep = np.asarray(win.entry_pos_dev)
+        ent = seeds[i][np.minimum(ep, seeds.shape[1] - 1)]
+        cbits = control[i][np.minimum(ep, seeds.shape[1] - 1)].astype(bool)
+        planes = np.asarray(aes_jax.pack_to_planes(jnp.asarray(ent)))
+        cmask = aes_jax.pack_bit_mask(cbits)
+        lo, hi = win.start_level, win.start_level + win.depth
+        vals, xp, xc = aes_pallas.hier_megakernel_reference_rows(
+            jnp.asarray(planes),
+            jnp.asarray(cmask),
+            win.path_dev,
+            jnp.asarray(cw_all[i, lo:hi]),
+            jnp.asarray(ccl_all[i, lo:hi]),
+            jnp.asarray(ccr_all[i, lo:hi]),
+            jnp.asarray(corrs[w][i]),
+            win.sel_dev,
+            bits=bits,
+            party=batch.party,
+            xor_group=prepared.xor_group,
+            keep=keep_g,
+            captures=win.captures,
+        )
+        vals = np.asarray(vals)
+        wp = win.plan.padded_words
+        flat = (
+            vals.reshape(keep_g, lpe, 32, wp)
+            .transpose(3, 2, 0, 1)
+            .reshape(wp * 32 * keep_g, lpe)
+        )
+        for g in win.gsels_dev:
+            outs.append(flat[np.asarray(g)])
+        xseeds = np.asarray(aes_jax.unpack_from_planes(jnp.asarray(np.asarray(xp))))
+        xcb = np.asarray(
+            backend_jax.unpack_mask_device(jnp.asarray(np.asarray(xc)))
+        )
+        sb, sl = win.state_base, win.state_len
+        seeds = np.zeros((k, sl, 4), np.uint32)
+        control = np.zeros((k, sl), np.uint32)
+        seeds[i] = xseeds[sb : sb + sl]
+        control[i] = xcb[sb : sb + sl]
+    return outs
+
+
+def _u64(vals):
+    return vals[..., 0].astype(np.uint64) | (
+        vals[..., 1].astype(np.uint64) << np.uint64(32)
+    )
+
+
+def _uniform_chain_workload(lds0, steps, C, delta=2):
+    """Continuation plan whose windows are exactly shape-uniform: after a
+    full-domain pre-advance at `lds0`, every step advances `delta` tree
+    levels under the "child 1" prefix chain S <- 4S + 1, which keeps C
+    prefixes on C distinct tree nodes at every level — segment bases,
+    gsel lengths and state widths never drift, so equal-step windows
+    share ONE compiled config."""
+    lds_list = [lds0] + [lds0 + delta * (i + 1) for i in range(steps)]
+    params = [DpfParameters(d, Int(64)) for d in lds_list]
+    dpf = DistributedPointFunction.create_incremental(params)
+    keys = [
+        dpf.generate_keys_incremental(a % (1 << lds_list[-1]), [7] * len(lds_list))[0]
+        for a in (3, 11, 27)
+    ]
+    S = [2 * i for i in range(C)]
+    plan = []
+    for i in range(1, len(lds_list)):
+        plan.append((i, sorted(S)))
+        S = [4 * s + 1 for s in S]
+    return dpf, keys, plan
+
+
+# ---------------------------------------------------------------------------
+# Planner pins (fast)
+# ---------------------------------------------------------------------------
+
+
+def test_plan_hierkernel_bounds():
+    for lanes in (1, 90, 4000, 100_000):
+        plan = evaluator.plan_hierkernel(lanes, 8, 16, 2, keep=2)
+        w = -(-lanes // 32)
+        assert plan.padded_words >= w
+        assert plan.tile_words * plan.num_tiles == plan.padded_words
+        assert plan.levels == 8
+        if plan.num_tiles > 1:
+            assert plan.tile_words >= 128
+            assert plan.tile_words & (plan.tile_words - 1) == 0
+        else:
+            assert plan.tile_words % 8 == 0
+    # default budget fills (8, 128) vregs for large windows
+    assert evaluator.plan_hierkernel(1_000_000, 16, 32, 2, keep=2).tile_words >= 1024
+    # tiny budgets split into multiple tiles (128-word floor)
+    assert (
+        evaluator.plan_hierkernel(
+            8192, 6, 6, 2, keep=2, vmem_budget=TINY_VMEM
+        ).num_tiles
+        >= 2
+    )
+    with pytest.raises(InvalidArgumentError):
+        evaluator.plan_hierkernel(64, 0, 4, 2)
+
+
+# ---------------------------------------------------------------------------
+# Real circuit vs the host oracle (eager replay)
+# ---------------------------------------------------------------------------
+
+
+def test_hierkernel_replay_matches_host_oracle_small():
+    """Fresh 5-level Int(64) bit-wise hierarchy (keep=2 block selection,
+    a depth-0 capture in window 0, three windows chained through the
+    exit state), REAL circuit: the replay == the native host engine at
+    every hierarchy level."""
+    levels = 5
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(0b10110, [9] * levels)
+    plan = _bitwise_plan(levels, 7, np.random.default_rng(3))
+
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    prepared = hierarchical.prepare_levels_fused(
+        bc, plan, group=2, mode="hierkernel"
+    )
+    assert len(prepared.hier_windows) == 3
+    with jax.disable_jit():
+        got = _hier_replay_all(dpf, [ka], prepared)
+    bch = hierarchical.BatchedContext.create(dpf, [ka])
+    for i, (h, p) in enumerate(plan):
+        want = hierarchical.evaluate_until_batch(bch, h, p, engine="host")
+        np.testing.assert_array_equal(
+            _u64(got[i]), np.asarray(want)[0].astype(np.uint64),
+            err_msg=f"level {h}",
+        )
+
+
+def test_hierkernel_replay_party1_small():
+    """Party-1 correction (the additive negation inside every capture,
+    NOT the DCF one-shot negation), REAL circuit, 4 levels."""
+    levels = 4
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    _, kb = dpf.generate_keys_incremental(0b1011, [5] * levels)
+    plan = _bitwise_plan(levels, 5, np.random.default_rng(4))
+    bc = hierarchical.BatchedContext.create(dpf, [kb])
+    prepared = hierarchical.prepare_levels_fused(
+        bc, plan, group=2, mode="hierkernel"
+    )
+    with jax.disable_jit():
+        got = _hier_replay_all(dpf, [kb], prepared)
+    bch = hierarchical.BatchedContext.create(dpf, [kb])
+    for i, (h, p) in enumerate(plan):
+        want = hierarchical.evaluate_until_batch(bch, h, p, engine="host")
+        np.testing.assert_array_equal(
+            _u64(got[i]), np.asarray(want)[0].astype(np.uint64),
+            err_msg=f"level {h}",
+        )
+
+
+@pytest.mark.slow
+def test_hierkernel_replay_128_levels_10k_prefixes_u128_oracle():
+    """THE acceptance oracle: a 128-level bit-wise hierarchy with 10k
+    uniform nonzeros — the heavy-hitters bench workload, crossing the
+    u64 -> U128 prefix-bookkeeping boundary at level 63 — REAL circuit,
+    BOTH parties: the eager replay of every window (ceil(128/8) = 16
+    windows) is bit-exact against the native host engine at every one of
+    the 128 hierarchy levels."""
+    levels = 128
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, kb = dpf.generate_keys_incremental(
+        42 % (1 << levels), [23] * levels
+    )
+    plan = _bitwise_plan(levels, 10_000, np.random.default_rng(7))
+    for key in (ka, kb):
+        bc = hierarchical.BatchedContext.create(dpf, [key])
+        prepared = hierarchical.prepare_levels_fused(
+            bc, plan, group=8, mode="hierkernel"
+        )
+        assert len(prepared.hier_windows) == 16
+        with jax.disable_jit():
+            got = _hier_replay_all(dpf, [key], prepared)
+        bch = hierarchical.BatchedContext.create(dpf, [key])
+        for i, (h, p) in enumerate(plan):
+            want = hierarchical.evaluate_until_batch(
+                bch, h, p, engine="host"
+            )
+            np.testing.assert_array_equal(
+                _u64(got[i]),
+                np.asarray(want)[0].astype(np.uint64),
+                err_msg=f"level {h} party {key.party}",
+            )
+
+
+# ---------------------------------------------------------------------------
+# Interpret-mode pallas plumbing (cheap circuit) through the REAL entry
+# point — ONE compiled config; every variant shares the compile
+# ---------------------------------------------------------------------------
+
+
+def test_hierkernel_entry_interpret_one_config(cheap_rows, monkeypatch):
+    """evaluate_levels_fused(mode='hierkernel') on a shape-uniform
+    2-window continuation plan: the pallas grid/BlockSpec plumbing, the
+    value-row transpose + per-step gathers, window chaining through the
+    state_cap-padded exit state, key chunking, the pipelined executor,
+    the DPF_TPU_HIERKERNEL env default and the prepared-plan replay are
+    all bit-exact vs the eager cheap replay — ONE compiled window
+    program (pinned via the jit cache), every variant reusing it."""
+    dpf, keys, plan = _uniform_chain_workload(lds0=6, steps=4, C=12)
+    keys = keys[:3]
+
+    def fresh_ctx():
+        bc = hierarchical.BatchedContext.create(dpf, keys)
+        hierarchical.evaluate_until_batch(bc, 0, device_output=True)
+        return bc
+
+    bc = fresh_ctx()
+    prepared = hierarchical.prepare_levels_fused(
+        bc, plan, group=2, mode="hierkernel"
+    )
+    ws = prepared.hier_windows
+    assert len(ws) == 2
+    # Shape uniformity — the precondition for the single compile.
+    assert ws[0].plan == ws[1].plan
+    assert ws[0].captures == ws[1].captures
+    assert ws[0].state_base == ws[1].state_base
+    assert ws[0].state_cap == ws[1].state_cap
+    assert [g.shape for g in ws[0].gsels_dev] == [
+        g.shape for g in ws[1].gsels_dev
+    ]
+
+    base = hierarchical.evaluate_levels_fused(
+        bc, prepared, key_chunk=2, pipeline=False
+    )
+    try:
+        assert hierarchical._hier_window_jit._cache_size() == 1
+    except AttributeError:
+        pass  # older jax without the cache-size API
+
+    # Cheap replay reference, per key (entry gather replayed from the
+    # same pre-advanced state via a dedicated replay context).
+    for i in range(len(keys)):
+        ref = _hier_replay_cont(dpf, keys, plan, i)
+        for d, (g, r) in enumerate(zip(base, ref)):
+            np.testing.assert_array_equal(
+                np.asarray(g)[i], r, err_msg=f"level {d} key {i}"
+            )
+
+    # Pipelined executor must not change results (same compiled program).
+    bc = fresh_ctx()
+    np.testing.assert_array_equal(
+        np.asarray(
+            hierarchical.evaluate_levels_fused(
+                bc, prepared, key_chunk=2, pipeline=True
+            )
+        ),
+        np.asarray(base),
+    )
+    # env default: DPF_TPU_HIERKERNEL=1 + mode=None resolves to hierkernel.
+    monkeypatch.setenv("DPF_TPU_HIERKERNEL", "1")
+    bc = fresh_ctx()
+    np.testing.assert_array_equal(
+        np.asarray(
+            hierarchical.evaluate_levels_fused(
+                bc, plan, group=2, key_chunk=2, pipeline=False
+            )
+        ),
+        np.asarray(base),
+    )
+    monkeypatch.delenv("DPF_TPU_HIERKERNEL")
+    # Prepared replay across a different key order — and the resumable
+    # state: both executions must resume identically on the plain path.
+    bc_a = fresh_ctx()
+    hierarchical.evaluate_levels_fused(
+        bc_a, plan[:-1], group=2, mode="hierkernel", key_chunk=2
+    )
+    bc_b = fresh_ctx()
+    hierarchical.evaluate_levels_fused(
+        bc_b, plan[:-1], group=2, mode="hierkernel", key_chunk=2,
+        pipeline=True,
+    )
+    h_last, p_last = plan[-1]
+    out_a = hierarchical.evaluate_until_batch(bc_a, h_last, p_last)
+    out_b = hierarchical.evaluate_until_batch(bc_b, h_last, p_last)
+    np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+
+def _hier_replay_cont(dpf, keys, plan, key_index):
+    """Continuation-entry replay: pre-advances a context to hierarchy
+    level 0 on the XLA path, then drives the window replay from that
+    state (the `_hier_replay_all` twin for continuation plans)."""
+    v = dpf.validator
+    bc = hierarchical.BatchedContext.create(dpf, keys)
+    hierarchical.evaluate_until_batch(bc, 0, device_output=True)
+    prepared = hierarchical.prepare_levels_fused(
+        bc, plan, group=2, mode="hierkernel"
+    )
+    bits, keep_g = prepared.bits, prepared.hier_keep
+    lpe = bits // 32
+    batch = evaluator.KeyBatch.from_keys(dpf, keys, prepared.final_level)
+    vcs = [
+        hierarchical._level_value_corrections(keys, v, h, bits)
+        for h in prepared.plan_levels
+    ]
+    k = len(keys)
+    corrs = [
+        hierarchical._hier_corr_rows(win, vcs, k, keep_g, lpe)
+        for win in prepared.hier_windows
+    ]
+    cw_all, ccl_all, ccr_all = batch.device_cw_arrays(0)
+    seeds = np.asarray(bc.seeds)
+    control = np.asarray(bc.control).astype(np.uint32)
+    i = key_index
+    outs = []
+    with jax.disable_jit():
+        for w, win in enumerate(prepared.hier_windows):
+            ep = np.asarray(win.entry_pos_dev)
+            ep = np.minimum(ep, seeds.shape[1] - 1)
+            planes = np.asarray(
+                aes_jax.pack_to_planes(jnp.asarray(seeds[i][ep]))
+            )
+            cmask = aes_jax.pack_bit_mask(control[i][ep].astype(bool))
+            lo, hi = win.start_level, win.start_level + win.depth
+            vals, xp, xc = aes_pallas.hier_megakernel_reference_rows(
+                jnp.asarray(planes),
+                jnp.asarray(cmask),
+                win.path_dev,
+                jnp.asarray(cw_all[i, lo:hi]),
+                jnp.asarray(ccl_all[i, lo:hi]),
+                jnp.asarray(ccr_all[i, lo:hi]),
+                jnp.asarray(corrs[w][i]),
+                win.sel_dev,
+                bits=bits,
+                party=batch.party,
+                xor_group=prepared.xor_group,
+                keep=keep_g,
+                captures=win.captures,
+            )
+            vals = np.asarray(vals)
+            wp = win.plan.padded_words
+            flat = (
+                vals.reshape(keep_g, lpe, 32, wp)
+                .transpose(3, 2, 0, 1)
+                .reshape(wp * 32 * keep_g, lpe)
+            )
+            for g in win.gsels_dev:
+                outs.append(flat[np.asarray(g)])
+            xseeds = np.asarray(
+                aes_jax.unpack_from_planes(jnp.asarray(np.asarray(xp)))
+            )
+            xcb = np.asarray(
+                backend_jax.unpack_mask_device(jnp.asarray(np.asarray(xc)))
+            )
+            sb, sl = win.state_base, win.state_len
+            seeds = np.zeros((k, sl, 4), np.uint32)
+            control = np.zeros((k, sl), np.uint32)
+            seeds[i] = xseeds[sb : sb + sl]
+            control[i] = xcb[sb : sb + sl]
+    return outs
+
+
+@pytest.mark.slow
+def test_hierkernel_interpret_multiwindow_multitile(cheap_rows):
+    """The forced multi-window, multi-prefix-tile plan (acceptance): 2
+    shape-uniform windows x 2 lane tiles under DPF_TPU_HIERKERNEL_VMEM;
+    interpret-mode pallas through the real entry point == the eager
+    cheap replay for every key and level."""
+    os.environ["DPF_TPU_HIERKERNEL_VMEM"] = str(TINY_VMEM)
+    try:
+        dpf, keys, plan = _uniform_chain_workload(lds0=10, steps=6, C=400)
+        keys = keys[:2]
+        bc = hierarchical.BatchedContext.create(dpf, keys)
+        hierarchical.evaluate_until_batch(bc, 0, device_output=True)
+        prepared = hierarchical.prepare_levels_fused(
+            bc, plan, group=3, mode="hierkernel"
+        )
+        ws = prepared.hier_windows
+        assert len(ws) == 2 and ws[0].plan.num_tiles >= 2, ws[0].plan
+        assert ws[0].plan == ws[1].plan and ws[0].captures == ws[1].captures
+        got = hierarchical.evaluate_levels_fused(bc, prepared)
+        for i in range(len(keys)):
+            ref = _hier_replay_cont(dpf, keys, plan, i)
+            for d, (g, r) in enumerate(zip(got, ref)):
+                np.testing.assert_array_equal(
+                    np.asarray(g)[i], r, err_msg=f"level {d} key {i}"
+                )
+    finally:
+        del os.environ["DPF_TPU_HIERKERNEL_VMEM"]
+
+
+# ---------------------------------------------------------------------------
+# Mode plumbing, guards and downgrade events (no kernel execution — fast)
+# ---------------------------------------------------------------------------
+
+
+def test_hierkernel_mode_guards():
+    levels = 4
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    dpf = DistributedPointFunction.create_incremental(params)
+    ka, _ = dpf.generate_keys_incremental(3, [5] * levels)
+    plan = _bitwise_plan(levels, 3, np.random.default_rng(5))
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    with pytest.raises(InvalidArgumentError, match="fused"):
+        hierarchical.evaluate_levels_fused(bc, plan, mode="nope")
+    # Explicit hierkernel on sub-word value widths raises...
+    dpf8 = DistributedPointFunction.create_incremental(
+        [DpfParameters(d, Int(8)) for d in (2, 4)]
+    )
+    k8, _ = dpf8.generate_keys_incremental(1, [3, 3])
+    bc8 = hierarchical.BatchedContext.create(dpf8, [k8])
+    with pytest.raises(NotImplementedError, match="32-bit-multiple"):
+        hierarchical.prepare_levels_fused(
+            bc8, [(0, []), (1, [0, 1])], mode="hierkernel"
+        )
+    # ...codec value types raise the fused path's own error either way.
+    dpfn = DistributedPointFunction.create(DpfParameters(4, IntModN(32, 97)))
+    kn, _ = dpfn.generate_keys(3, 55)
+    bn = hierarchical.BatchedContext.create(dpfn, [kn])
+    with pytest.raises(InvalidArgumentError, match="scalar Int/XorWrapper"):
+        hierarchical.evaluate_levels_fused(bn, [(0, [])], mode="hierkernel")
+    # A window that advances zero tree levels (a lone level-0 step at
+    # tree depth 0): explicit raises. (Mid-plan zero-level steps cannot
+    # occur — the validator keeps tree levels strictly increasing — but
+    # the composition guards them defensively.)
+    dpf1 = DistributedPointFunction.create_incremental(
+        [DpfParameters(d, Int(64)) for d in (1, 2)]
+    )
+    k1, _ = dpf1.generate_keys_incremental(1, [3, 3])
+    b1 = hierarchical.BatchedContext.create(dpf1, [k1])
+    with pytest.raises(NotImplementedError, match="zero tree levels"):
+        hierarchical.prepare_levels_fused(b1, [(0, [])], mode="hierkernel")
+    # Mesh sharding is fused-only.
+    from distributed_point_functions_tpu.parallel import sharded
+
+    mesh = sharded.make_mesh(1, 1)
+    bc2 = hierarchical.BatchedContext.create(dpf, [ka])
+    with pytest.raises(InvalidArgumentError, match="mesh"):
+        hierarchical.evaluate_levels_fused(
+            bc2, plan, mode="hierkernel", mesh=mesh
+        )
+    # A prepared plan only executes under its own mode.
+    bc3 = hierarchical.BatchedContext.create(dpf, [ka])
+    prepared = hierarchical.prepare_levels_fused(bc3, plan, group=2)
+    with pytest.raises(InvalidArgumentError, match="re-prepare"):
+        hierarchical.evaluate_levels_fused(bc3, prepared, mode="hierkernel")
+    # The env A/B default yields to an explicit use_pallas=False; an
+    # EXPLICIT mode wins over the engine knob (the walkkernel rule) —
+    # resolution only, no kernel execution.
+    os.environ["DPF_TPU_HIERKERNEL"] = "1"
+    try:
+        bc4 = hierarchical.BatchedContext.create(dpf, [ka])
+        with integrity.capture_events() as events:
+            mode, _p = hierarchical._resolve_hier_prepare(
+                bc4, plan, 2, None, None, False
+            )
+        assert mode == "fused"
+        assert "engine-downgrade" in [e.kind for e in events]
+        mode, p2 = hierarchical._resolve_hier_prepare(
+            bc4, plan, 2, "hierkernel", None, False
+        )
+        assert mode == "hierkernel" and p2.mode == "hierkernel"
+    finally:
+        del os.environ["DPF_TPU_HIERKERNEL"]
+    # Prepare-only composition across the u64 -> U128 crossing at level
+    # 63 (the numeric differential is the slow oracle test): the window
+    # bookkeeping must compose without touching a kernel.
+    deep = 66
+    dparams = [DpfParameters(i + 1, Int(64)) for i in range(deep)]
+    ddpf = DistributedPointFunction.create_incremental(dparams)
+    dk, _ = ddpf.generate_keys_incremental(5, [9] * deep)
+    dplan = _bitwise_plan(deep, 3, np.random.default_rng(9))
+    dbc = hierarchical.BatchedContext.create(ddpf, [dk])
+    dprep = hierarchical.prepare_levels_fused(
+        dbc, dplan, group=8, mode="hierkernel"
+    )
+    assert len(dprep.hier_windows) == -(-deep // 8)
+
+
+def test_hierkernel_env_default_downgrade_event_payload():
+    """ISSUE 5 satellite: the DPF_TPU_HIERKERNEL env default silently
+    falling back to the fused path emits a structured engine-downgrade
+    IntegrityEvent with a pinned payload — and the call still computes
+    correct results through the fused path."""
+    # Sub-word value width (Int(16)) — a value shape the hierkernel's
+    # 32-bit-limb capture tail rejects but the fused path handles.
+    dpf = DistributedPointFunction.create_incremental(
+        [DpfParameters(d, Int(16)) for d in (2, 4)]
+    )
+    ka, _ = dpf.generate_keys_incremental(2, [3, 5])
+    plan = [(0, []), (1, [0, 1])]
+    os.environ["DPF_TPU_HIERKERNEL"] = "1"
+    try:
+        bc = hierarchical.BatchedContext.create(dpf, [ka])
+        with integrity.capture_events() as events:
+            got = hierarchical.evaluate_levels_fused(bc, plan, use_pallas=False)
+    finally:
+        del os.environ["DPF_TPU_HIERKERNEL"]
+    kinds = [e.kind for e in events]
+    assert "engine-downgrade" in kinds, kinds
+    ev = events[kinds.index("engine-downgrade")]
+    assert ev.data["from"] == "hierkernel"
+    assert ev.data["downgraded_to"] == "fused"
+    assert ev.data["path"] == "hierarchical"
+    assert "reason" in ev.data and ev.data["plan_steps"] == 2
+    # The downgraded call still runs the fused path correctly.
+    bc_ref = hierarchical.BatchedContext.create(dpf, [ka])
+    ref = [
+        hierarchical.evaluate_until_batch(bc_ref, h, p) for h, p in plan
+    ]
+    for g, r in zip(got, ref):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(r))
+
+
+def test_fused_narrow_pallas_downgrade_event():
+    """The fused path's silent narrow-width Pallas -> XLA downgrade
+    (every step under one vreg row) now surfaces as an engine-downgrade
+    event when the caller explicitly requested the row kernels."""
+    levels = 3
+    dpf = DistributedPointFunction.create_incremental(
+        [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    )
+    ka, _ = dpf.generate_keys_incremental(1, [5] * levels)
+    plan = _bitwise_plan(levels, 2, np.random.default_rng(6))
+    bc = hierarchical.BatchedContext.create(dpf, [ka])
+    with integrity.capture_events() as events:
+        hierarchical.evaluate_levels_fused(bc, plan, use_pallas=True)
+    ev = [e for e in events if e.kind == "engine-downgrade"]
+    assert ev and ev[0].data["from"] == "fused-pallas"
+    assert ev[0].data["downgraded_to"] == "fused-xla"
+    # The zero-expansion level-0 step is not counted (nothing to
+    # downgrade); the two 1-level advances are fully narrow.
+    assert ev[0].data["narrow_steps"] == levels - 1
+    # ...and with use_pallas=False (no kernel requested) there is
+    # nothing to downgrade: no event.
+    bc2 = hierarchical.BatchedContext.create(dpf, [ka])
+    with integrity.capture_events() as events:
+        hierarchical.evaluate_levels_fused(bc2, plan, use_pallas=False)
+    assert "engine-downgrade" not in [e.kind for e in events]

@@ -97,3 +97,46 @@ class TestWalkTrafficModel:
     def test_walk_hashes_per_point(self):
         assert roofline.walk_hashes_per_point(32) == 33.0
         assert roofline.walk_hashes_per_point(32, captures=33) == 65.0
+
+
+class TestHierTrafficModel:
+    """ISSUE 5: the hierarchical-advance HBM traffic model behind the
+    hierkernel A/B records (bench_heavy_hitters mode="hierkernel")."""
+
+    def test_hierkernel_eliminates_per_level_state_traffic(self):
+        # The fused advance round-trips gathered seed planes + hashed
+        # planes + index tables per (prefix, level) — ~100 B; the
+        # hierkernel keeps the window's walk in VMEM, leaving the value
+        # output + packed masks + the window-amortized entry/exit.
+        fused = roofline.hier_hbm_bytes_per_prefix_level("fused")
+        for group in (8, 16, 32):
+            hk = roofline.hier_hbm_bytes_per_prefix_level(
+                "hierkernel", group=group
+            )
+            assert hk < 32  # "tens of bytes"
+            assert fused > 3 * hk
+        # deeper windows amortize the entry/exit further
+        assert roofline.hier_hbm_bytes_per_prefix_level(
+            "hierkernel", group=32
+        ) < roofline.hier_hbm_bytes_per_prefix_level("hierkernel", group=8)
+        with pytest.raises(ValueError):
+            roofline.hier_hbm_bytes_per_prefix_level("walk")
+
+    def test_hier_fields_shape(self):
+        f = roofline.hier_hbm_fields(4e6, "fused")
+        g = roofline.hier_hbm_fields(4e6, "hierkernel", group=16)
+        for d in (f, g):
+            assert d["hier_hbm_bytes_per_prefix_level_model"] > 0
+            assert d["hier_vpu_ceiling_prefix_levels_per_sec"] > 0
+            assert d["hier_binding_wall"] in ("vpu", "hbm")
+            assert 0 < d["hier_mfu_estimate"] < 1
+            # every key is hier_-prefixed: records can carry this model
+            # next to the full-domain/walk ones without key collisions
+            assert all(key.startswith("hier_") for key in d)
+        # The hierkernel SPENDS compute to buy dispatch count (~group/2 x
+        # the hashes: every lane walks its whole window): its VPU ceiling
+        # must honestly sit below the fused one.
+        assert (
+            g["hier_vpu_ceiling_prefix_levels_per_sec"]
+            < f["hier_vpu_ceiling_prefix_levels_per_sec"]
+        )

@@ -102,7 +102,12 @@ def _main() -> int:
     # ISSUE 4: evaluate_at_batch + DCF batch_evaluate differentials vs
     # the host oracle — the hardware gate for the walk-megakernel family,
     # CHECK_MODE=walkkernel from tools/tpu_measure.sh's gate-walkkernel
-    # stage) — the program shapes fail independently on a broken
+    # stage) or "hierkernel" (the hierarchical prefix-window megakernel,
+    # ISSUE 5: a heavy-hitters-shaped evaluate_levels_fused advance
+    # verified at EVERY level vs the host engine; shapes read as
+    # (num_keys, levels) — tpu_measure.sh's gate-hierkernel stage;
+    # CHECK_HH_GROUP sizes the window, CHECK_HH_NONZEROS the leaf set)
+    # — the program shapes fail independently on a broken
     # backend (PERF.md). This tool measures the RAW platform:
     # auto-slabbing would hide exactly the over-threshold programs being
     # probed, so it is force-disabled regardless of the caller's
@@ -138,13 +143,14 @@ def _main() -> int:
 
 def _hh_plan(levels, num_finals, rng):
     """Heavy-hitters-shaped fused-advance plan: every 1-level advance under
-    the surviving prefixes of `num_finals` random leaves."""
-    finals = sorted({int(x) for x in rng.integers(0, 1 << levels, size=num_finals)})
-    pres = [
-        sorted({f >> (levels - (i + 1)) for f in finals})
-        for i in range(levels)
-    ]
-    return [(0, [])] + [(i, pres[i - 1]) for i in range(1, levels)]
+    the surviving prefixes of `num_finals` random leaves (construction
+    shared with the library's device check / the test suites via
+    hierarchical.bitwise_hierarchy_plan so the plan convention cannot
+    drift)."""
+    from distributed_point_functions_tpu.ops import hierarchical
+
+    finals = {int(x) for x in rng.integers(0, 1 << levels, size=num_finals)}
+    return hierarchical.bitwise_hierarchy_plan(levels, finals)
 
 
 def _fused_matches_host(hierarchical, evaluator, dpf, key, outs, plan) -> bool:

@@ -125,6 +125,22 @@ BENCH_DCF_MODE=walkkernel \
   stage dcf_walkkernel 1500 python tools/run_bench_stage.py bench_dcf.py \
   RECORD_SUFFIX=_walkkernel SUPERSEDES=dcf_batch
 
+# 2b''. Hierarchical-megakernel A/B records (ISSUE 5), same discipline:
+# the correctness gate first (CHECK_MODE=hierkernel verifies a
+# heavy-hitters-shaped prefix-window advance at EVERY level vs the host
+# engine on-chip — shapes are (num_keys, levels); group=32 => 4 window
+# programs for 128 levels), then the heavy-hitters bench on the
+# hierkernel strategy in its own results.json slot. SUPERSEDES targets
+# the HOST-engine heavy_hitters record — a verified faster device record
+# flips the engine table's last "host wins" row, which run_bench_stage's
+# cross-engine supersede records explicitly; the bench's own host-oracle
+# spot verification gates the verified flag.
+CHECK_MODE=hierkernel CHECK_SHAPES=1x24,2x64 CHECK_HH_GROUP=32 \
+  stage gate-hierkernel 900 python tools/check_device.py
+BENCH_HH_ENGINE=device BENCH_HH_MODE=hierkernel BENCH_HH_GROUP=32 \
+  stage heavy_hitters_hierkernel 2700 python tools/run_bench_stage.py bench_heavy_hitters.py \
+  RECORD_SUFFIX=_hierkernel SUPERSEDES=heavy_hitters
+
 # 2c. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
 # the pipelined chunk executor forced OFF land in their own results.json
 # slots, so the on/off pair is a first-class record pair (not just the
@@ -185,6 +201,7 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
 # stop re-firing sessions.
 required="headline gate-megakernel headline_megakernel pir_megakernel \
 gate-walkkernel evaluate_at_walkkernel dcf_walkkernel \
+gate-hierkernel heavy_hitters_hierkernel \
 headline-syncexec pir-syncexec evalat dcf hh-device \
 extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
