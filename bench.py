@@ -396,12 +396,22 @@ def _run(
     run_once(keys[:key_chunk], key_chunk, verbose=True)
     _log(f"warmup (compile + first chunk): {time.time() - t0:.1f}s")
 
-    from distributed_point_functions_tpu.utils import profiling
+    from distributed_point_functions_tpu.utils import profiling, telemetry
 
     t0 = time.time()
-    with profiling.trace():  # set DPF_TPU_PROFILE_DIR to capture a trace
+    # Telemetry capture around the PRIMARY timed pass (ISSUE 6): the
+    # record gains the measured chunk dispatch count, per-stage busy
+    # times, the library-computed pipeline_occupancy, and dispatch-
+    # latency percentiles — the cost-model router's inputs — at zero
+    # added device programs (host-side perf_counter arithmetic only;
+    # pinned by tests/test_dispatch_audit.py).
+    with profiling.trace(), telemetry.capture() as tel:
+        # set DPF_TPU_PROFILE_DIR to capture a Perfetto trace
         folds = run_once(keys, key_chunk)
     elapsed = time.time() - t0
+    tel_snap = tel.snapshot()
+    for line in telemetry.summary(tel_snap).splitlines():
+        _log(line)
 
     total_evals = num_keys * (1 << log_domain)
     evals_per_sec = total_evals / elapsed
@@ -464,6 +474,7 @@ def _run(
     _log(f"device-vs-host verification: {n_ok}/{len(sample)} sampled keys match")
     result = _result(log_domain, num_keys, evals_per_sec, backend)
     result["verified_keys"] = f"{n_ok}/{len(sample)}"
+    result.update(telemetry.bench_fields(tel_snap))
     if sync_elapsed is not None:
         # pipeline_overlap = sync wall-clock / pipelined wall-clock: > 1
         # means the executor hides real latency; ~1 means this link's

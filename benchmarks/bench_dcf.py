@@ -107,9 +107,16 @@ def bench(jax, smoke):
     )
     if engine != "host":
         timed_pull(run(dcf, keys, xs))  # warm the fold program
-    with Timer() as t:
+    # Telemetry capture around the timed loop (ISSUE 6): device-engine
+    # records gain dispatch_count / stage times / pipeline_occupancy as
+    # provenance fields; the host engine dispatches nothing and gains
+    # nothing.
+    from distributed_point_functions_tpu.utils import telemetry
+
+    with telemetry.capture() as tel, Timer() as t:
         for xs_i in xs_sets:
             timed_pull(run(dcf, keys, xs_i))
+    telemetry_fields = telemetry.bench_fields(tel.snapshot())
     evals = num_keys * num_points * reps
     device_rate = None
     if engine == "host" and jax.default_backend() != "cpu":
@@ -164,6 +171,7 @@ def bench(jax, smoke):
             "engine": engine,
             **({"mode": mode} if engine == "device" else {}),
             **walk_fields,
+            **telemetry_fields,
             **(
                 {"device_engine_comparisons_per_s": device_rate}
                 if device_rate

@@ -97,9 +97,15 @@ def bench(jax, smoke):
         ok = bool((dev == host_vals).all())
     log(f"device-vs-host verification ({len(sample)} keys): "
         f"{'OK' if ok else 'MISMATCH'}")
-    with Timer() as t:
+    # Telemetry capture around the timed loop (ISSUE 6): the record gains
+    # the measured chunk dispatch count, per-stage times and
+    # pipeline_occupancy as provenance fields (not a schema break).
+    from distributed_point_functions_tpu.utils import telemetry
+
+    with telemetry.capture() as tel, Timer() as t:
         for points in point_sets[1:]:
             run(points)
+    telemetry_fields = telemetry.bench_fields(tel.snapshot())
     evals = num_keys * num_points * reps
 
     # Secondary: the native host engine on the same workload, for the
@@ -148,6 +154,7 @@ def bench(jax, smoke):
             "num_points": num_points,
             "mode": mode,
             **walk_fields,
+            **telemetry_fields,
             **(
                 {"host_engine_point_evals_per_s": host_rate}
                 if host_rate

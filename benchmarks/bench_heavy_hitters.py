@@ -148,8 +148,15 @@ def bench(jax, smoke):
             "final-level host-oracle verification: "
             f"{'OK' if verified else 'MISMATCH'}"
         )
-    with Timer() as t:
+    # Telemetry capture around the timed pass (ISSUE 6): hierkernel-mode
+    # records gain the measured window dispatch count, per-stage busy
+    # times and pipeline_occupancy (provenance fields; host-engine runs
+    # dispatch nothing through the executor and gain nothing).
+    from distributed_point_functions_tpu.utils import telemetry
+
+    with telemetry.capture() as tel, Timer() as t:
         run_once(dpf, key, prefixes, num_levels)
+    telemetry_fields = telemetry.bench_fields(tel.snapshot())
 
     prepared_stats = {}
     if engine == "device":
@@ -250,6 +257,7 @@ def bench(jax, smoke):
             "engine": engine,
             **({"mode": mode, "group": group} if engine == "device" else {}),
             **hier_fields,
+            **telemetry_fields,
             **prepared_stats,
             **({"seconds_by_levels": sweep} if sweep else {}),
         },

@@ -31,8 +31,10 @@ run_fast() {
   # suite (tests/test_hierkernel.py, ISSUE 5 — ONE compiled interpret
   # config on a shape-uniform window plan, every equivalence variant
   # sharing it per the ~40-115 s/config compile budget; eager
-  # real-circuit coverage goes through the replays, never pallas_call);
-  # pytest collects them with the rest of tests/ — no
+  # real-circuit coverage goes through the replays, never pallas_call)
+  # and the telemetry-bus suite (tests/test_telemetry.py, ISSUE 6 —
+  # spans/counters/decisions on the XLA paths only, no new pallas
+  # configs); pytest collects them with the rest of tests/ — no
   # separate invocation, which would run them twice. JAX_PLATFORMS=cpu
   # is pinned explicitly (belt to conftest.py's in-process suspenders)
   # so the tier can never contend for the single-process TPU claim.

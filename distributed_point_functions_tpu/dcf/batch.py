@@ -28,6 +28,7 @@ import numpy as np
 from ..core import uint128
 from ..ops import aes_jax, backend_jax, evaluator
 from ..utils import errors, integrity
+from ..utils import telemetry as _tm
 
 
 def _capture_tables(dcf, xs_padded: np.ndarray, num_points: int):
@@ -295,6 +296,7 @@ def _prep_points(dcf, keys: Sequence, xs: Sequence[int], p_pad: int):
     return batch, paths, acc_mask, block_sel, depth_to_hierarchy
 
 
+@_tm.traced("dcf.batch_evaluate")
 def batch_evaluate(
     dcf, keys: Sequence, xs: Sequence[int], use_pallas=None, interpret=False,
     key_chunk=None, pipeline=None, mode=None,
@@ -333,6 +335,7 @@ def batch_evaluate(
     mode = evaluator._resolve_walk_mode(
         mode, True, bits, v.hierarchy_to_tree[v.num_hierarchy_levels - 1],
         use_pallas,
+        op="dcf.batch_evaluate",
     )
     if mode == "walkkernel":
         return _batch_evaluate_walkkernel(
@@ -443,6 +446,7 @@ def batch_evaluate(
             lambda item: np.asarray(item[1])[: item[0], :num_points],
             pipe,
             backend=fib,
+            op="dcf.batch_evaluate",
         )
     )
     return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
@@ -509,6 +513,7 @@ def _batch_evaluate_walkkernel(
             lambda item: np.asarray(item[1])[: item[0], :num_points],
             pipe,
             backend="pallas",
+            op="dcf.batch_evaluate",
         )
     )
     return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)

@@ -32,6 +32,7 @@ import numpy as np
 from ..core.value_types import Int
 from ..dcf.dcf import DcfKey, DistributedComparisonFunction
 from ..ops import evaluator
+from ..utils import telemetry as _tm
 from ..utils.errors import InvalidArgumentError
 from .prng import BasicRng, SecurePrng
 
@@ -160,6 +161,7 @@ class MultipleIntervalContainmentGate:
             res.append(self._combine(key, x, s_p, s_q_prime, i))
         return res
 
+    @_tm.traced("mic.batch_eval")
     def batch_eval(
         self, key: MicKey, xs: Sequence[int], engine: str = "device",
         **device_kwargs,

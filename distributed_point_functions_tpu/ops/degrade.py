@@ -32,6 +32,7 @@ from typing import Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils import faultinject, integrity
+from ..utils import telemetry as _tm
 from ..utils.errors import (
     DataCorruptionError,
     DataLossError,
@@ -165,6 +166,7 @@ def _run_chain(op_name: str, policy: DegradationPolicy, attempt_fn):
                         backend,
                         op=op_name,
                     )
+                    _tm.counter("degrade.recovered", op=op_name)
                 return result
             except Exception as exc:  # noqa: BLE001 — classified below
                 err = classify_exception(exc)
@@ -181,6 +183,7 @@ def _run_chain(op_name: str, policy: DegradationPolicy, attempt_fn):
                             op=op_name,
                             key_chunk=new_chunk,
                         )
+                        _tm.counter("degrade.chunk_halvings", op=op_name)
                         chunk = new_chunk
                         continue
                 elif isinstance(err, UnavailableError):
@@ -195,6 +198,7 @@ def _run_chain(op_name: str, policy: DegradationPolicy, attempt_fn):
                             op=op_name,
                             retry=retries,
                         )
+                        _tm.counter("degrade.retries", op=op_name)
                         if delay > 0:
                             time.sleep(delay)
                         continue
@@ -209,6 +213,16 @@ def _run_chain(op_name: str, policy: DegradationPolicy, attempt_fn):
                     integrity.emit_event(
                         "degrade", detail, backend, op=op_name,
                         error=type(err).__name__,
+                    )
+                    # Degradation IS an engine decision (ISSUE 6): record
+                    # the level transition with a structured reason next
+                    # to the explicit/env-default resolutions.
+                    _tm.decision(
+                        op_name,
+                        chain[level_idx + 1],
+                        "degrade",
+                        reason=type(err).__name__,
+                        from_backend=backend,
                     )
                     degraded = True
                 break

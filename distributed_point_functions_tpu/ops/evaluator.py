@@ -41,6 +41,7 @@ from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..core.value_types import Int, XorWrapper
 from ..utils import faultinject
+from ..utils import telemetry as _tm
 from ..utils.envflags import env_bool as _env_bool
 from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, value_codec
@@ -737,11 +738,17 @@ def full_domain_fold_chunks(
         # a caller qualifying the XLA engine (CHECK_PALLAS=0) must not
         # silently get the Mosaic megakernel — the mirror of the r3
         # explicit-use_pallas=True rule (same policy as _resolve_walk_mode).
-        mode = "fold" if use_pallas is False else _fold_mode_default()
+        if use_pallas is False:
+            mode, mode_source = "fold", "pinned-xla"
+        else:
+            mode, mode_source = _fold_mode_default(), "env-default"
+    else:
+        mode_source = "explicit"
     if mode not in ("fold", "megakernel"):
         raise InvalidArgumentError(
             f"mode must be 'fold' or 'megakernel', got {mode!r}"
         )
+    _tm.decision("full_domain_fold_chunks", mode, mode_source)
     if use_pallas is None:
         use_pallas = _pallas_default()
     if mode == "megakernel":
@@ -898,7 +905,8 @@ def full_domain_fold_chunks(
             )
 
     yield from _pl.prefetch_thunks(
-        _thunks(), pipe, backend=_fi_backend(use_pallas)
+        _thunks(), pipe, backend=_fi_backend(use_pallas),
+        op="full_domain_fold_chunks",
     )
 
 
@@ -1019,6 +1027,11 @@ def _prepare_chunk(
     seeds_h, control_mask, cw, ccl, ccr, corr, m = _prepare_chunk_host(
         kb, host_levels, scalar_fast, bits
     )
+    if _tm.enabled():
+        _tm.counter(
+            "bytes.h2d",
+            _tm.nbytes_of([seeds_h, control_mask, cw, ccl, ccr, corr]),
+        )
     return _PreparedChunk(
         valid=valid,
         seeds=jnp.asarray(seeds_h),
@@ -1349,6 +1362,7 @@ def full_domain_evaluate_chunks(
             pipe,
             depth=1,
             backend=fib,
+            op="full_domain_evaluate_chunks",
         )
         return
 
@@ -1442,7 +1456,10 @@ def full_domain_evaluate_chunks(
                         _piece, lo, min(slab, m_lanes - lo)
                     )
 
-        yield from _pl.prefetch_thunks(_slab_thunks(), pipe, depth=1, backend=fib)
+        yield from _pl.prefetch_thunks(
+            _slab_thunks(), pipe, depth=1, backend=fib,
+            op="full_domain_evaluate_chunks",
+        )
         return
 
     if mode == "fused":
@@ -1471,6 +1488,7 @@ def full_domain_evaluate_chunks(
             pipe,
             depth=1,
             backend=fib,
+            op="full_domain_evaluate_chunks",
         )
         return
 
@@ -1512,6 +1530,7 @@ def full_domain_evaluate_chunks(
         pipe,
         depth=1,
         backend=fib,
+        op="full_domain_evaluate_chunks",
     )
 
 
@@ -1835,6 +1854,7 @@ def _walk_mode_default() -> str:
 def _resolve_walk_mode(
     mode: Optional[str], scalar_fast: bool, bits: int, levels: int,
     use_pallas: Optional[bool] = None,
+    op: str = "evaluate_at_batch",
 ) -> str:
     """Resolves the point-walk strategy for one call — ONE policy shared
     by `evaluate_at_batch` and `dcf.batch.batch_evaluate` so it cannot
@@ -1846,12 +1866,20 @@ def _resolve_walk_mode(
     platform-default resolution): an explicit False also pins the env
     default to "walk" — a call qualifying the XLA engine (CHECK_PALLAS=0)
     must not silently get a Mosaic kernel, the mirror of the r3
-    explicit-True rule."""
+    explicit-True rule.
+
+    Every resolution emits exactly one telemetry decision record under
+    `op` (ISSUE 6): source "explicit" | "env-default" | "pinned-xla" |
+    "downgrade" (with the reason), so an A/B harness can tell "kernel
+    lost" from "kernel never ran" without parsing logs."""
     explicit = mode is not None
+    source, reason = "explicit", ""
     if mode is None:
         if use_pallas is False:
+            _tm.decision(op, "walk", "pinned-xla", reason="use_pallas=False")
             return "walk"
         mode = _walk_mode_default()
+        source = "env-default"
     if mode not in ("walk", "walkkernel"):
         raise InvalidArgumentError(
             f"mode must be 'walk' or 'walkkernel', got {mode!r}"
@@ -1864,14 +1892,17 @@ def _resolve_walk_mode(
                     "with 32-bit-multiple widths; use mode='walk' for codec "
                     "(IntModN/Tuple) or sub-word outputs"
                 )
-            return "walk"
-        if levels < 1:
+            mode, source = "walk", "downgrade"
+            reason = "codec or sub-word value type"
+        elif levels < 1:
             if explicit:
                 raise InvalidArgumentError(
                     "mode='walkkernel' needs at least one tree level (got "
                     f"{levels}); use mode='walk' for trivial domains"
                 )
-            return "walk"
+            mode, source = "walk", "downgrade"
+            reason = "trivial domain (no tree levels)"
+    _tm.decision(op, mode, source, reason=reason)
     return mode
 
 
@@ -2102,6 +2133,7 @@ def _walk_megakernel_thunks(
     )
 
 
+@_tm.traced("full_domain_evaluate")
 def full_domain_evaluate(
     dpf: DistributedPointFunction,
     keys: Sequence[DpfKey],
@@ -2170,6 +2202,7 @@ def full_domain_evaluate(
             # executor exists to avoid (PERF.md).
             depth=1,
             backend=_fi_backend(use_pallas),
+            op="full_domain_evaluate_chunks",
         )
     )
     is_tuple = isinstance(outs[0], tuple) if outs else False
@@ -2354,6 +2387,7 @@ def _evaluate_points_codec_jit(
     )
 
 
+@_tm.traced("evaluate_at_batch")
 def evaluate_at_batch(
     dpf: DistributedPointFunction,
     keys: Sequence[DpfKey],
@@ -2431,6 +2465,7 @@ def evaluate_at_batch(
     mode = _resolve_walk_mode(
         mode, scalar_fast, bits if scalar_fast else 0,
         v.hierarchy_to_tree[hierarchy_level], use_pallas_raw,
+        op="evaluate_at_batch",
     )
     fib = "pallas" if mode == "walkkernel" else _fi_backend(use_pallas)
 
@@ -2533,7 +2568,9 @@ def evaluate_at_batch(
         )
 
     if device_output:
-        pieces = list(_pl.prefetch_thunks(thunks, pipe, backend=fib))
+        pieces = list(
+            _pl.prefetch_thunks(thunks, pipe, backend=fib, op="evaluate_at_batch")
+        )
         if scalar_fast:
             outs = [o[:valid, :p] for valid, o in pieces]
             out = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=0)
@@ -2565,10 +2602,11 @@ def evaluate_at_batch(
 
     pieces = list(
         _pl.consume(
-            _pl.prefetch_thunks(thunks, pipe, backend=fib),
+            _pl.prefetch_thunks(thunks, pipe, backend=fib, op="evaluate_at_batch"),
             _pull,
             pipe,
             backend=fib,
+            op="evaluate_at_batch",
         )
     )
     if scalar_fast:

@@ -36,6 +36,7 @@ from ..core import uint128
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey, EvaluationContext, PartialEvaluation
 from ..utils import integrity
+from ..utils import telemetry as _tm
 from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, evaluator, value_codec
 from . import pipeline as _pl
@@ -999,7 +1000,7 @@ def _resolve_hier_prepare(ctx, plan, group, mode, mesh, use_pallas):
             f"mode must be 'fused' or 'hierkernel', got {mode!r}"
         )
     if mode == "hierkernel":
-        reason = None
+        reason, source = None, "downgrade"
         if mesh is not None:
             if explicit:
                 raise InvalidArgumentError(
@@ -1010,19 +1011,36 @@ def _resolve_hier_prepare(ctx, plan, group, mode, mesh, use_pallas):
         elif use_pallas is False and not explicit:
             # The env A/B default yields to an explicit engine knob (a
             # call qualifying the XLA engine must not silently get a
-            # Mosaic kernel); an EXPLICIT mode still wins over it.
-            reason = "use_pallas=False pins the XLA engine"
+            # Mosaic kernel); an EXPLICIT mode still wins over it. The
+            # decision source matches _resolve_walk_mode's taxonomy for
+            # the identical situation: a caller-pinned engine, not a
+            # capability downgrade.
+            reason, source = "use_pallas=False pins the XLA engine", "pinned-xla"
         if reason is None:
             try:
-                return "hierkernel", prepare_levels_fused(
+                prepared = prepare_levels_fused(
                     ctx, plan, group, mode="hierkernel"
                 )
             except NotImplementedError as e:
                 if explicit:
                     raise
                 reason = str(e)
+            else:
+                _tm.decision(
+                    "evaluate_levels_fused", "hierkernel",
+                    "explicit" if explicit else "env-default",
+                )
+                return "hierkernel", prepared
         _emit_hier_downgrade(
             "hierkernel", "fused", reason, plan_steps=len(plan)
+        )
+        _tm.decision(
+            "evaluate_levels_fused", "fused", source, reason=reason
+        )
+    else:
+        _tm.decision(
+            "evaluate_levels_fused", "fused",
+            "explicit" if explicit else "env-default",
         )
     return "fused", prepare_levels_fused(ctx, plan, group)
 
@@ -1441,7 +1459,9 @@ def _evaluate_hierkernel(
         make_thunk(idx, valid)
         for idx, valid in _pl.chunk_indices(k, chunk)
     )
-    per_chunk = list(_pl.map_chunks(thunks, finalize, pipe))
+    per_chunk = list(
+        _pl.map_chunks(thunks, finalize, pipe, op="evaluate_levels_fused")
+    )
 
     if keep_device:
         _, outs_final, xs, xc = per_chunk[0]
@@ -1473,6 +1493,7 @@ def _evaluate_hierkernel(
     return outs_final
 
 
+@_tm.traced("evaluate_levels_fused")
 def evaluate_levels_fused(
     ctx: BatchedContext,
     plan,

@@ -46,6 +46,7 @@ CPU, where XLA does not implement donation and would warn per program.
 
 from __future__ import annotations
 
+import itertools
 import os
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
@@ -55,6 +56,7 @@ from typing import Callable, Iterable, Iterator, Optional, TypeVar
 import numpy as np
 
 from ..utils import faultinject
+from ..utils import telemetry as _tm
 from ..utils.envflags import env_bool as _env_bool
 
 T = TypeVar("T")
@@ -112,6 +114,8 @@ def chunk_indices(num_items: int, chunk: int) -> Iterator[tuple]:
         pad = chunk - valid if num_items > chunk else 0
         if pad:
             idx = np.concatenate([idx, np.zeros(pad, dtype=np.int64)])
+        if _tm.enabled():
+            _tm.observe("chunk.items", valid)
         yield idx, valid
 
 
@@ -120,6 +124,7 @@ def prefetch_thunks(
     pipeline: bool,
     depth: Optional[int] = None,
     backend: Optional[str] = None,
+    op: Optional[str] = None,
 ) -> Iterator[T]:
     """Stage-1/2 driver. Each thunk performs ONE chunk's host pack +
     upload + async device-program dispatch and returns the chunk's
@@ -134,14 +139,32 @@ def prefetch_thunks(
     tests corrupt a pipeline mid-flight) and ``chunk_delay("launch")``
     (the artificial dispatch-latency knob behind the CPU overlap proxy).
     Both are armed-plan no-ops in production.
+
+    `op` labels this entry point's telemetry (ISSUE 6): with the bus
+    enabled, every chunk launch emits a ``pipeline.launch`` span (the
+    injected launch delay counts as dispatch latency and is inside it),
+    a ``pipeline.chunks_launched`` counter tick and a
+    ``pipeline.queue_depth`` gauge (chunks in flight). Disabled, the
+    per-chunk cost is one boolean check — pinned by
+    tests/test_telemetry.py.
     """
     if depth is None:
         depth = depth_default()
     window: deque = deque()
+    idx = 0
     for thunk in thunks:
         faultinject.maybe_raise("chunk_launch", backend=backend)
-        faultinject.chunk_delay("launch", backend=backend)
-        window.append(thunk())
+        if _tm.enabled():
+            with _tm.span("pipeline.launch", op=op, chunk=idx):
+                faultinject.chunk_delay("launch", backend=backend)
+                result = thunk()
+            _tm.counter("pipeline.chunks_launched", op=op)
+            _tm.gauge("pipeline.queue_depth", len(window) + 1, op=op)
+        else:
+            faultinject.chunk_delay("launch", backend=backend)
+            result = thunk()
+        window.append(result)
+        idx += 1
         if not pipeline or len(window) > depth:
             yield window.popleft()
     while window:
@@ -154,6 +177,7 @@ def consume(
     pipeline: bool,
     depth: Optional[int] = None,
     backend: Optional[str] = None,
+    op: Optional[str] = None,
 ) -> Iterator[R]:
     """Stage-3 driver. Pulls each upstream chunk through `finalize` (the
     blocking D2H transfer + sentinel verification + host-side fold) — on a
@@ -166,13 +190,31 @@ def consume(
     in-flight finalize is drained before the exception propagates: the
     caller can immediately rerun on a fallback backend (ops/degrade.py)
     without racing a background pull, and chunks already yielded remain
-    valid."""
+    valid.
+
+    Telemetry (ISSUE 6): each finalize emits a ``pipeline.finalize`` span
+    whose parent is the span active when `consume` was CALLED (captured
+    on the main thread), so the span tree is identical whether finalize
+    runs inline or on the worker thread; its duration is the measured
+    dispatch latency (blocking wait + pull), and the pulled host bytes
+    tick the ``bytes.d2h`` counter."""
     if depth is None:
         depth = depth_default()
+    parent = _tm.current_span_id() if _tm.enabled() else None
+    seq = itertools.count()
 
     def _finalize(item: T) -> R:
-        faultinject.chunk_delay("finalize", backend=backend)
-        return finalize(item)
+        if not _tm.enabled():
+            faultinject.chunk_delay("finalize", backend=backend)
+            return finalize(item)
+        with _tm.span(
+            "pipeline.finalize", parent=parent, op=op, chunk=next(seq)
+        ):
+            faultinject.chunk_delay("finalize", backend=backend)
+            out = finalize(item)
+        _tm.counter("pipeline.chunks_finalized", op=op)
+        _tm.counter("bytes.d2h", _tm.nbytes_of(out), op=op)
+        return out
 
     if not pipeline:
         for item in results:
@@ -216,13 +258,16 @@ def map_chunks(
     pipeline: bool,
     depth: Optional[int] = None,
     backend: Optional[str] = None,
+    op: Optional[str] = None,
 ) -> Iterator[R]:
     """prefetch_thunks + consume composed: the full three-stage executor
-    for entry points that own both the dispatch and the pull."""
+    for entry points that own both the dispatch and the pull. `op` labels
+    both stages' telemetry."""
     return consume(
-        prefetch_thunks(thunks, pipeline, depth, backend),
+        prefetch_thunks(thunks, pipeline, depth, backend, op=op),
         finalize,
         pipeline,
         depth,
         backend,
+        op=op,
     )

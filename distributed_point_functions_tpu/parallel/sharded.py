@@ -35,6 +35,7 @@ from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..ops import aes_jax, backend_jax, evaluator
 from ..utils import errors, faultinject
+from ..utils import telemetry as _tm
 
 
 def make_mesh(n_key_shards: int, n_domain_shards: int, devices=None) -> Mesh:
@@ -620,6 +621,7 @@ def prepare_pir_database(
     )
 
 
+@_tm.traced("pir_query_batch_chunked")
 def pir_query_batch_chunked(
     dpf: DistributedPointFunction,
     keys: Sequence[DpfKey],
@@ -758,6 +760,7 @@ def pir_query_batch_chunked(
                 _pull,
                 pipe,
                 backend=fi_backend,
+                op="pir_query_batch_chunked",
             )
         )
         return _pir_verify_fold(
@@ -789,7 +792,10 @@ def pir_query_batch_chunked(
                     acc, off = None, 0
 
         outs = list(
-            _pl.consume(_chunk_folds(), _pull, pipe, backend=fi_backend)
+            _pl.consume(
+                _chunk_folds(), _pull, pipe, backend=fi_backend,
+                op="pir_query_batch_chunked",
+            )
         )
         return _pir_verify_fold(
             probe, np.concatenate(outs, axis=0), db_nat,
@@ -814,7 +820,12 @@ def pir_query_batch_chunked(
         ):
             yield n_valid, _pir_fold(vals, db_dev)
 
-    outs = list(_pl.consume(_folded(), _pull, pipe, backend=fi_backend))
+    outs = list(
+        _pl.consume(
+            _folded(), _pull, pipe, backend=fi_backend,
+            op="pir_query_batch_chunked",
+        )
+    )
     return _pir_verify_fold(
         probe, np.concatenate(outs, axis=0), db_nat,
         "pir_query_batch_chunked", fi_backend,

@@ -27,7 +27,12 @@ afterthought:
   and every degradation decision (``ops/degrade.py``) emits an
   :class:`IntegrityEvent` through registered hooks and the
   ``distributed_point_functions_tpu.integrity`` logger, so operators can
-  see when a server is running degraded.
+  see when a server is running degraded. Since ISSUE 6 the hook registry
+  is the telemetry bus's locked, exception-isolated
+  ``telemetry.HookRegistry`` and every event is also forwarded onto that
+  bus (``utils/telemetry.py``: capture()/snapshot(), the JSONL sink, the
+  summary table) — ``add_event_hook``/``capture_events`` remain the
+  back-compat surface.
 
 Enabled per-call via the ``integrity=`` keyword or process-wide via the
 ``DPF_TPU_INTEGRITY`` env var (strict boolean parsing; unset = off).
@@ -45,7 +50,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import faultinject
+from . import faultinject, telemetry
 from .envflags import env_bool as _env_bool
 from .errors import (
     DataCorruptionError,
@@ -89,7 +94,12 @@ class IntegrityEvent:
     timestamp: float
 
 
-_hooks: List[Callable[[IntegrityEvent], None]] = []
+# The hook registry lives on the telemetry bus (ISSUE 6): locked and
+# exception-isolated, because the pipelined executor's finalize worker
+# emits events concurrently with hook registration — the old module-level
+# list was mutated unlocked and a raising subscriber propagated into the
+# executor (pinned by tests/test_telemetry.py).
+_hooks = telemetry.HookRegistry(_log)
 
 _EVENT_LEVELS = {
     "corruption": logging.ERROR,
@@ -109,9 +119,9 @@ _EVENT_LEVELS = {
 
 
 def add_event_hook(fn: Callable[[IntegrityEvent], None]) -> Callable:
-    """Registers `fn` to receive every IntegrityEvent. Returns `fn`."""
-    _hooks.append(fn)
-    return fn
+    """Registers `fn` to receive every IntegrityEvent. Returns `fn`.
+    Back-compat shim over the telemetry bus's locked registry."""
+    return _hooks.add(fn)
 
 
 def remove_event_hook(fn: Callable[[IntegrityEvent], None]) -> None:
@@ -144,11 +154,11 @@ def emit_event(kind: str, detail: str, backend: str = "", **data) -> IntegrityEv
         ev.backend,
         ev.detail,
     )
-    for fn in list(_hooks):
-        try:
-            fn(ev)
-        except Exception:  # a broken hook must not mask the event path
-            _log.exception("integrity event hook failed")
+    # Locked, exception-isolated fan-out (HookRegistry), then the re-home:
+    # the same event flows onto the telemetry bus, so sentinel verdicts
+    # and engine downgrades share the capture/JSONL/summary surface.
+    _hooks.emit(ev)
+    telemetry.integrity_event(ev)
     return ev
 
 

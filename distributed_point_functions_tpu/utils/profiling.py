@@ -1,8 +1,13 @@
-"""Profiling/tracing utilities — the observability layer the reference
-delegates to external tools (SURVEY.md §5: no in-library tracing; perf work
-lives in google-benchmark). On TPU the equivalent is a jax.profiler trace
-viewable in TensorBoard/Perfetto, plus named trace annotations around the
-framework's phases (keygen, host expansion, device expansion, finalize).
+"""Profiling/tracing utilities — the Perfetto-facing edge of the
+observability layer (utils/telemetry.py owns the in-process bus).
+
+The reference delegates all of this to external tools (SURVEY.md §5: no
+in-library tracing; perf work lives in google-benchmark). On TPU the
+equivalent is a jax.profiler trace viewable in TensorBoard/Perfetto:
+:func:`trace` is the documented capture entry, and while a trace is
+active every telemetry span (ops/pipeline.py stage spans, the @traced
+entry points) bridges to a ``jax.profiler.TraceAnnotation`` so the
+library's own phase structure appears on the timeline.
 """
 
 from __future__ import annotations
@@ -11,6 +16,8 @@ import contextlib
 import os
 import time
 from typing import Iterator, Optional
+
+from . import telemetry
 
 
 @contextlib.contextmanager
@@ -22,6 +29,11 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
 
         with profiling.trace():
             evaluator.full_domain_evaluate(...)
+
+    While the trace is active, telemetry spans bridge to
+    jax.profiler.TraceAnnotation (the ISSUE 6 Perfetto bridge), so the
+    pipeline's launch/finalize stages and the bulk entry points appear as
+    named regions in the captured timeline.
     """
     log_dir = log_dir or os.environ.get("DPF_TPU_PROFILE_DIR")
     if not log_dir:
@@ -30,22 +42,38 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
     import jax
 
     jax.profiler.start_trace(log_dir)
+    telemetry.set_profile_bridge(True)
     try:
         yield
     finally:
+        telemetry.set_profile_bridge(False)
         jax.profiler.stop_trace()
 
 
 def annotate(name: str):
-    """Named region in the profiler timeline (jax.profiler.TraceAnnotation)."""
+    """Named region in the profiler timeline (jax.profiler.TraceAnnotation).
+
+    No-op-safe (ISSUE 6 satellite): returns a null context unless a
+    profiler is plausibly attached (DPF_TPU_PROFILE_DIR set or a
+    :func:`trace` block active) — the old version imported jax and built
+    a TraceAnnotation unconditionally, paying the annotation on every
+    call with no profiler to receive it."""
+    if not (os.environ.get("DPF_TPU_PROFILE_DIR") or telemetry._profile_bridge):
+        return contextlib.nullcontext()
     import jax
 
     return jax.profiler.TraceAnnotation(name)
 
 
 class Stopwatch:
-    """Wall-clock phase timing with a one-line report; host-side fallback
-    when no profiler is attached."""
+    """Wall-clock phase timing with a one-line report.
+
+    Folded onto the telemetry bus (ISSUE 6 satellite): every lap also
+    lands as a completed ``stopwatch.<name>`` span record when a
+    collector is active, so ad-hoc phase timings share the
+    capture/JSONL/summary surface instead of living only in a local
+    report string. Free when telemetry is disabled (one boolean check
+    inside observe_span)."""
 
     def __init__(self) -> None:
         self.phases: list[tuple[str, float]] = []
@@ -56,6 +84,7 @@ class Stopwatch:
         dt = now - self._t0
         self.phases.append((name, dt))
         self._t0 = now
+        telemetry.observe_span(f"stopwatch.{name}", dt)
         return dt
 
     def report(self) -> str:

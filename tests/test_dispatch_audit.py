@@ -200,6 +200,50 @@ def test_pipelined_chunked_paths_program_budget(program_counter):
         )
 
 
+def test_telemetry_enabled_program_budget(program_counter):
+    """ISSUE 6: the telemetry bus must add ZERO device programs — every
+    measurement is host-side perf_counter arithmetic / .nbytes metadata,
+    never a jnp op. Same shapes and budgets as the pipelined-path audit
+    above (compile reuse), but with a capture collector active, the
+    integrity event stream re-homed through the bus, and spans enabled on
+    every chunk."""
+    from distributed_point_functions_tpu.utils import telemetry
+
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9, 100, 731], [[1, 2, 3, 4]])
+
+    for pipe in (False, True):
+        tag = f"[telemetry,pipeline={'on' if pipe else 'off'}]"
+
+        def run_fold():
+            with telemetry.capture() as tel:
+                list(
+                    evaluator.full_domain_fold_chunks(
+                        dpf, keys, key_chunk=2, pipeline=pipe
+                    )
+                )
+            assert tel.snapshot()["dispatch_count"] == 2
+
+        def run_levels():
+            with telemetry.capture():
+                list(
+                    evaluator.full_domain_evaluate_chunks(
+                        dpf, keys, key_chunk=2, mode="levels", pipeline=pipe
+                    )
+                )
+
+        # Identical budgets to the telemetry-off audit above: the bus
+        # observed both chunks without dispatching anything of its own.
+        _assert_programs(
+            program_counter, run_fold,
+            f"full_domain_fold_chunks{tag}", budget=2,
+        )
+        _assert_programs(
+            program_counter, run_levels,
+            f"full_domain_evaluate_chunks[levels]{tag}", budget=14,
+        )
+
+
 def test_megakernel_program_budget(program_counter, monkeypatch):
     """ISSUE 3: mode='megakernel' is EXACTLY one device program per chunk
     — pack + the slab pallas_call + the fold-width reduction are one jit —

@@ -67,7 +67,7 @@ def _main() -> int:
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    from distributed_point_functions_tpu.utils import integrity
+    from distributed_point_functions_tpu.utils import integrity, telemetry
     from distributed_point_functions_tpu.utils.errors import (
         DataCorruptionError,
         InternalError,
@@ -122,15 +122,22 @@ def _main() -> int:
     # path, unset = platform default (ops/pipeline.py) — qualify a
     # platform with both, since donation and the in-flight window are
     # pipeline-only execution shapes.
-    try:
-        failures = integrity.run_device_check(
-            shapes=shapes, mode=mode, use_pallas=_check_pallas_env(),
-            pipeline=_tristate_env("CHECK_PIPELINE"),
-        )
-    except (DataCorruptionError, InternalError) as e:
-        print(f"SELF-TEST FAILED: {e}")
-        failures = 1
-    failures += _run_extras(jax, rng)
+    # Telemetry capture around the whole differential run (ISSUE 6): the
+    # summary table below is the same surface the serving router reads —
+    # chunk dispatch counts, per-stage busy time, engine decisions and
+    # integrity verdicts — so a CHECK_MODE run doubles as a dispatch-
+    # latency measurement of the platform it just verified.
+    with telemetry.capture() as tel:
+        try:
+            failures = integrity.run_device_check(
+                shapes=shapes, mode=mode, use_pallas=_check_pallas_env(),
+                pipeline=_tristate_env("CHECK_PIPELINE"),
+            )
+        except (DataCorruptionError, InternalError) as e:
+            print(f"SELF-TEST FAILED: {e}")
+            failures = 1
+        failures += _run_extras(jax, rng)
+    print(telemetry.summary(tel.snapshot()))
     if failures:
         print(
             "DEVICE OUTPUT IS WRONG on this backend — do not trust its "

@@ -154,6 +154,12 @@ def main(argv):
     if suffix and rec.get("bench"):
         rec["bench"] = rec["bench"] + suffix
     rec.setdefault("date", time.strftime("%Y-%m-%d"))
+    # Telemetry provenance (ISSUE 6): when the stage exported a JSONL
+    # event log (tools/tpu_measure.sh sets DPF_TPU_TELEMETRY_LOG per
+    # stage), point the merged record at the artifact so the
+    # span/decision stream behind a number stays findable.
+    if env.get("DPF_TPU_TELEMETRY_LOG"):
+        rec.setdefault("telemetry_log", env["DPF_TPU_TELEMETRY_LOG"])
     results_path = os.path.join(BENCH_DIR, "results.json")
     run_all.merge_records([rec], results_path)
     if supersedes:

@@ -40,6 +40,11 @@ if ! flock -w "${TPU_CLAIM_WAIT:-60}" 9; then
 fi
 export TPU_CLAIM_HELD=1
 touch "$stages"
+# Per-stage telemetry artifacts (ISSUE 6): every stage child exports its
+# span/decision/integrity event stream as JSONL next to the session log;
+# run_bench_stage.py stamps the path onto the merged record.
+telemetry_dir="tools/telemetry"
+mkdir -p "$telemetry_dir"
 echo "=== tpu_measure $(date -u +%FT%TZ) budget=${budget}s resume=[$(paste -sd, "$stages")] ===" | tee -a "$log"
 
 # stage NAME TIMEOUT CMD... — skips completed stages (unless STAGE_ALWAYS=1),
@@ -63,7 +68,8 @@ stage() {
     echo "--- stage $name timeout clipped to ${tmo}s (budget) ---" | tee -a "$log"
   fi
   echo "--- stage $name (timeout ${tmo}s) ---" | tee -a "$log"
-  timeout -k 60 "$tmo" "$@" 2>&1 9>&- | tail -40 | tee -a "$log"
+  DPF_TPU_TELEMETRY_LOG="$PWD/$telemetry_dir/${name}.jsonl" \
+    timeout -k 60 "$tmo" "$@" 2>&1 9>&- | tail -40 | tee -a "$log"
   local rc=${PIPESTATUS[0]}
   echo "--- stage $name rc=$rc ---" | tee -a "$log"
   if [ "$rc" -eq 0 ]; then echo "$name" >>"$stages"; fi
