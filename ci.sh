@@ -70,8 +70,14 @@ run_faults() {
   # fault class must be detected by sentinel verification and recovered by
   # the Pallas->JAX->numpy fallback chain. Forced onto XLA:CPU so the tier
   # never contends for the TPU claim and detection is exercised against a
-  # known-good backend.
+  # known-good backend. ISSUE 7 adds the supervisor suite
+  # (tests/test_supervisor.py, collected by the marker) plus a short
+  # deterministic chaos-soak pass: seeded fault schedules (corruption,
+  # OOM, unavailable, device_hang) across all six bulk entry points,
+  # asserting bit-exact recovery and telemetry completeness (<60 s,
+  # zero Pallas configs on CPU).
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -x -m faults
+  JAX_PLATFORMS=cpu python tools/chaos_soak.py --rounds 2 --seed 7
 }
 
 case "$tier" in

@@ -27,7 +27,7 @@ import numpy as np
 
 from ..core import uint128
 from ..ops import aes_jax, backend_jax, evaluator
-from ..utils import errors, integrity
+from ..utils import errors, faultinject, integrity
 from ..utils import telemetry as _tm
 
 
@@ -449,7 +449,12 @@ def batch_evaluate(
             op="dcf.batch_evaluate",
         )
     )
-    return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
+    out = pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
+    # Output-corruption seam for the runtime integrity layer (ISSUE 7):
+    # DCF has no sentinel-probe hook, so the supervisor's host-oracle spot
+    # check is what detects device-side corruption — this is where the
+    # chaos harness injects it. No-op (one truthiness check) unarmed.
+    return faultinject.corrupt_output(out, backend=fib)
 
 
 def _batch_evaluate_walkkernel(
@@ -516,7 +521,8 @@ def _batch_evaluate_walkkernel(
             op="dcf.batch_evaluate",
         )
     )
-    return pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
+    out = pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
+    return faultinject.corrupt_output(out, backend="pallas")
 
 
 def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:

@@ -137,6 +137,33 @@ class MultipleIntervalContainmentGate:
             points.append((x + n - 1 - q_prime) % n)
         return points
 
+    def _check_masked_inputs(self, xs: Sequence[int]) -> None:
+        """Input validation shared by batch_eval and the supervisor's
+        robust wrapper (ops/supervisor.mic_batch_eval_robust)."""
+        n = 1 << self.log_group_size
+        for x in xs:
+            if not 0 <= x < n:
+                raise InvalidArgumentError(
+                    "Masked input should be between 0 and 2^log_group_size"
+                )
+
+    def _combine_batch(
+        self, key: MicKey, xs: Sequence[int], values
+    ) -> np.ndarray:
+        """mod-N combine of a flat (points x intervals x {p, q'}) DCF
+        value vector back into per-(input, interval) shares — the single
+        owner of the 2m-stride layout, shared by batch_eval and the
+        robust wrapper so the point packing cannot drift between them."""
+        n = 1 << self.log_group_size
+        m = len(self.intervals)
+        out = np.zeros((len(xs), m), dtype=object)
+        for xi, x in enumerate(xs):
+            for i in range(m):
+                s_p = int(values[2 * m * xi + 2 * i]) % n
+                s_q_prime = int(values[2 * m * xi + 2 * i + 1]) % n
+                out[xi, i] = self._combine(key, int(x), s_p, s_q_prime, i)
+        return out
+
     def _combine(self, key: MicKey, x: int, s_p: int, s_q_prime: int, i: int) -> int:
         n = 1 << self.log_group_size
         p, q = self.intervals[i]
@@ -176,12 +203,7 @@ class MultipleIntervalContainmentGate:
         mode="walkkernel": the whole gate evaluation — every interval's
         two comparison walks — becomes ONE walk-megakernel program).
         """
-        n = 1 << self.log_group_size
-        for x in xs:
-            if not 0 <= x < n:
-                raise InvalidArgumentError(
-                    "Masked input should be between 0 and 2^log_group_size"
-                )
+        self._check_masked_inputs(xs)
         all_points: List[int] = []
         for x in xs:
             all_points.extend(self._eval_points(int(x)))
@@ -195,11 +217,4 @@ class MultipleIntervalContainmentGate:
             )
         else:
             values = evaluator.values_to_numpy(evals, 128)[0]  # [len(xs)*2m]
-        m = len(self.intervals)
-        out = np.zeros((len(xs), m), dtype=object)
-        for xi, x in enumerate(xs):
-            for i in range(m):
-                s_p = int(values[2 * m * xi + 2 * i]) % n
-                s_q_prime = int(values[2 * m * xi + 2 * i + 1]) % n
-                out[xi, i] = self._combine(key, int(x), s_p, s_q_prime, i)
-        return out
+        return self._combine_batch(key, xs, values)

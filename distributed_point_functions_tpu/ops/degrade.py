@@ -12,15 +12,29 @@ integrity layer (utils/integrity.py):
   3. **Numpy/native host engine** (core/host_eval.py) — the oracle
      itself; slow but trusted, the level of last resort.
 
-Per level: transient failures (``UnavailableError``) retry with bounded
+Since ISSUE 7 the chain walks **(mode, backend) rungs**, not flat
+backends: the first rung of a call that would run a megakernel mode is
+that kernel, and a Mosaic-specific miscompile degrades to the *still-
+device* shipped shape (megakernel→fold, walkkernel→walk,
+hierkernel→fused) before leaving the device at all. ``ops/supervisor.py``
+builds the per-op chains and adds the journaled / deadline-bounded
+wrappers for the remaining bulk entry points (DCF, MIC, hierarchical,
+PIR); the flat-backend wrappers below keep their shape with rungs whose
+mode component is None.
+
+Per rung: transient failures (``UnavailableError`` — including dispatch-
+deadline expiries from the supervisor's watchdog) retry with bounded
 exponential backoff; ``ResourceExhaustedError`` halves the key-batch
 chunk down to ``min_key_chunk`` before degrading; detected corruption
 (``DataCorruptionError`` from sentinel verification) degrades
 *immediately* — deterministic wrong answers do not get retried at the
-level that produced them. Every decision emits a structured event through
-``utils.integrity.emit_event`` (kinds "retry", "chunk-halved", "degrade",
-"recovered") so operators can see a server running degraded; see README
-"Running degraded" for the log-line format.
+level that produced them; a rung that cannot express the call
+(``RungUnsupported``) is skipped with no retries. Every decision emits a
+structured event through ``utils.integrity.emit_event`` (kinds "retry",
+"chunk-halved", "degrade", "recovered") plus a telemetry
+``decision(source="degrade")`` record per rung transition, so operators
+can see a server running degraded; see README "Running degraded" for the
+log-line format.
 """
 
 from __future__ import annotations
@@ -38,6 +52,7 @@ from ..utils.errors import (
     DataLossError,
     DpfError,
     InternalError,
+    InvalidArgumentError,
     ResourceExhaustedError,
     UnavailableError,
 )
@@ -52,14 +67,35 @@ class DegradationPolicy:
     backoff_seconds: float = 0.05  # base of the exponential backoff
     min_key_chunk: int = 1  # floor of resource-exhaustion chunk halving
     verify: Optional[bool] = None  # sentinel verification (None = env default)
+    #: Dispatch deadline in seconds for every device wait inside the chain
+    #: (ops/supervisor.py watchdog). None = the DPF_TPU_DEADLINE env
+    #: default; 0 disables even an env-armed deadline for this call.
+    deadline_seconds: Optional[float] = None
 
 
 DEFAULT_POLICY = DegradationPolicy()
 
-#: The fallback chain, fastest first. "pallas" is only present when the
-#: platform default would use the Mosaic kernels (real TPUs or a forced
-#: DPF_TPU_PALLAS=1); on CPU the chain starts at "jax".
+
+class RungUnsupported(Exception):
+    """Raised by an attempt_fn whose rung cannot express the call (e.g. an
+    explicit kernel mode rejecting a plan shape): the chain skips straight
+    to the next rung — no retries, no chunk halving — and records the
+    degrade with reason "unsupported". Never escapes `_run_chain`."""
+
+    def __init__(self, reason: str, cause: Optional[BaseException] = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.cause = cause
+
+
+#: The flat fallback chain, fastest first. "pallas" is only present when
+#: the platform default would use the Mosaic kernels (real TPUs or a
+#: forced DPF_TPU_PALLAS=1); on CPU the chain starts at "jax".
 BACKEND_LEVELS = ("pallas", "jax", "numpy")
+
+#: A chain rung: (mode, backend). mode None = the entry point's shipped
+#: default shape; backend "numpy" is the host oracle of last resort.
+Rung = Tuple[Optional[str], str]
 
 
 def fallback_chain() -> Tuple[str, ...]:
@@ -68,6 +104,12 @@ def fallback_chain() -> Tuple[str, ...]:
     if evaluator._pallas_default():
         return BACKEND_LEVELS
     return BACKEND_LEVELS[1:]
+
+
+def rung_label(rung: Rung) -> str:
+    """Human/telemetry label of one rung: "jax", "walkkernel/pallas", …"""
+    mode, backend = rung
+    return backend if mode is None else f"{mode}/{backend}"
 
 
 #: Taxonomy categories the chain may retry / degrade around. Everything
@@ -100,6 +142,15 @@ def classify_exception(exc: BaseException) -> Optional[DpfError]:
     if "RESOURCE_EXHAUSTED" in upper or "OUT OF MEMORY" in upper:
         err = ResourceExhaustedError(text)
     elif "UNAVAILABLE" in upper or "DEADLINE_EXCEEDED" in upper or "FAILED TO CONNECT" in upper:
+        err = UnavailableError(text)
+    elif (
+        ("ABORTED" in upper or "CANCELLED" in upper)
+        and "XLARUNTIMEERROR" in type(exc).__name__.upper()
+    ):
+        # jaxlib surfaces a killed/cancelled device computation (runtime
+        # restart, preempted tunnel) as XlaRuntimeError ABORTED/CANCELLED;
+        # untranslated it fell past the chain uncaught (ISSUE 7). They are
+        # transient platform states: retry, then degrade.
         err = UnavailableError(text)
     elif "INTERNAL" in upper and "XLARUNTIMEERROR" in type(exc).__name__.upper():
         err = InternalError(text)
@@ -140,92 +191,137 @@ def _scalar_bits(dpf, hierarchy_level):
     return evaluator._value_kind(value_type)
 
 
-def _run_chain(op_name: str, policy: DegradationPolicy, attempt_fn):
+def _run_chain(
+    op_name: str,
+    policy: DegradationPolicy,
+    attempt_fn,
+    chain: Optional[Sequence] = None,
+):
     """Walks the fallback chain for one logical operation.
 
-    `attempt_fn(backend, key_chunk)` performs the operation at one level
-    (sentinel-verified for device levels) and returns the result; this
-    driver owns retry / backoff / chunk-halving / degradation and the
-    structured events. Raises the last error when even the host engine
-    fails.
+    `attempt_fn(mode, backend, key_chunk)` performs the operation at one
+    rung (sentinel- or spot-verified for device rungs) and returns the
+    result; this driver owns retry / backoff / chunk-halving /
+    degradation, the structured events, and the dispatch-deadline scope
+    (``policy.deadline_seconds`` arms ops/supervisor.py's watchdog for
+    every wait inside the attempt). Raises the last error when even the
+    host engine fails.
+
+    `chain` is a sequence of (mode, backend) rungs (bare backend strings
+    are promoted to mode=None rungs); None = the flat platform chain —
+    ops/supervisor.py composes the per-op mode-aware chains
+    (megakernel→fold→jax→numpy, walkkernel→walk→jax→numpy,
+    hierkernel→fused→jax→numpy).
     """
-    chain = fallback_chain()
+    from . import supervisor as _sv  # function-level: supervisor imports us
+
+    rungs: Tuple[Rung, ...] = tuple(
+        (None, r) if isinstance(r, str) else (r[0], r[1])
+        for r in (fallback_chain() if chain is None else chain)
+    )
     last_err: Optional[BaseException] = None
     degraded = False
-    for level_idx, backend in enumerate(chain):
-        chunk = None  # resolved lazily by attempt_fn's default
-        retries = 0
-        while True:
-            try:
-                faultinject.maybe_raise("device_call", backend=backend)
-                result = attempt_fn(backend, chunk)
-                if degraded:
-                    integrity.emit_event(
-                        "recovered",
-                        f"{op_name} served by fallback level {backend!r}",
-                        backend,
-                        op=op_name,
+
+    def _degrade_edge(level_idx, rung, err, reason=None):
+        mode, backend = rung
+        nxt = rungs[level_idx + 1]
+        detail = (
+            f"{op_name}: {rung_label(rung)!r} -> {rung_label(nxt)!r} "
+            f"after {reason or type(err).__name__}"
+        )
+        if isinstance(err, DataCorruptionError) and err.pattern:
+            detail += f" ({err.pattern})"
+        integrity.emit_event(
+            "degrade", detail, backend, op=op_name,
+            error=type(err).__name__,
+            **({"mode": mode} if mode else {}),
+        )
+        # Degradation IS an engine decision (ISSUE 6): record the rung
+        # transition with a structured reason next to the explicit/
+        # env-default resolutions.
+        _tm.decision(
+            op_name,
+            rung_label(nxt),
+            "degrade",
+            reason=reason or type(err).__name__,
+            from_backend=rung_label(rung),
+        )
+
+    with _sv.deadline_scope(policy.deadline_seconds):
+        for level_idx, rung in enumerate(rungs):
+            mode, backend = rung
+            chunk = None  # resolved lazily by attempt_fn's default
+            retries = 0
+            while True:
+                try:
+                    faultinject.maybe_raise(
+                        "device_call", backend=backend, mode=mode
                     )
-                    _tm.counter("degrade.recovered", op=op_name)
-                return result
-            except Exception as exc:  # noqa: BLE001 — classified below
-                err = classify_exception(exc)
-                if err is None:
-                    raise
-                if isinstance(err, ResourceExhaustedError):
-                    new_chunk = _halve(chunk, policy, attempt_fn)
-                    if new_chunk is not None:
+                    result = attempt_fn(mode, backend, chunk)
+                    if degraded:
                         integrity.emit_event(
-                            "chunk-halved",
-                            f"{op_name} on {backend!r}: resource exhausted, "
-                            f"key chunk -> {new_chunk}",
+                            "recovered",
+                            f"{op_name} served by fallback rung "
+                            f"{rung_label(rung)!r}",
                             backend,
                             op=op_name,
-                            key_chunk=new_chunk,
                         )
-                        _tm.counter("degrade.chunk_halvings", op=op_name)
-                        chunk = new_chunk
-                        continue
-                elif isinstance(err, UnavailableError):
-                    if retries < policy.max_retries:
-                        retries += 1
-                        delay = policy.backoff_seconds * (2 ** (retries - 1))
-                        integrity.emit_event(
-                            "retry",
-                            f"{op_name} on {backend!r} unavailable; retry "
-                            f"{retries}/{policy.max_retries} after {delay:.3f}s",
-                            backend,
-                            op=op_name,
-                            retry=retries,
-                        )
-                        _tm.counter("degrade.retries", op=op_name)
-                        if delay > 0:
-                            time.sleep(delay)
-                        continue
-                # DataCorruptionError (and exhausted retries / chunk floor):
-                # degrade to the next level.
-                last_err = err
-                if level_idx + 1 < len(chain):
-                    detail = f"{op_name}: {backend!r} -> " \
-                        f"{chain[level_idx + 1]!r} after {type(err).__name__}"
-                    if isinstance(err, DataCorruptionError) and err.pattern:
-                        detail += f" ({err.pattern})"
-                    integrity.emit_event(
-                        "degrade", detail, backend, op=op_name,
-                        error=type(err).__name__,
-                    )
-                    # Degradation IS an engine decision (ISSUE 6): record
-                    # the level transition with a structured reason next
-                    # to the explicit/env-default resolutions.
-                    _tm.decision(
-                        op_name,
-                        chain[level_idx + 1],
-                        "degrade",
-                        reason=type(err).__name__,
-                        from_backend=backend,
-                    )
-                    degraded = True
-                break
+                        _tm.counter("degrade.recovered", op=op_name)
+                    return result
+                except RungUnsupported as exc:
+                    # This rung cannot express the call at all: skip it
+                    # without retries — the shipped shape one rung down
+                    # can (the resolver-downgrade contract, made explicit
+                    # for chains that pin kernel modes).
+                    err = exc.cause or InvalidArgumentError(exc.reason)
+                    last_err = err
+                    if level_idx + 1 < len(rungs):
+                        _degrade_edge(level_idx, rung, err, reason="unsupported")
+                        degraded = True
+                    break
+                except Exception as exc:  # noqa: BLE001 — classified below
+                    err = classify_exception(exc)
+                    if err is None:
+                        raise
+                    if isinstance(err, ResourceExhaustedError):
+                        new_chunk = _halve(chunk, policy, attempt_fn)
+                        if new_chunk is not None:
+                            integrity.emit_event(
+                                "chunk-halved",
+                                f"{op_name} on {rung_label(rung)!r}: resource "
+                                f"exhausted, key chunk -> {new_chunk}",
+                                backend,
+                                op=op_name,
+                                key_chunk=new_chunk,
+                            )
+                            _tm.counter("degrade.chunk_halvings", op=op_name)
+                            chunk = new_chunk
+                            continue
+                    elif isinstance(err, UnavailableError):
+                        if retries < policy.max_retries:
+                            retries += 1
+                            delay = policy.backoff_seconds * (2 ** (retries - 1))
+                            integrity.emit_event(
+                                "retry",
+                                f"{op_name} on {rung_label(rung)!r} "
+                                f"unavailable; retry "
+                                f"{retries}/{policy.max_retries} after "
+                                f"{delay:.3f}s",
+                                backend,
+                                op=op_name,
+                                retry=retries,
+                            )
+                            _tm.counter("degrade.retries", op=op_name)
+                            if delay > 0:
+                                time.sleep(delay)
+                            continue
+                    # DataCorruptionError (and exhausted retries / chunk
+                    # floor): degrade to the next rung.
+                    last_err = err
+                    if level_idx + 1 < len(rungs):
+                        _degrade_edge(level_idx, rung, err)
+                        degraded = True
+                    break
     assert last_err is not None
     raise last_err
 
@@ -267,7 +363,8 @@ def full_domain_evaluate_robust(
 
     _scalar_bits(dpf, hierarchy_level)  # raises early for codec types
 
-    def attempt(backend: str, chunk: Optional[int]):
+    def attempt(mode: Optional[str], backend: str, chunk: Optional[int]):
+        del mode  # the full-domain values path has one execution shape
         ck = chunk if chunk is not None else key_chunk
         if backend == "numpy":
             # The host engine IS the oracle: nothing meaningful to verify
@@ -296,16 +393,26 @@ def evaluate_at_robust(
     hierarchy_level: int = -1,
     policy: DegradationPolicy = DEFAULT_POLICY,
     pipeline: Optional[bool] = None,
+    mode: Optional[str] = None,
 ) -> np.ndarray:
     """`evaluator.evaluate_at_batch` behind the integrity + degradation
     stack. Scalar outputs; returns uint32[K, P, lpe] limbs. `pipeline`:
     see `full_domain_evaluate_robust` — the executor drains in-flight work
-    before any error reaches this chain."""
-    from . import evaluator
+    before any error reaches this chain.
+
+    The chain is mode-aware (ISSUE 7): when the resolved walk strategy is
+    "walkkernel" (explicit `mode` or the DPF_TPU_WALKKERNEL env), the
+    first rung is the walk megakernel and a Mosaic-specific failure
+    degrades to the still-device per-level walk before leaving the device
+    — walkkernel → walk/pallas → walk/jax → numpy."""
+    from . import evaluator, supervisor
 
     _scalar_bits(dpf, hierarchy_level)
+    chain = supervisor.walk_chain(
+        dpf, hierarchy_level, mode, op="evaluate_at_batch"
+    )
 
-    def attempt(backend: str, chunk: Optional[int]):
+    def attempt(mode_r: Optional[str], backend: str, chunk: Optional[int]):
         if backend == "numpy":
             return _host_evaluate_at_limbs(dpf, keys, points, hierarchy_level)
         # evaluate_at_batch has no default chunking of its own (the K x P
@@ -321,10 +428,11 @@ def evaluate_at_robust(
                 use_pallas=(backend == "pallas"),
                 integrity=True if policy.verify is None else policy.verify,
                 pipeline=pipeline,
+                mode=mode_r,
             )
             for i in range(0, len(keys), ck)
         ]
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 
     attempt.default_chunk = len(keys) if keys else 1
-    return _run_chain("evaluate_at_batch", policy, attempt)
+    return _run_chain("evaluate_at_batch", policy, attempt, chain=chain)

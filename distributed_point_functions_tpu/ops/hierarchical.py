@@ -35,7 +35,7 @@ import numpy as np
 from ..core import uint128
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey, EvaluationContext, PartialEvaluation
-from ..utils import integrity
+from ..utils import faultinject, integrity
 from ..utils import telemetry as _tm
 from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, evaluator, value_codec
@@ -1596,9 +1596,10 @@ def evaluate_levels_fused(
                 "mode='hierkernel' does not support mesh sharding; use "
                 "mode='fused'"
             )
-        return _evaluate_hierkernel(
+        outs = _evaluate_hierkernel(
             ctx, prepared, device_output, key_chunk, pipeline
         )
+        return outs if device_output else _corrupt_outs(outs, "pallas")
     if use_pallas is None:
         use_pallas = evaluator._pallas_default()
     if use_pallas:
@@ -1766,7 +1767,26 @@ def evaluate_levels_fused(
 
     if device_output:
         return list(outs_all)
-    return [np.asarray(o) for o in outs_all]
+    return _corrupt_outs(
+        [np.asarray(o) for o in outs_all],
+        evaluator._fi_backend(use_pallas),
+    )
+
+
+def _corrupt_outs(outs: list, backend: str) -> list:
+    """Output-corruption seam for the runtime integrity layer (ISSUE 7):
+    the hierarchical path has no sentinel-probe hook, so the supervisor's
+    host-oracle spot check (ops/supervisor.evaluate_levels_fused_robust)
+    is what detects device-side corruption — this is where the chaos
+    harness injects it. No-op (one truthiness check) unarmed."""
+    if not faultinject.is_active():
+        return outs
+    return [
+        faultinject.corrupt_output(o, backend=backend)
+        if isinstance(o, np.ndarray)
+        else o  # tuple-typed outputs are outside the scalar probe scope
+        for o in outs
+    ]
 
 
 def _expand_batch_host(

@@ -28,7 +28,15 @@ from sentinel verification at stage 3, or an injected ``chunk_launch``
 fault), every in-flight finalize is **drained** — awaited, not abandoned —
 before the exception propagates. A degradation rerun (ops/degrade.py)
 therefore never races a background pull, and results already yielded to
-the consumer stay valid (completed chunks are not lost).
+the consumer stay valid (completed chunks are not lost). The drain wait
+is bounded (``DPF_TPU_DRAIN_TIMEOUT``, default 60 s) and an expiry is
+surfaced — a structured "drain-timeout" IntegrityEvent plus a
+``pipeline.drain_timeout`` counter — instead of silently proceeding
+(ISSUE 7). With a dispatch deadline armed (``DPF_TPU_DEADLINE`` or a
+DegradationPolicy ``deadline_seconds``, ops/supervisor.py), every
+per-chunk launch and finalize wait is watchdog-bounded and an expiry
+raises ``UnavailableError`` — a *hung* device call enters the
+retry→degrade path instead of wedging the executor forever.
 
 Enabled per-call via the ``pipeline=`` keyword on every bulk entry point
 or process-wide via ``DPF_TPU_PIPELINE`` (strict boolean). Default: ON
@@ -46,6 +54,7 @@ CPU, where XLA does not implement donation and would warn per program.
 
 from __future__ import annotations
 
+import functools
 import itertools
 import os
 from collections import deque
@@ -61,6 +70,16 @@ from ..utils.envflags import env_bool as _env_bool
 
 T = TypeVar("T")
 R = TypeVar("R")
+
+
+def _sv():
+    """ops.supervisor, imported lazily: it sits above this module in the
+    dependency order (supervisor -> degrade -> utils; nothing back here),
+    but a module-level import would still couple the executor's import
+    cost to the whole resilience layer for callers that never arm it."""
+    from . import supervisor
+
+    return supervisor
 
 
 def pipeline_default() -> bool:
@@ -154,15 +173,26 @@ def prefetch_thunks(
     idx = 0
     for thunk in thunks:
         faultinject.maybe_raise("chunk_launch", backend=backend)
+
+        def _launch(thunk=thunk):
+            # Inside the supervisor's deadline watchdog (when armed): the
+            # injected hang and the real dispatch wait are both bounded.
+            faultinject.chunk_delay("launch", backend=backend)
+            faultinject.device_hang("launch", backend=backend)
+            _sv().check_abandoned()
+            return thunk()
+
         if _tm.enabled():
             with _tm.span("pipeline.launch", op=op, chunk=idx):
-                faultinject.chunk_delay("launch", backend=backend)
-                result = thunk()
+                result = _sv().deadline_call(
+                    _launch, "pipeline.launch", op=op, backend=backend
+                )
             _tm.counter("pipeline.chunks_launched", op=op)
             _tm.gauge("pipeline.queue_depth", len(window) + 1, op=op)
         else:
-            faultinject.chunk_delay("launch", backend=backend)
-            result = thunk()
+            result = _sv().deadline_call(
+                _launch, "pipeline.launch", op=op, backend=backend
+            )
         window.append(result)
         idx += 1
         if not pipeline or len(window) > depth:
@@ -206,33 +236,53 @@ def consume(
     def _finalize(item: T) -> R:
         if not _tm.enabled():
             faultinject.chunk_delay("finalize", backend=backend)
+            faultinject.device_hang("finalize", backend=backend)
+            _sv().check_abandoned()
             return finalize(item)
         with _tm.span(
             "pipeline.finalize", parent=parent, op=op, chunk=next(seq)
         ):
             faultinject.chunk_delay("finalize", backend=backend)
+            faultinject.device_hang("finalize", backend=backend)
+            _sv().check_abandoned()
             out = finalize(item)
         _tm.counter("pipeline.chunks_finalized", op=op)
         _tm.counter("bytes.d2h", _tm.nbytes_of(out), op=op)
         return out
 
     if not pipeline:
+        sv = _sv()
         for item in results:
-            yield _finalize(item)
+            # Serial finalize runs inline: the deadline watchdog (when
+            # armed) hosts the blocking pull on its own thread so a hang
+            # converts to UnavailableError instead of wedging the caller.
+            yield sv.deadline_call(
+                functools.partial(_finalize, item),
+                "pipeline.finalize",
+                op=op,
+                backend=backend,
+            )
         return
 
     pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="dpf-pipeline")
     pending: deque = deque()
+    sv = _sv()
     try:
         try:
             for item in results:
                 pending.append(pool.submit(_finalize, item))
                 while len(pending) > depth:
-                    yield pending.popleft().result()
+                    yield sv.deadline_result(
+                        pending.popleft(), "pipeline.finalize",
+                        op=op, backend=backend,
+                    )
             while pending:
-                yield pending.popleft().result()
+                yield sv.deadline_result(
+                    pending.popleft(), "pipeline.finalize",
+                    op=op, backend=backend,
+                )
         except BaseException:
-            drain(pending)
+            drain(pending, backend=backend, op=op)
             raise
     finally:
         # Normal exhaustion leaves nothing pending; after drain() the
@@ -240,16 +290,48 @@ def consume(
         pool.shutdown(wait=False)
 
 
-def drain(pending) -> None:
+def drain_timeout_default() -> float:
+    """Bound on the drain-on-error wait (seconds): DPF_TPU_DRAIN_TIMEOUT,
+    default 60 — the pre-ISSUE-7 hardcoded constant, now a knob."""
+    try:
+        return float(os.environ.get("DPF_TPU_DRAIN_TIMEOUT", "60"))
+    except ValueError:
+        return 60.0
+
+
+def drain(pending, backend: Optional[str] = None, op: Optional[str] = None) -> None:
     """Cancels what has not started and awaits what has: after drain, no
     background thread touches device buffers. Bounded wait — a wedged
     device pull must not hang the error path forever (the exception being
-    propagated is the primary signal; a stuck transfer surfaces in the
-    runtime's own logs)."""
+    propagated is the primary signal). A timeout is no longer silent
+    (ISSUE 7): chunks still in flight when the wait expires mean a
+    background thread MAY still touch device buffers — a DataLossError-
+    kind fact the degradation rerun needs to know, surfaced as a
+    structured "drain-timeout" IntegrityEvent plus a
+    ``pipeline.drain_timeout`` counter."""
     for f in pending:
         f.cancel()
-    if pending:
-        _futures_wait(list(pending), timeout=60)
+    if not pending:
+        return
+    timeout = drain_timeout_default()
+    _done, not_done = _futures_wait(list(pending), timeout=timeout)
+    if not_done:
+        from ..utils import integrity as _integrity
+
+        _integrity.emit_event(
+            "drain-timeout",
+            f"pipeline drain: {len(not_done)} in-flight finalize(s) still "
+            f"running after {timeout:g}s — a wedged device pull may still "
+            "touch device buffers behind the degradation rerun "
+            "(DataLossError-kind; raise DPF_TPU_DRAIN_TIMEOUT or arm "
+            "DPF_TPU_DEADLINE to convert hangs earlier)",
+            backend or "",
+            op=op,
+            error="DataLossError",
+            pending=len(not_done),
+            timeout_seconds=timeout,
+        )
+        _tm.counter("pipeline.drain_timeout", op=op)
 
 
 def map_chunks(

@@ -631,6 +631,7 @@ def pir_query_batch_chunked(
     mode: str = "levels",
     integrity=None,
     pipeline=None,
+    use_pallas=None,
 ) -> np.ndarray:
     """Single-device PIR answers via the chunked bulk evaluator.
 
@@ -682,16 +683,25 @@ def pir_query_batch_chunked(
     device program and chunk N-1's response pull (worker thread). The
     per-chunk fold dispatches stay on the main thread in chunk order, so
     answers are deterministic and bit-identical to the serial path.
+
+    `use_pallas` (None = platform default) pins the expansion engine of
+    the non-megakernel modes — how the supervisor's degradation chain
+    (ops/supervisor.pir_query_batch_robust, ISSUE 7) distinguishes its
+    fold/pallas and fold/jax rungs.
     """
     from ..ops import evaluator as ev
     from ..ops import pipeline as _pl
 
-    # The chunk evaluators resolve use_pallas=None to the platform default;
-    # the fault-injection level of this call follows that resolution (the
-    # megakernel is a Mosaic program regardless of the use_pallas knob).
+    # The chunk evaluators resolve use_pallas=None to the platform default
+    # (an explicit value — the supervisor pinning a degradation rung,
+    # ISSUE 7 — passes through); the fault-injection level of this call
+    # follows that resolution (the megakernel is a Mosaic program
+    # regardless of the use_pallas knob).
     fi_backend = (
         "pallas" if mode == "megakernel"
-        else ev._fi_backend(ev._pallas_default())
+        else ev._fi_backend(
+            ev._pallas_default() if use_pallas is None else use_pallas
+        )
     )
     keys, probe = _pir_probe(
         dpf, keys, integrity, "pir_query_batch_chunked", fi_backend
@@ -756,6 +766,7 @@ def pir_query_batch_chunked(
                 ev.full_domain_fold_chunks(
                     dpf, keys, key_chunk=key_chunk, host_levels=host_levels,
                     db_lane=db_dev, pipeline=pipeline, mode=mode,
+                    use_pallas=use_pallas,
                 ),
                 _pull,
                 pipe,
@@ -782,7 +793,7 @@ def pir_query_batch_chunked(
             acc, off = None, 0
             for n_valid, vals in ev.full_domain_evaluate_chunks(
                 dpf, keys, key_chunk=key_chunk, host_levels=h, mode="fused",
-                lane_slab=slab, pipeline=pipeline,
+                lane_slab=slab, pipeline=pipeline, use_pallas=use_pallas,
             ):
                 fold = _pir_fold_slab(vals, db_dev, off)
                 acc = fold if acc is None else acc ^ fold
@@ -817,6 +828,7 @@ def pir_query_batch_chunked(
             leaf_order=(mode == "walk"),
             mode=mode,
             pipeline=pipeline,
+            use_pallas=use_pallas,
         ):
             yield n_valid, _pir_fold(vals, db_dev)
 

@@ -32,11 +32,26 @@ seams where real corruption has been observed or is conceivable:
                       cost, so overlap is measurable on CPU where the
                       real ~66 ms tunnel latency does not exist
                       (tests/test_pipeline.py's overlap proxy).
+  ``device_hang``   — sleep ``hang_seconds`` at ONE chunk's launch or
+                      finalize boundary (``hang_point``): models a
+                      *hung* dispatch or pull — the axon-tunnel failure
+                      mode where a device call neither returns nor
+                      errors. The supervisor's dispatch-deadline
+                      watchdog (ops/supervisor.py, DPF_TPU_DEADLINE)
+                      must convert it into ``UnavailableError`` within
+                      the deadline; without a deadline armed the hook
+                      sleeps the full ``hang_seconds`` — exactly the
+                      wedged executor ISSUE 7 exists to cure, kept
+                      finite so tests terminate.
 
 Faults are scoped by a context manager and never active by default; every
 hook is a no-op returning its input unchanged when no plan is armed, so
 production paths pay one truthiness check. Plans are plain data — no
-randomness — so every test failure replays exactly.
+randomness — so every test failure replays exactly. ``backends`` /
+``modes`` scope a plan to specific fallback-chain rungs (the supervisor's
+(mode, backend) chains), ``skip_fires`` delays arming past the first N
+matches (how a chaos schedule fails chunk N of a journaled job), and
+``max_fires`` bounds the total count of actual firings.
 """
 
 from __future__ import annotations
@@ -51,7 +66,7 @@ import numpy as np
 #: Recognized injection stages (see module docstring).
 STAGES = (
     "seeds", "cw", "wire", "device_output", "device_call", "chunk_launch",
-    "chunk_delay",
+    "chunk_delay", "device_hang",
 )
 
 
@@ -62,8 +77,16 @@ class FaultPlan:
     ``key_row`` selects the batch row to corrupt (negative = from the end,
     so ``-1`` hits an appended sentinel probe). ``backends`` restricts the
     plan to specific backend levels ("pallas" / "jax" / "numpy"); None
-    fires everywhere. ``max_fires`` bounds how many times the plan
-    triggers (e.g. 1 = corrupt the first attempt only, so a retry or a
+    fires everywhere. ``modes`` restricts it further to specific execution
+    modes of a supervisor (mode, backend) rung ("megakernel" /
+    "walkkernel" / "hierkernel" / "fold" / "walk" / "fused"); a
+    mode-scoped plan NEVER fires at hooks that do not declare a mode
+    (backend-only seams, the numpy rung) — it targets exactly the named
+    rungs. ``skip_fires`` lets the first N
+    matching hook calls pass clean before the plan arms (a mid-job
+    failure: chunks 0..N-1 verify and journal, chunk N dies).
+    ``max_fires`` bounds how many times the plan actually triggers after
+    that (e.g. 1 = corrupt the first armed attempt only, so a retry or a
     fallback level sees clean data).
     """
 
@@ -84,8 +107,13 @@ class FaultPlan:
     # chunk_delay (seconds slept per chunk at each pipeline stage)
     delay_launch: float = 0.0
     delay_finalize: float = 0.0
+    # device_hang (seconds one chunk wedges; point "launch" / "finalize")
+    hang_seconds: float = 0.0
+    hang_point: str = "finalize"
     # scoping
     backends: Optional[FrozenSet[str]] = None
+    modes: Optional[FrozenSet[str]] = None
+    skip_fires: int = 0
     max_fires: Optional[int] = None
     fires: int = 0
 
@@ -93,13 +121,27 @@ class FaultPlan:
         if self.stage not in STAGES:
             raise ValueError(f"unknown fault stage {self.stage!r}; one of {STAGES}")
 
-    def _matches(self, stage: str, backend: Optional[str]) -> bool:
+    def _matches(
+        self, stage: str, backend: Optional[str], mode: Optional[str] = None
+    ) -> bool:
         if self.stage != stage:
             return False
         if self.backends is not None and backend is not None:
             if backend not in self.backends:
                 return False
-        return self.max_fires is None or self.fires < self.max_fires
+        if self.modes is not None:
+            # A mode-scoped plan targets exactly the named chain rungs: a
+            # hook that declares no mode (backend-only seams, the numpy
+            # rung) never matches it — else a plan aimed at a kernel rung
+            # would also poison the recovery levels below it.
+            if mode is None or mode not in self.modes:
+                return False
+        limit = (
+            None
+            if self.max_fires is None
+            else self.skip_fires + self.max_fires
+        )
+        return limit is None or self.fires < limit
 
 
 _active: list = []
@@ -121,11 +163,25 @@ def inject(*plans: FaultPlan):
             _active.remove(p)
 
 
-def _take(stage: str, backend: Optional[str]) -> Optional[FaultPlan]:
+def _take(
+    stage: str,
+    backend: Optional[str],
+    mode: Optional[str] = None,
+    pred=None,
+) -> Optional[FaultPlan]:
     for plan in _active:
-        if plan._matches(stage, backend):
-            plan.fires += 1
-            return plan
+        if not plan._matches(stage, backend, mode):
+            continue
+        if pred is not None and not pred(plan):
+            # Stage-specific scoping (e.g. device_hang's hang_point):
+            # a non-matching plan is not consumed.
+            continue
+        plan.fires += 1
+        if plan.fires <= plan.skip_fires:
+            # Matched but not yet armed: this call passes clean and the
+            # match is consumed (deterministic mid-job scheduling).
+            continue
+        return plan
     return None
 
 
@@ -195,11 +251,17 @@ def corrupt_output(values: np.ndarray, backend: Optional[str] = None) -> np.ndar
     return out
 
 
-def maybe_raise(stage: str = "device_call", backend: Optional[str] = None) -> None:
+def maybe_raise(
+    stage: str = "device_call",
+    backend: Optional[str] = None,
+    mode: Optional[str] = None,
+) -> None:
     """Raises the armed plan's exception (degradation-policy tests).
-    stage "device_call" fires once per backend attempt (ops/degrade.py);
-    stage "chunk_launch" fires per chunk inside the pipelined executor."""
-    plan = _take(stage, backend)
+    stage "device_call" fires once per rung attempt (ops/degrade.py's
+    chain walk passes the rung's mode so mode-scoped plans can fail e.g.
+    only the "walkkernel" rung); stage "chunk_launch" fires per chunk
+    inside the pipelined executor."""
+    plan = _take(stage, backend, mode)
     if plan is not None and plan.exception is not None:
         raise plan.exception
 
@@ -219,3 +281,22 @@ def chunk_delay(point: str, backend: Optional[str] = None) -> None:
     seconds = plan.delay_launch if point == "launch" else plan.delay_finalize
     if seconds > 0:
         time.sleep(seconds)
+
+
+def device_hang(point: str, backend: Optional[str] = None) -> None:
+    """Sleeps the armed device_hang plan's ``hang_seconds`` at one pipeline
+    stage boundary when ``hang_point`` matches `point` ("launch" or
+    "finalize") — the CPU-testable stand-in for a wedged device dispatch
+    or pull (the tunnel failure mode that today blocks forever). The
+    supervisor runs this hook *inside* its deadline-watchdog scope
+    (ops/pipeline.py launch thunks, finalize waits), so an armed
+    DPF_TPU_DEADLINE converts the hang to ``UnavailableError`` while the
+    hung sleep finishes out on a daemon thread."""
+    if not _active:
+        return
+    plan = _take(
+        "device_hang", backend,
+        pred=lambda p: p.hang_point in (point, "any"),
+    )
+    if plan is not None and plan.hang_seconds > 0:
+        time.sleep(plan.hang_seconds)

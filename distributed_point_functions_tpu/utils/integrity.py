@@ -56,6 +56,7 @@ from .errors import (
     DataCorruptionError,
     DataLossError,
     InternalError,
+    UnavailableError,
 )
 
 _log = logging.getLogger("distributed_point_functions_tpu.integrity")
@@ -659,7 +660,12 @@ def run_device_check(
     verified at EVERY hierarchy level against the host engine —
     CHECK_MODE=hierkernel, the hardware gate for the prefix-window
     family; num_keys drives the key batch, log_domain the level count)
-    — the program shapes fail independently on a broken backend.
+    — the program shapes fail independently on a broken backend — or
+    "supervisor" (ISSUE 7: per shape, the first fallback rung is forced
+    Unavailable via fault injection and the robust wrapper must recover
+    bit-correct through the NEXT rung on-device, with a
+    decision(source="degrade") record — CHECK_MODE=supervisor exercises
+    one real degrade transition on hardware for the next tunnel window).
 
     `pipeline` (None = DPF_TPU_PIPELINE env / platform default) drives the
     chunk generators through the pipelined executor (ops/pipeline.py) —
@@ -689,6 +695,10 @@ def run_device_check(
         )
     if mode == "hierkernel":
         return failures + _run_hierkernel_check(
+            shapes, rng, report, pipeline=pipeline
+        )
+    if mode == "supervisor":
+        return failures + _run_supervisor_check(
             shapes, rng, report, pipeline=pipeline
         )
     for num_keys, lds in shapes:
@@ -732,6 +742,72 @@ def run_device_check(
                 mode=mode,
             )
         failures += bad
+    return failures
+
+
+def _run_supervisor_check(shapes, rng, report, pipeline=None) -> int:
+    """CHECK_MODE=supervisor body of `run_device_check` (ISSUE 7): per
+    (num_keys, log_domain) shape, the robust full-domain wrapper runs
+    with its FIRST fallback rung forced ``UnavailableError`` by a scoped
+    fault plan, so the chain must retry, degrade, and serve the batch
+    from the next rung — on a real TPU that second rung is still a
+    device engine, making this the hardware gate for one real degrade
+    transition (retry backoff, rung handoff, sentinel verification on
+    the fallback engine, and the decision record) rather than a
+    CPU-simulated one."""
+    from ..core.dpf import DistributedPointFunction
+    from ..core.host_eval import full_domain_evaluate_host, values_to_limbs
+    from ..core.params import DpfParameters
+    from ..core.value_types import Int
+    from ..ops import degrade
+
+    failures = 0
+    policy = degrade.DegradationPolicy(backoff_seconds=0.0)
+    first_backend = degrade.fallback_chain()[0]
+    for num_keys, lds in shapes:
+        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
+        betas = [[int(x) for x in rng.integers(1, 1000, size=num_keys)]]
+        keys, _ = dpf.generate_keys_batch(alphas, betas)
+        want = values_to_limbs(full_domain_evaluate_host(dpf, keys), 64)
+        with telemetry.capture() as tel, capture_events() as events:
+            with faultinject.inject(
+                faultinject.FaultPlan(
+                    stage="device_call",
+                    exception=UnavailableError(
+                        "UNAVAILABLE: injected supervisor check"
+                    ),
+                    backends=frozenset({first_backend}),
+                )
+            ):
+                got = degrade.full_domain_evaluate_robust(
+                    dpf, keys, policy=policy, pipeline=pipeline,
+                )
+        snap = tel.snapshot()
+        bad = int((got != want).any(axis=(1, 2)).sum())
+        degraded = any(e.kind == "degrade" for e in events)
+        recorded = snap["decisions_by_source"].get("degrade", 0) >= 1
+        ok = bad == 0 and degraded and recorded
+        status = "OK" if ok else (
+            f"MISMATCH ({bad}/{num_keys} keys)" if bad
+            else "NO DEGRADE RECORD"
+        )
+        report(
+            f"keys={num_keys:4d} log_domain={lds:3d} mode=supervisor "
+            f"(rung {first_backend!r} forced unavailable): {status}"
+        )
+        if not ok:
+            emit_event(
+                "corruption",
+                f"supervisor check failed at log_domain={lds}: "
+                f"bad={bad}, degrade_event={degraded}, "
+                f"decision_recorded={recorded}",
+                _backend_name(),
+                num_keys=num_keys,
+                log_domain=lds,
+                mode="supervisor",
+            )
+            failures += max(bad, 1)
     return failures
 
 

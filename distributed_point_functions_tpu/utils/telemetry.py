@@ -580,6 +580,19 @@ class Collector:
                 (stage_seconds["launch"] + stage_seconds["finalize"]) / wall, 3
             )
         ev_dicts = [r.to_dict() for r in events]
+        decisions = [e for e in ev_dicts if e["kind"] == "decision"]
+        integrity_evs = [e for e in ev_dicts if e["kind"] == "integrity"]
+        # Aggregations the resilience layer reads (ISSUE 7): the chaos
+        # harness asserts telemetry completeness by matching the
+        # "degrade" integrity-event count against the decision records
+        # with source="degrade" — one record per chain-rung transition.
+        by_source: Dict[str, int] = {}
+        for d in decisions:
+            src = d.get("data", {}).get("source", "")
+            by_source[src] = by_source.get(src, 0) + 1
+        by_kind: Dict[str, int] = {}
+        for e in integrity_evs:
+            by_kind[e["name"]] = by_kind.get(e["name"], 0) + 1
         return {
             "wall_seconds": wall,
             "counters": {_key_label(k): v for k, v in counters.items()},
@@ -590,8 +603,10 @@ class Collector:
             "histograms": histograms,
             "events": ev_dicts,
             "spans": [e for e in ev_dicts if e["kind"] == "span"],
-            "decisions": [e for e in ev_dicts if e["kind"] == "decision"],
-            "integrity": [e for e in ev_dicts if e["kind"] == "integrity"],
+            "decisions": decisions,
+            "integrity": integrity_evs,
+            "decisions_by_source": by_source,
+            "integrity_by_kind": by_kind,
             "dispatch_count": dispatch_count,
             "stage_seconds": stage_seconds,
             "pipeline_occupancy": occupancy,

@@ -1,0 +1,348 @@
+#!/usr/bin/env python
+"""Deterministic chaos-soak harness for the resilient job supervisor.
+
+Drives randomized-but-SEEDED fault schedules (ISSUE 7) across all six
+bulk entry points — full-domain, EvaluateAt, DCF batch, MIC gate,
+hierarchical advance, PIR — through their robust wrappers
+(ops/degrade.py + ops/supervisor.py) and asserts, per case:
+
+  1. **bit-exact recovery**: the served result equals the host oracle,
+     whatever rung finally answered;
+  2. **telemetry completeness**: every "degrade" IntegrityEvent has a
+     matching ``decision(source="degrade")`` record (the PR 6 bus), so a
+     server running degraded is never invisible to the router;
+  3. for hang cases, a ``deadline-expired`` event: the watchdog converted
+     the hang instead of wedging.
+
+Fault classes: ``corruption`` (device_output), ``oom``
+(RESOURCE_EXHAUSTED device_call), ``unavailable`` (device_call), and
+``hang`` (the ISSUE 7 ``device_hang`` stage bounded by a
+``DegradationPolicy.deadline_seconds`` watchdog). Every plan is scoped to
+the chain's FIRST rung backend so recovery is always reachable; the
+schedule is a pure function of ``--seed``, so any failure replays
+exactly.
+
+Usage (ci.sh faults runs the short deterministic pass)::
+
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --rounds 2 --seed 7
+    python tools/chaos_soak.py --entries dcf,pir --rounds 8   # focused
+
+On CPU the chains start at the XLA rungs (the kernel rungs join on
+Mosaic platforms or under the DPF_TPU_MEGAKERNEL/WALKKERNEL/HIERKERNEL
+A/B envs); the kernel-rung transitions are separately unit-pinned in
+tests/test_supervisor.py with injected failures, so this harness compiles
+zero Pallas configs in its CI configuration.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+FAULT_KINDS = ("corruption", "oom", "unavailable", "hang")
+
+#: Deadline armed for hang cases; the injected hang is 4x it, so a wedged
+#: watchdog fails the wall-clock assertion loudly.
+HANG_DEADLINE = 0.25
+HANG_SECONDS = 1.0
+
+
+def _build_fixtures(rng):
+    """The six entry-point fixtures: tiny shapes (the .jax_cache'd test
+    program families where possible), host-oracle truth precomputed."""
+    from distributed_point_functions_tpu.core import host_eval
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
+    from distributed_point_functions_tpu.gates.mic import (
+        MultipleIntervalContainmentGate,
+    )
+    from distributed_point_functions_tpu.ops import degrade, hierarchical, supervisor
+    from distributed_point_functions_tpu.parallel import sharded
+
+    fixtures = {}
+
+    # full-domain: the lds-8 robust-chain family test_integrity compiles.
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    keys, _ = dpf.generate_keys_batch([3, 70, 201], [[5, 9, 40]])
+    want = host_eval.values_to_limbs(
+        host_eval.full_domain_evaluate_host(dpf, keys), 64
+    )
+    fixtures["full_domain"] = {
+        "want": want,
+        "run": lambda policy: degrade.full_domain_evaluate_robust(
+            dpf, keys, key_chunk=2, policy=policy, pipeline=False
+        ),
+        "chain": supervisor.full_domain_chain(),
+    }
+
+    # evaluate_at: same DPF, a small point batch.
+    pts = [0, 3, 70, 201]
+    want_at = host_eval.values_to_limbs(
+        host_eval.evaluate_at_host(dpf, keys, pts, 0), 64
+    )
+    fixtures["evaluate_at"] = {
+        "want": want_at,
+        "run": lambda policy: degrade.evaluate_at_robust(
+            dpf, keys, pts, policy=policy
+        ),
+        "chain": supervisor.walk_chain(dpf, -1, None),
+        "corrupt_pattern": "lane",  # 4 points: "bit4" (index>=16) is empty
+    }
+
+    # DCF batch: lds-8 Int(64), the test_pipeline DCF family.
+    from distributed_point_functions_tpu.dcf.dcf import (
+        DistributedComparisonFunction,
+    )
+
+    dcf = DistributedComparisonFunction.create(8, Int(64))
+    dka, _dkb = dcf.generate_keys(77, 4242)
+    dkeys = [dka]
+    xs = [1, 5, 77, 200, 255]
+    want_dcf = supervisor._ints_to_limbs(
+        [[dcf.evaluate(k, x) for x in xs] for k in dkeys], 64
+    )
+    fixtures["dcf"] = {
+        "want": want_dcf,
+        "run": lambda policy: supervisor.batch_evaluate_robust(
+            dcf, dkeys, xs, policy=policy
+        ),
+        "chain": supervisor.dcf_chain(dcf, None),
+        "corrupt_pattern": "lane",  # 5 points: "bit4" (index>=16) is empty
+    }
+
+    # MIC gate: a 6-bit group, two intervals, python host truth.
+    gate = MultipleIntervalContainmentGate.create(6, [(2, 10), (20, 40)])
+    mk0, _mk1 = gate.gen(5, [3, 7])
+    mxs = [9, 33]
+    want_mic = np.array([gate.eval(mk0, x) for x in mxs], dtype=object)
+    fixtures["mic"] = {
+        "want": want_mic,
+        "run": lambda policy: supervisor.mic_batch_eval_robust(
+            gate, mk0, mxs, policy=policy
+        ),
+        "chain": supervisor.dcf_chain(gate.dcf, None),
+        "corrupt_pattern": "lane",  # 8 gate points: "bit4" is empty
+    }
+
+    # hierarchical: a 4-level bit-wise heavy-hitters plan, 2 keys.
+    levels = 4
+    params = [DpfParameters(i + 1, Int(64)) for i in range(levels)]
+    hdpf = DistributedPointFunction.create_incremental(params)
+    finals = sorted({int(x) for x in rng.integers(0, 1 << levels, size=5)})
+    hkeys = [
+        hdpf.generate_keys_incremental(a, [23] * levels)[0]
+        for a in finals[:2]
+    ]
+    plan = hierarchical.bitwise_hierarchy_plan(levels, finals)
+    ref_ctx = hierarchical.BatchedContext.create(hdpf, hkeys)
+    want_hier = [
+        host_eval.values_to_limbs(
+            np.asarray(
+                hierarchical.evaluate_until_batch(ref_ctx, h, p, engine="host")
+            ),
+            64,
+        )
+        for h, p in plan
+    ]
+
+    def _run_hier(policy):
+        ctx = hierarchical.BatchedContext.create(hdpf, hkeys)
+        return supervisor.evaluate_levels_fused_robust(
+            ctx, plan, group=2, policy=policy
+        )
+
+    fixtures["hierarchical"] = {
+        "want": want_hier,
+        "run": _run_hier,
+        "chain": supervisor.hier_chain(None),
+        "corrupt_pattern": "lane",  # shallow entries: "bit4" is empty
+    }
+
+    # PIR: the lds-10 XorWrapper(128) test_pipeline family.
+    pdpf = DistributedPointFunction.create(DpfParameters(10, XorWrapper(128)))
+    db = rng.integers(0, 2**32, size=(1 << 10, 4), dtype=np.uint32)
+    pkeys = [pdpf.generate_keys(5, 1 << 100)[0], pdpf.generate_keys(9, 1 << 99)[0]]
+    pdb = sharded.prepare_pir_database(pdpf, db, order="lane")
+    want_pir = supervisor._host_pir_fold(pdpf, pkeys, db, 128)
+    fixtures["pir"] = {
+        "want": want_pir,
+        "run": lambda policy: supervisor.pir_query_batch_robust(
+            pdpf, pkeys, pdb, key_chunk=2, policy=policy, pipeline=False
+        ),
+        "chain": supervisor.fold_chain(None),
+        # A folded PIR response has no position axis, so the "bit4"
+        # pattern is structurally empty there (see sharded._pir_verify_fold)
+        # — corrupt the lone fold lane instead.
+        "corrupt_pattern": "lane",
+    }
+    return fixtures
+
+
+def _fault_plans(kind, first_backend, rng, corrupt_pattern=None):
+    """Seeded FaultPlan(s) for one case, scoped to the first rung."""
+    from distributed_point_functions_tpu.utils import faultinject
+    from distributed_point_functions_tpu.utils.errors import (
+        ResourceExhaustedError,
+        UnavailableError,
+    )
+
+    scope = frozenset({first_backend})
+    if kind == "corruption":
+        pattern = corrupt_pattern or ("bit4" if rng.integers(2) else "lane")
+        return [
+            faultinject.FaultPlan(
+                stage="device_output", pattern=pattern,
+                lane=int(rng.integers(4)), key_row=-1, backends=scope,
+            )
+        ]
+    if kind == "oom":
+        return [
+            faultinject.FaultPlan(
+                stage="device_call",
+                exception=ResourceExhaustedError("RESOURCE_EXHAUSTED: chaos"),
+                backends=scope,
+            )
+        ]
+    if kind == "unavailable":
+        # max_fires beyond the retry budget: the rung must actually fall.
+        return [
+            faultinject.FaultPlan(
+                stage="device_call",
+                exception=UnavailableError("UNAVAILABLE: chaos"),
+                backends=scope,
+            )
+        ]
+    if kind == "hang":
+        point = "finalize" if rng.integers(2) else "launch"
+        return [
+            faultinject.FaultPlan(
+                stage="device_hang", hang_seconds=HANG_SECONDS,
+                hang_point=point, backends=scope, max_fires=1,
+            )
+        ]
+    raise ValueError(kind)
+
+
+def _assert_equal(name, got, want):
+    if isinstance(want, list):
+        assert len(got) == len(want), f"{name}: entry count {len(got)} != {len(want)}"
+        for i, (g, w) in enumerate(zip(got, want)):
+            assert np.array_equal(np.asarray(g), np.asarray(w)), (
+                f"{name}: entry {i} mismatch"
+            )
+    elif want.dtype == object:
+        assert (np.asarray(got) == want).all(), f"{name}: share mismatch"
+    else:
+        assert np.array_equal(np.asarray(got), want), f"{name}: value mismatch"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--rounds", type=int, default=4)
+    ap.add_argument(
+        "--entries", default="",
+        help="comma-filter: full_domain,evaluate_at,dcf,mic,hierarchical,pir",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    try:
+        cache = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_cache",
+        )
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:
+        pass
+
+    from distributed_point_functions_tpu.ops import degrade
+    from distributed_point_functions_tpu.utils import faultinject, integrity
+    from distributed_point_functions_tpu.utils import telemetry
+
+    print(f"chaos soak: backend={jax.default_backend()} seed={args.seed} "
+          f"rounds={args.rounds}")
+    rng = np.random.default_rng(args.seed)
+    fixtures = _build_fixtures(rng)
+    if args.entries:
+        want_names = {e.strip() for e in args.entries.split(",")}
+        unknown = want_names - fixtures.keys()
+        if unknown:
+            print(f"unknown entries: {sorted(unknown)}", file=sys.stderr)
+            return 2
+        fixtures = {k: v for k, v in fixtures.items() if k in want_names}
+
+    failures = 0
+    cases = 0
+    t_start = time.perf_counter()
+    for rnd in range(args.rounds):
+        for name, fx in fixtures.items():
+            kind = FAULT_KINDS[int(rng.integers(len(FAULT_KINDS)))]
+            first_backend = fx["chain"][0][1]
+            policy = degrade.DegradationPolicy(
+                backoff_seconds=0.0,
+                deadline_seconds=HANG_DEADLINE if kind == "hang" else None,
+            )
+            plans = _fault_plans(
+                kind, first_backend, rng, fx.get("corrupt_pattern")
+            )
+            t0 = time.perf_counter()
+            status = "OK"
+            try:
+                with telemetry.capture() as cap, \
+                        integrity.capture_events() as events:
+                    with faultinject.inject(*plans):
+                        got = fx["run"](policy)
+                _assert_equal(name, got, fx["want"])
+                snap = cap.snapshot()
+                n_degrade_events = sum(
+                    1 for e in events if e.kind == "degrade"
+                )
+                n_degrade_decisions = snap["decisions_by_source"].get(
+                    "degrade", 0
+                )
+                assert n_degrade_decisions == n_degrade_events, (
+                    f"{name}: {n_degrade_events} degrade events but "
+                    f"{n_degrade_decisions} decision(source='degrade') "
+                    "records — telemetry incomplete"
+                )
+                if kind in ("corruption", "oom"):
+                    # Deterministic faults must actually walk the chain.
+                    assert n_degrade_events >= 1, (
+                        f"{name}: fault {kind} never degraded"
+                    )
+                if kind == "hang":
+                    kinds_seen = {e.kind for e in events}
+                    assert "deadline-expired" in kinds_seen, (
+                        f"{name}: hang injected but no deadline-expired "
+                        f"event (saw {sorted(kinds_seen)})"
+                    )
+            except AssertionError as exc:
+                status = f"FAIL: {exc}"
+                failures += 1
+            except Exception as exc:  # noqa: BLE001 — soak must report all
+                status = f"ERROR: {type(exc).__name__}: {exc}"
+                failures += 1
+            cases += 1
+            dt = time.perf_counter() - t0
+            print(
+                f"  round {rnd} {name:12s} fault={kind:11s} "
+                f"rung0={first_backend:6s} {dt:6.2f}s  {status}"
+            )
+    total = time.perf_counter() - t_start
+    verdict = "PASS" if failures == 0 else f"FAIL ({failures}/{cases} cases)"
+    print(f"chaos soak: {cases} cases in {total:.1f}s — {verdict}")
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

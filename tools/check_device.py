@@ -107,7 +107,12 @@ def _main() -> int:
     # verified at EVERY level vs the host engine; shapes read as
     # (num_keys, levels) — tpu_measure.sh's gate-hierkernel stage;
     # CHECK_HH_GROUP sizes the window, CHECK_HH_NONZEROS the leaf set)
-    # — the program shapes fail independently on a broken
+    # or "supervisor" (the resilient job supervisor, ISSUE 7: the first
+    # fallback rung is forced UnavailableError and the robust wrapper
+    # must recover bit-correct through the NEXT rung on-device with a
+    # decision(source="degrade") record — one real degrade transition
+    # exercised on hardware, CHECK_MODE=supervisor for the next tunnel
+    # window) — the program shapes fail independently on a broken
     # backend (PERF.md). This tool measures the RAW platform:
     # auto-slabbing would hide exactly the over-threshold programs being
     # probed, so it is force-disabled regardless of the caller's
