@@ -1,0 +1,308 @@
+"""Serving front door A/B (ISSUE 8): continuous batching vs naive
+per-request dispatch under a seeded Poisson stream of small mixed
+requests.
+
+The load generator draws ``BENCH_SERVING_REQUESTS`` requests (seeded —
+the schedule replays exactly) with exponential inter-arrival gaps and a
+mixed op distribution (full-domain expansions, point batches, DCF
+comparisons — each request a few keys/points, the shape the engine table
+says loses to dispatch latency when served one at a time). Both arms
+serve the identical schedule:
+
+* **naive** — one direct entry-point call per request, in arrival order
+  (service starts at max(arrival, previous completion): an ideal
+  zero-overhead sequential server).
+* **frontdoor** — requests submitted to ``serving.FrontDoor`` at their
+  arrival times; the continuous batcher aggregates compatible requests
+  into merged batches executed through the supervisor.
+
+On CPU the ~66 ms device dispatch latency does not exist, so the
+``chunk_delay`` fault-injection stage supplies it
+(``BENCH_SERVING_DELAY_MS`` per chunk launch AND finalize — the
+test_pipeline overlap-proxy pattern); on a real device the bench runs
+undelayed and measures the genuine tunnel latency. Both arms are forced
+onto the device engine class so the A/B isolates the batcher (routing
+quality is covered by CHECK_MODE=router and the router decision mix this
+record also carries).
+
+Record: throughput speedup (the headline value), per-arm req/s, p50/p95
+request latency, the batch-width histogram, and the router's decision mix
+from a separate auto-routed pass.
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def _make_requests(serving, rng, n, dpf, dcf, keys_fd, keys_dcf):
+    """The seeded mixed-request schedule: (arrival_offset_s, Request)."""
+    mean_gap = float(os.environ.get("BENCH_SERVING_GAP_MS", 5.0)) / 1e3
+    gaps = rng.exponential(mean_gap, size=n)
+    arrivals = np.cumsum(gaps) - gaps[0]
+    lds = dcf.log_domain_size
+    reqs = []
+    for i in range(n):
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            reqs.append(
+                serving.Request.full_domain(dpf, [keys_fd[i % len(keys_fd)]])
+            )
+        elif kind == 1:
+            pts = [int(x) for x in rng.integers(0, dpf_domain(dpf), size=8)]
+            reqs.append(
+                serving.Request.evaluate_at(
+                    dpf, [keys_fd[i % len(keys_fd)]], pts
+                )
+            )
+        else:
+            xs = [int(x) for x in rng.integers(0, 1 << lds, size=8)]
+            reqs.append(
+                serving.Request.dcf(dcf, [keys_dcf[i % len(keys_dcf)]], xs)
+            )
+    return list(zip(arrivals.tolist(), reqs))
+
+
+def dpf_domain(dpf):
+    return 1 << dpf.validator.parameters[-1].log_domain_size
+
+
+def _naive_serve(schedule, evaluator, key_chunk, pipeline):
+    """Sequential per-request dispatch: service begins at
+    max(arrival, previous completion); returns (wall, latencies)."""
+    import time
+
+    t0 = time.perf_counter()
+    latencies = []
+    for arrival, req in schedule:
+        now = time.perf_counter() - t0
+        if now < arrival:
+            time.sleep(arrival - now)
+            now = arrival
+        if req.op == "full_domain":
+            evaluator.full_domain_evaluate(
+                req.obj, list(req.keys), key_chunk=key_chunk,
+                pipeline=pipeline,
+            )
+        elif req.op == "evaluate_at":
+            evaluator.evaluate_at_batch(
+                req.obj, list(req.keys), list(req.points),
+                pipeline=pipeline,
+            )
+        else:
+            req.obj.batch_evaluate(
+                list(req.keys), list(req.points), pipeline=pipeline
+            )
+        latencies.append((time.perf_counter() - t0) - arrival)
+    return time.perf_counter() - t0, latencies
+
+
+def _frontdoor_serve(serving, schedule, **door_kwargs):
+    import time
+
+    with serving.FrontDoor(**door_kwargs) as door:
+        t0 = time.perf_counter()
+        futures = []
+        for arrival, req in schedule:
+            now = time.perf_counter() - t0
+            if now < arrival:
+                time.sleep(arrival - now)
+            futures.append(door.submit(req))
+        for f in futures:
+            f.result(timeout=600)
+        wall = time.perf_counter() - t0
+    latencies = [
+        (f.completed_at - t0_abs)
+        for f, t0_abs in zip(
+            futures, [t0 + a for a, _ in schedule]
+        )
+    ]
+    return wall, latencies, futures
+
+
+def _pcts(latencies):
+    v = np.sort(np.asarray(latencies))
+    return (
+        round(float(v[len(v) // 2]) * 1e3, 2),
+        round(float(v[min(len(v) - 1, int(len(v) * 0.95))]) * 1e3, 2),
+    )
+
+
+def bench(jax, smoke):
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.core.dpf import (
+        DistributedPointFunction,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.dcf.dcf import (
+        DistributedComparisonFunction,
+    )
+    from distributed_point_functions_tpu.ops import evaluator, supervisor
+    from distributed_point_functions_tpu.utils import faultinject, telemetry
+
+    n = int(os.environ.get("BENCH_SERVING_REQUESTS", 64 if smoke else 200))
+    lds = int(os.environ.get("BENCH_SERVING_LOG_DOMAIN", 6 if smoke else 14))
+    # Serving-realistic chunking: merged full-domain batches dispatch
+    # ceil(K/32) programs vs one per request — the amortization itself.
+    # (key_chunk=2 would make full-domain dispatches scale with keys and
+    # cancel the merge win; it exists only for test-suite shape reuse.)
+    key_chunk = int(os.environ.get("BENCH_SERVING_KEY_CHUNK", 32))
+    width = int(os.environ.get("BENCH_SERVING_WIDTH", 64))
+    max_wait_ms = float(os.environ.get("BENCH_SERVING_WAIT_MS", 10.0))
+    # CPU proxy: injected per-chunk dispatch latency (launch + finalize
+    # each). 0 on device — the tunnel supplies the real thing.
+    delay_ms = float(
+        os.environ.get(
+            "BENCH_SERVING_DELAY_MS",
+            12.0 if jax.default_backend() == "cpu" else 0.0,
+        )
+    )
+    pool = 32  # distinct key pool the schedule cycles through
+    rng = np.random.default_rng(int(os.environ.get("BENCH_SEED", 17)))
+
+    dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+    dcf = DistributedComparisonFunction.create(lds, Int(64))
+    with Timer() as tk:
+        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=pool)]
+        betas = [[int(x) for x in rng.integers(1, 1000, size=pool)]]
+        keys_fd, _ = dpf.generate_keys_batch(alphas, betas)
+        keys_dcf = [
+            dcf.generate_keys(int(rng.integers(0, 1 << lds)), 4242)[0]
+            for _ in range(4)
+        ]
+    log(f"keygen: {tk.elapsed:.2f}s ({pool} DPF + 4 DCF keys)")
+
+    schedule = _make_requests(serving, rng, n, dpf, dcf, keys_fd, keys_dcf)
+    mix = {}
+    for _, r in schedule:
+        mix[r.op] = mix.get(r.op, 0) + 1
+    log(f"schedule: {n} requests, op mix {mix}")
+
+    def delay_plan():
+        d = delay_ms / 1e3
+        return faultinject.FaultPlan(
+            stage="chunk_delay", delay_launch=d, delay_finalize=d
+        )
+
+    def with_delay(fn):
+        if delay_ms <= 0:
+            return fn()
+        with faultinject.inject(delay_plan()):
+            return fn()
+
+    # Warm BOTH arms by replaying the full schedule once, UNDER the same
+    # injected delays but untimed: XLA compiles of every program family
+    # an arm will touch and the supervisor's one-time probe caches must
+    # never read as dispatch latency (the walkkernel-budget lesson; on
+    # hardware the .jax_cache plays this role). The warm pass keeps the
+    # delays so the batcher's flush timing — and therefore the bucketed
+    # merged-batch shapes the timed pass will compile against — matches.
+    with Timer() as tw:
+        with_delay(
+            lambda: _naive_serve(
+                _replay(schedule), evaluator, key_chunk, None
+            )
+        )
+        # Two front-door replays: batch composition (and therefore the
+        # bucketed shapes) depends on queue timing, so shapes that only
+        # appear once the queues run deep compile during the FIRST warm
+        # replay; the second confirms the steady state a long-running
+        # server sits in.
+        for _ in range(2):
+            with_delay(
+                lambda: _frontdoor_serve(
+                    serving, _replay(schedule), engine="device",
+                    max_wait_ms=max_wait_ms, width_target=width,
+                    key_chunk=key_chunk, pipeline=True,
+                )
+            )
+    log(f"warm pass (both arms, compiles + probe caches): {tw.elapsed:.2f}s")
+
+    naive_sched = _replay(schedule)
+    naive_wall, naive_lat = with_delay(
+        lambda: _naive_serve(naive_sched, evaluator, key_chunk, None)
+    )
+    log(f"naive: {naive_wall:.2f}s ({n / naive_wall:.1f} req/s)")
+
+    door_sched = _replay(schedule)
+    with telemetry.capture() as tel:
+        door_wall, door_lat, futures = with_delay(
+            lambda: _frontdoor_serve(
+                serving, door_sched, engine="device",
+                max_wait_ms=max_wait_ms, width_target=width,
+                key_chunk=key_chunk, pipeline=True,
+            )
+        )
+    snap = tel.snapshot()
+    log(f"frontdoor: {door_wall:.2f}s ({n / door_wall:.1f} req/s)")
+
+    # Router decision mix: replay the schedule once through engine="auto"
+    # (undelayed, after the timed arms) so the record shows what the
+    # cost model would pick live.
+    with telemetry.capture() as tel_auto:
+        _frontdoor_serve(
+            serving, [(0.0, r) for _, r in _replay(schedule)],
+            engine="auto", max_wait_ms=max_wait_ms, width_target=width,
+            key_chunk=key_chunk,
+        )
+    decisions = {}
+    for d in tel_auto.decision_records(source="router"):
+        label = d["data"].get("choice", "?")
+        decisions[label] = decisions.get(label, 0) + 1
+
+    speedup = naive_wall / door_wall if door_wall > 0 else 0.0
+    p50_n, p95_n = _pcts(naive_lat)
+    p50_d, p95_d = _pcts(door_lat)
+    widths = snap["histograms"].get("serving.batch_width", {})
+    return {
+        "bench": "serving",
+        "metric": "frontdoor_speedup_vs_naive",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "config": {
+            "requests": n,
+            "log_domain": lds,
+            "key_chunk": key_chunk,
+            "width_target": width,
+            "max_wait_ms": max_wait_ms,
+            "injected_delay_ms": delay_ms,
+            "op_mix": mix,
+            "naive_req_per_sec": round(n / naive_wall, 2),
+            "frontdoor_req_per_sec": round(n / door_wall, 2),
+            "naive_latency_ms": {"p50": p50_n, "p95": p95_n},
+            "frontdoor_latency_ms": {"p50": p50_d, "p95": p95_d},
+            "batch_width": {
+                k: widths.get(k) for k in ("count", "p50", "max") if widths
+            },
+            "router_decision_mix": decisions,
+            "batches": int(
+                sum(
+                    v
+                    for k, v in snap["counters"].items()
+                    if k.startswith("serving.batches")
+                )
+            ),
+        },
+        **telemetry.bench_fields(snap),
+    }
+
+
+def _replay(schedule):
+    """Clones the schedule with fresh futures (a Request's future is
+    single-shot; warm passes, timed arms and the decision-mix pass each
+    re-serve the identical work)."""
+    import dataclasses
+
+    from distributed_point_functions_tpu.serving.batcher import ServedFuture
+
+    return [
+        (arrival, dataclasses.replace(r, future=ServedFuture()))
+        for arrival, r in schedule
+    ]
+
+
+if __name__ == "__main__":
+    run_bench("serving", bench)

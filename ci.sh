@@ -34,7 +34,12 @@ run_fast() {
   # real-circuit coverage goes through the replays, never pallas_call)
   # and the telemetry-bus suite (tests/test_telemetry.py, ISSUE 6 —
   # spans/counters/decisions on the XLA paths only, no new pallas
-  # configs); pytest collects them with the rest of tests/ — no
+  # configs) and the serving-front-door suite (tests/test_serving.py,
+  # ISSUE 8 — router pins, batcher units, six-op bit-exact e2e and the
+  # 2x throughput A/B, built strictly on the lds-6 chunk-2 XLA program
+  # family test_pipeline already compiles: ZERO new pallas interpret
+  # configs, per the walkkernel compile-budget lesson); pytest
+  # collects them with the rest of tests/ — no
   # separate invocation, which would run them twice. JAX_PLATFORMS=cpu
   # is pinned explicitly (belt to conftest.py's in-process suspenders)
   # so the tier can never contend for the single-process TPU claim.

@@ -1,0 +1,30 @@
+"""Serving front door (ISSUE 8): continuous batching of asynchronously
+arriving small requests into the wide uniform batches the device engines
+need, plus a cost-model router that picks host vs device vs kernel mode
+per batch — executed through the resilient job supervisor.
+
+    from distributed_point_functions_tpu import serving
+
+    with serving.FrontDoor() as door:
+        fut = door.submit(serving.Request.evaluate_at(dpf, [key], points))
+        limbs = fut.result(timeout=5)
+"""
+
+from .batcher import (  # noqa: F401
+    ContinuousBatcher,
+    Request,
+    ServedFuture,
+    WarmCache,
+    plan_digest,
+)
+from .frontdoor import FrontDoor  # noqa: F401
+from .router import (  # noqa: F401
+    ANCHORS,
+    DISPATCH_SECONDS_PRIOR,
+    ENGINE_TABLE,
+    CostModel,
+    RouteDecision,
+    Router,
+    Workload,
+    engine_table_predictions,
+)

@@ -1,0 +1,594 @@
+"""Continuous batcher: aggregate small requests into wide device batches.
+
+The device engines win only on wide uniform batches (PERF.md's engine
+table), but serving traffic arrives as many small requests — a few keys
+or points each — and at ~66 ms per-dispatch RPC latency, dispatching each
+request individually hands every workload to the host engine or eats the
+latency. This module applies iteration-level continuous batching (the
+Orca idea, Yu et al. OSDI 2022, here at FSS-batch rather than model-token
+granularity):
+
+* **Compatibility queues** — requests merge only when one device program
+  can serve them: the queue key is (op, DPF parameter signature, value
+  type, domain, op-specific extras) via :func:`Request.signature`. Keys
+  concatenate along the batch axis; evaluation points union (the batched
+  entry points evaluate every key at every point, so a merged batch is a
+  superset program and each request's answer is a row/column slice).
+* **Batch-deadline timers** — a queue flushes when its width reaches
+  ``width_target`` OR its oldest request has waited ``max_wait_ms``:
+  wide batches when traffic is heavy, bounded latency when it is not.
+* **Admission control** — total queued requests are bounded by
+  ``max_queue_depth``; past it, ``submit`` raises
+  ``ResourceExhaustedError`` immediately (fail fast beats queue collapse;
+  the caller sheds or retries with backoff).
+* **Warm cache** — :class:`WarmCache` holds the prepared-state tier
+  (``PreparedPirDatabase`` / ``PreparedLevelsPlan`` / ``PreparedKeyBatch``)
+  keyed by params signature + content digest, LRU-bounded, so the
+  expensive one-time uploads (a PIR database crossing a ~5 MB/s link, the
+  hierarchical gather tables) are paid per *content*, not per batch.
+
+The batcher owns one worker thread; flushes run on it, serialized — the
+execution layer behind it (ops/supervisor.py robust wrappers) drives one
+device. Telemetry: ``serving.submitted`` / ``serving.rejected`` /
+``serving.batches`` counters, ``serving.batch_width`` and
+``serving.queue_wait_ms`` histograms, a ``serving.queue_depth`` gauge —
+the bench's batch-width histogram and the router's feedback loop read
+these off the ISSUE 6 bus.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import telemetry as _tm
+from ..utils.errors import InvalidArgumentError, ResourceExhaustedError
+
+#: Ops the front door serves — the six bulk entry points.
+OPS = ("full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical")
+
+
+class ServedFuture:
+    """One request's pending result. ``result(timeout)`` blocks until the
+    batch containing the request completes (or its failure propagates —
+    every request in a failed batch gets the batch's exception)."""
+
+    __slots__ = (
+        "_event", "_value", "_error", "submitted_at", "completed_at",
+        "batch_width", "choice",
+    )
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+        self.submitted_at: float = 0.0
+        self.completed_at: float = 0.0
+        #: width of the merged batch this request rode (set at flush).
+        self.batch_width: int = 0
+        #: the routed engine/mode label (set at flush).
+        self.choice: str = ""
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("request not served within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+    @property
+    def latency_seconds(self) -> float:
+        """submit -> completion wall time (valid once done)."""
+        return max(0.0, self.completed_at - self.submitted_at)
+
+    def _resolve(self, value) -> None:
+        self._value = value
+        self.completed_at = time.perf_counter()
+        self._event.set()
+
+    def _reject(self, exc: BaseException) -> None:
+        self._error = exc
+        self.completed_at = time.perf_counter()
+        self._event.set()
+
+
+def _digest(*parts) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        if isinstance(p, np.ndarray):
+            h.update(np.ascontiguousarray(p).tobytes())
+        else:
+            h.update(repr(p).encode())
+    return h.hexdigest()[:16]
+
+
+def _prefix_bytes(prefixes) -> bytes:
+    """Canonical bytes of a prefix sequence: int32/int64 arrays, lists
+    and tuples of the same values must digest identically, or equal
+    plans never merge and the warm cache re-uploads per representation.
+    Structured arrays (>64-bit prefix limbs) hash raw — no int() form."""
+    if isinstance(prefixes, np.ndarray) and prefixes.dtype.fields:
+        return np.ascontiguousarray(prefixes).tobytes()
+    return repr([int(x) for x in prefixes]).encode()
+
+
+def plan_digest(plan) -> str:
+    """Content digest of a raw hierarchical plan (list of
+    (hierarchy_level, prefixes)) — the compatibility-queue and warm-cache
+    key component for hierarchical requests."""
+    h = hashlib.sha256()
+    for lvl, prefixes in plan:
+        h.update(repr(int(lvl)).encode())
+        h.update(_prefix_bytes(prefixes))
+    return h.hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class Request:
+    """One small serving request: an op, its cryptographic object(s), and
+    the op-specific work. Build via the classmethods — they validate the
+    op-specific fields and keep the signature rules in one place."""
+
+    op: str
+    obj: object  # DistributedPointFunction / DCF / MIC gate
+    keys: tuple = ()
+    points: tuple = ()  # evaluate_at / dcf / mic evaluation points
+    plan: Optional[list] = None  # hierarchical (hierarchy_level, prefixes)
+    group: int = 16
+    db: object = None  # pir: shared database (array or PreparedPirDatabase)
+    hierarchy_level: int = -1
+    future: ServedFuture = dataclasses.field(default_factory=ServedFuture)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def full_domain(cls, dpf, keys: Sequence, hierarchy_level: int = -1):
+        return cls(
+            op="full_domain", obj=dpf, keys=tuple(keys),
+            hierarchy_level=hierarchy_level,
+        )
+
+    @classmethod
+    def evaluate_at(
+        cls, dpf, keys: Sequence, points: Sequence[int],
+        hierarchy_level: int = -1,
+    ):
+        return cls(
+            op="evaluate_at", obj=dpf, keys=tuple(keys),
+            points=tuple(int(p) for p in points),
+            hierarchy_level=hierarchy_level,
+        )
+
+    @classmethod
+    def dcf(cls, dcf, keys: Sequence, xs: Sequence[int]):
+        return cls(
+            op="dcf", obj=dcf, keys=tuple(keys),
+            points=tuple(int(x) for x in xs),
+        )
+
+    @classmethod
+    def mic(cls, gate, key, xs: Sequence[int]):
+        return cls(
+            op="mic", obj=gate, keys=(key,),
+            points=tuple(int(x) for x in xs),
+        )
+
+    @classmethod
+    def pir(cls, dpf, keys: Sequence, db):
+        return cls(op="pir", obj=dpf, keys=tuple(keys), db=db)
+
+    @classmethod
+    def hierarchical(cls, dpf, keys: Sequence, plan, group: int = 16):
+        return cls(
+            op="hierarchical", obj=dpf, keys=tuple(keys),
+            plan=[(int(h), p) for h, p in plan], group=group,
+        )
+
+    # -- batching ----------------------------------------------------------
+    def _validator(self):
+        if self.op in ("dcf",):
+            return self.obj.dpf.validator
+        if self.op == "mic":
+            return self.obj.dcf.dpf.validator
+        return self.obj.validator
+
+    def params_signature(self) -> tuple:
+        from ..utils import integrity
+
+        return integrity._params_signature(self._validator())
+
+    def party(self) -> int:
+        k = self.keys[0]
+        if self.op == "dcf":
+            return k.key.party
+        if self.op == "mic":
+            return k.dcf_key.key.party
+        return k.party
+
+    def signature(self) -> tuple:
+        """The compatibility-queue key: requests with equal signatures can
+        merge into one device batch. Params signature covers value type
+        and domain per hierarchy level; op-specific extras pin what the
+        merged program additionally shares (the PIR database identity,
+        the hierarchical plan + group, the MIC key — a MIC batch is one
+        key's gate evaluated at many masked inputs)."""
+        if self.op not in OPS:
+            raise InvalidArgumentError(f"unknown serving op {self.op!r}")
+        if not self.keys:
+            raise InvalidArgumentError("request carries no keys")
+        # Party rides every signature: a merged KeyBatch must be one
+        # party's keys (the KeyBatch.from_keys contract).
+        base = (self.op, self.params_signature(), self.party())
+        if self.op in ("full_domain", "evaluate_at"):
+            return base + (self.hierarchy_level,)
+        if self.op == "pir":
+            return base + (id(self.db),)
+        if self.op == "hierarchical":
+            return base + (plan_digest(self.plan), self.group)
+        if self.op == "mic":
+            key = self.keys[0]
+            return base + (
+                _digest(key.dcf_key.key.seed, tuple(key.output_mask_shares)),
+            )
+        return base  # dcf
+
+    @property
+    def width(self) -> int:
+        """This request's contribution to the batch-width target: keys
+        for the key-merged ops, evaluation points for MIC (one key by
+        construction)."""
+        return len(self.points) if self.op == "mic" else len(self.keys)
+
+
+class _Queue:
+    __slots__ = ("sig", "requests", "width", "oldest")
+
+    def __init__(self, sig):
+        self.sig = sig
+        self.requests: List[Request] = []
+        self.width = 0
+        self.oldest = float("inf")
+
+
+class ContinuousBatcher:
+    """Per-signature compatibility queues + the flush worker.
+
+    ``flush`` is called on the worker thread as ``flush(sig, requests)``
+    and must resolve/reject every request's future; an exception it
+    raises rejects the whole batch (each future carries it). Use as a
+    context manager, or call :meth:`start` / :meth:`stop` explicitly;
+    :meth:`pump` flushes ripe queues inline for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        flush: Callable[[tuple, List[Request]], None],
+        max_wait_ms: float = 5.0,
+        width_target: int = 64,
+        max_queue_depth: int = 1024,
+    ):
+        if width_target < 1 or max_queue_depth < 1:
+            raise InvalidArgumentError(
+                "width_target and max_queue_depth must be >= 1"
+            )
+        self._flush = flush
+        self.max_wait = max_wait_ms / 1e3
+        self.width_target = width_target
+        self.max_queue_depth = max_queue_depth
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: Dict[tuple, _Queue] = collections.OrderedDict()
+        self._pending = 0
+        self._worker: Optional[threading.Thread] = None
+        self._stop = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "ContinuousBatcher":
+        with self._lock:
+            if self._worker is not None:
+                return self
+            self._stop = False
+            self._worker = threading.Thread(
+                target=self._run, name="dpf-serving-batcher", daemon=True
+            )
+            self._worker.start()
+        return self
+
+    def stop(self) -> None:
+        """Flushes everything still queued, then joins the worker."""
+        with self._lock:
+            self._stop = True
+            self._cond.notify_all()
+            worker = self._worker
+            self._worker = None
+        if worker is not None:
+            worker.join()
+        self.pump(force=True)
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, req: Request) -> ServedFuture:
+        sig = req.signature()  # validate outside the lock
+        width = req.width
+        if width < 1:
+            raise InvalidArgumentError("request carries no keys/points")
+        with self._lock:
+            if self._stop:
+                # After stop()'s final drain a queued request would never
+                # flush — fail fast like admission control, not a hang.
+                _tm.counter("serving.rejected", op=req.op)
+                raise ResourceExhaustedError(
+                    "serving batcher is stopped: request rejected "
+                    "(start() the batcher / front door again to serve)"
+                )
+            if self._pending >= self.max_queue_depth:
+                _tm.counter("serving.rejected", op=req.op)
+                raise ResourceExhaustedError(
+                    f"serving queue full ({self._pending} pending >= "
+                    f"max_queue_depth={self.max_queue_depth}): admission "
+                    "control rejected the request — retry with backoff"
+                )
+            q = self._queues.get(sig)
+            new_queue = q is None
+            if new_queue:
+                q = self._queues[sig] = _Queue(sig)
+            req.future.submitted_at = time.perf_counter()
+            q.requests.append(req)
+            q.width += width
+            q.oldest = min(q.oldest, req.future.submitted_at)
+            self._pending += 1
+            if _tm.enabled():
+                _tm.counter("serving.submitted", op=req.op)
+                _tm.gauge("serving.queue_depth", self._pending)
+            # Wake the worker only when this submit changes what it
+            # should do: a NEW queue needs its deadline armed, a queue
+            # crossing the width target needs flushing now. A submit
+            # into an existing sub-target queue can't move its deadline
+            # earlier (q.oldest only ages), so waking would just rescan
+            # every queue under the lock on the hot path.
+            if new_queue or q.width >= self.width_target:
+                self._cond.notify_all()
+        return req.future
+
+    def pending(self) -> int:
+        with self._lock:
+            return self._pending
+
+    # -- flushing ----------------------------------------------------------
+    def _take_ripe(self, now: float, force: bool) -> List[_Queue]:
+        """Pops every queue that is ripe (width target met, deadline
+        passed, or force). Caller holds no lock."""
+        ripe: List[_Queue] = []
+        with self._lock:
+            for sig in list(self._queues):
+                q = self._queues[sig]
+                if not q.requests:
+                    del self._queues[sig]
+                    continue
+                expired = now - q.oldest >= self.max_wait
+                if force or expired or q.width >= self.width_target:
+                    del self._queues[sig]
+                    self._pending -= len(q.requests)
+                    ripe.append(q)
+            if _tm.enabled() and ripe:
+                _tm.gauge("serving.queue_depth", self._pending)
+        return ripe
+
+    def _run_flush(self, q: _Queue) -> None:
+        op = q.requests[0].op
+        if _tm.enabled():
+            _tm.counter("serving.batches", op=op)
+            _tm.observe("serving.batch_width", q.width, op=op)
+            now = time.perf_counter()
+            for r in q.requests:
+                _tm.observe(
+                    "serving.queue_wait_ms",
+                    (now - r.future.submitted_at) * 1e3,
+                    op=op,
+                )
+        for r in q.requests:
+            r.future.batch_width = q.width
+        try:
+            self._flush(q.sig, q.requests)
+        except BaseException as exc:  # noqa: BLE001 — delivered per future
+            for r in q.requests:
+                if not r.future.done():
+                    r.future._reject(exc)
+        # A flush that "succeeds" but forgets a future would hang its
+        # caller forever; surface the contract violation instead.
+        for r in q.requests:
+            if not r.future.done():
+                r.future._reject(
+                    InvalidArgumentError(
+                        "serving flush completed without resolving this "
+                        "request (front-door bug)"
+                    )
+                )
+
+    def pump(self, force: bool = False) -> int:
+        """Flushes ripe (or, with force, all) queues inline on the caller
+        thread; returns the number of batches flushed. The deterministic
+        test/shutdown path — the worker thread does exactly this on a
+        timer."""
+        flushed = 0
+        for q in self._take_ripe(time.perf_counter(), force):
+            self._run_flush(q)
+            flushed += 1
+        return flushed
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                deadline = None
+                now = time.perf_counter()
+                ready = False
+                for q in self._queues.values():
+                    if not q.requests:
+                        continue
+                    if (
+                        q.width >= self.width_target
+                        or now - q.oldest >= self.max_wait
+                    ):
+                        ready = True
+                        break
+                    d = q.oldest + self.max_wait
+                    deadline = d if deadline is None else min(deadline, d)
+                if not ready:
+                    timeout = (
+                        None if deadline is None
+                        else max(0.0, deadline - now)
+                    )
+                    self._cond.wait(timeout=timeout)
+                    if self._stop:
+                        return
+            self.pump()
+
+
+# ---------------------------------------------------------------------------
+# Warm cache: the prepared-state tier
+# ---------------------------------------------------------------------------
+
+
+class _LRU:
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.data: "collections.OrderedDict" = collections.OrderedDict()
+
+    def get(self, key):
+        if key in self.data:
+            self.data.move_to_end(key)
+            return self.data[key]
+        return None
+
+    def put(self, key, value):
+        self.data[key] = value
+        self.data.move_to_end(key)
+        while len(self.data) > self.capacity:
+            self.data.popitem(last=False)
+
+
+class WarmCache:
+    """LRU cache of the prepared-state tier, keyed by params signature +
+    content digest:
+
+    * ``pir_db`` — ``PreparedPirDatabase`` per (params, db identity,
+      order, host_levels): the database crosses the host link once per
+      content and order, not per query batch.
+    * ``levels_plan`` — ``PreparedLevelsPlan`` per (params, plan digest,
+      group, mode): the hierarchical gather tables compose + upload once
+      and replay across key batches (the documented prepared-replay
+      contract).
+    * ``key_batch`` — ``PreparedKeyBatch`` per (params, key digest,
+      hierarchy level, key_chunk, host_levels): a repeated key set (a
+      persistent client, a key batch folded against several databases)
+      skips the per-call pack + upload.
+
+    Capacities are entry counts per tier; a PIR database can be ~100 MB,
+    so the default keeps few.
+    """
+
+    def __init__(self, db_capacity: int = 4, plan_capacity: int = 8,
+                 keys_capacity: int = 8):
+        self._lock = threading.Lock()
+        self._dbs = _LRU(db_capacity)
+        self._plans = _LRU(plan_capacity)
+        self._keys = _LRU(keys_capacity)
+
+    def _get_or_make(self, lru: _LRU, key, make, op: str):
+        with self._lock:
+            hit = lru.get(key)
+        if hit is not None:
+            _tm.counter("serving.cache_hit", op=op)
+            return hit
+        _tm.counter("serving.cache_miss", op=op)
+        value = make()
+        with self._lock:
+            lru.put(key, value)
+        return value
+
+    def pir_db(self, dpf, db, order: str, host_levels=None):
+        """The database prepared in ``order`` — pass-through when ``db``
+        is already a ``PreparedPirDatabase`` of that order. Keyed by the
+        source object's identity, with the source kept alive INSIDE the
+        cache entry: id() alone could alias a new database allocated at
+        a freed one's address and silently serve stale PIR rows."""
+        from ..parallel import sharded
+
+        if isinstance(db, sharded.PreparedPirDatabase) and db.order == order:
+            return db
+        key = ("pir", id(db), order, host_levels)
+
+        def make():
+            src = (
+                db.natural_host(dpf)
+                if isinstance(db, sharded.PreparedPirDatabase)
+                else np.asarray(db)
+            )
+            prepared = sharded.prepare_pir_database(
+                dpf, src, host_levels, order=order
+            )
+            return (db, prepared)  # db ref pins the id the key encodes
+
+        return self._get_or_make(self._dbs, key, make, "pir")[1]
+
+    def levels_plan(self, dpf, keys, plan, group: int, mode=None):
+        """``PreparedLevelsPlan`` for (plan, group, mode) — composed from
+        a context over `keys` but replayable across any key batch of the
+        same DPF (the prepared-replay contract tools/check_device.py's
+        "prepared" extra verifies on-chip)."""
+        from ..ops import hierarchical
+        from ..utils import integrity
+
+        key = (
+            "plan", integrity._params_signature(dpf.validator),
+            plan_digest(plan), group, mode,
+        )
+
+        def make():
+            ctx = hierarchical.BatchedContext.create(dpf, list(keys))
+            return hierarchical.prepare_levels_fused(
+                ctx, plan, group, mode=mode
+            )
+
+        return self._get_or_make(self._plans, key, make, "hierarchical")
+
+    def key_batch(self, dpf, keys, hierarchy_level: int = -1,
+                  key_chunk: int = 128, host_levels=None):
+        from ..ops import evaluator
+        from ..utils import integrity
+
+        digest = _digest(*[
+            (
+                k.seed, k.party,
+                tuple(cw.seed for cw in k.correction_words),
+                tuple(int(v) for v in k.last_level_value_correction),
+            )
+            for k in keys
+        ])
+        key = (
+            "keys", integrity._params_signature(dpf.validator), digest,
+            hierarchy_level, key_chunk, host_levels, len(keys),
+        )
+        return self._get_or_make(
+            self._keys, key,
+            lambda: evaluator.PreparedKeyBatch(
+                dpf, list(keys), hierarchy_level, key_chunk=key_chunk,
+                host_levels=host_levels,
+            ),
+            "full_domain",
+        )

@@ -1,0 +1,618 @@
+"""The serving front door: batcher + router + supervisor, composed.
+
+One object serves all six bulk entry points behind a submit/await
+interface::
+
+    with FrontDoor() as door:
+        fut = door.submit(Request.evaluate_at(dpf, [key], points))
+        limbs = fut.result(timeout=5)
+
+Per merged batch, the flow is:
+
+1. the **continuous batcher** (serving/batcher.py) aggregated compatible
+   small requests into one wide batch;
+2. the **cost-model router** (serving/router.py) predicts wall time per
+   (engine, mode) candidate from live dispatch latency + throughput
+   anchors and picks the cheapest, emitting ``decision(source="router")``
+   (an explicit ``engine=`` override skips prediction and records
+   ``source="explicit"``);
+3. the batch executes **through the PR 7 robust wrappers**
+   (ops/supervisor.py) so dispatch deadlines, mode-aware degradation
+   chains and chunk journals are inherited, not re-grown — with
+   ``robust=False`` the raw entry points run instead (no degradation, but
+   the warm-cache prepared tiers — ``PreparedLevelsPlan`` replay,
+   ``PreparedKeyBatch`` — become usable, since the chains cannot re-target
+   prepared mode-specific tables);
+4. the batch's telemetry (captured around the execution only) feeds back:
+   measured wall time updates the router's rate EWMA, measured
+   ``pipeline.finalize`` spans update its dispatch-latency EWMA, and any
+   ``decision(source="degrade")`` records penalize the failed choice
+   (``Router.on_degrade``).
+
+Every request's answer is a row/column slice of the merged batch's
+result, so results are bit-exact vs calling the entry point directly with
+that request's keys/points (pinned by tests/test_serving.py), and the
+merged batch launches exactly the device programs the chosen engine would
+launch for a direct call (pinned by tests/test_dispatch_audit.py).
+
+The front door never *holds* device results: every op's device rung
+already normalizes to host uint32 limb arrays (the robust-wrapper
+contract), and slicing is numpy row selection.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils import telemetry as _tm
+from ..utils.errors import InvalidArgumentError
+from .batcher import ContinuousBatcher, Request, ServedFuture, WarmCache
+from .router import RouteDecision, Router, Workload
+
+
+def _value_meta(validator, hierarchy_level: int) -> Tuple[int, str]:
+    """(bits, kind) of the output value type at `hierarchy_level` — the
+    router's anchor bucket."""
+    from ..ops import evaluator, value_codec
+
+    if hierarchy_level < 0:
+        hierarchy_level = validator.num_hierarchy_levels - 1
+    vt = validator.parameters[hierarchy_level].value_type
+    spec = value_codec.build_spec(
+        vt, validator.blocks_needed[hierarchy_level]
+    )
+    if spec.is_scalar_direct and spec.blocks_needed == 1:
+        bits, _ = evaluator._value_kind(vt)
+        return bits, ("u128" if bits == 128 else "u64")
+    return getattr(vt, "bitsize", 64), "codec"
+
+
+def _pow2_pad(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def _bucket_target(n: int, chunk: Optional[int] = None, floor: int = 0) -> int:
+    """The shape-bucketed axis length `n` pads to (see _pad_keys) — shared
+    by the padding itself and the router's device-work model, so the cost
+    a device candidate is predicted (and learned) at is the cost of the
+    program that actually runs."""
+    if n <= 0:
+        return n
+    if chunk is not None:
+        return math.ceil(n / chunk) * chunk
+    return max(_pow2_pad(n), _pow2_pad(floor))
+
+
+def _pad_keys(
+    keys: list, bucket: bool, chunk: Optional[int] = None, floor: int = 0
+) -> list:
+    """Shape bucketing: pads a merged key batch by repeating the last
+    key. Merged batches otherwise carry a unique key count per flush,
+    and the entry points compile one XLA program PER DISTINCT SHAPE — a
+    compile storm locally and, through the tunnel's remote compiler, a
+    latency cliff per novel batch width. Padded rows are appended after
+    every request's rows, so slicing is unaffected.
+
+    Two regimes: single-program ops (evaluate_at / dcf / hierarchical)
+    pad to the next power of two — <= 2x compute on an engine chosen for
+    having headroom, zero extra dispatches. Chunked ops (full_domain /
+    PIR, `chunk` given) pad to the next key-chunk MULTIPLE — ceil(K/chunk)
+    is unchanged, so this never adds a dispatch, and every program is
+    exactly the chunk-wide shape of the warm family (a sub-chunk batch
+    would otherwise compile at its own width per the chunk_indices
+    small-batch exception; a power of two ABOVE the multiple would add
+    whole extra chunks = extra ~66 ms dispatches, the one cost the front
+    door exists to amortize).
+
+    `floor` (single-program ops only) pads AT LEAST to pow2(floor) — the
+    front door passes its width target, so deadline-triggered small
+    flushes ride the same wide uniform program the full flushes compile:
+    ONE shape per op in steady state, which is also what the device
+    engines are fastest at. Padding applies only on the device arm (the
+    caller gates `bucket`): the host engine has no program shapes to
+    stabilize and would pay the padding as real per-key work."""
+    if not bucket or not keys:
+        return keys
+    target = _bucket_target(len(keys), chunk=chunk, floor=floor)
+    return list(keys) + [keys[-1]] * (target - len(keys))
+
+
+def _pad_points(points: list, bucket: bool, floor: int = 0) -> list:
+    """The point-axis twin of :func:`_pad_keys` (merged point unions are
+    also unique per flush; `floor` gives the same steady-state
+    one-shape-per-op property). Padding repeats point 0; requests slice
+    their own column indices, all < the unpadded length."""
+    if not bucket or not points:
+        return points
+    target = _bucket_target(len(points), floor=floor)
+    return list(points) + [points[0]] * (target - len(points))
+
+
+#: serving op -> the degrade-chain op labels its batches execute under
+#: (ops/degrade._run_chain's op_name; MIC rides the DCF chain) — the
+#: _learn feedback filter. telemetry.capture() is process-global, so a
+#: concurrently flushing door/thread's degrade records land in this
+#: batch's capture window; penalizing this batch's choice for another
+#: op's failure would teach the shared cost model from misattributed
+#: events.
+_DEGRADE_OPS = {
+    "full_domain": ("full_domain_evaluate",),
+    "evaluate_at": ("evaluate_at_batch",),
+    "dcf": ("dcf.batch_evaluate",),
+    "mic": ("dcf.batch_evaluate",),
+    "pir": ("pir_query_batch",),
+    "hierarchical": ("evaluate_levels_fused",),
+}
+
+
+def _union(seqs: Sequence[Sequence[int]]) -> Tuple[list, List[np.ndarray]]:
+    """Order-preserving union of int sequences + each input's index rows
+    into it (the merged-points slicing map)."""
+    index: Dict[int, int] = {}
+    merged: list = []
+    rows = []
+    for seq in seqs:
+        r = np.empty(len(seq), dtype=np.int64)
+        for i, x in enumerate(seq):
+            j = index.get(x)
+            if j is None:
+                j = index[x] = len(merged)
+                merged.append(x)
+            r[i] = j
+        rows.append(r)
+    return merged, rows
+
+
+class FrontDoor:
+    """The serving composition. Knobs:
+
+    * ``engine`` — "auto" (the router decides per batch), or "host" /
+      "device" to force an engine class (the A/B harness arms; decisions
+      are then recorded with ``source="explicit"``).
+    * ``mode`` — device execution mode override (None = the router's /
+      entry points' choice).
+    * ``max_wait_ms`` / ``width_target`` / ``max_queue_depth`` — the
+      batcher's deadline, width and admission knobs.
+    * ``robust`` — execute through ops/supervisor.py (default) vs the raw
+      entry points (enables the prepared-plan / prepared-keys warm tiers).
+    * ``policy`` / ``pipeline`` — passed through to the execution layer.
+    * ``key_chunk`` — chunking for the CHUNKED ops only (full_domain /
+      PIR, whose dispatch count scales with keys regardless of merging;
+      the batching win there is executor overlap + shape reuse). The
+      point-walk ops (evaluate_at / DCF / MIC) and hierarchical advances
+      always run their natural one-program-per-batch shape — chunking a
+      width-floored merged batch would multiply dispatches by padding,
+      the exact cost the front door exists to amortize.
+    * ``router`` — a serving.router.Router (shared across doors to pool
+      learning; default constructs one, loading ``DPF_TPU_ROUTER_CALIB``).
+    """
+
+    def __init__(
+        self,
+        router: Optional[Router] = None,
+        engine: str = "auto",
+        mode: Optional[str] = None,
+        max_wait_ms: float = 5.0,
+        width_target: int = 64,
+        max_queue_depth: int = 1024,
+        robust: bool = True,
+        policy=None,
+        pipeline: Optional[bool] = None,
+        key_chunk: Optional[int] = None,
+        cache: Optional[WarmCache] = None,
+        bucket: bool = True,
+    ):
+        if engine not in ("auto", "host", "device"):
+            raise InvalidArgumentError(
+                f"engine must be 'auto', 'host' or 'device', got {engine!r}"
+            )
+        self.router = router or Router()
+        self.engine = engine
+        self.mode = mode
+        self.robust = robust
+        self.pipeline = pipeline
+        self.key_chunk = key_chunk
+        #: shape bucketing (see _pad_keys): pads merged batch axes to
+        #: powers of two so flushes reuse compiled programs instead of
+        #: compiling one per distinct merged width.
+        self.bucket = bucket
+        self.cache = cache or WarmCache()
+        if policy is None:
+            from ..ops import degrade
+
+            policy = degrade.DEFAULT_POLICY
+        self.policy = policy
+        self.batcher = ContinuousBatcher(
+            self._execute,
+            max_wait_ms=max_wait_ms,
+            width_target=width_target,
+            max_queue_depth=max_queue_depth,
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "FrontDoor":
+        self.batcher.start()
+        return self
+
+    def stop(self) -> None:
+        self.batcher.stop()
+        if self.router.calibration:
+            try:
+                self.router.save_calibration()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "FrontDoor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- submission --------------------------------------------------------
+    def submit(self, request: Request) -> ServedFuture:
+        return self.batcher.submit(request)
+
+    def serve(
+        self, requests: Sequence[Request], timeout: Optional[float] = None
+    ) -> list:
+        """Submits all, pumps until served (works without the worker
+        thread), returns each request's result in order."""
+        futures = [self.submit(r) for r in requests]
+        if self.batcher._worker is None:
+            self.batcher.pump(force=True)
+        return [f.result(timeout) for f in futures]
+
+    # -- workload + routing ------------------------------------------------
+    def _workload(self, reqs: List[Request], union=None) -> Workload:
+        """The router's view of this batch. The device axes carry the
+        shape-bucketed sizes the device arm will actually pad to
+        (_pad_keys/_pad_points use the same _bucket_target), so a device
+        candidate is costed — and its rate learned — at the program that
+        runs, while the host is costed at the real request work."""
+        r0 = reqs[0]
+        v = r0._validator()
+        num_keys = sum(len(r.keys) for r in reqs)
+        wt = self.batcher.width_target if self.bucket else 0
+        if r0.op == "mic":
+            m = len(r0.obj.intervals)
+            merged = len(union[0])
+            dev_pts = _bucket_target(merged, floor=wt) if self.bucket else None
+            return Workload(
+                op="mic", num_keys=1, points=merged * 2 * m,
+                value_bits=128, value_kind="u128",
+                device_points=dev_pts and dev_pts * 2 * m,
+            )
+        hl = r0.hierarchy_level if r0.op in ("full_domain", "evaluate_at") else -1
+        bits, kind = _value_meta(v, hl)
+        lds = v.parameters[hl].log_domain_size
+        if r0.op == "hierarchical":
+            total = sum(
+                max(1, len(np.atleast_1d(np.asarray(p, dtype=object))))
+                for _, p in r0.plan
+            )
+            return Workload(
+                op="hierarchical", num_keys=num_keys, levels=len(r0.plan),
+                avg_prefixes=max(1, total // max(1, len(r0.plan))),
+                group=r0.group, value_bits=bits, value_kind=kind,
+                # pow2 only, no width floor (matching _run_hierarchical).
+                device_num_keys=(
+                    _bucket_target(num_keys) if self.bucket else None
+                ),
+            )
+        points = len(union[0]) if union is not None else 0
+        # key_chunk reaches the model for the CHUNKED ops only, at the
+        # value execution will use (_run_full_domain / _run_pir): the
+        # point-walk ops and hierarchical advances run one program per
+        # batch, where a chunk would predict phantom dispatches.
+        ck = None
+        dev_keys = dev_pts = None
+        if r0.op == "full_domain":
+            ck = self.key_chunk or 32
+            if self.bucket:
+                dev_keys = _bucket_target(num_keys, chunk=ck)
+        elif r0.op == "pir":
+            ck = self.key_chunk or 64
+            if self.bucket:
+                dev_keys = _bucket_target(num_keys, chunk=ck)
+        elif self.bucket:  # evaluate_at / dcf: width-target floors
+            dev_keys = _bucket_target(num_keys, floor=wt)
+            dev_pts = _bucket_target(points, floor=wt)
+        return Workload(
+            op=r0.op, num_keys=num_keys, points=points, log_domain=lds,
+            value_bits=bits, value_kind=kind, key_chunk=ck,
+            device_num_keys=dev_keys, device_points=dev_pts,
+        )
+
+    def _route(self, w: Workload) -> RouteDecision:
+        if self.engine == "auto":
+            return self.router.route(w)
+        mode = self.mode
+        decision = RouteDecision(
+            self.engine, mode if self.engine == "device" else None, 0.0, {}
+        )
+        _tm.decision(w.op, decision.choice, "explicit", via="serving")
+        return decision
+
+    # -- execution ---------------------------------------------------------
+    def _execute(self, sig: tuple, reqs: List[Request]) -> None:
+        """The batcher's flush callback: route, run, learn, slice."""
+        import time
+
+        # The merged point union is shared by the router's point count
+        # and the runner's slicing map — computed once per batch.
+        union = (
+            _union([r.points for r in reqs])
+            if reqs[0].op in ("evaluate_at", "dcf", "mic")
+            else None
+        )
+        w = self._workload(reqs, union)
+        decision = self._route(w)
+        with _tm.span("serving.execute", op=w.op, choice=decision.choice):
+            with _tm.capture(ring=2048) as tel:
+                t0 = time.perf_counter()
+                results = self._run(
+                    reqs, decision.engine, decision.mode, union
+                )
+                seconds = time.perf_counter() - t0
+        self._learn(w, decision, seconds, tel)
+        for r, value in zip(reqs, results):
+            r.future.choice = decision.choice
+            r.future._resolve(value)
+
+    def _learn(self, w: Workload, decision: RouteDecision, seconds, tel) -> None:
+        """Feed the measured batch back into the router: rate EWMA,
+        dispatch-latency EWMA, and degrade penalties."""
+        names = _DEGRADE_OPS.get(w.op, ())
+        for d in tel.decision_records(source="degrade"):
+            if d.get("name") not in names:
+                continue  # another op's concurrent degrade: not ours
+            self.router.on_degrade(
+                w.op, decision.engine, decision.mode,
+                d.get("data", {}).get("reason", ""),
+            )
+        # Dispatch latency is a property of the process's device link,
+        # not of this op — a concurrent batch's finalize spans landing
+        # in the window still measure the same quantity.
+        lat = tel.latency("span.pipeline.finalize")
+        if lat and decision.engine == "device":
+            self.router.observe_dispatch(lat["p50"])
+        self.router.observe(w, decision.engine, decision.mode, seconds)
+
+    def _run(
+        self, reqs: List[Request], engine: str, mode: Optional[str],
+        union=None,
+    ):
+        op = reqs[0].op
+        run = getattr(self, f"_run_{op}")
+        return run(reqs, engine, mode, union)
+
+    # Each _run_* merges the batch, executes on the chosen engine, and
+    # returns one result per request (a row/column slice of the batch
+    # result). Device paths go through ops/supervisor.py when
+    # self.robust; host paths run the same host-oracle arms the robust
+    # chains use as their rung of last resort — identical limb formats.
+
+    def _run_full_domain(self, reqs, engine, mode, union=None):
+        from ..ops import degrade, evaluator, supervisor
+
+        dpf, hl = reqs[0].obj, reqs[0].hierarchy_level
+        # Bucketing still matters under chunking: a batch smaller than
+        # one chunk compiles at its own width (the chunk_indices
+        # small-batch exception).
+        ck = self.key_chunk or 32
+        keys = _pad_keys(
+            [k for r in reqs for k in r.keys],
+            self.bucket and engine == "device", chunk=ck,
+        )
+        if engine == "host":
+            out = degrade._host_full_domain_limbs(dpf, keys, hl, ck)
+        elif self.robust:
+            out = supervisor.full_domain_evaluate_robust(
+                dpf, keys, hl, key_chunk=ck, policy=self.policy,
+                pipeline=self.pipeline,
+            )
+        else:
+            prepared = self.cache.key_batch(dpf, keys, hl, key_chunk=ck)
+            from ..ops import pipeline as _pl
+
+            chunks = evaluator.full_domain_evaluate_chunks(
+                dpf, prepared, hl, pipeline=self.pipeline
+            )
+            outs = [
+                np.asarray(o)[:valid]
+                for valid, o in _pl.consume(
+                    chunks, lambda item: item, _pl.resolve(self.pipeline),
+                    depth=1, op="full_domain_evaluate",
+                )
+            ]
+            out = outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+        return self._slice_rows(reqs, out)
+
+    def _run_evaluate_at(self, reqs, engine, mode, union=None):
+        from ..ops import degrade, supervisor
+
+        dpf, hl = reqs[0].obj, reqs[0].hierarchy_level
+        pad = self.bucket and engine == "device"
+        keys = _pad_keys(
+            [k for r in reqs for k in r.keys], pad,
+            floor=self.batcher.width_target,
+        )
+        points, rows = union if union is not None else _union(
+            [r.points for r in reqs]
+        )
+        points = _pad_points(points, pad, floor=self.batcher.width_target)
+        if engine == "host":
+            out = degrade._host_evaluate_at_limbs(dpf, keys, points, hl)
+        elif self.robust:
+            out = supervisor.evaluate_at_robust(
+                dpf, keys, points, hl, policy=self.policy,
+                pipeline=self.pipeline, mode=mode,
+            )
+        else:
+            from ..ops import evaluator
+
+            out = evaluator.evaluate_at_batch(
+                dpf, keys, points, hl, pipeline=self.pipeline, mode=mode,
+            )
+        out = np.asarray(out)
+        sliced, start = [], 0
+        for r, cols in zip(reqs, rows):
+            k = len(r.keys)
+            sliced.append(out[start : start + k][:, cols])
+            start += k
+        return sliced
+
+    def _run_dcf(self, reqs, engine, mode, union=None):
+        from ..ops import evaluator, supervisor
+
+        dcf = reqs[0].obj
+        pad = self.bucket and engine == "device"
+        keys = _pad_keys(
+            [k for r in reqs for k in r.keys], pad,
+            floor=self.batcher.width_target,
+        )
+        xs, rows = union if union is not None else _union(
+            [r.points for r in reqs]
+        )
+        xs = _pad_points(xs, pad, floor=self.batcher.width_target)
+        if engine == "host":
+            bits, _ = evaluator._value_kind(dcf.value_type)
+            out, _covered = supervisor._dcf_host_limbs(dcf, keys, xs, bits)
+        elif self.robust:
+            out = supervisor.batch_evaluate_robust(
+                dcf, keys, xs, policy=self.policy,
+                pipeline=self.pipeline, mode=mode,
+            )
+        else:
+            out = dcf.batch_evaluate(
+                keys, xs, pipeline=self.pipeline, mode=mode
+            )
+        out = np.asarray(out)
+        sliced, start = [], 0
+        for r, cols in zip(reqs, rows):
+            k = len(r.keys)
+            sliced.append(out[start : start + k][:, cols])
+            start += k
+        return sliced
+
+    def _run_mic(self, reqs, engine, mode, union=None):
+        from ..ops import supervisor
+
+        gate, key = reqs[0].obj, reqs[0].keys[0]
+        xs, rows = union if union is not None else _union(
+            [r.points for r in reqs]
+        )
+        xs = _pad_points(
+            xs, self.bucket and engine == "device",
+            floor=self.batcher.width_target,
+        )
+        if engine == "host":
+            out = gate.batch_eval(key, xs, engine="host")
+        elif self.robust:
+            out = supervisor.mic_batch_eval_robust(
+                gate, key, xs, policy=self.policy,
+                pipeline=self.pipeline, mode=mode,
+            )
+        else:
+            out = gate.batch_eval(key, xs, engine="device", mode=mode)
+        out = np.asarray(out)
+        return [out[cols] for cols in rows]
+
+    def _run_pir(self, reqs, engine, mode, union=None):
+        from ..ops import evaluator, supervisor
+        from ..parallel import sharded
+
+        dpf, db = reqs[0].obj, reqs[0].db
+        ck = self.key_chunk or 64
+        keys = _pad_keys(
+            [k for r in reqs for k in r.keys],
+            self.bucket and engine == "device", chunk=ck,
+        )
+        v = dpf.validator
+        bits, _ = evaluator._value_kind(v.parameters[-1].value_type)
+        if engine == "host":
+            nat = (
+                db.natural_host(dpf)
+                if isinstance(db, sharded.PreparedPirDatabase)
+                else np.asarray(db)
+            )
+            out = supervisor._host_pir_fold(dpf, keys, nat, bits)
+        else:
+            # Mirror pir_query_batch_chunked's order contract: walk/fused
+            # consume the natural-order DB, fold/levels the lane order.
+            eff = mode or "fold"
+            if eff == "megakernel":
+                want_order = "megakernel"
+            elif eff in ("walk", "fused"):
+                want_order = "natural"
+            else:
+                want_order = "lane"
+            pdb = self.cache.pir_db(dpf, db, want_order)
+            if self.robust:
+                out = supervisor.pir_query_batch_robust(
+                    dpf, keys, pdb, key_chunk=ck, policy=self.policy,
+                    pipeline=self.pipeline, mode=mode,
+                )
+            else:
+                out = sharded.pir_query_batch_chunked(
+                    dpf, keys, pdb, key_chunk=ck, mode=mode or "fold",
+                    pipeline=self.pipeline,
+                )
+        return self._slice_rows(reqs, np.asarray(out))
+
+    def _run_hierarchical(self, reqs, engine, mode, union=None):
+        from ..core import host_eval
+        from ..ops import evaluator, hierarchical, supervisor
+
+        dpf = reqs[0].obj
+        plan, group = reqs[0].plan, reqs[0].group
+        # pow2 only, no width floor: hierarchical device compute scales
+        # with keys x prefixes, so width-target padding could multiply a
+        # 10k-prefix advance many-fold — shape stability is enough here.
+        keys = _pad_keys(
+            [k for r in reqs for k in r.keys],
+            self.bucket and engine == "device",
+        )
+        ctx = hierarchical.BatchedContext.create(dpf, keys)
+        v = dpf.validator
+        if engine == "host":
+            outs = []
+            for h, prefixes in plan:
+                bits, _ = evaluator._value_kind(v.parameters[h].value_type)
+                ref = hierarchical.evaluate_until_batch(
+                    ctx, h, prefixes, engine="host"
+                )
+                outs.append(host_eval.values_to_limbs(np.asarray(ref), bits))
+        elif self.robust:
+            outs = supervisor.evaluate_levels_fused_robust(
+                ctx, plan, group, policy=self.policy, mode=mode,
+                pipeline=self.pipeline,
+            )
+        else:
+            prepared = self.cache.levels_plan(
+                dpf, reqs[0].keys, plan, group, mode=mode
+            )
+            outs = hierarchical.evaluate_levels_fused(
+                ctx, prepared, pipeline=self.pipeline
+            )
+            outs = [np.asarray(o) for o in outs]
+        # Per request: the row slice of every plan entry's output.
+        results, start = [], 0
+        for r in reqs:
+            k = len(r.keys)
+            results.append([o[start : start + k] for o in outs])
+            start += k
+        return results
+
+    @staticmethod
+    def _slice_rows(reqs, out):
+        out = np.asarray(out)
+        sliced, start = [], 0
+        for r in reqs:
+            k = len(r.keys)
+            sliced.append(out[start : start + k])
+            start += k
+        return sliced

@@ -1,0 +1,575 @@
+"""Cost-model engine router: host vs device vs kernel mode, per batch.
+
+PERF.md's engine table ("Engine-choice results") encodes a sharp
+host/device crossover — the ~66 ms per-dispatch RPC latency of this
+image's tunnel makes the device lose any workload that arrives as small
+requests, while wide uniform batches win by 10-14x — but until ISSUE 8
+that knowledge lived in ``DPF_TPU_*`` env vars and bench defaults. This
+module turns it into a per-batch decision::
+
+    predicted_seconds(engine, mode) =
+        dispatches(workload, mode) * dispatch_seconds(engine)   # latency
+      + work_items(workload) / rate(op, engine, mode, kind)     # throughput
+
+* **Dispatch term** — the program count each execution mode provably
+  launches (1 per key chunk for the fold/walk shapes, ceil(levels/group)
+  per hierarchical advance — the same arithmetic tests/test_dispatch_audit
+  pins) times the per-dispatch latency: a live EWMA fed from the telemetry
+  bus's ``pipeline.finalize`` spans when the front door has measured any,
+  else the cold-start prior (PERF.md: 65.7 ms tiny-jit RPC through the
+  tunnel). The host engine has no RPC — its dispatch term is zero.
+* **Throughput term** — measured rate anchors from PERF.md's verified
+  rows (each entry cites its table row), adjusted online: every served
+  batch's measured wall time updates an EWMA of the chosen engine's rate,
+  and every supervisor degrade event multiplies a decaying penalty into
+  the failed choice's predictions (``on_degrade``) so a flaky kernel mode
+  routes around itself.
+
+Modes with **no verified device measurement** (megakernel / walkkernel /
+hierkernel — all staged-for-tunnel, ROADMAP) are *not* candidates by
+default: routing production traffic on a projection would re-create the
+caching-illusion era PERF.md documents. They enter the candidate set only
+once a live measurement teaches them (``observe`` / a calibration file
+from a hardware window) or when ``include_projections=True`` explicitly
+opts into the roofline-ceiling estimates (the ``CHECK_MODE=router``
+hardware stage does, to exercise one routed batch per engine class).
+
+Every resolution emits a ``decision(source="router")`` telemetry record
+carrying the predicted cost of the chosen candidate AND the alternatives,
+so an A/B harness can tell "router mispredicted" from "engine lost".
+
+The anchor table is NOT a second copy of PERF.md's numbers growing apart
+from it: tests/test_serving.py pins that routing these anchors reproduces
+every winner row of the engine table, and utils/roofline.py's CLI prints
+the router's predictions next to the measured anchors so a drift is
+visible in the artifact the table is built from.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..utils import telemetry as _tm
+from ..utils.errors import InvalidArgumentError
+
+# ---------------------------------------------------------------------------
+# Cold-start priors (PERF.md anchors; each entry cites its source row)
+# ---------------------------------------------------------------------------
+
+#: Per-dispatch RPC latency prior, seconds (PERF.md "dispatch latency
+#: (tiny jit)": 65.7 ms through this image's tunnel; 0.21 ms locally).
+DISPATCH_SECONDS_PRIOR = 0.0657
+
+#: EWMA smoothing for online rate/dispatch updates: new = a*x + (1-a)*old.
+EWMA_ALPHA = 0.3
+
+#: Derate applied to roofline ceilings when include_projections=True: a
+#: staged-for-tunnel kernel mode is predicted at this fraction of its
+#: modeled ceiling (the verified Mosaic fold runs ~28% of the VPU
+#: roofline, PERF.md MFU table — 0.1 is deliberately pessimistic).
+PROJECTION_DERATE = 0.1
+
+#: items/s rate anchors per (op, engine, mode) and value kind. Verified
+#: measured rows only (PERF.md "Engine-choice results", re-measured
+#: 2026-07-31); kinds missing from an entry fall back to the "u64" rate
+#: scaled by 64/bits. Units: full_domain/pir = domain evals/s,
+#: evaluate_at/dcf/mic = point evals/s, hierarchical = (prefix x level)
+#: advances/s.
+ANCHORS: Dict[Tuple[str, str, Optional[str]], Dict[str, float]] = {
+    # full-domain 2^20 x 1024 keys u64: 1.06-1.13 G evals/s device
+    # (fold/128 + Mosaic row kernels, verified 8/8) vs 72-112 M host.
+    ("full_domain", "host", None): {
+        "u64": 99.7e6,   # native engine headline (1 thread)
+        "u128": 8e6,     # "~8 M evals/s class" table row
+        "codec": 30.4e6, # host one-pass IntModN correction rate
+    },
+    ("full_domain", "device", "fold"): {
+        "u64": 1.06e9,
+        # XorWrapper<u128> row: 12.7 M evals/s measured AT the dispatch
+        # floor (82 ms/expansion incl. ~66 ms RPC); the compute-term
+        # anchor backs the dispatch share out: 2^20 / (82-66) ms.
+        "u128": 65.5e6,
+        "codec": 68.6e6,  # 8-level IntModN<u64> hierarchy row (slabbed fused)
+    },
+    # batched EvaluateAt 1024 x 4096, 2^32: host VAES walk 5.3-5.9 M pt/s
+    # vs 2.0 M pt/s per-level device walk.
+    ("evaluate_at", "host", None): {"u64": 5.5e6},
+    ("evaluate_at", "device", "walk"): {"u64": 2.0e6},
+    # DCF 512 x 512, 2^24: host 1.06-1.25 M cmp/s vs 590 K device walk.
+    ("dcf", "host", None): {"u64": 1.15e6, "u128": 0.8e6},
+    ("dcf", "device", "walk"): {"u64": 590e3, "u128": 400e3},
+    # heavy-hitters 128-level bit hierarchy, 10k prefixes: host ~0.27
+    # s/key = 1.28 M prefix-level advances in 0.27 s; device 11.45 s/key
+    # (per-level dispatch measurement — the verified device anchor; the
+    # grouped fused path's ~0.56 s is a projection until the tunnel).
+    ("hierarchical", "host", None): {"u64": 4.7e6},
+    ("hierarchical", "device", "fused"): {"u64": 112e3},
+    # two-server PIR 2^24 x 64 queries: 21.3 q/s device (in-program inner
+    # product, verified) vs 1.5 q/s host — normalized to domain evals/s
+    # at the 2^24 config.
+    ("pir", "host", None): {"u64": 25.2e6, "u128": 25.2e6},
+    ("pir", "device", "fold"): {"u64": 357e6, "u128": 357e6},
+}
+
+#: Device modes with NO verified measurement (staged-for-tunnel, ROADMAP):
+#: candidates only via a learned rate, a calibration file, or
+#: include_projections=True.
+UNVERIFIED_MODES: Dict[Tuple[str, str], Tuple[str, ...]] = {
+    ("full_domain", "device"): ("megakernel",),
+    ("evaluate_at", "device"): ("walkkernel",),
+    ("dcf", "device"): ("walkkernel",),
+    ("hierarchical", "device"): ("hierkernel",),
+    ("pir", "device"): ("megakernel",),
+}
+
+#: Fallback key chunking for standalone Workloads — the dispatch-count
+#: model's denominator, matching what serving EXECUTES when no chunk is
+#: given (supervisor.full_domain_evaluate_robust chunks at 32, PIR at
+#: 64; point walks run one program per batch). The front door always
+#: passes its effective chunk explicitly, so this only binds Workloads
+#: built by hand.
+_DEFAULT_KEY_CHUNK = {"full_domain": 32, "pir": 64}
+
+_OPS = ("full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical")
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """The router's view of one merged batch: enough shape to count work
+    items and device programs, nothing else. ``value_kind`` buckets the
+    rate anchors ("u64" = scalar widths <= 64, "u128", "codec" =
+    IntModN/Tuple); ``avg_prefixes``/``levels`` are the hierarchical
+    walk's work axes; ``points`` is shared across keys (the batched
+    entry-point contract)."""
+
+    op: str
+    num_keys: int = 1
+    points: int = 0
+    log_domain: int = 0
+    levels: int = 0
+    avg_prefixes: int = 0
+    group: int = 16
+    value_bits: int = 64
+    value_kind: str = "u64"
+    key_chunk: Optional[int] = None
+    #: shape-bucketed device axes (the front door's _bucket_target
+    #: padding; None = same as the request axes): the device engine runs
+    #: THE PADDED PROGRAM, so its cost must be predicted — and its rate
+    #: learned — at the padded work, or a small deadline flush poisons
+    #: the rate EWMA by the padding factor. The host engine never pads.
+    device_num_keys: Optional[int] = None
+    device_points: Optional[int] = None
+
+    def _axes(self, engine: Optional[str]) -> Tuple[int, int]:
+        if engine == "device":
+            return (
+                self.device_num_keys or self.num_keys,
+                self.device_points or self.points,
+            )
+        return self.num_keys, self.points
+
+    def work_items(self, engine: Optional[str] = None) -> float:
+        """Work items the `engine` actually computes for this batch:
+        request-level axes for the host (and for reporting, engine=None),
+        the shape-bucketed padded axes for the device."""
+        keys, points = self._axes(engine)
+        if self.op in ("full_domain", "pir"):
+            return float(keys) * float(1 << self.log_domain)
+        if self.op in ("evaluate_at", "dcf", "mic"):
+            return float(keys) * float(points)
+        if self.op == "hierarchical":
+            return (
+                float(keys)
+                * float(max(1, self.levels))
+                * float(max(1, self.avg_prefixes))
+            )
+        raise InvalidArgumentError(f"unknown router op {self.op!r}")
+
+    def dispatches(self, mode: Optional[str]) -> int:
+        """Device programs the mode provably launches for this batch —
+        the same counts tests/test_dispatch_audit.py pins (1 per key
+        chunk for fold/walk/megakernel shapes; ceil(levels/group) windows
+        per hierarchical advance, times key chunks for the hierkernel).
+        Counted on the device axes — only the device engine dispatches,
+        and chunk-multiple padding never changes the count."""
+        keys, _ = self._axes("device")
+        ck = self.key_chunk or _DEFAULT_KEY_CHUNK.get(self.op, keys)
+        chunks = max(1, math.ceil(keys / max(1, ck)))
+        if self.op == "hierarchical":
+            windows = max(1, math.ceil(max(1, self.levels) / max(1, self.group)))
+            return windows * (chunks if mode == "hierkernel" else 1)
+        return chunks
+
+
+#: The measured engine table (PERF.md "Engine-choice results") as router
+#: workloads: (row label, Workload, measured winner). The router pin
+#: (tests/test_serving.py) asserts ``route()`` reproduces every winner
+#: from the cold-start anchors alone; utils/roofline.py's CLI prints the
+#: predictions next to the measured rows.
+ENGINE_TABLE = (
+    ("full-domain 2^20 x 1024 keys u64",
+     # key_chunk=128: the measured headline ran fold/128 (PERF.md).
+     Workload(op="full_domain", num_keys=1024, log_domain=20,
+              key_chunk=128), "device"),
+    ("full-domain 2^20 XorWrapper<u128>, 1 key",
+     Workload(op="full_domain", num_keys=1, log_domain=20, value_bits=128,
+              value_kind="u128"), "device"),
+    ("heavy-hitters 128-level, 10k prefixes, 1 key",
+     Workload(op="hierarchical", num_keys=1, levels=128, avg_prefixes=10000),
+     "host"),
+    ("DCF 512 keys x 512 points, 2^24",
+     Workload(op="dcf", num_keys=512, points=512, log_domain=24), "host"),
+    ("sparse-histogram experiments (hierarchical, 1 key)",
+     Workload(op="hierarchical", num_keys=1, levels=32,
+              avg_prefixes=1 << 17), "host"),
+    ("batched EvaluateAt 1024 x 4096, 2^32",
+     Workload(op="evaluate_at", num_keys=1024, points=4096, log_domain=32),
+     "host"),
+    ("two-server PIR 2^24 x 64 queries",
+     Workload(op="pir", num_keys=64, log_domain=24, value_bits=128,
+              value_kind="u128"), "device"),
+    ("8-level IntModN<u64> hierarchy, 256 keys",
+     Workload(op="full_domain", num_keys=256, log_domain=24,
+              value_kind="codec", key_chunk=4), "device"),
+)
+
+
+@dataclasses.dataclass
+class RouteDecision:
+    """One routing outcome: the chosen (engine, mode), its predicted wall
+    seconds, and the full candidate table (label -> predicted seconds)
+    the choice was made from."""
+
+    engine: str
+    mode: Optional[str]
+    predicted_seconds: float
+    costs: Dict[str, float]
+
+    @property
+    def choice(self) -> str:
+        return f"{self.engine}/{self.mode}" if self.mode else self.engine
+
+
+def _kind_rate(table: Dict[str, float], kind: str, bits: int) -> float:
+    """Anchor rate for a value kind, falling back to the u64 rate scaled
+    by width (a 128-bit value moves/corrects 2x the limbs)."""
+    if kind in table:
+        return table[kind]
+    return table["u64"] * (64.0 / max(64, bits))
+
+
+class CostModel:
+    """predicted wall seconds per (engine, mode) candidate for a Workload.
+
+    Thread-safe: the front door's batcher thread calls ``predict`` /
+    ``observe`` while a monitoring thread may snapshot ``state()``.
+    """
+
+    def __init__(
+        self,
+        dispatch_seconds: float = DISPATCH_SECONDS_PRIOR,
+        include_projections: bool = False,
+        host_threads: Optional[int] = None,
+    ):
+        self._lock = threading.Lock()
+        self.dispatch_prior = float(dispatch_seconds)
+        self.dispatch_ewma: Optional[float] = None
+        self.include_projections = include_projections
+        self.host_threads = host_threads
+        #: learned items/s per (op, engine, mode, kind) — EWMA over
+        #: measured batches; overrides the cold-start anchors.
+        self.learned: Dict[Tuple[str, str, Optional[str], str], float] = {}
+        #: decaying multiplicative penalty per (op, engine, mode): > 1
+        #: after a degrade event fed back from the supervisor.
+        self.penalty: Dict[Tuple[str, str, Optional[str]], float] = {}
+
+    # -- dispatch term -----------------------------------------------------
+    def dispatch_seconds(self, engine: str) -> float:
+        if engine == "host":
+            return 0.0  # no RPC: the native engine runs in-process
+        with self._lock:
+            return (
+                self.dispatch_ewma
+                if self.dispatch_ewma is not None
+                else self.dispatch_prior
+            )
+
+    def observe_dispatch(self, seconds: float) -> None:
+        """Feeds one measured per-dispatch latency (the telemetry bus's
+        ``pipeline.finalize`` span p50 is the canonical source)."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            if self.dispatch_ewma is None:
+                self.dispatch_ewma = float(seconds)
+            else:
+                self.dispatch_ewma = (
+                    EWMA_ALPHA * float(seconds)
+                    + (1 - EWMA_ALPHA) * self.dispatch_ewma
+                )
+
+    # -- throughput term ---------------------------------------------------
+    def _host_speedup(self) -> float:
+        from ..utils import roofline
+
+        return roofline.host_thread_speedup(self.host_threads)
+
+    def rate(
+        self, op: str, engine: str, mode: Optional[str], kind: str, bits: int
+    ) -> Optional[float]:
+        """items/s for a candidate, or None when the candidate has no
+        basis (unverified mode with no learned rate and projections off).
+        MIC rides the DCF anchors — its gate evaluation IS a DCF batch
+        (2m comparison points per input) plus a host combine."""
+        anchor_op = "dcf" if op == "mic" else op
+        with self._lock:
+            learned = self.learned.get((anchor_op, engine, mode, kind))
+        if learned is not None:
+            return learned
+        table = ANCHORS.get((anchor_op, engine, mode))
+        if table is not None:
+            rate = _kind_rate(table, kind, bits)
+            return rate * self._host_speedup() if engine == "host" else rate
+        if (
+            engine == "device"
+            and mode in UNVERIFIED_MODES.get((anchor_op, "device"), ())
+            and self.include_projections
+        ):
+            return self._projection_rate(anchor_op, mode, bits)
+        return None
+
+    def _projection_rate(self, op: str, mode: str, bits: int) -> float:
+        """Roofline-ceiling estimate for a staged-for-tunnel kernel mode,
+        derated by PROJECTION_DERATE. Explicit opt-in only."""
+        from ..utils import roofline
+
+        lpe = max(1, bits // 32)
+        ops_per = roofline.hash_ops_per_block()["element_ops_per_block"]
+        if op in ("full_domain", "pir"):
+            # megakernel: ~3 hashes per leaf (hashes_per_eval at depth).
+            return roofline.V5E_VPU_OPS_PER_SEC / (3.0 * ops_per) * PROJECTION_DERATE
+        if op in ("evaluate_at", "dcf", "mic"):
+            caps = 33 if op in ("dcf", "mic") else 1
+            f = roofline.walk_hbm_fields(1.0, 32, "walkkernel", lpe, caps)
+            return f["walk_vpu_ceiling_points_per_sec"] * PROJECTION_DERATE
+        f = roofline.hier_hbm_fields(1.0, "hierkernel", lpe, 2, 32)
+        return (
+            f["hier_vpu_ceiling_prefix_levels_per_sec"] * PROJECTION_DERATE
+        )
+
+    # -- learning ----------------------------------------------------------
+    def observe(
+        self,
+        w: Workload,
+        engine: str,
+        mode: Optional[str],
+        seconds: float,
+    ) -> None:
+        """Teaches the model one measured batch: the compute-term rate
+        EWMA updates from (wall - dispatch share), and a prior degrade
+        penalty on this choice decays (the choice is serving again)."""
+        if seconds <= 0:
+            return
+        op = "dcf" if w.op == "mic" else w.op
+        disp = (
+            w.dispatches(mode) * self.dispatch_seconds(engine)
+            if engine == "device"
+            else 0.0
+        )
+        compute = max(seconds - disp, seconds * 0.05)
+        rate = w.work_items(engine) / compute
+        key = (op, engine, mode, w.value_kind)
+        with self._lock:
+            old = self.learned.get(key)
+            self.learned[key] = (
+                rate if old is None else EWMA_ALPHA * rate + (1 - EWMA_ALPHA) * old
+            )
+            pkey = (op, engine, mode)
+            if pkey in self.penalty:
+                decayed = self.penalty[pkey] ** 0.5
+                if decayed <= 1.05:
+                    del self.penalty[pkey]
+                else:
+                    self.penalty[pkey] = decayed
+
+    def on_degrade(
+        self, op: str, engine: str, mode: Optional[str], reason: str = ""
+    ) -> None:
+        """Feedback from a supervisor degrade event: the failed choice's
+        predictions are penalized 4x (stacking, capped 256x) until
+        successful batches decay it — a flaky kernel mode routes around
+        itself without being permanently blacklisted."""
+        key = ("dcf" if op == "mic" else op, engine, mode)
+        with self._lock:
+            self.penalty[key] = min(self.penalty.get(key, 1.0) * 4.0, 256.0)
+        _tm.counter("router.degrade_penalty", op=op)
+
+    # -- prediction --------------------------------------------------------
+    def candidates(self, op: str) -> Tuple[Tuple[str, Optional[str]], ...]:
+        anchor_op = "dcf" if op == "mic" else op
+        out = [("host", None)]
+        for (a_op, engine, mode) in ANCHORS:
+            if a_op == anchor_op and engine == "device":
+                out.append(("device", mode))
+        for mode in UNVERIFIED_MODES.get((anchor_op, "device"), ()):
+            with self._lock:
+                has_learned = any(
+                    k[:3] == (anchor_op, "device", mode) for k in self.learned
+                )
+            if has_learned or self.include_projections:
+                out.append(("device", mode))
+        return tuple(out)
+
+    def predict(self, w: Workload) -> Dict[Tuple[str, Optional[str]], float]:
+        """Candidate -> predicted wall seconds (dispatch + throughput,
+        times any degrade penalty)."""
+        if w.op not in _OPS:
+            raise InvalidArgumentError(
+                f"unknown router op {w.op!r} (one of {_OPS})"
+            )
+        out: Dict[Tuple[str, Optional[str]], float] = {}
+        op = "dcf" if w.op == "mic" else w.op
+        for engine, mode in self.candidates(w.op):
+            rate = self.rate(w.op, engine, mode, w.value_kind, w.value_bits)
+            if rate is None or rate <= 0:
+                continue
+            disp = (
+                w.dispatches(mode) * self.dispatch_seconds(engine)
+                if engine == "device"
+                else 0.0
+            )
+            cost = disp + w.work_items(engine) / rate
+            with self._lock:
+                cost *= self.penalty.get((op, engine, mode), 1.0)
+            out[(engine, mode)] = cost
+        return out
+
+    def state(self) -> dict:
+        """JSON-serializable calibration state (the DPF_TPU_ROUTER_CALIB
+        file format)."""
+        with self._lock:
+            return {
+                "dispatch_ewma": self.dispatch_ewma,
+                "learned": {
+                    "|".join(str(p) for p in k): v
+                    for k, v in self.learned.items()
+                },
+                "penalty": {
+                    "|".join(str(p) for p in k): v
+                    for k, v in self.penalty.items()
+                },
+            }
+
+    def load_state(self, state: dict) -> None:
+        def _untuple(s: str) -> tuple:
+            parts = s.split("|")
+            return tuple(None if p == "None" else p for p in parts)
+
+        with self._lock:
+            if state.get("dispatch_ewma"):
+                self.dispatch_ewma = float(state["dispatch_ewma"])
+            for k, v in (state.get("learned") or {}).items():
+                self.learned[_untuple(k)] = float(v)
+            for k, v in (state.get("penalty") or {}).items():
+                self.penalty[_untuple(k)] = float(v)
+
+
+class Router:
+    """The front door's decision maker: a CostModel plus the telemetry
+    emission and calibration-file plumbing.
+
+    ``calibration`` (default: the ``DPF_TPU_ROUTER_CALIB`` env) names a
+    JSON file of learned rates / dispatch EWMA / penalties; it is loaded
+    at construction and ``save_calibration()`` writes the current state
+    back — how a hardware window's measurements persist into the next
+    serving process.
+    """
+
+    def __init__(
+        self,
+        model: Optional[CostModel] = None,
+        calibration: Optional[str] = None,
+    ):
+        self.model = model or CostModel()
+        self.calibration = (
+            calibration
+            if calibration is not None
+            else os.environ.get("DPF_TPU_ROUTER_CALIB") or None
+        )
+        if self.calibration and os.path.exists(self.calibration):
+            try:
+                with open(self.calibration) as f:
+                    self.model.load_state(json.load(f))
+            except (OSError, ValueError):
+                pass  # a torn calibration file must never block serving
+
+    def save_calibration(self, path: Optional[str] = None) -> None:
+        path = path or self.calibration
+        if not path:
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.model.state(), f)
+        os.replace(tmp, path)
+
+    def route(self, w: Workload) -> RouteDecision:
+        """Picks the cheapest candidate and emits the
+        ``decision(source="router")`` record with the predicted costs."""
+        costs = self.model.predict(w)
+        if not costs:
+            raise InvalidArgumentError(
+                f"no routable candidate for op {w.op!r}"
+            )
+        (engine, mode), predicted = min(costs.items(), key=lambda kv: kv[1])
+        labeled = {
+            (f"{e}/{m}" if m else e): round(c, 6) for (e, m), c in costs.items()
+        }
+        decision = RouteDecision(engine, mode, predicted, labeled)
+        _tm.decision(
+            w.op,
+            decision.choice,
+            "router",
+            predicted_ms=round(predicted * 1e3, 3),
+            costs_ms={k: round(v * 1e3, 3) for k, v in labeled.items()},
+            num_keys=w.num_keys,
+            work_items=w.work_items(),
+        )
+        return decision
+
+    def observe(
+        self, w: Workload, engine: str, mode: Optional[str], seconds: float
+    ) -> None:
+        self.model.observe(w, engine, mode, seconds)
+
+    def observe_dispatch(self, seconds: float) -> None:
+        self.model.observe_dispatch(seconds)
+
+    def on_degrade(
+        self, op: str, engine: str, mode: Optional[str], reason: str = ""
+    ) -> None:
+        self.model.on_degrade(op, engine, mode, reason)
+
+
+def engine_table_predictions(
+    router: Optional[Router] = None,
+) -> list:
+    """(label, measured winner, predicted winner, costs) per engine-table
+    row — the roofline CLI's "router predictions vs measured anchors"
+    section and the router-pin test share this. The default router pins
+    host_threads=1: every engine-table host number was measured at the
+    reference-parity single thread."""
+    router = router or Router(model=CostModel(host_threads=1), calibration="")
+    rows = []
+    for label, w, measured in ENGINE_TABLE:
+        costs = router.model.predict(w)
+        (engine, _mode), _ = min(costs.items(), key=lambda kv: kv[1])
+        labeled = {
+            (f"{e}/{m}" if m else e): c for (e, m), c in costs.items()
+        }
+        rows.append((label, measured, engine, labeled))
+    return rows

@@ -701,6 +701,10 @@ def run_device_check(
         return failures + _run_supervisor_check(
             shapes, rng, report, pipeline=pipeline
         )
+    if mode == "router":
+        return failures + _run_router_check(
+            shapes, rng, report, pipeline=pipeline
+        )
     for num_keys, lds in shapes:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
         alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
@@ -742,6 +746,89 @@ def run_device_check(
                 mode=mode,
             )
         failures += bad
+    return failures
+
+
+def _run_router_check(shapes, rng, report, pipeline=None) -> int:
+    """CHECK_MODE=router body of `run_device_check` (ISSUE 8): the
+    serving front door on the live backend.
+
+    Three layers, per (num_keys, log_domain) shape:
+
+    1. **Model pins** — the router's cold-start anchors must reproduce
+       every winner row of the measured engine table
+       (serving.router.ENGINE_TABLE): a drifted anchor table is a
+       failure even before anything dispatches.
+    2. **One real routed batch per engine class** — num_keys single-key
+       requests are submitted to a FrontDoor per engine setting ("auto"
+       = the router decides with live dispatch latency, then forced
+       "device" and "host"), aggregated into one merged batch, executed
+       through the supervisor, and every request's sliced answer is
+       verified against the host oracle.
+    3. **Decision records** — the auto batch must carry a
+       ``decision(source="router")`` with predicted costs; the forced
+       batches ``source="explicit"``. The live routed choice is
+       reported next to the model's cold-start prediction, so a
+       hardware window immediately shows whether measured dispatch
+       latency moves the crossover.
+    """
+    from ..core.dpf import DistributedPointFunction
+    from ..core.host_eval import full_domain_evaluate_host, values_to_limbs
+    from ..core.params import DpfParameters
+    from ..core.value_types import Int
+    from .. import serving
+    from . import telemetry
+
+    failures = 0
+    table = serving.engine_table_predictions()
+    for label, measured, routed, _costs in table:
+        ok = routed == measured
+        report(
+            f"router pin: {label}: predicted {routed!r} vs measured "
+            f"{measured!r} {'OK' if ok else 'MISPREDICTED'}"
+        )
+        failures += 0 if ok else 1
+
+    for num_keys, lds in shapes:
+        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
+        betas = [[int(x) for x in rng.integers(1, 1000, size=num_keys)]]
+        keys, _ = dpf.generate_keys_batch(alphas, betas)
+        want = values_to_limbs(full_domain_evaluate_host(dpf, keys), 64)
+        router = serving.Router(calibration="")
+        for engine in ("auto", "device", "host"):
+            with telemetry.capture() as tel:
+                with serving.FrontDoor(
+                    router=router, engine=engine, max_wait_ms=50,
+                    width_target=num_keys, pipeline=pipeline,
+                ) as door:
+                    futs = [
+                        door.submit(serving.Request.full_domain(dpf, [k]))
+                        for k in keys
+                    ]
+                    outs = [f.result(timeout=600) for f in futs]
+            bad = sum(
+                0 if np.array_equal(np.asarray(outs[i])[0], want[i]) else 1
+                for i in range(num_keys)
+            )
+            src = "router" if engine == "auto" else "explicit"
+            decisions = tel.decision_records(source=src, op="full_domain")
+            if not decisions:
+                bad += 1
+                detail = f"no decision(source={src!r}) recorded"
+            elif src == "router" and "predicted_ms" not in decisions[0].get(
+                "data", {}
+            ):
+                bad += 1
+                detail = "router decision carries no predicted cost"
+            else:
+                detail = f"chose {decisions[-1]['data'].get('choice')}"
+            status = "OK" if bad == 0 else f"MISMATCH ({bad})"
+            report(
+                f"keys={num_keys:4d} log_domain={lds:3d} mode=router "
+                f"engine={engine}: {status} ({detail})"
+            )
+            failures += bad
     return failures
 
 

@@ -368,6 +368,17 @@ class _Hist:
             "p99": pct(0.99),
         }
 
+    def ewma(self, alpha: float = 0.3) -> float:
+        """Exponentially weighted mean over the stored samples in arrival
+        order (``values`` appends chronologically) — the router's live
+        dispatch-latency estimate, favoring recent observations."""
+        out = 0.0
+        seen = False
+        for v in self.values:
+            out = v if not seen else alpha * v + (1 - alpha) * out
+            seen = True
+        return out
+
 
 def counter(name: str, value: float = 1, op: Optional[str] = None) -> None:
     """Adds `value` to counter (name, op) in every active collector.
@@ -612,6 +623,51 @@ class Collector:
             "pipeline_occupancy": occupancy,
         }
 
+    def latency(
+        self, name: str, op: Optional[str] = None, alpha: float = 0.3
+    ) -> Optional[dict]:
+        """Router-facing point lookup (ISSUE 8): percentiles + EWMA of ONE
+        histogram — ``latency("span.pipeline.finalize")`` is the measured
+        per-dispatch latency — without deriving the whole snapshot (the
+        cost model queries this per served batch; ``snapshot()`` copies
+        the event ring and merges every histogram). ``op=None`` merges
+        across ops; a specific ``op`` reads that key alone. Returns None
+        when nothing has been observed."""
+        with _lock:
+            if op is not None:
+                h = self.hists.get((name, op))
+            else:
+                h = None
+                for (n, _o), cand in self.hists.items():
+                    if n == name:
+                        h = cand if h is None else h.merged(cand)
+            if h is None or not h.count:
+                return None
+            stats = h.stats()
+            stats["mean"] = h.total / h.count
+            stats["ewma"] = h.ewma(alpha)
+            return stats
+
+    def decision_records(
+        self, source: Optional[str] = None, op: Optional[str] = None
+    ) -> list:
+        """Decision records currently in the ring, optionally filtered by
+        ``source`` ("router" / "degrade" / "explicit" / ...) and op name —
+        the front door's degrade-feedback scan, without the full
+        ``snapshot()``."""
+        with _lock:
+            events = list(self.events)
+        out = []
+        for rec in events:
+            if rec.kind != "decision":
+                continue
+            if op is not None and rec.name != op:
+                continue
+            if source is not None and rec.data.get("source") != source:
+                continue
+            out.append(rec.to_dict())
+        return out
+
     def summary(self) -> str:
         return summary(self.snapshot())
 
@@ -737,6 +793,18 @@ def snapshot() -> Optional[dict]:
     or None when no global collector is installed. Scoped measurement
     should use :func:`capture` instead."""
     return _global_ring.snapshot() if _global_ring is not None else None
+
+
+def dispatch_latency(op: Optional[str] = None) -> Optional[dict]:
+    """Measured per-dispatch latency stats (``pipeline.finalize`` span =
+    blocking wait on a dispatched program + its pull) from the
+    process-global ring collector, or None when no global collector is
+    active / nothing dispatched. The serving router's live-latency source
+    for long-lived processes (scoped callers use
+    ``Collector.latency("span.pipeline.finalize")`` on a capture)."""
+    if _global_ring is None:
+        return None
+    return _global_ring.latency("span.pipeline.finalize", op)
 
 
 # ---------------------------------------------------------------------------

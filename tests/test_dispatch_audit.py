@@ -552,3 +552,47 @@ def test_sharded_pir_program_budget(program_counter):
         "pir_query_batch[mesh 2x4]",
         budget=1,
     )
+
+
+def test_serving_frontdoor_adds_zero_programs(program_counter):
+    """ISSUE 8 acceptance pin: serving N single-key requests through the
+    front door launches EXACTLY the device programs a direct call of the
+    chosen engine launches for the merged batch — routing, batching,
+    telemetry capture and per-request slicing are all host-side. Counted
+    against the identical supervisor wrapper call (same keys, chunking,
+    verification policy)."""
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.ops import supervisor
+
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9, 44, 77], [[1, 2, 3, 4]])
+
+    def direct():
+        supervisor.full_domain_evaluate_robust(
+            dpf, list(keys), key_chunk=2, pipeline=False
+        )
+
+    direct()  # warm: compiles + probe caches
+    program_counter["programs"] = 0
+    direct()
+    direct_count = program_counter["programs"]
+    assert direct_count >= 1
+
+    def door_pass():
+        door = serving.FrontDoor(
+            engine="device", max_wait_ms=1e6, width_target=4, key_chunk=2,
+            pipeline=False,
+        )
+        door.serve(
+            [serving.Request.full_domain(dpf, [k]) for k in keys],
+            timeout=120,
+        )
+
+    door_pass()  # warm
+    program_counter["programs"] = 0
+    door_pass()
+    assert program_counter["programs"] == direct_count, (
+        f"front door launched {program_counter['programs']} device "
+        f"programs vs {direct_count} for the direct merged call — "
+        "routing must add zero dispatches"
+    )

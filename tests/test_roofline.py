@@ -140,3 +140,54 @@ class TestHierTrafficModel:
             g["hier_vpu_ceiling_prefix_levels_per_sec"]
             < f["hier_vpu_ceiling_prefix_levels_per_sec"]
         )
+
+
+class TestHostAnchor:
+    """ISSUE 8 satellite: the host-engine cost anchor accounts for
+    DPF_TPU_THREADS scaling — the router's host-side predictions read it."""
+
+    def test_single_thread_is_the_measured_anchor(self, monkeypatch):
+        monkeypatch.delenv("DPF_TPU_THREADS", raising=False)
+        assert roofline.host_threads_default() == 1
+        assert roofline.host_thread_speedup() == 1.0
+        assert (
+            roofline.host_anchor_evals_per_sec()
+            == roofline.HOST_ANCHOR_EVALS_PER_SEC
+        )
+
+    def test_thread_scaling_model(self, monkeypatch):
+        assert roofline.host_thread_speedup(4) == pytest.approx(
+            1.0 + roofline.HOST_THREAD_EFFICIENCY * 3
+        )
+        monkeypatch.setenv("DPF_TPU_THREADS", "8")
+        assert roofline.host_threads_default() == 8
+        assert roofline.host_anchor_evals_per_sec() == pytest.approx(
+            roofline.HOST_ANCHOR_EVALS_PER_SEC
+            * (1.0 + roofline.HOST_THREAD_EFFICIENCY * 7)
+        )
+        # 0 = all hardware threads (the native-engine convention).
+        monkeypatch.setenv("DPF_TPU_THREADS", "0")
+        import os as _os
+
+        assert roofline.host_threads_default() == (_os.cpu_count() or 1)
+        # garbage falls back to the reference-parity single thread
+        monkeypatch.setenv("DPF_TPU_THREADS", "lots")
+        assert roofline.host_threads_default() == 1
+
+    def test_threads_shift_router_host_predictions(self):
+        from distributed_point_functions_tpu.serving.router import (
+            CostModel,
+            Workload,
+        )
+
+        w = Workload(op="full_domain", num_keys=1024, log_domain=20)
+        c1 = CostModel(host_threads=1).predict(w)[("host", None)]
+        c8 = CostModel(host_threads=8).predict(w)[("host", None)]
+        assert c8 == pytest.approx(c1 / roofline.host_thread_speedup(8))
+
+    def test_cli_prints_router_predictions(self, capsys):
+        assert roofline.main([]) == 0
+        out = capsys.readouterr().out
+        assert "Router predictions vs measured engine table" in out
+        assert "Host-engine anchor" in out
+        assert "MISPREDICTED" not in out  # anchors in sync with PERF.md

@@ -1,0 +1,679 @@
+"""Serving front door (distributed_point_functions_tpu/serving) — ISSUE 8.
+
+Covers, all on the forced-CPU test platform and STRICTLY on program
+families other suites already compile (lds-6 Int(64), key_chunk=2 — the
+test_pipeline/test_telemetry family; ZERO new pallas configs):
+
+* router pins: the cost model's cold-start anchors reproduce every winner
+  row of PERF.md's engine table; decision records carry
+  ``source="router"`` with predicted costs; unverified kernel modes are
+  not candidates until learned; online rate/dispatch learning shifts
+  decisions; degrade feedback penalizes and decays; calibration
+  round-trips through the DPF_TPU_ROUTER_CALIB file format.
+* batcher: compatibility-queue keying (params signature, party,
+  hierarchy level, PIR database identity, plan digest), width-target and
+  max-wait flushes, admission control, flush-error propagation to every
+  future, the worker thread's deadline timer.
+* warm cache: PreparedPirDatabase / PreparedLevelsPlan / PreparedKeyBatch
+  reuse across batches keyed by params signature + content digest.
+* end-to-end: mixed small requests of all six ops served bit-exact vs
+  the host oracle / direct entry-point calls, on the routed engine and
+  with engine forced to each class.
+* the ISSUE 8 acceptance A/B: >= 200 seeded small requests with injected
+  per-dispatch latency serve at >= 2x the throughput of naive per-request
+  dispatch, bit-exact.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import serving
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.host_eval import (
+    full_domain_evaluate_host,
+    values_to_limbs,
+)
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
+from distributed_point_functions_tpu.dcf.dcf import DistributedComparisonFunction
+from distributed_point_functions_tpu.gates.mic import (
+    MultipleIntervalContainmentGate,
+)
+from distributed_point_functions_tpu.ops import evaluator, hierarchical
+from distributed_point_functions_tpu.serving.router import (
+    CostModel,
+    Router,
+    Workload,
+)
+from distributed_point_functions_tpu.utils import faultinject, telemetry
+from distributed_point_functions_tpu.utils.errors import (
+    InvalidArgumentError,
+    ResourceExhaustedError,
+)
+
+def _dpf6(num_keys=8, seed=13):
+    rng = np.random.default_rng(seed)
+    dpf = DistributedPointFunction.create(DpfParameters(6, Int(64)))
+    alphas = [int(x) for x in rng.integers(0, 64, size=num_keys)]
+    betas = [[int(x) for x in rng.integers(1, 1000, size=num_keys)]]
+    keys, _ = dpf.generate_keys_batch(alphas, betas)
+    return dpf, keys
+
+
+def host_limbs(dpf, keys):
+    return values_to_limbs(full_domain_evaluate_host(dpf, keys), 64)
+
+
+# ---------------------------------------------------------------------------
+# Router pins
+# ---------------------------------------------------------------------------
+
+
+class TestRouter:
+    def test_engine_table_winners_reproduced(self):
+        """ISSUE 8 acceptance: given the PERF.md anchors as priors, the
+        router reproduces EVERY winner row of the measured engine
+        table."""
+        rows = serving.engine_table_predictions()
+        assert len(rows) == len(serving.ENGINE_TABLE) == 8
+        for label, measured, routed, costs in rows:
+            assert routed == measured, (
+                f"router mispredicts {label!r}: chose {routed!r}, the "
+                f"measured winner is {measured!r} (costs {costs})"
+            )
+            assert "host" in costs and any(
+                k.startswith("device") for k in costs
+            ), label
+
+    def test_decision_record_source_router_with_costs(self):
+        router = Router(model=CostModel(host_threads=1), calibration="")
+        w = Workload(op="full_domain", num_keys=1024, log_domain=20)
+        with telemetry.capture() as tel:
+            decision = router.route(w)
+        assert decision.engine == "device" and decision.mode == "fold"
+        recs = tel.decision_records(source="router", op="full_domain")
+        assert len(recs) == 1
+        data = recs[0]["data"]
+        assert data["choice"] == "device/fold"
+        assert data["predicted_ms"] == pytest.approx(
+            decision.predicted_seconds * 1e3, rel=1e-6
+        )
+        # The full candidate table rides the record: an A/B harness can
+        # tell "router mispredicted" from "engine lost".
+        assert set(data["costs_ms"]) == set(decision.costs)
+
+    def test_unverified_modes_gated(self):
+        m = CostModel(host_threads=1)
+        assert ("device", "walkkernel") not in m.candidates("evaluate_at")
+        assert ("device", "hierkernel") not in m.candidates("hierarchical")
+        assert ("device", "megakernel") not in m.candidates("pir")
+        # Projections opt in explicitly (the CHECK_MODE=router stage)...
+        mp = CostModel(host_threads=1, include_projections=True)
+        assert ("device", "walkkernel") in mp.candidates("evaluate_at")
+        # ...and a live measurement teaches the mode into the candidate
+        # set permanently.
+        w = Workload(op="evaluate_at", num_keys=64, points=4096, log_domain=20)
+        m.observe(w, "device", "walkkernel", seconds=0.05)
+        assert ("device", "walkkernel") in m.candidates("evaluate_at")
+
+    def test_online_learning_shifts_the_choice(self):
+        m = CostModel(host_threads=1)
+        router = Router(model=m, calibration="")
+        # Small point batch: the anchors say host.
+        w = Workload(op="evaluate_at", num_keys=4, points=64, log_domain=20)
+        assert router.route(w).engine == "host"
+        # Teach a dramatically better device rate + near-zero dispatch
+        # latency (a local chip, not the tunnel): the choice flips.
+        for _ in range(8):
+            m.observe(w, "device", "walk", seconds=1e-5)
+            m.observe_dispatch(1e-5)
+        assert router.route(w).engine == "device"
+
+    def test_dispatch_ewma_updates(self):
+        m = CostModel()
+        assert m.dispatch_seconds("device") == serving.DISPATCH_SECONDS_PRIOR
+        assert m.dispatch_seconds("host") == 0.0
+        m.observe_dispatch(0.010)
+        assert m.dispatch_seconds("device") == pytest.approx(0.010)
+        m.observe_dispatch(0.020)
+        got = m.dispatch_seconds("device")
+        assert 0.010 < got < 0.020  # EWMA, not last-write-wins
+
+    def test_degrade_penalty_and_decay(self):
+        m = CostModel(host_threads=1)
+        w = Workload(op="pir", num_keys=64, log_domain=24, value_bits=128,
+                     value_kind="u128")
+        base = m.predict(w)[("device", "fold")]
+        m.on_degrade("pir", "device", "fold", "UnavailableError")
+        assert m.predict(w)[("device", "fold")] == pytest.approx(4 * base)
+        # Successful serving decays the penalty back toward 1.
+        for _ in range(12):
+            m.observe(w, "device", "fold", seconds=3.0)
+            m.penalty.get(("pir", "device", "fold"), 1.0)
+        assert m.penalty.get(("pir", "device", "fold"), 1.0) == 1.0
+
+    def test_calibration_roundtrip(self, tmp_path):
+        path = str(tmp_path / "calib.json")
+        r1 = Router(model=CostModel(host_threads=1), calibration=path)
+        w = Workload(op="evaluate_at", num_keys=4, points=64, log_domain=20)
+        for _ in range(8):
+            r1.observe(w, "device", "walk", seconds=1e-5)
+            r1.observe_dispatch(1e-5)
+        assert r1.route(w).engine == "device"
+        r1.save_calibration()
+        r2 = Router(model=CostModel(host_threads=1), calibration=path)
+        assert r2.route(w).engine == "device"
+        assert r2.model.dispatch_ewma == pytest.approx(r1.model.dispatch_ewma)
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(InvalidArgumentError):
+            CostModel().predict(Workload(op="nope"))
+
+
+# ---------------------------------------------------------------------------
+# Batcher
+# ---------------------------------------------------------------------------
+
+
+class TestBatcher:
+    def _collector(self):
+        batches = []
+
+        def flush(sig, reqs):
+            batches.append((sig, list(reqs)))
+            for r in reqs:
+                r.future._resolve(("served", len(reqs)))
+
+        return batches, flush
+
+    def test_compatibility_queue_keying(self):
+        dpf6, keys6 = _dpf6(4)
+        dpf7 = DistributedPointFunction.create(DpfParameters(7, Int(64)))
+        keys7, keys7b = dpf7.generate_keys_batch([3, 9], [[5, 6]])
+        batches, flush = self._collector()
+        b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=100)
+        b.submit(serving.Request.full_domain(dpf6, keys6[:1]))
+        b.submit(serving.Request.full_domain(dpf6, keys6[1:3]))
+        b.submit(serving.Request.full_domain(dpf7, keys7[:1]))  # other params
+        b.submit(serving.Request.full_domain(dpf7, keys7b[:1]))  # other party
+        b.submit(serving.Request.evaluate_at(dpf6, keys6[:1], [1]))  # other op
+        assert b.pending() == 5
+        assert b.pump(force=True) == 4  # 4 distinct compatibility queues
+        sizes = sorted(len(reqs) for _, reqs in batches)
+        assert sizes == [1, 1, 1, 2]
+
+    def test_width_target_flush(self):
+        dpf, keys = _dpf6(4)
+        batches, flush = self._collector()
+        b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=3)
+        futs = [b.submit(serving.Request.full_domain(dpf, [k])) for k in keys[:2]]
+        assert b.pump() == 0  # width 2 < target, deadline far away
+        futs.append(b.submit(serving.Request.full_domain(dpf, keys[2:4])))
+        assert b.pump() == 1  # width 4 >= 3: ripe
+        assert all(f.done() for f in futs)
+        assert futs[0].result() == ("served", 3)
+        assert futs[0].batch_width == 4
+
+    def test_max_wait_deadline_flush(self):
+        dpf, keys = _dpf6(2)
+        batches, flush = self._collector()
+        b = serving.ContinuousBatcher(flush, max_wait_ms=30, width_target=100)
+        fut = b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        assert b.pump() == 0
+        time.sleep(0.05)
+        assert b.pump() == 1  # oldest request exceeded max_wait
+        assert fut.done()
+
+    def test_worker_thread_serves_on_deadline(self):
+        dpf, keys = _dpf6(2)
+        _, flush = self._collector()
+        with serving.ContinuousBatcher(
+            flush, max_wait_ms=20, width_target=100
+        ) as b:
+            fut = b.submit(serving.Request.full_domain(dpf, keys[:1]))
+            assert fut.result(timeout=10) == ("served", 1)
+            assert fut.latency_seconds < 5
+
+    def test_admission_control(self):
+        dpf, keys = _dpf6(4)
+        batches, flush = self._collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100, max_queue_depth=2
+        )
+        b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        b.submit(serving.Request.full_domain(dpf, keys[1:2]))
+        with telemetry.capture() as tel:
+            with pytest.raises(ResourceExhaustedError, match="admission"):
+                b.submit(serving.Request.full_domain(dpf, keys[2:3]))
+        assert tel.snapshot()["counters"].get("serving.rejected[full_domain]") == 1
+        b.pump(force=True)  # drained: admission reopens
+        b.submit(serving.Request.full_domain(dpf, keys[2:3]))
+
+    def test_flush_error_rejects_every_future(self):
+        dpf, keys = _dpf6(2)
+
+        def flush(sig, reqs):
+            raise RuntimeError("backend exploded")
+
+        b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=2)
+        f1 = b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        f2 = b.submit(serving.Request.full_domain(dpf, keys[1:2]))
+        b.pump(force=True)
+        for f in (f1, f2):
+            with pytest.raises(RuntimeError, match="backend exploded"):
+                f.result(timeout=1)
+
+    def test_flush_forgetting_a_future_is_surfaced(self):
+        dpf, keys = _dpf6(2)
+
+        def flush(sig, reqs):
+            reqs[0].future._resolve("ok")  # forgets reqs[1]
+
+        b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=2)
+        f1 = b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        f2 = b.submit(serving.Request.full_domain(dpf, keys[1:2]))
+        b.pump(force=True)
+        assert f1.result(timeout=1) == "ok"
+        with pytest.raises(InvalidArgumentError, match="without resolving"):
+            f2.result(timeout=1)
+
+    def test_empty_request_rejected(self):
+        dpf, _ = _dpf6(1)
+        b = serving.ContinuousBatcher(lambda s, r: None)
+        with pytest.raises(InvalidArgumentError):
+            b.submit(serving.Request.full_domain(dpf, []))
+
+    def test_submit_after_stop_rejected(self):
+        # A request landing after stop()'s final drain has no worker and
+        # no future pump: it must fail fast, not hang its caller.
+        dpf, keys = _dpf6(2)
+        batches, flush = self._collector()
+        b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=100)
+        b.start()
+        f1 = b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        b.stop()
+        assert f1.result(timeout=1) == ("served", 1)
+        with pytest.raises(ResourceExhaustedError, match="stopped"):
+            b.submit(serving.Request.full_domain(dpf, keys[1:2]))
+        b.start()  # restart reopens admission
+        f2 = b.submit(serving.Request.full_domain(dpf, keys[1:2]))
+        b.stop()
+        assert f2.result(timeout=1) == ("served", 1)
+
+
+# ---------------------------------------------------------------------------
+# Warm cache
+# ---------------------------------------------------------------------------
+
+
+class TestWarmCache:
+    def test_pir_db_prepared_once(self):
+        dpf = DistributedPointFunction.create(DpfParameters(6, XorWrapper(64)))
+        rng = np.random.default_rng(3)
+        db = rng.integers(0, 2**32, size=(64, 2), dtype=np.uint32)
+        cache = serving.WarmCache()
+        with telemetry.capture() as tel:
+            p1 = cache.pir_db(dpf, db, "lane")
+            p2 = cache.pir_db(dpf, db, "lane")
+        assert p1 is p2
+        counters = tel.snapshot()["counters"]
+        assert counters.get("serving.cache_miss[pir]") == 1
+        assert counters.get("serving.cache_hit[pir]") == 1
+
+    def test_key_batch_digest_reuse(self):
+        dpf, keys = _dpf6(4)
+        cache = serving.WarmCache()
+        p1 = cache.key_batch(dpf, keys[:2], key_chunk=2)
+        p2 = cache.key_batch(dpf, list(keys[:2]), key_chunk=2)  # same content
+        p3 = cache.key_batch(dpf, keys[2:4], key_chunk=2)  # different keys
+        assert p1 is p2 and p1 is not p3
+        assert isinstance(p1, evaluator.PreparedKeyBatch)
+
+    def test_levels_plan_reuse(self):
+        params = [DpfParameters(i + 1, Int(64)) for i in range(3)]
+        dpf = DistributedPointFunction.create_incremental(params)
+        k1, _ = dpf.generate_keys_incremental(3, [7, 8, 9])
+        plan = hierarchical.bitwise_hierarchy_plan(3, {3})
+        cache = serving.WarmCache()
+        p1 = cache.levels_plan(dpf, [k1], plan, group=2)
+        p2 = cache.levels_plan(dpf, [k1], plan, group=2)
+        p3 = cache.levels_plan(dpf, [k1], plan, group=3)  # other geometry
+        assert p1 is p2 and p1 is not p3
+
+
+# ---------------------------------------------------------------------------
+# Front door end-to-end (bit-exactness vs the host oracle)
+# ---------------------------------------------------------------------------
+
+
+class TestFrontDoor:
+    def test_mixed_ops_one_door_bit_exact(self):
+        """Mixed small requests of three ops through ONE front door, all
+        answers bit-exact vs the host oracle (the router picks the
+        engine; on this CPU platform with the ~66 ms dispatch prior that
+        is the host engine — the decision records prove the routing
+        happened)."""
+        dpf, keys = _dpf6(6)
+        want = host_limbs(dpf, keys)
+        dcf = DistributedComparisonFunction.create(6, Int(64))
+        ka, _ = dcf.generate_keys(17, 999)
+        xs = [3, 17, 40, 63]
+        pts = [0, 17, 63, 5]
+        with telemetry.capture() as tel:
+            with serving.FrontDoor(max_wait_ms=20, width_target=4) as door:
+                f_fd = [
+                    door.submit(serving.Request.full_domain(dpf, [k]))
+                    for k in keys[:3]
+                ]
+                f_ea = door.submit(
+                    serving.Request.evaluate_at(dpf, keys[3:5], pts)
+                )
+                f_dcf = door.submit(serving.Request.dcf(dcf, [ka], xs))
+                outs_fd = [f.result(30) for f in f_fd]
+                out_ea = f_ea.result(30)
+                out_dcf = f_dcf.result(30)
+        for i in range(3):
+            np.testing.assert_array_equal(outs_fd[i][0], want[i])
+        np.testing.assert_array_equal(out_ea[0], want[3][pts])
+        np.testing.assert_array_equal(out_ea[1], want[4][pts])
+        want_dcf = np.array([dcf.evaluate(ka, x) for x in xs], dtype=np.uint64)
+        got_dcf = evaluator.values_to_numpy(out_dcf, 64)[0].astype(np.uint64)
+        np.testing.assert_array_equal(got_dcf, want_dcf)
+        # Every batch was routed, with predicted costs on the record.
+        recs = tel.decision_records(source="router")
+        assert len(recs) >= 3
+        assert all("predicted_ms" in r["data"] for r in recs)
+
+    def test_requests_merge_into_shared_batches(self):
+        dpf, keys = _dpf6(6)
+        with serving.FrontDoor(max_wait_ms=50, width_target=6) as door:
+            futs = [
+                door.submit(serving.Request.full_domain(dpf, [k]))
+                for k in keys
+            ]
+            [f.result(30) for f in futs]
+        # All six single-key requests rode merged batches (the width
+        # target), not per-request dispatches.
+        assert max(f.batch_width for f in futs) >= 4
+
+    def test_pir_bit_exact_with_warm_cache(self):
+        dpf = DistributedPointFunction.create(DpfParameters(6, XorWrapper(64)))
+        rng = np.random.default_rng(4)
+        db = rng.integers(0, 2**32, size=(64, 2), dtype=np.uint32)
+        alphas = [3, 40]
+        keys_a, keys_b = [], []
+        for a in alphas:
+            k0, k1 = dpf.generate_keys(a, (1 << 64) - 1)
+            keys_a.append(k0)
+            keys_b.append(k1)
+        cache = serving.WarmCache()
+        with serving.FrontDoor(
+            max_wait_ms=20, width_target=2, cache=cache
+        ) as door:
+            ra = [
+                door.submit(serving.Request.pir(dpf, [k], db)).result(30)
+                for k in keys_a
+            ]
+            rb = [
+                door.submit(serving.Request.pir(dpf, [k], db)).result(30)
+                for k in keys_b
+            ]
+        for i, a in enumerate(alphas):
+            np.testing.assert_array_equal(ra[i][0] ^ rb[i][0], db[a])
+
+    def test_pir_walk_fused_db_order_mapping(self):
+        # pir_query_batch_chunked's order contract: walk/fused consume
+        # the NATURAL-order DB, fold/levels the lane order. The front
+        # door must prepare the order the mode needs — serving a
+        # documented mode override must not raise on every batch.
+        dpf = DistributedPointFunction.create(DpfParameters(6, XorWrapper(64)))
+        rng = np.random.default_rng(6)
+        db = rng.integers(0, 2**32, size=(64, 2), dtype=np.uint32)
+        k0, k1 = dpf.generate_keys(29, (1 << 64) - 1)
+        for mode in ("walk", "fused"):
+            answers = []
+            for key in (k0, k1):
+                cache = serving.WarmCache()
+                with serving.FrontDoor(
+                    engine="device", mode=mode, robust=False, key_chunk=2,
+                    cache=cache, bucket=False,
+                ) as door:
+                    fut = door.submit(serving.Request.pir(dpf, [key], db))
+                    answers.append(np.asarray(fut.result(60)))
+                ((_, prepared),) = cache._dbs.data.values()
+                assert prepared.order == "natural", mode
+            np.testing.assert_array_equal(
+                answers[0][0] ^ answers[1][0], db[29], err_msg=mode
+            )
+
+    def test_workload_chunk_models_execution(self):
+        # The dispatch model's denominator is the chunk execution will
+        # use: chunked ops carry the front door's effective chunk
+        # (default 32, supervisor.full_domain_evaluate_robust's) and the
+        # one-program-per-batch ops never carry one — a chunk there
+        # would predict phantom dispatches.
+        from distributed_point_functions_tpu.serving import frontdoor
+
+        dpf, keys = _dpf6(4)
+        door = serving.FrontDoor(key_chunk=2)
+        reqs = [serving.Request.full_domain(dpf, keys[:2])]
+        assert door._workload(reqs).key_chunk == 2
+        assert serving.FrontDoor()._workload(reqs).key_chunk == 32
+        e_reqs = [serving.Request.evaluate_at(dpf, keys[:2], [1, 2])]
+        union = frontdoor._union([r.points for r in e_reqs])
+        w = door._workload(e_reqs, union)
+        assert w.key_chunk is None and w.points == 2
+        assert w.dispatches("walk") == 1  # one program per merged batch
+        # Device candidates are costed/learned at the shape-bucketed
+        # padded program (width_target floor), the host at the real
+        # request work — a small deadline flush must not poison the
+        # device rate EWMA by the padding factor.
+        wt = door.batcher.width_target
+        assert w.device_num_keys == wt and w.device_points == wt
+        assert w.work_items("device") == wt * wt
+        assert w.work_items("host") == w.work_items() == 2 * 2
+
+    def test_hierarchical_bit_exact(self):
+        params = [DpfParameters(i + 1, Int(64)) for i in range(4)]
+        dpf = DistributedPointFunction.create_incremental(params)
+        k1, _ = dpf.generate_keys_incremental(5, [11, 12, 13, 14])
+        k2, _ = dpf.generate_keys_incremental(9, [21, 22, 23, 24])
+        plan = hierarchical.bitwise_hierarchy_plan(4, {5, 9})
+        with serving.FrontDoor(max_wait_ms=20, width_target=2) as door:
+            f1 = door.submit(serving.Request.hierarchical(dpf, [k1], plan))
+            f2 = door.submit(serving.Request.hierarchical(dpf, [k2], plan))
+            o1, o2 = f1.result(60), f2.result(60)
+        assert f1.batch_width == 2  # same plan digest: one merged context
+        for key, outs in ((k1, o1), (k2, o2)):
+            bch = hierarchical.BatchedContext.create(dpf, [key])
+            for i, (h, p) in enumerate(plan):
+                ref = hierarchical.evaluate_until_batch(bch, h, p, engine="host")
+                got = evaluator.values_to_numpy(outs[i], 64)[0]
+                np.testing.assert_array_equal(
+                    got.astype(np.uint64), ref[0].astype(np.uint64)
+                )
+
+    def test_mic_bit_exact(self):
+        n = 1 << 10
+        intervals = [(0, n // 4), (n // 2, n - 1)]
+        gate = MultipleIntervalContainmentGate.create(10, intervals)
+        rng = np.random.default_rng(9)
+        r_in = int(rng.integers(0, n))
+        r_outs = [int(r) for r in rng.integers(0, n, size=2)]
+        k0, k1 = gate.gen(r_in, r_outs)
+        x_reals = [int(x) for x in rng.integers(0, n, size=4)]
+        xs = [(x + r_in) % n for x in x_reals]
+        with serving.FrontDoor(max_wait_ms=20, width_target=4) as door:
+            f0a = door.submit(serving.Request.mic(gate, k0, xs[:2]))
+            f0b = door.submit(serving.Request.mic(gate, k0, xs[2:]))
+            f1 = door.submit(serving.Request.mic(gate, k1, xs))
+            o0 = np.concatenate([f0a.result(120), f0b.result(120)], axis=0)
+            o1 = f1.result(120)
+        # The two k0 requests merged (same key digest); k1 queued alone.
+        assert f0a.batch_width == 4 and f1.batch_width == 4
+        for j, x_real in enumerate(x_reals):
+            for i, (p, q) in enumerate(intervals):
+                got = (int(o0[j][i]) + int(o1[j][i]) - r_outs[i]) % n
+                assert got == (1 if p <= x_real <= q else 0), (j, i)
+
+    def test_forced_engines_agree(self):
+        """engine="device" and engine="host" serve the same answers (the
+        device arm rides the lds-6 chunk-2 program family test_pipeline
+        compiles; decisions are recorded as explicit, not router)."""
+        dpf, keys = _dpf6(4)
+        want = host_limbs(dpf, keys)
+        outs = {}
+        with telemetry.capture() as tel:
+            for engine in ("host", "device"):
+                with serving.FrontDoor(
+                    engine=engine, max_wait_ms=20, width_target=4,
+                    key_chunk=2,
+                ) as door:
+                    futs = [
+                        door.submit(serving.Request.full_domain(dpf, [k]))
+                        for k in keys
+                    ]
+                    outs[engine] = [f.result(60) for f in futs]
+        for engine in ("host", "device"):
+            for i in range(4):
+                np.testing.assert_array_equal(outs[engine][i][0], want[i])
+        assert not tel.decision_records(source="router")
+        assert tel.decision_records(source="explicit", op="full_domain")
+
+    def test_router_learns_dispatch_latency_from_served_batches(self):
+        """The front door feeds each device batch's measured
+        pipeline.finalize latency into the router's dispatch EWMA — the
+        live half of the cost model's dispatch term."""
+        dpf, keys = _dpf6(4)
+        router = Router(model=CostModel(host_threads=1), calibration="")
+        assert router.model.dispatch_ewma is None
+        with serving.FrontDoor(
+            router=router, engine="device", max_wait_ms=20,
+            width_target=4, key_chunk=2, pipeline=False,
+        ) as door:
+            futs = [
+                door.submit(serving.Request.full_domain(dpf, [k]))
+                for k in keys
+            ]
+            [f.result(60) for f in futs]
+        assert router.model.dispatch_ewma is not None
+        assert router.model.dispatch_ewma < serving.DISPATCH_SECONDS_PRIOR
+        # ...and the rate EWMA learned the op too.
+        assert any(
+            k[0] == "full_domain" and k[1] == "device"
+            for k in router.model.learned
+        )
+
+
+# ---------------------------------------------------------------------------
+# The acceptance A/B: front door >= 2x naive under injected dispatch latency
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.faults
+def test_frontdoor_throughput_2x_vs_naive_dispatch():
+    """ISSUE 8 acceptance: with an injected per-dispatch delay (the
+    chunk_delay CPU proxy for the ~66 ms tunnel), >= 200 seeded small
+    MIXED requests served through the front door complete at >= 2x the
+    throughput of naive per-request dispatch, bit-exact vs direct
+    entry-point calls. Full-domain rides the lds-6 Int(64) chunk-2
+    family; the merged evaluate_at/DCF programs are compiled once in the
+    warm pass (shape bucketing floors them at one shape per op)."""
+    dpf, keys = _dpf6(120, seed=11)
+    want = host_limbs(dpf, keys)
+    dcf = DistributedComparisonFunction.create(6, Int(64))
+    rng = np.random.default_rng(23)
+    dkeys = [
+        dcf.generate_keys(int(rng.integers(0, 64)), 4242)[0] for _ in range(4)
+    ]
+    ea_pts = [
+        [int(x) for x in rng.integers(0, 64, size=8)] for _ in range(40)
+    ]
+    dcf_xs = [
+        [int(x) for x in rng.integers(0, 64, size=8)] for _ in range(40)
+    ]
+
+    def requests():
+        reqs = [serving.Request.full_domain(dpf, [k]) for k in keys]
+        reqs += [
+            serving.Request.evaluate_at(dpf, [keys[i % 120]], ea_pts[i])
+            for i in range(40)
+        ]
+        reqs += [
+            serving.Request.dcf(dcf, [dkeys[i % 4]], dcf_xs[i])
+            for i in range(40)
+        ]
+        return reqs  # 200 seeded small mixed requests
+
+    def door_pass(reqs, timed):
+        with serving.FrontDoor(
+            engine="device", max_wait_ms=10, width_target=64,
+            key_chunk=2, pipeline=True,
+        ) as door:
+            t0 = time.perf_counter()
+            futs = [door.submit(r) for r in reqs]
+            outs = [f.result(timeout=300) for f in futs]
+            return time.perf_counter() - t0, outs
+
+    def naive_pass(reqs):
+        outs = []
+        t0 = time.perf_counter()
+        for r in reqs:
+            if r.op == "full_domain":
+                outs.append(
+                    evaluator.full_domain_evaluate(
+                        r.obj, list(r.keys), key_chunk=2, pipeline=False
+                    )
+                )
+            elif r.op == "evaluate_at":
+                outs.append(
+                    evaluator.evaluate_at_batch(
+                        r.obj, list(r.keys), list(r.points), pipeline=False
+                    )
+                )
+            else:
+                outs.append(
+                    r.obj.batch_evaluate(
+                        list(r.keys), list(r.points), pipeline=False
+                    )
+                )
+        return time.perf_counter() - t0, outs
+
+    delay = 0.012
+
+    def plan():
+        return faultinject.FaultPlan(
+            stage="chunk_delay", delay_launch=delay, delay_finalize=delay
+        )
+
+    # Warm BOTH arms (compiles, probe caches, the bucketed merged
+    # shapes) outside the timed region — the walkkernel-budget lesson:
+    # compile time must never read as dispatch latency.
+    naive_pass(requests())
+    door_pass(requests(), timed=False)
+
+    with faultinject.inject(plan()):
+        naive_s, naive_outs = naive_pass(requests())
+    with faultinject.inject(plan()):
+        door_s, door_outs = door_pass(requests(), timed=True)
+
+    ref = naive_outs  # direct entry-point calls, verified vs oracle below
+    for i in range(120):
+        np.testing.assert_array_equal(door_outs[i][0], want[i])
+        np.testing.assert_array_equal(ref[i][0], want[i])
+    for i in range(40):  # evaluate_at slices vs the direct calls
+        np.testing.assert_array_equal(door_outs[120 + i], ref[120 + i])
+        np.testing.assert_array_equal(door_outs[160 + i], ref[160 + i])
+    speedup = naive_s / door_s
+    print(
+        f"\nserving A/B: naive {naive_s:.2f}s, frontdoor {door_s:.2f}s "
+        f"({speedup:.2f}x)"
+    )
+    # Measured ~4x on this platform (PERF.md "Serving front door"); 2x
+    # is the acceptance bound with margin for a loaded CI box.
+    assert speedup >= 2.0, (
+        f"front door {door_s:.2f}s vs naive {naive_s:.2f}s "
+        f"({speedup:.2f}x < 2x): batching is not amortizing dispatch latency"
+    )

@@ -418,3 +418,69 @@ def test_pipeline_occupancy_matches_overlap_proxy(dpf6, keys16):
         f"pipelined occupancy {occ_piped} < 1.2: the executor's stage "
         "overlap is not visible in the telemetry it exists to measure"
     )
+
+
+def test_latency_helper_point_lookup():
+    """ISSUE 8 satellite: Collector.latency gives the router percentiles
+    + EWMA of one histogram without deriving the whole snapshot, with
+    per-op and merged-across-ops views."""
+    with telemetry.capture() as tel:
+        for v in (10.0, 20.0, 30.0, 40.0):
+            telemetry.observe("span.pipeline.finalize", v, op="op_a")
+        telemetry.observe("span.pipeline.finalize", 100.0, op="op_b")
+        stats = tel.latency("span.pipeline.finalize", op="op_a")
+        assert stats["count"] == 4
+        assert stats["p50"] == 30.0  # nearest-rank on 4 samples
+        assert stats["mean"] == pytest.approx(25.0)
+        # EWMA folds in arrival order: exactly the alpha=0.3 recurrence
+        # over (10, 20, 30, 40).
+        want = 10.0
+        for v in (20.0, 30.0, 40.0):
+            want = 0.3 * v + 0.7 * want
+        assert stats["ewma"] == pytest.approx(want)
+        merged = tel.latency("span.pipeline.finalize")
+        assert merged["count"] == 5 and merged["max"] == 100.0
+        assert tel.latency("span.no_such") is None
+        assert tel.latency("span.pipeline.finalize", op="op_c") is None
+
+
+def test_latency_ewma_orders_by_arrival():
+    h = telemetry._Hist()
+    for v in (100.0, 1.0, 1.0, 1.0):
+        h.add(v)
+    assert h.ewma(alpha=0.5) < 15.0  # the old spike decays away
+    h2 = telemetry._Hist()
+    for v in (1.0, 1.0, 1.0, 100.0):
+        h2.add(v)
+    assert h2.ewma(alpha=0.5) > 50.0  # a fresh spike dominates
+
+
+def test_decision_records_filtering():
+    with telemetry.capture() as tel:
+        telemetry.decision("op_a", "device/fold", "router", predicted_ms=1.5)
+        telemetry.decision("op_a", "fold", "explicit")
+        telemetry.decision("op_b", "host", "degrade", reason="Unavailable")
+        assert len(tel.decision_records()) == 3
+        routed = tel.decision_records(source="router")
+        assert len(routed) == 1
+        assert routed[0]["data"]["predicted_ms"] == 1.5
+        assert len(tel.decision_records(source="degrade", op="op_b")) == 1
+        assert tel.decision_records(source="degrade", op="op_a") == []
+
+
+def test_dispatch_latency_global_helper(monkeypatch):
+    # No global ring installed -> None (the scoped-capture path is
+    # Collector.latency).
+    monkeypatch.delenv("DPF_TPU_TELEMETRY", raising=False)
+    telemetry.configure_from_env()
+    assert telemetry.dispatch_latency() is None
+    monkeypatch.setenv("DPF_TPU_TELEMETRY", "1")
+    telemetry.configure_from_env()
+    try:
+        assert telemetry.dispatch_latency() is None  # nothing dispatched yet
+        telemetry.observe("span.pipeline.finalize", 0.066, op="x")
+        stats = telemetry.dispatch_latency()
+        assert stats["count"] == 1 and stats["ewma"] == pytest.approx(0.066)
+    finally:
+        monkeypatch.delenv("DPF_TPU_TELEMETRY", raising=False)
+        telemetry.configure_from_env()

@@ -112,7 +112,14 @@ def _main() -> int:
     # must recover bit-correct through the NEXT rung on-device with a
     # decision(source="degrade") record — one real degrade transition
     # exercised on hardware, CHECK_MODE=supervisor for the next tunnel
-    # window) — the program shapes fail independently on a broken
+    # window) or "router" (the serving front door, ISSUE 8: the
+    # cost-model router's cold-start anchors must reproduce every winner
+    # row of the measured engine table, then one real routed batch per
+    # engine class — auto/device/host — is aggregated from single-key
+    # requests, executed through the supervisor, sliced back, and
+    # verified against the host oracle, with the decision(source=
+    # "router") records checked for predicted costs; tpu_measure.sh's
+    # serving_router stage) — the program shapes fail independently on a broken
     # backend (PERF.md). This tool measures the RAW platform:
     # auto-slabbing would hide exactly the over-threshold programs being
     # probed, so it is force-disabled regardless of the caller's

@@ -147,6 +147,20 @@ BENCH_HH_ENGINE=device BENCH_HH_MODE=hierkernel BENCH_HH_GROUP=32 \
   stage heavy_hitters_hierkernel 2700 python tools/run_bench_stage.py bench_heavy_hitters.py \
   RECORD_SUFFIX=_hierkernel SUPERSEDES=heavy_hitters
 
+# 2b'''. Serving front door (ISSUE 8): the router gate first
+# (CHECK_MODE=router verifies the cost model's engine-table pins, then
+# serves one real routed batch per engine class — auto / forced device /
+# forced host — through the continuous batcher + supervisor on-chip,
+# sliced answers verified against the host oracle; the auto batch's
+# decision(source="router") records carry live-measured dispatch
+# latency, the first hardware calibration of the crossover), then the
+# serving A/B bench in its own results.json slot: Poisson small-request
+# load through the front door vs naive per-request dispatch, REAL
+# dispatch latency instead of the CPU chunk_delay proxy.
+CHECK_MODE=router CHECK_SHAPES=16x14,64x18 \
+  stage serving_router 900 python tools/check_device.py
+stage serving 1500 python tools/run_bench_stage.py bench_serving.py
+
 # 2c. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
 # the pipelined chunk executor forced OFF land in their own results.json
 # slots, so the on/off pair is a first-class record pair (not just the
@@ -208,6 +222,7 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
 required="headline gate-megakernel headline_megakernel pir_megakernel \
 gate-walkkernel evaluate_at_walkkernel dcf_walkkernel \
 gate-hierkernel heavy_hitters_hierkernel \
+serving_router serving \
 headline-syncexec pir-syncexec evalat dcf hh-device \
 extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
