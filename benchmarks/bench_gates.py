@@ -1,0 +1,155 @@
+"""FSS gate family benchmark (ISSUE 9): DReLU + spline(ReLU) through the
+shared framework at production batch shapes.
+
+Each gate evaluation is ONE fused batched-DCF pass of
+(num_components keys) x (num_sites * batch points) — the record's
+headline is gate evaluations/s, and the config carries the
+DCF-invocations-per-gate-eval accounting (components x sites: the walks
+the program actually runs, including the uniform-program-family waste
+PERF.md's "FSS gate family" table documents) plus the walk roofline
+fields. Host-oracle spot verification (gate.eval, exact Python ints)
+gates the `verified` flag — an unverified device number must never
+SUPERSEDE a stored record (the bench_dcf pattern, tools/run_bench_stage.py).
+
+Knobs: BENCH_GATES_GATE (drelu|relu, default both), BENCH_LOG_GROUP (16),
+BENCH_GATE_BATCH (2048), BENCH_GATES_ENGINE (host when the native engine
+is available, else device), BENCH_GATES_MODE (walk|walkkernel — a device
+strategy, forces engine=device like bench_dcf's BENCH_DCF_MODE).
+"""
+
+import os
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def _one_gate(jax, gate_name, gate, log_group, batch, reps, engine, mode, rng):
+    from distributed_point_functions_tpu.utils import roofline, telemetry
+
+    n = gate.n
+    r_in = int(rng.integers(0, n))
+    r_outs = [int(r) for r in rng.integers(0, n, size=gate.num_outputs)]
+    with Timer() as tk:
+        k0, _ = gate.gen(r_in, r_outs)
+    log(
+        f"{gate_name}: keygen {tk.elapsed:.2f}s "
+        f"({gate.num_components} component DCF keys)"
+    )
+    kwargs = {} if engine == "host" else {"mode": mode}
+    xs_sets = [
+        [int(x) for x in rng.integers(0, n, size=batch)] for _ in range(reps)
+    ]
+    with Timer() as warm:
+        out = gate.batch_eval(k0, xs_sets[0], engine=engine, **kwargs)
+    assert out.shape == (batch, gate.num_outputs)
+    log(f"{gate_name}: warmup (compile + run) {warm.elapsed:.1f}s")
+    # Host-oracle spot verification of the warmed output: exact-int
+    # per-point gate.eval on a handful of inputs.
+    ok = True
+    for xi in range(0, batch, max(1, batch // 4))[:4] if batch else []:
+        want = gate.eval(k0, xs_sets[0][xi])
+        if [int(v) for v in out[xi]] != [int(v) for v in want]:
+            ok = False
+    log(f"{gate_name}: host-oracle spot verification: {'OK' if ok else 'MISMATCH'}")
+    # Distinct input sets per rep + the result already host-side: identical
+    # repeated device programs time as ~0 through this image's tunnel.
+    with telemetry.capture() as tel, Timer() as t:
+        for xs_i in xs_sets:
+            gate.batch_eval(k0, xs_i, engine=engine, **kwargs)
+    telemetry_fields = telemetry.bench_fields(tel.snapshot())
+    gate_evals = batch * reps
+    dcf_walks_per_eval = gate.num_components * gate.num_sites
+    fields = {
+        "log_group_size": log_group,
+        "batch": batch,
+        "engine": engine,
+        **({"mode": mode} if engine != "host" else {}),
+        "num_components": gate.num_components,
+        "num_sites": gate.num_sites,
+        # The fused pass walks every component at every site: the DCF
+        # invocations one gate evaluation costs (PERF.md "FSS gate family").
+        "dcf_invocations_per_gate_eval": dcf_walks_per_eval,
+        "dcf_walks_per_sec": round(gate_evals * dcf_walks_per_eval / t.elapsed),
+        **telemetry_fields,
+    }
+    if engine != "host":
+        # Walk traffic model at the DCF-walk rate (lpe=4: Int(128) payload
+        # limbs), same fields as bench_dcf's device records.
+        T = gate.dcf.dpf.validator.hierarchy_to_tree[-1]
+        fields.update(
+            roofline.walk_hbm_fields(
+                gate_evals * dcf_walks_per_eval / t.elapsed,
+                T, mode, lpe=4, captures=T + 1,
+            )
+        )
+    return {
+        **({} if ok else {
+            "error": "device output failed host-oracle spot verification"
+        }),
+        "bench": f"gates_{gate_name}",
+        "metric": (
+            f"{gate_name} gate batch_eval, batch {batch}, "
+            f"log_group={log_group}"
+            + (f", mode={mode}" if engine != "host" else "")
+        ),
+        "value": round(gate_evals / t.elapsed, 1),
+        "unit": "gate evals/s",
+        "verified": bool(ok),
+        "config": fields,
+        **({"platform": "cpu"} if engine == "host" else {}),
+    }
+
+
+def bench(jax, smoke):
+    from distributed_point_functions_tpu import native
+    from distributed_point_functions_tpu.gates import DReluGate, ReluGate
+
+    log_group = int(os.environ.get("BENCH_LOG_GROUP", 8 if smoke else 16))
+    batch = int(os.environ.get("BENCH_GATE_BATCH", 64 if smoke else 2048))
+    reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
+    which = os.environ.get("BENCH_GATES_GATE", "")
+    # Host engine default when available (the DCF engine-table winner at
+    # point-walk shapes); walkkernel/walk are device strategies.
+    engine = os.environ.get(
+        "BENCH_GATES_ENGINE", "host" if native.available() else "device"
+    )
+    mode = os.environ.get("BENCH_GATES_MODE", "walk")
+    if mode == "walkkernel":
+        engine = "device"
+    if engine == "host" and not native.available():
+        engine = "device"
+    log(f"engine: {engine} mode: {mode}")
+    rng = np.random.default_rng(0x9A7E)
+
+    results = []
+    gates_to_run = [
+        ("drelu", DReluGate.create(log_group)),
+        ("relu", ReluGate.create(log_group)),
+    ]
+    for name, gate in gates_to_run:
+        if which and name != which:
+            continue
+        results.append(
+            _one_gate(
+                jax, name, gate, log_group, batch, reps, engine, mode, rng
+            )
+        )
+    # One JSON line per run (the common.py contract): the primary record
+    # is the ReLU (the spline workhorse); the DReLU record rides in config
+    # unless it was the only gate requested.
+    if len(results) == 1:
+        return results[0]
+    primary = results[-1]
+    primary["config"]["drelu"] = {
+        "value": results[0]["value"],
+        "unit": results[0]["unit"],
+        "verified": results[0]["verified"],
+        **results[0]["config"],
+    }
+    primary["verified"] = all(r["verified"] for r in results)
+    return primary
+
+
+if __name__ == "__main__":
+    run_bench("gates", bench)

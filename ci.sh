@@ -38,7 +38,13 @@ run_fast() {
   # ISSUE 8 — router pins, batcher units, six-op bit-exact e2e and the
   # 2x throughput A/B, built strictly on the lds-6 chunk-2 XLA program
   # family test_pipeline already compiles: ZERO new pallas interpret
-  # configs, per the walkkernel compile-budget lesson); pytest
+  # configs, per the walkkernel compile-budget lesson) and the FSS
+  # gate-family suite (tests/test_gates_framework.py, ISSUE 9 — the
+  # family-parameterized mod-N edge matrix + wire/robust/serving
+  # plumbing, every gate's batch_eval reusing the already-compiled
+  # fused-DCF walk program families: again ZERO new pallas configs;
+  # kernel-path coverage stays with the MIC walkkernel differentials
+  # in test_mic_gate.py, which the whole family flattens onto); pytest
   # collects them with the rest of tests/ — no
   # separate invocation, which would run them twice. JAX_PLATFORMS=cpu
   # is pinned explicitly (belt to conftest.py's in-process suspenders)

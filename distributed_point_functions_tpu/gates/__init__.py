@@ -1,0 +1,25 @@
+"""FSS gate family: DCF-derived two-party gates over masked inputs,
+every one compiled onto the batched DCF walk through the shared
+framework (gates/framework.py — ONE fused batched-DCF pass per gate
+batch, walk or walkkernel mode).
+
+* :class:`MultipleIntervalContainmentGate` — m interval predicates
+  (BCG+ Fig. 14), the founding gate.
+* :class:`DReluGate` / :class:`ReluGate` — the secure-ML activation pair
+  (comparison gate; ReLU as the fixed two-piece spline).
+* :class:`SplineGate` — piecewise-polynomial evaluation, the fixed-point
+  math workhorse.
+* :class:`BitDecompositionGate` — arithmetic-to-boolean share conversion.
+"""
+
+from .bitdecomp import BitDecompositionGate  # noqa: F401
+from .framework import (  # noqa: F401
+    GateKey,
+    GatePlan,
+    MaskedGate,
+    bundle_eval,
+)
+from .mic import MicKey, MultipleIntervalContainmentGate  # noqa: F401
+from .prng import BasicRng, CounterRng, SecurePrng  # noqa: F401
+from .relu import DReluGate, ReluGate  # noqa: F401
+from .spline import SplineGate  # noqa: F401

@@ -1,0 +1,486 @@
+"""FSS gate framework: masked-input gates compiled onto the batched DCF walk.
+
+The reference's gate layer stops at one hand-built gate (MIC,
+multiple_interval_containment.cc); this module turns its structure into a
+*framework* so every new DCF-derived gate — comparison/DReLU, splines,
+bit decomposition (BCG+ eprint 2020/1392; the gates-as-preprocessed-dealer
+model of BGI eprint 2018/707) — is a capture-plan over the existing
+batched-DCF machinery rather than a new 1k-LoC kernel body.
+
+The shared structure (BCG+ §4, all built on Lemma 1/Fig. 14's interval
+containment): a dealer knows an input mask ``r_in``; the parties hold the
+public masked input ``x = x_real + r_in mod N`` and per-party key
+material; the gate output is an additive sharing (mod N, or mod 2 for
+boolean outputs) of ``f(x_real)`` plus an output mask. Every gate here
+decomposes into three dealer-computable ingredients:
+
+* **Component DCF keys** — one or more DCF key pairs at
+  ``alpha = r_in' - 1`` with a payload ``beta`` the dealer picks
+  (:meth:`MaskedGate._component_specs`). Scalar ``Int(128)`` payloads
+  only: a vector-payload gate (BCG+'s spline form) is expressed as one
+  component key per payload element, which keeps every gate inside the
+  exact fused-DCF program family the MIC gate already compiles
+  (dcf/batch.py walk + walkkernel) — see gates/spline.py for the
+  key-size tradeoff note.
+* **Mask shares** — additive shares of dealer-computed correction values
+  (the interval wrap counts of BCG+ Lemma 1, payload shares, output
+  masks), split by the gate's :class:`~.prng.SecurePrng`.
+* **A site/combine plan** — per masked input, which DCF evaluation
+  points are needed (:meth:`MaskedGate._points`) and how the evaluated
+  (component x site) value matrix linearly combines with the mask shares
+  and public comparisons into output shares
+  (:meth:`MaskedGate._combine_one`).
+
+:class:`GatePlan` is the flatten/evaluate path every gate shares: the
+(inputs x sites) grid flattens into ONE fused batched-DCF pass
+(``dcf.batch_evaluate`` — all component keys x all flattened points, one
+device program per key chunk in walk mode, the whole gate in one
+walk-megakernel program under ``mode="walkkernel"``), exactly the way
+gates/mic.py did by hand before this framework existed. The robust and
+serving layers reuse the same plan (ops/supervisor.gate_batch_eval_robust,
+serving "gate" requests), so there is one flatten/evaluate path in the
+repo, not four.
+
+Everything dealer-side is exact Python-int arithmetic mod N (N | 2^128,
+so reducing the DCF's mod-2^128 shares mod N is exact — the same
+argument gates/mic.py documents).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dcf.dcf import DcfKey
+from ..utils import telemetry as _tm
+from ..utils.errors import InvalidArgumentError
+from .prng import BasicRng, SecurePrng
+
+# ---------------------------------------------------------------------------
+# Interval-containment algebra (BCG+ Lemma 1 / Fig. 14), shared by every gate
+# ---------------------------------------------------------------------------
+
+
+def ic_points(n: int, x: int, p: int, q: int) -> Tuple[int, int]:
+    """The two DCF evaluation points of one interval-containment instance
+    over Z_n: the masked input's comparisons against p and q' = q+1."""
+    q_prime = (q + 1) % n
+    return (x + n - 1 - p) % n, (x + n - 1 - q_prime) % n
+
+
+def ic_alpha(n: int, r_in: int) -> int:
+    """The component DCF's evaluation threshold: r_in - 1 mod n."""
+    return (n - 1 + r_in) % n
+
+
+def ic_wrap_count(n: int, r_in: int, p: int, q: int) -> int:
+    """The dealer's mask-wraparound correction count for interval [p, q]
+    under input mask r_in (the bracketed term of gates/mic.py's ``z``,
+    BCG+ Lemma 2): an integer in {-1, 0, 1, 2, 3}."""
+    q_prime = (q + 1) % n
+    alpha_p = (p + r_in) % n
+    alpha_q = (q + r_in) % n
+    alpha_q_prime = (q + 1 + r_in) % n
+    return (
+        (1 if alpha_p > alpha_q else 0)
+        - (1 if alpha_p > p else 0)
+        + (1 if alpha_q_prime > q_prime else 0)
+        + (1 if alpha_q == n - 1 else 0)
+    )
+
+
+def ic_public_term(n: int, x: int, p: int, q: int) -> int:
+    """The public comparison term both parties can compute from the
+    masked input: 1{x > p} - 1{x > q'}. Multiplied by each party's share
+    of the payload (for payload 1, party 0 holds 0 and party 1 holds 1 —
+    the ``party_term`` of gates/mic.py)."""
+    q_prime = (q + 1) % n
+    return (1 if x > p else 0) - (1 if x > q_prime else 0)
+
+
+def ic_share(
+    n: int, pub: int, w_share: int, s_p: int, s_q_prime: int, z_share: int
+) -> int:
+    """One interval-containment output share: for payload w, reconstructs
+    to ``w * 1{x_real in [p, q]}`` across the two parties. ``pub`` is
+    :func:`ic_public_term`, ``w_share`` this party's additive share of
+    the payload, ``s_p``/``s_q_prime`` its DCF value shares at the two
+    :func:`ic_points` (already reduced mod n), ``z_share`` its share of
+    ``wrap_count * w`` (+ any output mask)."""
+    return (pub * w_share - s_p + s_q_prime + z_share) % n
+
+
+def split_share(value: int, modulus: int, prng: SecurePrng) -> Tuple[int, int]:
+    """Additive 2-sharing of ``value`` mod ``modulus`` (party-0 share
+    drawn from the prng — one rand128 per split, the draw order golden
+    key tests pin)."""
+    s0 = prng.rand128() % modulus
+    return s0, (value - s0) % modulus
+
+
+# ---------------------------------------------------------------------------
+# Keys
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class GateKey:
+    """One party's generic gate key: component DCF keys + the gate's
+    mask-share vector (layout owned by the gate class; see
+    protos/serialization.serialize_gate_key for the wire form)."""
+
+    dcf_keys: List[DcfKey]
+    mask_shares: List[int]
+
+    @property
+    def party(self) -> int:
+        return self.dcf_keys[0].key.party
+
+
+# ---------------------------------------------------------------------------
+# The flatten/evaluate path (ONE fused batched-DCF pass per gate batch)
+# ---------------------------------------------------------------------------
+
+
+def _values_as_ints(evals, engine: str) -> np.ndarray:
+    """Normalize a batched-DCF result to an object ndarray of Python ints
+    [K, P]: host engine returns uint64 (lo, hi) pairs for the gates'
+    Int(128) payloads, the device engine uint32 limb vectors."""
+    from ..ops import evaluator
+
+    evals = np.asarray(evals)
+    if engine == "host":
+        if evals.ndim == 3:  # uint64[K, P, 2] (lo, hi)
+            return evals[..., 0].astype(object) | (
+                evals[..., 1].astype(object) << 64
+            )
+        return evals.astype(object)
+    return evaluator.values_to_numpy(evals, 128)
+
+
+@dataclasses.dataclass
+class GatePlan:
+    """The flattened (inputs x DCF-evaluation-sites) layout of one gate
+    batch — the object that compiles a gate onto the batched DCF walk.
+
+    ``points`` is the flat evaluation-point list: input ``xi``'s
+    ``num_sites`` points occupy ``points[xi * num_sites : (xi + 1) *
+    num_sites]``. :meth:`evaluate` runs them against ALL component keys
+    in ONE ``dcf.batch_evaluate`` pass (the fused walk — one device
+    program per key chunk in walk mode, one walk-megakernel program under
+    ``mode="walkkernel"``); :meth:`combine` reduces the resulting
+    (component x site) matrix mod N and hands each input's slice to the
+    gate's linear combine. The waste of evaluating every component at
+    every site (components only read their own interval's sites) is the
+    price of staying inside one uniform program family; the per-gate
+    accounting lives in PERF.md's "FSS gate family" table.
+    """
+
+    gate: "MaskedGate"
+    xs: List[int]
+    points: List[int]
+
+    @classmethod
+    def build(cls, gate: "MaskedGate", xs: Sequence[int]) -> "GatePlan":
+        gate._check_masked_inputs(xs)
+        xs = [int(x) for x in xs]
+        points: List[int] = []
+        for x in xs:
+            pts = gate._points(x)
+            if len(pts) != gate.num_sites:
+                raise InvalidArgumentError(
+                    f"{type(gate).__name__}._points returned {len(pts)} "
+                    f"sites, declared num_sites={gate.num_sites}"
+                )
+            points.extend(pts)
+        return cls(gate=gate, xs=xs, points=points)
+
+    def evaluate(
+        self, dcf_keys: Sequence[DcfKey], engine: str = "device",
+        **device_kwargs,
+    ) -> np.ndarray:
+        """ONE fused batched-DCF pass over all components x all sites;
+        returns object ints [num_components, len(points)]."""
+        evals = self.gate.dcf.batch_evaluate(
+            list(dcf_keys), self.points, engine=engine, **device_kwargs
+        )
+        return _values_as_ints(evals, engine)
+
+    def combine(self, key, values: np.ndarray) -> np.ndarray:
+        """Per-input linear combine of the evaluated site matrix: returns
+        an object ndarray [len(xs), num_outputs] of share values."""
+        gate = self.gate
+        n = gate.n
+        s = gate.num_sites
+        dcf_keys, shares = gate._key_parts(key)
+        party = dcf_keys[0].key.party
+        values = np.asarray(values, dtype=object)
+        out = np.zeros((len(self.xs), gate.num_outputs), dtype=object)
+        for xi, x in enumerate(self.xs):
+            vals = values[:, s * xi : s * (xi + 1)] % n
+            out[xi] = gate._combine_one(party, shares, x, vals)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Gate base class
+# ---------------------------------------------------------------------------
+
+
+class MaskedGate(abc.ABC):
+    """A two-party FSS gate over Z_N (N = 2^log_group_size) with masked
+    input, evaluated through one fused batched-DCF pass.
+
+    Subclasses declare the dealer algebra (component DCF specs, mask
+    values) and the eval plan (sites, combine); ``gen`` / ``eval`` /
+    ``batch_eval`` are the shared templates. All component DCFs ride
+    ``Int(128)`` payloads over a 2^log_group_size domain — the program
+    family gates/mic.py established.
+    """
+
+    def __init__(self, log_group_size: int, dcf, num_outputs: int):
+        self.log_group_size = log_group_size
+        self._dcf = dcf
+        self.num_outputs = num_outputs
+
+    # -- shared construction ----------------------------------------------
+    @staticmethod
+    def _create_dcf(log_group_size: int):
+        from ..core.value_types import Int
+        from ..dcf.dcf import DistributedComparisonFunction
+
+        if log_group_size < 1 or log_group_size > 127:
+            raise InvalidArgumentError(
+                "log_group_size should be in > 0 and < 128"
+            )
+        return DistributedComparisonFunction.create(log_group_size, Int(128))
+
+    @property
+    def n(self) -> int:
+        return 1 << self.log_group_size
+
+    @property
+    def dcf(self):
+        """The shared component DCF (its DPF drives the fused walk)."""
+        return self._dcf
+
+    # -- subclass contract -------------------------------------------------
+    @property
+    @abc.abstractmethod
+    def num_components(self) -> int:
+        """Component DCF keys per party key (static: key size)."""
+
+    @property
+    @abc.abstractmethod
+    def num_sites(self) -> int:
+        """DCF evaluation points per masked input (static: plan shape)."""
+
+    @abc.abstractmethod
+    def _component_specs(self, r_in: int) -> List[Tuple[int, int]]:
+        """Dealer: per component key, its (alpha, beta) DCF parameters."""
+
+    @abc.abstractmethod
+    def _mask_values(self, r_in: int, r_outs: Sequence[int]) -> List[int]:
+        """Dealer: the plaintext correction/mask values to split."""
+
+    @abc.abstractmethod
+    def _points(self, x: int) -> List[int]:
+        """The ``num_sites`` DCF evaluation points for masked input x."""
+
+    @abc.abstractmethod
+    def _combine_one(
+        self, party: int, shares: Sequence[int], x: int, vals: np.ndarray
+    ) -> List[int]:
+        """Party's output shares from its mask shares + the reduced
+        (component x site) value matrix for one input."""
+
+    def _mask_moduli(self) -> List[int]:
+        """Modulus per mask value (default: the group order; boolean
+        outputs override with 2s)."""
+        return [self.n] * len(self._mask_values(0, [0] * self.num_outputs))
+
+    def config_signature(self) -> tuple:
+        """The gate's public configuration beyond (class, log_group_size)
+        — the identity serving compatibility queues key on
+        (serving/batcher.py): two requests merge into one batch only if
+        their gates agree on it. A subclass whose constructor takes any
+        public parameter (intervals, coefficients, a shift amount, ...)
+        MUST override and return it all, else differently-configured
+        instances of the same class + key material would merge and the
+        whole batch would be evaluated under one request's config."""
+        return ()
+
+    def _make_key(self, dcf_keys: List[DcfKey], shares: List[int]):
+        return GateKey(dcf_keys, shares)
+
+    def _key_parts(self, key) -> Tuple[List[DcfKey], List[int]]:
+        return key.dcf_keys, key.mask_shares
+
+    def _validate_r_out(self, r: int) -> bool:
+        return 0 <= r < self.n
+
+    # -- templates ---------------------------------------------------------
+    def _check_masked_inputs(self, xs: Sequence[int]) -> None:
+        """Input validation shared by batch_eval and the supervisor's
+        robust wrapper (ops/supervisor.gate_batch_eval_robust)."""
+        n = self.n
+        for x in xs:
+            if not 0 <= x < n:
+                raise InvalidArgumentError(
+                    "Masked input should be between 0 and 2^log_group_size"
+                )
+
+    def gen(
+        self,
+        r_in: int,
+        r_outs: Sequence[int],
+        prng: Optional[SecurePrng] = None,
+        dcf_seeds=None,
+    ):
+        """Dealer keygen for masks ``r_in`` / ``r_outs``: component DCF
+        key pairs + additively split mask values. ``prng`` supplies the
+        share randomness (one rand128 per mask value, in
+        ``_mask_values`` order — the draw order golden-key tests pin);
+        ``dcf_seeds`` optionally pins the component DCF keygen seeds (a
+        single (s0, s1) pair for one-component gates, else one pair per
+        component) — together they make ``gen`` fully deterministic."""
+        if prng is None:
+            prng = BasicRng()
+        n = self.n
+        if len(r_outs) != self.num_outputs:
+            raise InvalidArgumentError(
+                "Count of output masks should be equal to the number of "
+                "gate outputs"
+            )
+        if not 0 <= r_in < n:
+            raise InvalidArgumentError(
+                "Input mask should be between 0 and 2^log_group_size"
+            )
+        for r in r_outs:
+            if not self._validate_r_out(int(r)):
+                raise InvalidArgumentError(
+                    "Output mask outside the gate's output group"
+                )
+        specs = self._component_specs(r_in)
+        if dcf_seeds is None:
+            seeds_list = [None] * len(specs)
+        elif (
+            len(specs) == 1
+            and len(dcf_seeds) == 2
+            and not hasattr(dcf_seeds[0], "__len__")
+        ):
+            seeds_list = [tuple(dcf_seeds)]
+        else:
+            seeds_list = [tuple(s) for s in dcf_seeds]
+            if len(seeds_list) != len(specs):
+                raise InvalidArgumentError(
+                    f"dcf_seeds must carry one (s0, s1) pair per component "
+                    f"({len(specs)}), got {len(seeds_list)}"
+                )
+        keys_0: List[DcfKey] = []
+        keys_1: List[DcfKey] = []
+        for (alpha, beta), sd in zip(specs, seeds_list):
+            k0, k1 = self._dcf.generate_keys(alpha, beta, seeds=sd)
+            keys_0.append(k0)
+            keys_1.append(k1)
+        values = self._mask_values(r_in, [int(r) for r in r_outs])
+        moduli = self._mask_moduli()
+        shares_0: List[int] = []
+        shares_1: List[int] = []
+        for v, mod in zip(values, moduli):
+            s0, s1 = split_share(int(v), mod, prng)
+            shares_0.append(s0)
+            shares_1.append(s1)
+        return self._make_key(keys_0, shares_0), self._make_key(keys_1, shares_1)
+
+    def eval(self, key, x: int) -> List[int]:
+        """Host per-point evaluation (reference-parity DCF walks): this
+        party's output shares for one masked input."""
+        self._check_masked_inputs([x])
+        n = self.n
+        dcf_keys, shares = self._key_parts(key)
+        pts = self._points(int(x))
+        vals = np.zeros((self.num_components, self.num_sites), dtype=object)
+        for c, dk in enumerate(dcf_keys):
+            for s, pt in enumerate(pts):
+                vals[c, s] = self._dcf.evaluate(dk, pt) % n
+        return self._combine_one(dcf_keys[0].key.party, shares, int(x), vals)
+
+    @_tm.traced("gate.batch_eval")
+    def batch_eval(
+        self, key, xs: Sequence[int], engine: str = "device",
+        **device_kwargs,
+    ) -> np.ndarray:
+        """Fused evaluation of a batch of masked inputs: ONE batched-DCF
+        pass over (num_components keys) x (num_sites * len(xs) points),
+        on the device (engine="device") or the native AES-NI host engine
+        (engine="host"; the gates' Int(128) payloads ride the two-word
+        wide kernel). ``device_kwargs`` pass through to the DCF device
+        path (notably ``mode="walkkernel"``: the whole gate evaluation
+        becomes ONE walk-megakernel program). Returns an object ndarray
+        [len(xs), num_outputs] of share values."""
+        plan = GatePlan.build(self, xs)
+        dcf_keys, _ = self._key_parts(key)
+        values = plan.evaluate(dcf_keys, engine=engine, **device_kwargs)
+        return plan.combine(key, values)
+
+
+def bundle_eval(
+    gate: MaskedGate,
+    keys: Sequence,
+    xs: Sequence[int],
+    engine: str = "device",
+    **device_kwargs,
+) -> np.ndarray:
+    """Evaluates key ``b`` on input ``xs[b]`` for a whole bundle in ONE
+    fused batched-DCF pass — the secure-ML inference shape (one
+    independent mask and key pair per activation, one device program for
+    the layer; examples/secure_relu_demo.py). All keys must come from
+    ``gate``'s dealer (same party, same component DCF).
+
+    The pass evaluates every bundled component key at every bundled
+    input's sites and the combine slices each key's own block — a
+    len(keys)-factor compute waste that buys ONE uniform program instead
+    of len(keys) dispatches (PERF.md "FSS gate family"). Returns
+    [len(keys), num_outputs] share values."""
+    if len(keys) != len(xs):
+        raise InvalidArgumentError(
+            f"bundle_eval needs one key per input, got {len(keys)} keys "
+            f"for {len(xs)} inputs"
+        )
+    if not keys:
+        return np.zeros((0, gate.num_outputs), dtype=object)
+    plan = GatePlan.build(gate, xs)
+    c = gate.num_components
+    s = gate.num_sites
+    all_dcf: List[DcfKey] = []
+    party0: Optional[int] = None
+    for b, key in enumerate(keys):
+        dcf_keys, _ = gate._key_parts(key)
+        if len(dcf_keys) != c:
+            raise InvalidArgumentError(
+                f"bundle key {b} has {len(dcf_keys)} component DCF keys, "
+                f"the gate declares {c}"
+            )
+        if party0 is None:
+            party0 = dcf_keys[0].key.party
+        elif dcf_keys[0].key.party != party0:
+            raise InvalidArgumentError(
+                f"bundle key {b} belongs to party "
+                f"{dcf_keys[0].key.party}, key 0 to party {party0} — a "
+                "bundle is ONE party's keys (mixing parties would "
+                "reconstruct garbage, not raise)"
+            )
+        all_dcf.extend(dcf_keys)
+    values = plan.evaluate(all_dcf, engine=engine, **device_kwargs)
+    n = gate.n
+    party = all_dcf[0].key.party
+    out = np.zeros((len(keys), gate.num_outputs), dtype=object)
+    for b, (key, x) in enumerate(zip(keys, plan.xs)):
+        _, shares = gate._key_parts(key)
+        vals = values[b * c : (b + 1) * c, b * s : (b + 1) * s] % n
+        out[b] = gate._combine_one(party, shares, x, vals)
+    return out

@@ -6,7 +6,7 @@ following BCG+ (eprint 2020/1392) Fig. 14: for m public intervals [p_i, q_i]
 and a masked input x = x_real + r_in, the two parties obtain additive shares
 (mod N = 2^log_group_size) of [x_real in [p_i, q_i]] for every i.
 
-* ``gen(r_in, r_out[])`` (.cc:104-204): one DCF key pair at
+* ``gen(r_in, r_outs[])`` (.cc:104-204): one DCF key pair at
   alpha = r_in - 1 mod N with beta = 1, plus per interval an additively
   shared correction term z derived from the mask wraparounds (Lemma 1-2).
 * ``eval(key, x)`` (.cc:206-275): per interval two DCF evaluations at
@@ -16,25 +16,28 @@ All mod-N arithmetic is exact on Python ints; since N divides 2^128 the
 reference's wrap-then-reduce uint128 arithmetic agrees with reducing the
 integer expression directly.
 
-TPU path: ``batch_eval`` flattens (points x intervals x {p, q'}) into ONE
-fused batched DCF pass (dcf/batch.py) — the reference walks the DCF tree
-2 * m times per input from the root, each walk itself O(n^2) AES; here the
-whole gate evaluation is a single O(n)-depth scan over a packed lane batch.
+Since ISSUE 9 the gate is the founding member of the gate *framework*
+(gates/framework.py): its wraparound algebra lives in the shared
+interval-containment helpers (``ic_points`` / ``ic_wrap_count`` /
+``ic_public_term`` / ``ic_share``), and ``gen`` / ``eval`` /
+``batch_eval`` are the framework templates — ``batch_eval`` flattens
+(points x intervals x {p, q'}) through the shared :class:`GatePlan` into
+ONE fused batched-DCF pass (dcf/batch.py; the reference walks the DCF
+tree 2m times per input from the root, each walk itself O(n^2) AES).
+``MicKey`` keeps its reference-proto shape (one DCF key + the per-interval
+mask shares) for wire compatibility.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Sequence, Tuple
 
 import numpy as np
 
-from ..core.value_types import Int
-from ..dcf.dcf import DcfKey, DistributedComparisonFunction
-from ..ops import evaluator
-from ..utils import telemetry as _tm
+from ..dcf.dcf import DcfKey
 from ..utils.errors import InvalidArgumentError
-from .prng import BasicRng, SecurePrng
+from . import framework
 
 
 @dataclasses.dataclass
@@ -49,11 +52,10 @@ class MicKey:
     output_mask_shares: List[int]
 
 
-class MultipleIntervalContainmentGate:
+class MultipleIntervalContainmentGate(framework.MaskedGate):
     def __init__(self, log_group_size: int, intervals: List[Tuple[int, int]], dcf):
-        self.log_group_size = log_group_size
+        super().__init__(log_group_size, dcf, num_outputs=len(intervals))
         self.intervals = intervals
-        self._dcf = dcf
 
     @classmethod
     def create(
@@ -71,150 +73,88 @@ class MultipleIntervalContainmentGate:
                 raise InvalidArgumentError(
                     "Interval upper bounds should be >= lower bound"
                 )
-        dcf = DistributedComparisonFunction.create(log_group_size, Int(128))
+        dcf = cls._create_dcf(log_group_size)
         return cls(log_group_size, [(int(p), int(q)) for p, q in intervals], dcf)
 
+    # -- framework contract ------------------------------------------------
     @property
-    def dcf(self) -> DistributedComparisonFunction:
-        return self._dcf
+    def num_components(self) -> int:
+        return 1
 
-    def gen(
-        self,
-        r_in: int,
-        r_outs: Sequence[int],
-        prng: Optional[SecurePrng] = None,
-        dcf_seeds=None,
-    ) -> Tuple[MicKey, MicKey]:
-        """Key pair for masks r_in / r_outs. `prng` supplies the mask-share
-        randomness (SecurePrng analog, prng.h:26-36); `dcf_seeds` optionally
-        pins the inner DCF keygen seeds — together they make `gen` fully
-        deterministic for golden-key tests."""
-        if prng is None:
-            prng = BasicRng()
-        n = 1 << self.log_group_size
-        if len(r_outs) != len(self.intervals):
-            raise InvalidArgumentError(
-                "Count of output masks should be equal to the number of intervals"
+    @property
+    def num_sites(self) -> int:
+        return 2 * len(self.intervals)
+
+    def config_signature(self) -> tuple:
+        return (tuple(self.intervals),)
+
+    def _component_specs(self, r_in: int) -> List[Tuple[int, int]]:
+        return [(framework.ic_alpha(self.n, r_in), 1)]
+
+    def _mask_values(self, r_in: int, r_outs: Sequence[int]) -> List[int]:
+        n = self.n
+        return [
+            (r_out + framework.ic_wrap_count(n, r_in, p, q)) % n
+            for (p, q), r_out in zip(self.intervals, r_outs)
+        ]
+
+    def _points(self, x: int) -> List[int]:
+        n = self.n
+        pts: List[int] = []
+        for p, q in self.intervals:
+            pts.extend(framework.ic_points(n, x, p, q))
+        return pts
+
+    def _combine_one(
+        self, party: int, shares: Sequence[int], x: int, vals: np.ndarray
+    ) -> List[int]:
+        n = self.n
+        return [
+            framework.ic_share(
+                n,
+                framework.ic_public_term(n, x, p, q),
+                party,
+                int(vals[0, 2 * i]),
+                int(vals[0, 2 * i + 1]),
+                shares[i],
             )
-        if not 0 <= r_in < n:
-            raise InvalidArgumentError(
-                "Input mask should be between 0 and 2^log_group_size"
-            )
-        for r in r_outs:
-            if not 0 <= r < n:
-                raise InvalidArgumentError(
-                    "Output mask should be between 0 and 2^log_group_size"
-                )
+            for i, (p, q) in enumerate(self.intervals)
+        ]
 
-        gamma = (n - 1 + r_in) % n
-        key_0, key_1 = self._dcf.generate_keys(gamma, 1, seeds=dcf_seeds)
-        shares_0, shares_1 = [], []
-        for (p, q), r_out in zip(self.intervals, r_outs):
-            q_prime = (q + 1) % n
-            alpha_p = (p + r_in) % n
-            alpha_q = (q + r_in) % n
-            alpha_q_prime = (q + 1 + r_in) % n
-            z = (
-                r_out
-                + (1 if alpha_p > alpha_q else 0)
-                - (1 if alpha_p > p else 0)
-                + (1 if alpha_q_prime > q_prime else 0)
-                + (1 if alpha_q == n - 1 else 0)
-            ) % n
-            z_0 = prng.rand128() % n
-            z_1 = (z - z_0) % n
-            shares_0.append(z_0)
-            shares_1.append(z_1)
-        return MicKey(key_0, shares_0), MicKey(key_1, shares_1)
+    def _make_key(self, dcf_keys: List[DcfKey], shares: List[int]) -> MicKey:
+        return MicKey(dcf_keys[0], shares)
 
+    def _key_parts(self, key: MicKey) -> Tuple[List[DcfKey], List[int]]:
+        return [key.dcf_key], key.output_mask_shares
+
+    # -- reference-shaped surface (kept for tests/serialization callers) ---
     def _eval_points(self, x: int) -> List[int]:
         """The 2m DCF evaluation points for one masked input."""
-        n = 1 << self.log_group_size
-        points = []
-        for p, q in self.intervals:
-            q_prime = (q + 1) % n
-            points.append((x + n - 1 - p) % n)
-            points.append((x + n - 1 - q_prime) % n)
-        return points
+        return self._points(int(x))
 
-    def _check_masked_inputs(self, xs: Sequence[int]) -> None:
-        """Input validation shared by batch_eval and the supervisor's
-        robust wrapper (ops/supervisor.mic_batch_eval_robust)."""
-        n = 1 << self.log_group_size
-        for x in xs:
-            if not 0 <= x < n:
-                raise InvalidArgumentError(
-                    "Masked input should be between 0 and 2^log_group_size"
-                )
+    def _combine(self, key: MicKey, x: int, s_p: int, s_q_prime: int, i: int) -> int:
+        n = self.n
+        p, q = self.intervals[i]
+        return framework.ic_share(
+            n,
+            framework.ic_public_term(n, x, p, q),
+            key.dcf_key.key.party,
+            s_p,
+            s_q_prime,
+            key.output_mask_shares[i],
+        )
 
     def _combine_batch(
         self, key: MicKey, xs: Sequence[int], values
     ) -> np.ndarray:
         """mod-N combine of a flat (points x intervals x {p, q'}) DCF
-        value vector back into per-(input, interval) shares — the single
-        owner of the 2m-stride layout, shared by batch_eval and the
-        robust wrapper so the point packing cannot drift between them."""
-        n = 1 << self.log_group_size
-        m = len(self.intervals)
-        out = np.zeros((len(xs), m), dtype=object)
-        for xi, x in enumerate(xs):
-            for i in range(m):
-                s_p = int(values[2 * m * xi + 2 * i]) % n
-                s_q_prime = int(values[2 * m * xi + 2 * i + 1]) % n
-                out[xi, i] = self._combine(key, int(x), s_p, s_q_prime, i)
-        return out
+        value vector back into per-(input, interval) shares — the
+        single-component form of :meth:`GatePlan.combine`, kept for
+        callers holding the flat one-key value layout."""
+        plan = framework.GatePlan.build(self, xs)
+        return plan.combine(key, np.asarray(values, dtype=object)[None, :])
 
-    def _combine(self, key: MicKey, x: int, s_p: int, s_q_prime: int, i: int) -> int:
-        n = 1 << self.log_group_size
-        p, q = self.intervals[i]
-        q_prime = (q + 1) % n
-        party_term = 0
-        if key.dcf_key.key.party:
-            party_term = (1 if x > p else 0) - (1 if x > q_prime else 0)
-        return (party_term - s_p + s_q_prime + key.output_mask_shares[i]) % n
-
-    def eval(self, key: MicKey, x: int) -> List[int]:
-        """Host evaluation: shares of [x - r_in in interval i] for each i."""
-        n = 1 << self.log_group_size
-        if not 0 <= x < n:
-            raise InvalidArgumentError(
-                "Masked input should be between 0 and 2^log_group_size"
-            )
-        points = self._eval_points(x)
-        res = []
-        for i in range(len(self.intervals)):
-            s_p = self._dcf.evaluate(key.dcf_key, points[2 * i]) % n
-            s_q_prime = self._dcf.evaluate(key.dcf_key, points[2 * i + 1]) % n
-            res.append(self._combine(key, x, s_p, s_q_prime, i))
-        return res
-
-    @_tm.traced("mic.batch_eval")
-    def batch_eval(
-        self, key: MicKey, xs: Sequence[int], engine: str = "device",
-        **device_kwargs,
-    ) -> np.ndarray:
-        """Fused evaluation of all intervals for a batch of masked inputs.
-
-        One fused DCF pass over len(xs) * 2m lanes — on the device
-        (engine="device") or the native AES-NI host engine (engine="host";
-        the gate's Int(128) values ride the two-word wide kernel). Returns
-        an object ndarray [len(xs), m] of share values mod N.
-        `device_kwargs` pass through to the DCF device path (notably
-        mode="walkkernel": the whole gate evaluation — every interval's
-        two comparison walks — becomes ONE walk-megakernel program).
-        """
-        self._check_masked_inputs(xs)
-        all_points: List[int] = []
-        for x in xs:
-            all_points.extend(self._eval_points(int(x)))
-        evals = self._dcf.batch_evaluate(
-            [key.dcf_key], all_points, engine=engine, **device_kwargs
-        )
-        if engine == "host":  # uint64[1, P, 2] (lo, hi) pairs
-            values = (
-                evals[0, :, 0].astype(object)
-                | (evals[0, :, 1].astype(object) << 64)
-            )
-        else:
-            values = evaluator.values_to_numpy(evals, 128)[0]  # [len(xs)*2m]
-        return self._combine_batch(key, xs, values)
+    # gen / eval / batch_eval are the framework templates
+    # (framework.MaskedGate): gen's draw order — one rand128 per interval
+    # after the single DCF keygen — matches the pre-framework
+    # implementation bit for bit (pinned by the golden-key test).

@@ -1,0 +1,170 @@
+"""Spline / piecewise-polynomial FSS gate (BCG+ eprint 2020/1392 §4).
+
+For public intervals [p_i, q_i] and public polynomial coefficients
+``a_{i,0..d}``, the parties obtain additive shares (mod N) of
+``sum_{i : x_real in [p_i, q_i]} p_i(x_real)`` + r_out for the masked
+input x = x_real + r_in — the fixed-point math workhorse (piecewise
+approximations of sigmoid/tanh/reciprocal, and ReLU exactly).
+
+Construction (validated exhaustively in tests/test_gates_framework.py):
+the dealer expands each piece's *shifted* polynomial
+``p_i^r(X) = p_i(X - r_in) mod N`` — evaluating it at the public masked
+input x gives ``p_i(x_real)`` exactly — and must deliver shares of the
+coefficient vector of the *active* piece. That is interval containment
+with payload ``w_{i,j} = coeff_j(p_i^r)``: component DCF key (i, j)
+carries ``beta = w_{i,j}`` at the shared threshold ``alpha = r_in - 1``,
+and the MIC combine algebra, linear in the payload, reconstructs
+``1{x_real in [p_i, q_i]} * w_{i,j}`` (the public comparison term is
+multiplied by dealer-provided *shares* of w, since w depends on r_in).
+Summing over i and evaluating at x yields the result.
+
+BCG+ express the same gate as ONE DCF with a vector payload in
+G^{m(d+1)}; this framework deliberately flattens the vector into
+m(d+1) scalar Int(128) component keys instead, so the gate rides the
+exact fused batched-DCF program family MIC compiles (walk and
+walkkernel) — trading ~m(d+1)x key-tree material and an m-factor
+evaluation waste (each component is evaluated at every interval's sites)
+for zero new kernel shapes. PERF.md "FSS gate family" carries the
+accounting.
+
+Key layout (``GateKey.mask_shares``): ``[w shares (m*(d+1))] +
+[z shares (m*(d+1), z_{i,j} = wrap_count_i * w_{i,j})] + [r_out share]``.
+"""
+
+from __future__ import annotations
+
+from math import comb
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.errors import InvalidArgumentError
+from . import framework
+
+
+class SplineGate(framework.MaskedGate):
+    """Piecewise-polynomial evaluation over Z_{2^log_group_size}."""
+
+    def __init__(self, log_group_size, intervals, coefficients, dcf):
+        super().__init__(log_group_size, dcf, num_outputs=1)
+        self.intervals = intervals
+        self.coefficients = coefficients
+        self.degree = len(coefficients[0]) - 1
+
+    @classmethod
+    def create(
+        cls,
+        log_group_size: int,
+        intervals: Sequence[Tuple[int, int]],
+        coefficients: Sequence[Sequence[int]],
+    ) -> "SplineGate":
+        """`coefficients[i][j]` is piece i's coefficient of X^j (mod N);
+        all pieces must share one degree (pad with zeros). Intervals are
+        validated in-range; they need not partition the domain — an
+        uncovered x_real evaluates to 0, overlapping pieces sum."""
+        dcf = cls._create_dcf(log_group_size)
+        n = 1 << log_group_size
+        if not intervals:
+            raise InvalidArgumentError("A spline needs at least one interval")
+        if len(coefficients) != len(intervals):
+            raise InvalidArgumentError(
+                "Count of coefficient vectors should be equal to the "
+                "number of intervals"
+            )
+        d = len(coefficients[0]) - 1
+        if d < 0:
+            raise InvalidArgumentError("Coefficient vectors cannot be empty")
+        for cs in coefficients:
+            if len(cs) != d + 1:
+                raise InvalidArgumentError(
+                    "All pieces must share one polynomial degree "
+                    "(zero-pad shorter coefficient vectors)"
+                )
+        for p, q in intervals:
+            if not (0 <= p < n and 0 <= q < n):
+                raise InvalidArgumentError(
+                    "Interval bounds should be between 0 and 2^log_group_size"
+                )
+            if p > q:
+                raise InvalidArgumentError(
+                    "Interval upper bounds should be >= lower bound"
+                )
+        return cls(
+            log_group_size,
+            [(int(p), int(q)) for p, q in intervals],
+            [[int(c) % n for c in cs] for cs in coefficients],
+            dcf,
+        )
+
+    # -- framework contract ------------------------------------------------
+    def config_signature(self) -> tuple:
+        return (
+            tuple(self.intervals),
+            tuple(tuple(cs) for cs in self.coefficients),
+        )
+
+    @property
+    def num_components(self) -> int:
+        return len(self.intervals) * (self.degree + 1)
+
+    @property
+    def num_sites(self) -> int:
+        return 2 * len(self.intervals)
+
+    def _shifted_coefficients(self, r_in: int) -> List[List[int]]:
+        """w_{i,j} = coeff_j of p_i(X - r_in) mod N (binomial expansion,
+        exact Python ints)."""
+        n = self.n
+        out = []
+        for cs in self.coefficients:
+            w = [0] * (self.degree + 1)
+            for k, a in enumerate(cs):
+                for j in range(k + 1):
+                    w[j] = (w[j] + a * comb(k, j) * pow(-r_in, k - j, n)) % n
+            out.append(w)
+        return out
+
+    def _component_specs(self, r_in: int) -> List[Tuple[int, int]]:
+        alpha = framework.ic_alpha(self.n, r_in)
+        return [
+            (alpha, w)
+            for ws in self._shifted_coefficients(r_in)
+            for w in ws
+        ]
+
+    def _mask_values(self, r_in: int, r_outs: Sequence[int]) -> List[int]:
+        n = self.n
+        shifted = self._shifted_coefficients(r_in)
+        ws = [w for piece in shifted for w in piece]
+        zs = []
+        for i, (p, q) in enumerate(self.intervals):
+            c = framework.ic_wrap_count(n, r_in, p, q)
+            zs.extend((c * w) % n for w in shifted[i])
+        return ws + zs + [r_outs[0] % n]
+
+    def _points(self, x: int) -> List[int]:
+        n = self.n
+        pts: List[int] = []
+        for p, q in self.intervals:
+            pts.extend(framework.ic_points(n, x, p, q))
+        return pts
+
+    def _combine_one(
+        self, party: int, shares: Sequence[int], x: int, vals: np.ndarray
+    ) -> List[int]:
+        n = self.n
+        k = self.num_components
+        w_sh = shares[:k]
+        z_sh = shares[k : 2 * k]
+        y = shares[2 * k]  # r_out share
+        for i, (p, q) in enumerate(self.intervals):
+            pub = framework.ic_public_term(n, x, p, q)
+            for j in range(self.degree + 1):
+                ci = i * (self.degree + 1) + j
+                cshare = framework.ic_share(
+                    n, pub, w_sh[ci],
+                    int(vals[ci, 2 * i]), int(vals[ci, 2 * i + 1]),
+                    z_sh[ci],
+                )
+                y = (y + cshare * pow(x, j, n)) % n
+        return [y]

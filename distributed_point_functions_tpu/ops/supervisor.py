@@ -34,7 +34,9 @@ PR 1 did not reach:
       hierarchical             hierkernel → fused/pallas → fused/jax → numpy
 
   plus the four robust wrappers PR 1 never had: ``batch_evaluate_robust``
-  (DCF), ``mic_batch_eval_robust``, ``evaluate_levels_fused_robust``
+  (DCF), ``mic_batch_eval_robust`` / ``gate_batch_eval_robust`` (the
+  whole FSS gate family rides the DCF chain through its shared
+  ``GatePlan`` flatten, ISSUE 9), ``evaluate_levels_fused_robust``
   (resuming from the exported ``BatchedContext`` state rather than
   re-walking verified prefix windows), and ``pir_query_batch_robust``
   (re-preparing the ``PreparedPirDatabase`` when a mode downgrade
@@ -713,6 +715,35 @@ def batch_evaluate_robust(
     return degrade._run_chain("dcf.batch_evaluate", policy, attempt, chain=chain)
 
 
+def gate_batch_eval_robust(
+    gate,
+    key,
+    xs: Sequence[int],
+    policy: DegradationPolicy = DEFAULT_POLICY,
+    key_chunk: Optional[int] = None,
+    pipeline: Optional[bool] = None,
+    mode: Optional[str] = None,
+) -> np.ndarray:
+    """Any framework gate's ``batch_eval`` (gates/framework.MaskedGate —
+    MIC, DReLU/ReLU, splines, bit decomposition) behind the supervisor:
+    the gate's single fused DCF pass (its :class:`GatePlan` flatten) runs
+    through :func:`batch_evaluate_robust` — inheriting the
+    walkkernel → walk/pallas → walk/jax → numpy chain and its host-oracle
+    spot checks — and the exact-int mask combine stays on the host.
+    Returns the same object ndarray [len(xs), num_outputs] of share
+    values the direct ``gate.batch_eval`` produces."""
+    from ..gates import framework as gate_framework
+    from . import evaluator
+
+    plan = gate_framework.GatePlan.build(gate, xs)
+    dcf_keys, _ = gate._key_parts(key)
+    evals = batch_evaluate_robust(
+        gate.dcf, list(dcf_keys), plan.points,
+        key_chunk=key_chunk, policy=policy, pipeline=pipeline, mode=mode,
+    )
+    return plan.combine(key, evaluator.values_to_numpy(evals, 128))
+
+
 def mic_batch_eval_robust(
     gate,
     key,
@@ -723,22 +754,12 @@ def mic_batch_eval_robust(
     mode: Optional[str] = None,
 ) -> np.ndarray:
     """`gates.mic.MultipleIntervalContainmentGate.batch_eval` behind the
-    supervisor: the gate's 2m-comparison DCF pass runs through
-    :func:`batch_evaluate_robust` (inheriting its chain + spot checks),
-    the mod-N combine stays on the host. Returns the same object ndarray
-    [len(xs), m] of share values the direct entry point produces."""
-    from . import evaluator
-
-    gate._check_masked_inputs(xs)
-    all_points = []
-    for x in xs:
-        all_points.extend(gate._eval_points(int(x)))
-    evals = batch_evaluate_robust(
-        gate.dcf, [key.dcf_key], all_points,
-        key_chunk=key_chunk, policy=policy, pipeline=pipeline, mode=mode,
-    )
-    return gate._combine_batch(
-        key, xs, evaluator.values_to_numpy(evals, 128)[0]
+    supervisor — the MIC-shaped alias of :func:`gate_batch_eval_robust`
+    (the gate framework made the generic form possible; this name stays
+    for the serving layer and chaos suites that grew up on it)."""
+    return gate_batch_eval_robust(
+        gate, key, xs,
+        policy=policy, key_chunk=key_chunk, pipeline=pipeline, mode=mode,
     )
 
 
@@ -851,6 +872,25 @@ def evaluate_levels_fused_robust(
     shadow = None
     if verify:
         shadow = hierarchical.BatchedContext.create(dpf, [ctx.keys[-1]])
+        if ctx.previous_hierarchy_level >= 0 or ctx.seeds is not None:
+            # The caller's context is already advanced (the adaptive
+            # per-level shape: heavy-hitters pruning feeds each level's
+            # survivors into the next call). Fast-forward the one-key
+            # shadow from the context's state — direct numpy copies with
+            # the last key's seed/control row sliced out, NOT the
+            # _ctx_record round-trip (which would base64-encode all K
+            # keys' planes once per robust call just to keep 1/K).
+            shadow.previous_hierarchy_level = ctx.previous_hierarchy_level
+            shadow.child_levels = ctx.child_levels
+            shadow.parent_tree = (
+                None if ctx.parent_tree is None else np.copy(ctx.parent_tree)
+            )
+            if ctx.seeds is not None:
+                shadow.seeds = np.copy(np.asarray(ctx.seeds)[-1:])
+                shadow.control = np.copy(np.asarray(ctx.control)[-1:])
+            else:
+                shadow.seeds = None
+                shadow.control = None
 
     outs: list = []
     try:

@@ -414,3 +414,38 @@ def parse_mic_key(buf: bytes):
     if dcf_key is None:
         raise InvalidArgumentError("MicKey has no dcfkey set")
     return MicKey(dcf_key=dcf_key, output_mask_shares=shares)
+
+
+# ---------------------------------------------------------------------------
+# Generic framework gate key (gates/framework.GateKey)
+# ---------------------------------------------------------------------------
+#
+# The natural generalization of the MicKey message: repeated component DCF
+# keys (field 1) + repeated mask-share integers (field 2). A one-component
+# GateKey therefore serializes BYTE-IDENTICALLY to a MicKey carrying the
+# same DCF key and shares — the framework's wire form is a superset of the
+# reference's gate message, not a fork (pinned in tests).
+
+
+def serialize_gate_key(gate_key, parameters: Sequence[DpfParameters]) -> bytes:
+    out = b""
+    for dk in gate_key.dcf_keys:
+        out += wire.len_field(1, serialize_dcf_key(dk, parameters))
+    for share in gate_key.mask_shares:
+        out += wire.len_field(2, _encode_value_integer(share))
+    return out
+
+
+def parse_gate_key(buf: bytes):
+    from ..gates.framework import GateKey
+
+    dcf_keys: List = []
+    shares: List[int] = []
+    for field, _, value in wire.iter_fields(buf):
+        if field == 1:
+            dcf_keys.append(parse_dcf_key(value))
+        elif field == 2:
+            shares.append(_decode_value_integer(value))
+    if not dcf_keys:
+        raise InvalidArgumentError("GateKey has no component DCF keys set")
+    return GateKey(dcf_keys=dcf_keys, mask_shares=shares)

@@ -50,8 +50,11 @@ import numpy as np
 from ..utils import telemetry as _tm
 from ..utils.errors import InvalidArgumentError, ResourceExhaustedError
 
-#: Ops the front door serves — the six bulk entry points.
-OPS = ("full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical")
+#: Ops the front door serves — the six bulk entry points plus the
+#: generic FSS gate family (ISSUE 9: any gates/framework.MaskedGate —
+#: DReLU/ReLU, splines, bit decomposition — served through its shared
+#: fused-DCF GatePlan; MIC predates the framework and keeps its own op).
+OPS = ("full_domain", "evaluate_at", "dcf", "mic", "gate", "pir", "hierarchical")
 
 
 class ServedFuture:
@@ -182,6 +185,16 @@ class Request:
         )
 
     @classmethod
+    def gate(cls, gate, key, xs: Sequence[int]):
+        """Any framework gate (gates/framework.MaskedGate): one party
+        key's gate evaluated at many masked inputs — the MIC batching
+        shape generalized to the whole family."""
+        return cls(
+            op="gate", obj=gate, keys=(key,),
+            points=tuple(int(x) for x in xs),
+        )
+
+    @classmethod
     def pir(cls, dpf, keys: Sequence, db):
         return cls(op="pir", obj=dpf, keys=tuple(keys), db=db)
 
@@ -196,7 +209,7 @@ class Request:
     def _validator(self):
         if self.op in ("dcf",):
             return self.obj.dpf.validator
-        if self.op == "mic":
+        if self.op in ("mic", "gate"):
             return self.obj.dcf.dpf.validator
         return self.obj.validator
 
@@ -211,6 +224,8 @@ class Request:
             return k.key.party
         if self.op == "mic":
             return k.dcf_key.key.party
+        if self.op == "gate":
+            return k.dcf_keys[0].key.party
         return k.party
 
     def signature(self) -> tuple:
@@ -238,14 +253,31 @@ class Request:
             return base + (
                 _digest(key.dcf_key.key.seed, tuple(key.output_mask_shares)),
             )
+        if self.op == "gate":
+            # One gate + one party key per queue (like MIC): the merged
+            # batch is that key's gate at the union of masked inputs.
+            # Gate identity = class + the framework's declared public
+            # config (MaskedGate.config_signature — the accessor every
+            # gate owns, so new gates can't silently under-key); key
+            # identity = the component seeds + mask shares.
+            key = self.keys[0]
+            g = self.obj
+            return base + (
+                type(g).__name__,
+                _digest(g.log_group_size, g.config_signature()),
+                _digest(
+                    tuple(dk.key.seed for dk in key.dcf_keys),
+                    tuple(key.mask_shares),
+                ),
+            )
         return base  # dcf
 
     @property
     def width(self) -> int:
         """This request's contribution to the batch-width target: keys
-        for the key-merged ops, evaluation points for MIC (one key by
-        construction)."""
-        return len(self.points) if self.op == "mic" else len(self.keys)
+        for the key-merged ops, evaluation points for the gate ops (one
+        key by construction)."""
+        return len(self.points) if self.op in ("mic", "gate") else len(self.keys)
 
 
 class _Queue:

@@ -143,6 +143,7 @@ _DEGRADE_OPS = {
     "evaluate_at": ("evaluate_at_batch",),
     "dcf": ("dcf.batch_evaluate",),
     "mic": ("dcf.batch_evaluate",),
+    "gate": ("dcf.batch_evaluate",),
     "pir": ("pir_query_batch",),
     "hierarchical": ("evaluate_levels_fused",),
 }
@@ -276,14 +277,18 @@ class FrontDoor:
         v = r0._validator()
         num_keys = sum(len(r.keys) for r in reqs)
         wt = self.batcher.width_target if self.bucket else 0
-        if r0.op == "mic":
-            m = len(r0.obj.intervals)
+        if r0.op in ("mic", "gate"):
+            # The gate ops' DCF pass runs (components keys) x (sites per
+            # input x merged inputs) walks — the axes the DCF anchors are
+            # rated in. Every gate (MIC included, a framework gate since
+            # ISSUE 9) declares them.
+            comps, sites = r0.obj.num_components, r0.obj.num_sites
             merged = len(union[0])
             dev_pts = _bucket_target(merged, floor=wt) if self.bucket else None
             return Workload(
-                op="mic", num_keys=1, points=merged * 2 * m,
+                op=r0.op, num_keys=comps, points=merged * sites,
                 value_bits=128, value_kind="u128",
-                device_points=dev_pts and dev_pts * 2 * m,
+                device_points=dev_pts and dev_pts * sites,
             )
         hl = r0.hierarchy_level if r0.op in ("full_domain", "evaluate_at") else -1
         bits, kind = _value_meta(v, hl)
@@ -345,7 +350,7 @@ class FrontDoor:
         # and the runner's slicing map — computed once per batch.
         union = (
             _union([r.points for r in reqs])
-            if reqs[0].op in ("evaluate_at", "dcf", "mic")
+            if reqs[0].op in ("evaluate_at", "dcf", "mic", "gate")
             else None
         )
         w = self._workload(reqs, union)
@@ -499,6 +504,14 @@ class FrontDoor:
         return sliced
 
     def _run_mic(self, reqs, engine, mode, union=None):
+        """MIC is a framework gate since ISSUE 9 (`mic_batch_eval_robust`
+        is an alias of `gate_batch_eval_robust`) — one serving path."""
+        return self._run_gate(reqs, engine, mode, union)
+
+    def _run_gate(self, reqs, engine, mode, union=None):
+        """Any framework gate (ISSUE 9): the MIC serving shape via the
+        shared GatePlan — one fused DCF pass for the merged input union,
+        per-request row slices of the [inputs, num_outputs] shares."""
         from ..ops import supervisor
 
         gate, key = reqs[0].obj, reqs[0].keys[0]
@@ -512,7 +525,7 @@ class FrontDoor:
         if engine == "host":
             out = gate.batch_eval(key, xs, engine="host")
         elif self.robust:
-            out = supervisor.mic_batch_eval_robust(
+            out = supervisor.gate_batch_eval_robust(
                 gate, key, xs, policy=self.policy,
                 pipeline=self.pipeline, mode=mode,
             )

@@ -135,7 +135,16 @@ UNVERIFIED_MODES: Dict[Tuple[str, str], Tuple[str, ...]] = {
 #: built by hand.
 _DEFAULT_KEY_CHUNK = {"full_domain": 32, "pir": 64}
 
-_OPS = ("full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical")
+_OPS = ("full_domain", "evaluate_at", "dcf", "mic", "gate", "pir", "hierarchical")
+
+
+def _anchor_op(op: str) -> str:
+    """The anchor-table op a serving op's rates come from. The gate ops
+    (MIC and the ISSUE 9 framework family) ARE batched-DCF passes plus a
+    host combine, so they ride the DCF anchors; their Workload carries
+    the flattened (components x sites) axes so the work-item count is
+    the DCF walks actually executed."""
+    return "dcf" if op in ("mic", "gate") else op
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,7 +189,7 @@ class Workload:
         keys, points = self._axes(engine)
         if self.op in ("full_domain", "pir"):
             return float(keys) * float(1 << self.log_domain)
-        if self.op in ("evaluate_at", "dcf", "mic"):
+        if self.op in ("evaluate_at", "dcf", "mic", "gate"):
             return float(keys) * float(points)
         if self.op == "hierarchical":
             return (
@@ -326,7 +335,7 @@ class CostModel:
         basis (unverified mode with no learned rate and projections off).
         MIC rides the DCF anchors — its gate evaluation IS a DCF batch
         (2m comparison points per input) plus a host combine."""
-        anchor_op = "dcf" if op == "mic" else op
+        anchor_op = _anchor_op(op)
         with self._lock:
             learned = self.learned.get((anchor_op, engine, mode, kind))
         if learned is not None:
@@ -353,8 +362,8 @@ class CostModel:
         if op in ("full_domain", "pir"):
             # megakernel: ~3 hashes per leaf (hashes_per_eval at depth).
             return roofline.V5E_VPU_OPS_PER_SEC / (3.0 * ops_per) * PROJECTION_DERATE
-        if op in ("evaluate_at", "dcf", "mic"):
-            caps = 33 if op in ("dcf", "mic") else 1
+        if op in ("evaluate_at", "dcf", "mic", "gate"):
+            caps = 33 if op in ("dcf", "mic", "gate") else 1
             f = roofline.walk_hbm_fields(1.0, 32, "walkkernel", lpe, caps)
             return f["walk_vpu_ceiling_points_per_sec"] * PROJECTION_DERATE
         f = roofline.hier_hbm_fields(1.0, "hierkernel", lpe, 2, 32)
@@ -375,7 +384,7 @@ class CostModel:
         penalty on this choice decays (the choice is serving again)."""
         if seconds <= 0:
             return
-        op = "dcf" if w.op == "mic" else w.op
+        op = _anchor_op(w.op)
         disp = (
             w.dispatches(mode) * self.dispatch_seconds(engine)
             if engine == "device"
@@ -404,14 +413,14 @@ class CostModel:
         predictions are penalized 4x (stacking, capped 256x) until
         successful batches decay it — a flaky kernel mode routes around
         itself without being permanently blacklisted."""
-        key = ("dcf" if op == "mic" else op, engine, mode)
+        key = (_anchor_op(op), engine, mode)
         with self._lock:
             self.penalty[key] = min(self.penalty.get(key, 1.0) * 4.0, 256.0)
         _tm.counter("router.degrade_penalty", op=op)
 
     # -- prediction --------------------------------------------------------
     def candidates(self, op: str) -> Tuple[Tuple[str, Optional[str]], ...]:
-        anchor_op = "dcf" if op == "mic" else op
+        anchor_op = _anchor_op(op)
         out = [("host", None)]
         for (a_op, engine, mode) in ANCHORS:
             if a_op == anchor_op and engine == "device":
@@ -433,7 +442,7 @@ class CostModel:
                 f"unknown router op {w.op!r} (one of {_OPS})"
             )
         out: Dict[Tuple[str, Optional[str]], float] = {}
-        op = "dcf" if w.op == "mic" else w.op
+        op = _anchor_op(w.op)
         for engine, mode in self.candidates(w.op):
             rate = self.rate(w.op, engine, mode, w.value_kind, w.value_bits)
             if rate is None or rate <= 0:

@@ -13,14 +13,29 @@ Protocol (semi-honest, additive shares mod 2^64):
    bits, and sends one serialized key to each server (the byte-compatible
    wire format — servers parse, never see plaintext values).
 2. Level by level, each server batch-evaluates ALL client keys under the
-   surviving candidate prefixes (ops/hierarchical.py BatchedContext — the
-   native host engine) and sums the per-prefix shares over clients.
+   surviving candidate prefixes (ops/hierarchical.py BatchedContext) and
+   sums the per-prefix shares over clients. Server-side evaluation runs
+   through the resilient job supervisor's robust wrapper
+   (ops/supervisor.evaluate_levels_fused_robust) — the deployment path:
+   dispatch deadlines, host-oracle spot checks, and the
+   hierkernel -> fused -> jax -> numpy degradation chain come for free,
+   instead of calling the raw engine the way a quickstart would.
 3. The servers exchange their per-prefix aggregate shares (two uint64
    vectors — the only communication), reconstruct counts, and keep the
    prefixes with count >= threshold for the next level. Individual
    contributions stay hidden inside the aggregates.
 
 Run: python examples/heavy_hitters_demo.py  (CPU; a few seconds)
+
+``HH_MODE`` selects the server-side execution strategy:
+
+* ``fused`` (default) — the grouped fused advance through the robust
+  wrapper (one device program per level on hardware).
+* ``hierkernel`` — the staged hierarchical megakernel through the same
+  wrapper (single-program prefix windows; off-TPU this runs the Pallas
+  interpreter and is SLOW — it is the staged-for-tunnel A/B arm).
+* ``host`` — the raw native host engine, no supervisor (the pre-ISSUE 9
+  quickstart shape, kept as the baseline arm).
 """
 
 import collections
@@ -36,14 +51,19 @@ BITS = 16  # value width
 BITS_PER_LEVEL = 2
 NUM_CLIENTS = int(os.environ.get("HH_CLIENTS", 120))
 THRESHOLD = int(os.environ.get("HH_THRESHOLD", 8))
+HH_MODE = os.environ.get("HH_MODE", "fused")
 
 
 def main() -> int:
     from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
     from distributed_point_functions_tpu.core.params import DpfParameters
     from distributed_point_functions_tpu.core.value_types import Int
-    from distributed_point_functions_tpu.ops import hierarchical
+    from distributed_point_functions_tpu.ops import evaluator, hierarchical, supervisor
     from distributed_point_functions_tpu.protos import serialization as ser
+
+    if HH_MODE not in ("host", "fused", "hierkernel"):
+        print(f"unknown HH_MODE {HH_MODE!r} (host|fused|hierkernel)")
+        return 2
 
     rng = np.random.default_rng(2026)
 
@@ -85,19 +105,34 @@ def main() -> int:
     ctx_a = hierarchical.BatchedContext.create(dpf, keys_a)
     ctx_b = hierarchical.BatchedContext.create(dpf, keys_b)
 
+    def server_advance(ctx, level, prefixes) -> np.ndarray:
+        """One server's per-candidate shares for one level, as uint64
+        [clients, candidates] — through the robust supervisor wrapper
+        (HH_MODE fused/hierkernel) or the raw host engine (HH_MODE=host)."""
+        if HH_MODE == "host":
+            out = hierarchical.evaluate_until_batch(
+                ctx, level, prefixes, engine="host"
+            )
+            return out.astype(np.uint64)
+        limbs = supervisor.evaluate_levels_fused_robust(
+            ctx, [(level, list(prefixes))], mode=HH_MODE
+        )[0]
+        return evaluator.values_to_numpy(limbs, 64)
+
+    print(f"# server mode: {HH_MODE}" + (
+        "" if HH_MODE == "host" else " (robust supervisor wrapper)"
+    ))
     t0 = time.time()
     prefixes = []
     for level in range(n_levels):
         # Each server: shares for every candidate child prefix, summed over
         # clients (the aggregate hides individual contributions).
-        out_a = hierarchical.evaluate_until_batch(
-            ctx_a, level, prefixes, engine="host"
+        agg_a = server_advance(ctx_a, level, prefixes).sum(
+            axis=0, dtype=np.uint64
         )
-        out_b = hierarchical.evaluate_until_batch(
-            ctx_b, level, prefixes, engine="host"
+        agg_b = server_advance(ctx_b, level, prefixes).sum(
+            axis=0, dtype=np.uint64
         )
-        agg_a = out_a.astype(np.uint64).sum(axis=0, dtype=np.uint64)
-        agg_b = out_b.astype(np.uint64).sum(axis=0, dtype=np.uint64)
         # The only server-to-server exchange: two aggregate vectors.
         counts = (agg_a + agg_b).astype(np.uint64)  # mod 2^64
         n_candidates = counts.shape[0]

@@ -353,6 +353,69 @@ def test_walkkernel_program_budget(program_counter, monkeypatch):
         jax.clear_caches()  # drop cheap-circuit traces
 
 
+def test_gate_family_program_budget(program_counter):
+    """ISSUE 9 acceptance pin: every framework gate's batch_eval flattens
+    to the SAME single fused batched-DCF pass MIC uses — EXACTLY one
+    device program per key chunk in walk mode (here: one chunk = one
+    program per call, multi-component keys included), and serving a gate
+    through the front door launches exactly the programs the direct
+    robust call launches (routing, GatePlan combine, and slicing are all
+    host-side)."""
+    from distributed_point_functions_tpu import gates, serving
+    from distributed_point_functions_tpu.ops import supervisor
+
+    relu = gates.ReluGate.create(6)
+    rk, _ = relu.gen(11, [3])
+    bits = gates.BitDecompositionGate.create(6)
+    bk, _ = bits.gen(45, [0] * 6)
+    xs = [0, 9, 32, 63]
+
+    for name, gate, key, want in (
+        ("relu.batch_eval[4 components]", relu, rk, 1),
+        ("bitdecomp.batch_eval[6 components]", bits, bk, 1),
+    ):
+        fn = lambda: gate.batch_eval(key, xs, mode="walk")  # noqa: B023
+        fn()  # warm: compiles allowed
+        program_counter["programs"] = 0
+        fn()
+        got = program_counter["programs"]
+        assert got == want, (
+            f"{name}: {got} device programs (pinned at EXACTLY {want} — "
+            "the framework exists so every gate is ONE fused DCF pass)"
+        )
+
+    def direct():
+        supervisor.gate_batch_eval_robust(relu, rk, xs, pipeline=False)
+
+    direct()  # warm: compiles + spot-check oracle caches
+    program_counter["programs"] = 0
+    direct()
+    direct_count = program_counter["programs"]
+    assert direct_count >= 1
+
+    def door_pass():
+        door = serving.FrontDoor(
+            engine="device", max_wait_ms=1e6, width_target=4,
+            pipeline=False,
+        )
+        with door:
+            futs = [
+                door.submit(serving.Request.gate(relu, rk, [x])) for x in xs
+            ]
+            door.batcher.pump(force=True)
+            for f in futs:
+                f.result(120)
+
+    door_pass()  # warm
+    program_counter["programs"] = 0
+    door_pass()
+    assert program_counter["programs"] == direct_count, (
+        f"front door launched {program_counter['programs']} device "
+        f"programs vs {direct_count} for the direct robust gate call — "
+        "serving must add zero dispatches"
+    )
+
+
 @pytest.mark.slow
 def test_hierkernel_program_budget(program_counter, monkeypatch):
     """ISSUE 5: mode='hierkernel' is EXACTLY ceil(levels / W) device

@@ -1,0 +1,373 @@
+"""FSS gate framework (ISSUE 9): the shared mod-N edge-case suite every
+gate runs through once, plus framework plumbing (wire format, robust
+wrapper, serving, bundle eval).
+
+The edge matrix is parameterized ONCE over the family instead of
+per-gate copies: wraparound input masks (r_in at 0, 1, 2^n-1, the sign
+boundary), boundary inputs at interval endpoints, BOTH parties, and
+exact-Python-int plaintext oracles. Each gate family compiles exactly one
+XLA program (shapes are constant across masks/parties: the mask only
+changes key *values*), and everything runs the walk-mode device path or
+pure host arithmetic — ZERO pallas interpret configs, per the walkkernel
+compile-budget lesson (the kernel path itself is covered by the MIC
+walkkernel differentials in test_mic_gate.py; every gate flattens through
+the same GatePlan onto the same program family, pinned by
+test_dispatch_audit.py::test_gate_family_program_budget).
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import gates
+from distributed_point_functions_tpu.gates import framework
+from distributed_point_functions_tpu.utils.errors import InvalidArgumentError
+
+RNG = np.random.default_rng(0x6A7E)
+
+
+# ---------------------------------------------------------------------------
+# The family matrix: (name, log_group_size, make_gate, oracle, out_modulus)
+# ---------------------------------------------------------------------------
+# oracle(gate, x_real) -> the exact plaintext outputs (Python ints).
+
+
+def _mic_oracle(gate, xr):
+    return [1 if p <= xr <= q else 0 for p, q in gate.intervals]
+
+
+def _drelu_oracle(gate, xr):
+    return [1 if xr < gate.n // 2 else 0]
+
+
+def _spline_oracle(gate, xr):
+    n = gate.n
+    y = 0
+    for (p, q), cs in zip(gate.intervals, gate.coefficients):
+        if p <= xr <= q:
+            y = (y + sum(c * pow(xr, j, n) for j, c in enumerate(cs))) % n
+    return [y]
+
+
+def _bits_oracle(gate, xr):
+    return [(xr >> j) & 1 for j in range(gate.log_group_size)]
+
+
+LG = 6  # one group size across the family: shapes shared where K matches
+N = 1 << LG
+
+FAMILY = [
+    # MIC: intervals hitting 0, the sign boundary, n-1, and a singleton.
+    (
+        "mic",
+        lambda: gates.MultipleIntervalContainmentGate.create(
+            LG, [(0, N // 4), (N // 4 + 1, N // 2), (7, 7)]
+        ),
+        _mic_oracle,
+        None,  # mod n outputs
+    ),
+    ("drelu", lambda: gates.DReluGate.create(LG), _drelu_oracle, None),
+    (
+        "relu",
+        lambda: gates.ReluGate.create(LG),
+        lambda g, xr: [max(0, g.to_signed(xr)) % g.n],
+        None,
+    ),
+    (
+        "spline",
+        lambda: gates.SplineGate.create(
+            LG,
+            [(0, 9), (10, N // 2 - 1), (N // 2, N - 1)],
+            [[3, 1, 2], [7, 0, 1], [1, 5, 0]],
+        ),
+        _spline_oracle,
+        None,
+    ),
+    (
+        "bitdecomp",
+        lambda: gates.BitDecompositionGate.create(LG),
+        _bits_oracle,
+        2,  # boolean output shares
+    ),
+]
+
+#: wraparound masks: zero, minimal, maximal (full wrap), both sides of
+#: the sign boundary.
+EDGE_MASKS = (0, 1, N - 1, N // 2, N // 2 - 1)
+
+#: boundary x_real values: domain ends, the sign boundary (the DReLU/
+#: ReLU knot from both sides), and a spline/MIC knot. Exactly 5 so the
+#: widest site count (MIC/spline: 5 x 6 sites = 30 points) stays within
+#: one 32-point pad — every K=1 family (MIC + DReLU) and every K-matched
+#: pair below shares ONE compiled XLA program per party (the compile-
+#: budget discipline; the wraparound masks shift every knot's
+#: neighborhood through the points anyway).
+EDGE_INPUTS = (0, 9, N // 2 - 1, N // 2, N - 1)
+
+
+def _reconstruct(gate, out0, out1, r_outs, out_mod):
+    n = gate.n
+    vals = []
+    for j in range(gate.num_outputs):
+        mod = out_mod or n
+        vals.append((int(out0[j]) + int(out1[j]) - int(r_outs[j])) % mod)
+    return vals
+
+
+def _r_outs(gate, out_mod):
+    hi = out_mod or gate.n
+    return [int(r) for r in RNG.integers(0, hi, size=gate.num_outputs)]
+
+
+@pytest.mark.parametrize("name,make,oracle,out_mod", FAMILY, ids=[f[0] for f in FAMILY])
+def test_gate_mod_n_edges_both_parties(name, make, oracle, out_mod):
+    """The shared edge suite: every wraparound mask x boundary input,
+    both parties' batch_eval (ONE fused device pass per party per mask —
+    constant shapes, one XLA compile per gate family) recombined against
+    the exact-int plaintext oracle."""
+    gate = make()
+    n = gate.n
+    for r_in in EDGE_MASKS:
+        r_outs = _r_outs(gate, out_mod)
+        k0, k1 = gate.gen(r_in, r_outs)
+        xs = [(xr + r_in) % n for xr in EDGE_INPUTS]
+        out0 = gate.batch_eval(k0, xs)
+        out1 = gate.batch_eval(k1, xs)
+        assert out0.shape == (len(xs), gate.num_outputs)
+        for xi, xr in enumerate(EDGE_INPUTS):
+            got = _reconstruct(gate, out0[xi], out1[xi], r_outs, out_mod)
+            want = [int(v) % (out_mod or n) for v in oracle(gate, xr)]
+            assert got == want, (name, r_in, xr, got, want)
+
+
+@pytest.mark.parametrize(
+    "name,make,oracle,out_mod", FAMILY[1:], ids=[f[0] for f in FAMILY[1:]]
+)
+def test_gate_eval_matches_batch_eval(name, make, oracle, out_mod):
+    """The per-point host path (reference-parity DCF walks, pure Python
+    ints) agrees with the fused batch path share for share — the
+    framework's two eval templates cannot drift. One wraparound mask, a
+    few inputs, both parties. (MIC's own suite pins this already.)"""
+    gate = make()
+    n = gate.n
+    r_in = n - 1
+    r_outs = _r_outs(gate, out_mod)
+    k0, k1 = gate.gen(r_in, r_outs)
+    xs = [0, 5, n - 1]
+    for key in (k0, k1):
+        batch = gate.batch_eval(key, xs)
+        for xi, x in enumerate(xs):
+            single = gate.eval(key, x)
+            assert [int(v) for v in batch[xi]] == [int(v) for v in single], (
+                name, x,
+            )
+
+
+def test_gate_host_engine_matches_device():
+    """engine='host' (native AES-NI wide kernel) produces bit-identical
+    shares to the device pass for a multi-component gate."""
+    from distributed_point_functions_tpu import native
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    gate = gates.ReluGate.create(LG)
+    k0, k1 = gate.gen(17, [5])
+    xs = [0, 13, 31, 32, 63]
+    for key in (k0, k1):
+        dev = gate.batch_eval(key, xs)
+        host = gate.batch_eval(key, xs, engine="host")
+        assert (dev == host).all()
+
+
+def test_gate_robust_wrapper_matches_direct():
+    """supervisor.gate_batch_eval_robust == direct batch_eval for a
+    framework gate (the generic form of the MIC wrapper: same GatePlan
+    flatten, the DCF chain + host-oracle spot checks underneath)."""
+    from distributed_point_functions_tpu.ops import supervisor
+
+    gate = gates.BitDecompositionGate.create(LG)
+    r_outs = [int(b) for b in RNG.integers(0, 2, size=LG)]
+    k0, k1 = gate.gen(N - 1, r_outs)
+    xs = [0, 9, 32, 63]
+    for key in (k0, k1):
+        direct = gate.batch_eval(key, xs)
+        robust = supervisor.gate_batch_eval_robust(gate, key, xs)
+        assert (direct == robust).all()
+    # reconstruction sanity on the robust outputs
+    r0 = supervisor.gate_batch_eval_robust(gate, k0, xs)
+    r1 = supervisor.gate_batch_eval_robust(gate, k1, xs)
+    for xi, x in enumerate(xs):
+        xr = (x - (N - 1)) % N
+        bits = gates.BitDecompositionGate.reconstruct_bits(r0[xi], r1[xi], r_outs)
+        assert bits == [(xr >> j) & 1 for j in range(LG)]
+
+
+def test_bundle_eval_one_key_per_input():
+    """bundle_eval: per-activation keys and inputs in ONE fused pass
+    agree with per-key batch_eval calls (the secure-ML layer shape)."""
+    gate = gates.ReluGate.create(LG)
+    n = gate.n
+    b = 4
+    keys0, keys1, r_ins, r_outs = [], [], [], []
+    for _ in range(b):
+        ri = int(RNG.integers(0, n))
+        ro = int(RNG.integers(0, n))
+        k0, k1 = gate.gen(ri, [ro])
+        keys0.append(k0)
+        keys1.append(k1)
+        r_ins.append(ri)
+        r_outs.append(ro)
+    x_real = [int(v) for v in RNG.integers(-(n // 2), n // 2, size=b)]
+    xs = [(gate.signed_lift(v) + ri) % n for v, ri in zip(x_real, r_ins)]
+    o0 = framework.bundle_eval(gate, keys0, xs)
+    o1 = framework.bundle_eval(gate, keys1, xs)
+    for i in range(b):
+        per_key = gate.batch_eval(keys0[i], [xs[i]])  # shares the K=4 family
+        assert int(per_key[0, 0]) == int(o0[i, 0])
+        got = gate.to_signed((int(o0[i, 0]) + int(o1[i, 0]) - r_outs[i]) % n)
+        assert got == max(0, x_real[i]), (i, got)
+    with pytest.raises(InvalidArgumentError):
+        framework.bundle_eval(gate, keys0, xs[:-1])
+
+
+def test_gate_key_wire_roundtrip_and_mic_superset():
+    """serialize_gate_key/parse_gate_key round-trips a multi-component
+    key, and a one-component GateKey serializes BYTE-IDENTICALLY to the
+    MicKey message carrying the same material — the framework wire form
+    is a superset of the reference's gate proto, not a fork."""
+    from distributed_point_functions_tpu.protos import serialization as ser
+
+    # The FAMILY spline config: its (K=9, 32-point) program family is
+    # already compiled by the edge suite — zero new programs here.
+    gate = FAMILY[3][1]()
+    params = gate.dcf.dpf.validator.parameters
+    k0, _ = gate.gen(3, [7])
+    blob = ser.serialize_gate_key(k0, params)
+    back = ser.parse_gate_key(blob)
+    assert len(back.dcf_keys) == gate.num_components
+    assert back.mask_shares == k0.mask_shares
+    assert [dk.key for dk in back.dcf_keys] == [dk.key for dk in k0.dcf_keys]
+    # parsed keys still evaluate
+    assert (gate.batch_eval(back, [0, 9]) == gate.batch_eval(k0, [0, 9])).all()
+
+    mic = gates.MultipleIntervalContainmentGate.create(5, [(1, 5)])
+    mk, _ = mic.gen(2, [3])
+    as_gate = gates.GateKey([mk.dcf_key], list(mk.output_mask_shares))
+    mparams = mic.dcf.dpf.validator.parameters
+    assert ser.serialize_gate_key(as_gate, mparams) == ser.serialize_mic_key(
+        mk, mparams
+    )
+    with pytest.raises(InvalidArgumentError):
+        ser.parse_gate_key(b"")
+
+
+def test_gate_gen_deterministic_golden():
+    """gen() with an injected CounterRng + pinned component DCF seeds is
+    fully deterministic for a multi-component gate, and the serialized
+    key fingerprint is pinned — the keygen-algebra guard the MIC golden
+    test provides, extended to the framework's multi-key form."""
+    import hashlib
+
+    gate = gates.ReluGate.create(8)
+    seeds = [
+        (0x1111111122222222 + i, 0x3333333344444444 + i)
+        for i in range(gate.num_components)
+    ]
+
+    def make():
+        return gate.gen(
+            77, [5], prng=gates.CounterRng(seed=b"relu-golden"),
+            dcf_seeds=seeds,
+        )
+
+    k0_a, k1_a = make()
+    k0_b, k1_b = make()
+    assert k0_a == k0_b and k1_a == k1_b, "gen must be deterministic"
+    from distributed_point_functions_tpu.protos import serialization as ser
+
+    blob = ser.serialize_gate_key(k0_a, gate.dcf.dpf.validator.parameters)
+    digest = hashlib.sha256(blob).hexdigest()
+    # Pinned fingerprint: changes only if the keygen algebra (shifted-
+    # coefficient expansion, share draw order) or the wire format changes
+    # — both must be deliberate (regenerate after verifying the change).
+    assert digest == (
+        "502c5a0d36cc1a0ab4f562ebe5064730f81ea9883dfbc123c9f17d1b651082d5"
+    ), digest
+    # shares still reconstruct
+    n = gate.n
+    for xr in (-100, -1, 0, 1, 100):
+        x = (gate.signed_lift(xr) + 77) % n
+        e0 = gate.eval(k0_a, x)
+        e1 = gate.eval(k1_a, x)
+        assert gate.to_signed((e0[0] + e1[0] - 5) % n) == max(0, xr)
+
+
+def test_gate_validation():
+    with pytest.raises(InvalidArgumentError):
+        gates.SplineGate.create(6, [], [])
+    with pytest.raises(InvalidArgumentError):
+        gates.SplineGate.create(6, [(5, 3)], [[1]])
+    with pytest.raises(InvalidArgumentError):
+        gates.SplineGate.create(6, [(0, 64)], [[1]])
+    with pytest.raises(InvalidArgumentError):
+        gates.SplineGate.create(6, [(0, 3)], [[1], [2]])
+    with pytest.raises(InvalidArgumentError):  # ragged degrees
+        gates.SplineGate.create(6, [(0, 3), (4, 7)], [[1, 2], [1]])
+    with pytest.raises(InvalidArgumentError):  # DCF needs a real domain
+        gates.DReluGate.create(1)
+    with pytest.raises(InvalidArgumentError):
+        gates.BitDecompositionGate.create(0)
+    gate = gates.DReluGate.create(6)
+    with pytest.raises(InvalidArgumentError):  # input mask out of group
+        gate.gen(64, [0])
+    with pytest.raises(InvalidArgumentError):  # output mask out of group
+        gate.gen(0, [64])
+    with pytest.raises(InvalidArgumentError):  # r_outs count
+        gate.gen(0, [0, 1])
+    bd = gates.BitDecompositionGate.create(4)
+    with pytest.raises(InvalidArgumentError):  # boolean masks only
+        bd.gen(0, [2, 0, 0, 0])
+    k0, _ = gate.gen(0, [0])
+    with pytest.raises(InvalidArgumentError):  # masked input out of group
+        gate.batch_eval(k0, [64])
+    with pytest.raises(InvalidArgumentError):  # seeds-per-component check
+        gates.ReluGate.create(6).gen(0, [0], dcf_seeds=[(1, 2)])
+
+
+def test_gate_serving_roundtrip():
+    """The serving front door's "gate" op: requests merge into one fused
+    pass, answers slice bit-exactly vs direct batch_eval, on the auto,
+    host, and device arms (the MIC serving shape generalized)."""
+    from distributed_point_functions_tpu import serving
+
+    gate = gates.ReluGate.create(LG)
+    n = gate.n
+    k0, _ = gate.gen(11, [3])
+    xs = [0, 5, 31, 32, 63, 40]
+    want = gate.batch_eval(k0, xs)
+    for engine in ("auto", "host", "device"):
+        door = serving.FrontDoor(
+            engine=engine, max_wait_ms=1e6, width_target=4, bucket=False
+        )
+        with door:
+            futs = [
+                door.submit(serving.Request.gate(gate, k0, [x])) for x in xs
+            ]
+            door.batcher.pump(force=True)
+            got = [f.result(60) for f in futs]
+        for xi in range(len(xs)):
+            assert (np.asarray(got[xi][0]) == want[xi]).all(), (engine, xi)
+    # queue keying: same gate+key merge, different keys do not
+    k0b, _ = gate.gen(12, [4])
+    ra = serving.Request.gate(gate, k0, [1])
+    rb = serving.Request.gate(gate, k0, [2])
+    rc = serving.Request.gate(gate, k0b, [3])
+    assert ra.signature() == rb.signature()
+    assert ra.signature() != rc.signature()
+    # router model: the gate workload rides the DCF anchors with the
+    # flattened (components x sites) axes
+    w = serving.Workload(
+        op="gate", num_keys=gate.num_components, points=len(xs) * gate.num_sites,
+        value_bits=128, value_kind="u128",
+    )
+    costs = serving.CostModel().predict(w)
+    assert ("host", None) in costs and ("device", "walk") in costs

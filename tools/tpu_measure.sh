@@ -161,6 +161,19 @@ CHECK_MODE=router CHECK_SHAPES=16x14,64x18 \
   stage serving_router 900 python tools/check_device.py
 stage serving 1500 python tools/run_bench_stage.py bench_serving.py
 
+# 2b''''. FSS gate family (ISSUE 9): device gate records at production
+# batch shapes — DReLU + ReLU(spline) through the shared framework, the
+# record carrying DCF-invocations-per-gate-eval + walk roofline fields,
+# host-oracle spot verification gating `verified` (an unverified number
+# never SUPERSEDES, the bench_dcf pattern). Walk-mode record first, then
+# the walkkernel A/B (the whole gate = ONE walk-megakernel program) in
+# its own slot superseding it when verified-faster.
+BENCH_GATES_ENGINE=device \
+  stage gates 1500 python tools/run_bench_stage.py bench_gates.py
+BENCH_GATES_MODE=walkkernel \
+  stage gates_walkkernel 1500 python tools/run_bench_stage.py bench_gates.py \
+  RECORD_SUFFIX=_walkkernel SUPERSEDES=gates_relu
+
 # 2c. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
 # the pipelined chunk executor forced OFF land in their own results.json
 # slots, so the on/off pair is a first-class record pair (not just the
@@ -222,7 +235,7 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
 required="headline gate-megakernel headline_megakernel pir_megakernel \
 gate-walkkernel evaluate_at_walkkernel dcf_walkkernel \
 gate-hierkernel heavy_hitters_hierkernel \
-serving_router serving \
+serving_router serving gates gates_walkkernel \
 headline-syncexec pir-syncexec evalat dcf hh-device \
 extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
