@@ -66,6 +66,9 @@ run_smoke() {
          --platform cpu --engine auto --max_expansion_factor 4 \
          --num_iterations 1 )
   python examples/pir_demo.py --log_domain 12 --platform cpu
+  # ISSUE 10: the same query through the REAL two-server RPC stack
+  # (serving/server.py + serving/client.py) on loopback.
+  python examples/pir_demo.py --log_domain 12 --platform cpu --serve
   python examples/heavy_hitters_demo.py
 }
 
@@ -89,6 +92,14 @@ run_faults() {
   # zero Pallas configs on CPU).
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -x -m faults
   JAX_PLATFORMS=cpu python tools/chaos_soak.py --rounds 2 --seed 7
+  # ISSUE 10: the socket chaos soak — two real server subprocesses on
+  # loopback, party 0 behind the frame-aware chaos proxy, a mixed
+  # two-server workload driven through serving/client.py with seeded
+  # wire faults (conn_reset / garbage_frame / slow_server / mid-batch
+  # server_kill + journal resume). Bounded rounds, loopback only,
+  # XLA:CPU, zero new pallas configs.
+  JAX_PLATFORMS=cpu python tools/chaos_soak.py --wire --seed 7 \
+    --wire-requests 60 --wire-faults 6
 }
 
 case "$tier" in

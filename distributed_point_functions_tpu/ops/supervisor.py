@@ -1094,15 +1094,20 @@ def full_domain_evaluate_robust(
     policy: DegradationPolicy = DEFAULT_POLICY,
     pipeline: Optional[bool] = None,
     journal: Optional[str] = None,
+    journal_dir: Optional[str] = None,
 ) -> np.ndarray:
     """`degrade.full_domain_evaluate_robust` plus chunk-journal
     checkpoint/resume: with `journal` (a file path), keys run in
     `key_chunk` groups, each group's verified limbs append to the journal
     as one chunk, and a restarted job with the same fingerprint (keys
     digest + params + chunking) re-dispatches only unjournaled chunks —
-    dispatch-audit pinned. Without `journal` this delegates untouched
-    (zero added programs, zero overhead)."""
-    if journal is None:
+    dispatch-audit pinned. `journal_dir` names a directory instead and
+    derives the file name FROM the job fingerprint — the RPC server's
+    form (ISSUE 10): a SIGKILLed server restarted over the same journal
+    directory resumes any re-sent job past its verified chunks without
+    either end tracking file names. Without both, this delegates
+    untouched (zero added programs, zero overhead)."""
+    if journal is None and journal_dir is None:
         return degrade.full_domain_evaluate_robust(
             dpf, keys, hierarchy_level, key_chunk=key_chunk,
             host_levels=host_levels, policy=policy, pipeline=pipeline,
@@ -1112,6 +1117,10 @@ def full_domain_evaluate_robust(
         "full_domain_evaluate", dpf, keys, hierarchy_level, None,
         extra=(key_chunk, host_levels),
     )
+    derived = journal is None
+    if derived:
+        os.makedirs(journal_dir, exist_ok=True)
+        journal = os.path.join(journal_dir, f"fd-{fp[:32]}.journal")
     jr = ChunkJournal(journal, fp, op="full_domain_evaluate")
     outs = []
     try:
@@ -1130,4 +1139,17 @@ def full_domain_evaluate_robust(
         jr.finalize()
     finally:
         jr.close()
+    if derived:
+        # The fingerprint-derived form is the RPC server's: every
+        # distinct client batch is a new file holding the job's whole
+        # encoded result, so a long-lived server would grow disk without
+        # bound. The journal exists to survive a crash DURING the job —
+        # once the result is in hand it has done that job; worst case a
+        # crash after this unlink but before the response delivers costs
+        # one recompute, never correctness. Caller-named `journal=` paths
+        # stay, replayable at zero programs (tests pin that).
+        try:
+            os.unlink(journal)
+        except OSError:
+            pass
     return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)

@@ -342,6 +342,26 @@ def parse_evaluation_context(buf: bytes) -> EvaluationContext:
 # ---------------------------------------------------------------------------
 
 
+def serialize_dcf_parameters(log_domain_size: int, value_type) -> bytes:
+    """DcfParameters message: one DpfParameters (field 1) whose
+    log_domain_size + value_type fully determine the DCF — the per-level
+    parameter list (DpfParameters(i, value_type) for i < n) is derived at
+    Create, exactly as DistributedComparisonFunction.create derives it
+    (/root/reference/dcf/distributed_comparison_function.cc:56-62)."""
+    return wire.len_field(
+        1, encode_dpf_parameters(DpfParameters(log_domain_size, value_type))
+    )
+
+
+def parse_dcf_parameters(buf: bytes):
+    """-> (log_domain_size, value_type)."""
+    for field, _, value in wire.iter_fields(buf):
+        if field == 1:
+            p = decode_dpf_parameters(value)
+            return p.log_domain_size, p.value_type
+    raise InvalidArgumentError("DcfParameters has no parameters set")
+
+
 def serialize_dcf_key(dcf_key, parameters: Sequence[DpfParameters]) -> bytes:
     return wire.len_field(1, serialize_dpf_key(dcf_key.key, parameters))
 

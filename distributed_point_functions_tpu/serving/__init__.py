@@ -10,6 +10,7 @@ per batch — executed through the resilient job supervisor.
         limbs = fut.result(timeout=5)
 """
 
+from . import wire  # noqa: F401
 from .batcher import (  # noqa: F401
     ContinuousBatcher,
     Request,
@@ -17,7 +18,14 @@ from .batcher import (  # noqa: F401
     WarmCache,
     plan_digest,
 )
+from .client import (  # noqa: F401
+    DpfClient,
+    PartyUnavailableError,
+    RetryPolicy,
+    TwoServerClient,
+)
 from .frontdoor import FrontDoor  # noqa: F401
+from .server import DpfServer  # noqa: F401
 from .router import (  # noqa: F401
     ANCHORS,
     DISPATCH_SECONDS_PRIOR,

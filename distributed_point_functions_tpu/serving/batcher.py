@@ -48,7 +48,11 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils import telemetry as _tm
-from ..utils.errors import InvalidArgumentError, ResourceExhaustedError
+from ..utils.errors import (
+    InternalError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+)
 
 #: Ops the front door serves — the six bulk entry points plus the
 #: generic FSS gate family (ISSUE 9: any gates/framework.MaskedGate —
@@ -150,6 +154,35 @@ class Request:
     db: object = None  # pir: shared database (array or PreparedPirDatabase)
     hierarchy_level: int = -1
     future: ServedFuture = dataclasses.field(default_factory=ServedFuture)
+    #: absolute completion deadline on the ``time.perf_counter`` clock,
+    #: or None (unbounded). Set via :meth:`with_deadline`; the RPC server
+    #: sets it from the request's remaining ``deadline_ms``. The front
+    #: door sheds at admission when it already can't be met, rejects it
+    #: at flush if it expired queued, and arms the supervisor's
+    #: ``deadline_scope`` with the batch's minimum remaining budget so a
+    #: wire deadline bounds device dispatch too (ISSUE 10).
+    deadline: Optional[float] = None
+
+    def with_deadline(self, seconds: Optional[float]) -> "Request":
+        """Arms this request's completion deadline `seconds` from now
+        (None disarms); returns self for construction chaining:
+        ``Request.evaluate_at(...).with_deadline(0.25)``."""
+        if seconds is None:
+            self.deadline = None
+        else:
+            if seconds <= 0:
+                raise InvalidArgumentError(
+                    f"deadline must be > 0 seconds, got {seconds!r}"
+                )
+            self.deadline = time.perf_counter() + float(seconds)
+        return self
+
+    def remaining(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds of deadline budget left (negative = expired), or None
+        when unbounded."""
+        if self.deadline is None:
+            return None
+        return self.deadline - (time.perf_counter() if now is None else now)
 
     # -- constructors ------------------------------------------------------
     @classmethod
@@ -321,6 +354,12 @@ class ContinuousBatcher:
         self._pending = 0
         self._worker: Optional[threading.Thread] = None
         self._stop = False
+        #: the exception that killed the worker thread, once dead. A dead
+        #: worker can never flush, so a `ServedFuture.wait()` with no
+        #: timeout on anything still queued would block FOREVER — the
+        #: worker's last act is rejecting every queued future and pinning
+        #: this marker so later submits fail fast too (ISSUE 10 satellite).
+        self._dead: Optional[BaseException] = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "ContinuousBatcher":
@@ -358,6 +397,12 @@ class ContinuousBatcher:
         if width < 1:
             raise InvalidArgumentError("request carries no keys/points")
         with self._lock:
+            if self._dead is not None:
+                _tm.counter("serving.rejected", op=req.op)
+                raise InternalError(
+                    "serving batcher worker thread died: request rejected "
+                    f"(cause: {type(self._dead).__name__}: {self._dead})"
+                ) from self._dead
             if self._stop:
                 # After stop()'s final drain a queued request would never
                 # flush — fail fast like admission control, not a hang.
@@ -461,7 +506,42 @@ class ContinuousBatcher:
             flushed += 1
         return flushed
 
+    @property
+    def dead(self) -> Optional[BaseException]:
+        """The exception that killed the worker, or None while healthy —
+        the server's readiness probe reports it."""
+        return self._dead
+
+    def _mark_dead(self, exc: BaseException) -> None:
+        """The dying worker's cleanup: pin the death marker (new submits
+        fail fast), then reject every queued future — nothing else will
+        ever flush them, and their waiters may hold no timeout."""
+        with self._lock:
+            self._dead = exc
+            orphans = [
+                r for q in self._queues.values() for r in q.requests
+            ]
+            self._queues.clear()
+            self._pending = 0
+            self._cond.notify_all()
+        _tm.counter("serving.worker_death")
+        wrapped = InternalError(
+            "serving batcher worker thread died mid-service "
+            f"(cause: {type(exc).__name__}: {exc})"
+        )
+        wrapped.__cause__ = exc
+        for r in orphans:
+            _tm.counter("serving.rejected", op=r.op)
+            if not r.future.done():
+                r.future._reject(wrapped)
+
     def _run(self) -> None:
+        try:
+            self._loop()
+        except BaseException as exc:  # noqa: BLE001 — delivered per future
+            self._mark_dead(exc)
+
+    def _loop(self) -> None:
         while True:
             with self._lock:
                 if self._stop:

@@ -48,7 +48,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..utils import telemetry as _tm
-from ..utils.errors import InvalidArgumentError
+from ..utils.errors import InvalidArgumentError, UnavailableError
 from .batcher import ContinuousBatcher, Request, ServedFuture, WarmCache
 from .router import RouteDecision, Router, Workload
 
@@ -205,6 +205,7 @@ class FrontDoor:
         key_chunk: Optional[int] = None,
         cache: Optional[WarmCache] = None,
         bucket: bool = True,
+        journal_dir: Optional[str] = None,
     ):
         if engine not in ("auto", "host", "device"):
             raise InvalidArgumentError(
@@ -220,6 +221,12 @@ class FrontDoor:
         #: powers of two so flushes reuse compiled programs instead of
         #: compiling one per distinct merged width.
         self.bucket = bucket
+        #: directory for full-domain chunk journals (ISSUE 10): robust
+        #: full-domain batches journal verified chunks under a
+        #: fingerprint-derived file name, so a SIGKILLed server restarted
+        #: over the same directory resumes a re-sent job past its
+        #: verified chunks. None = no journaling (zero overhead).
+        self.journal_dir = journal_dir
         self.cache = cache or WarmCache()
         if policy is None:
             from ..ops import degrade
@@ -254,7 +261,47 @@ class FrontDoor:
 
     # -- submission --------------------------------------------------------
     def submit(self, request: Request) -> ServedFuture:
+        self._shed_check(request)
         return self.batcher.submit(request)
+
+    def _shed_check(self, request: Request) -> None:
+        """Deadline-aware admission (ISSUE 10 satellite): reject NOW when
+        the predicted completion — the batcher's queue-wait bound plus
+        the router's cheapest predicted wall for this request alone —
+        already exceeds the request's deadline. Richer than bounded depth:
+        a doomed request never occupies a queue slot, and the client's
+        fail-fast arrives a full queue-wait earlier than the expiry
+        would. Prediction uses the single-request workload (its merged
+        batch can only be wider, and a wider batch is never cheaper for
+        THIS request's rows), the queue bound is ``max_wait`` (a flush
+        happens at the latest then), and a cheapest-candidate estimate
+        under-promises rather than over-sheds."""
+        remaining = request.remaining()
+        if remaining is None:
+            return
+        union = (
+            _union([request.points])
+            if request.op in ("evaluate_at", "dcf", "mic", "gate")
+            else None
+        )
+        try:
+            costs = self.router.model.predict(self._workload([request], union))
+        except InvalidArgumentError:
+            costs = {}
+        if self.engine != "auto":
+            forced = {k: v for k, v in costs.items() if k[0] == self.engine}
+            costs = forced or costs
+        predicted = min(costs.values()) if costs else 0.0
+        if self.batcher.max_wait + predicted <= remaining:
+            return
+        _tm.counter("serving.shed_deadline", op=request.op)
+        raise UnavailableError(
+            f"DEADLINE_EXCEEDED: {request.op} shed at admission — "
+            f"predicted completion {self.batcher.max_wait + predicted:.3f}s "
+            f"(queue-wait bound {self.batcher.max_wait:.3f}s + predicted "
+            f"wall {predicted:.3f}s) exceeds the {remaining:.3f}s of "
+            "deadline budget remaining"
+        )
 
     def serve(
         self, requests: Sequence[Request], timeout: Optional[float] = None
@@ -346,6 +393,31 @@ class FrontDoor:
         """The batcher's flush callback: route, run, learn, slice."""
         import time
 
+        from ..ops import supervisor as _sv
+
+        # Requests whose deadline expired while queued are rejected
+        # before the batch runs — the wire contract promises fail-fast,
+        # and running them would spend device time on an answer nobody
+        # can use. Survivors' minimum remaining budget arms the
+        # supervisor's deadline_scope below.
+        now = time.perf_counter()
+        live: List[Request] = []
+        budget: Optional[float] = None
+        for r in reqs:
+            remaining = r.remaining(now)
+            if remaining is not None and remaining <= 0:
+                _tm.counter("serving.shed_deadline", op=r.op)
+                r.future._reject(UnavailableError(
+                    f"DEADLINE_EXCEEDED: {r.op} request expired while "
+                    f"queued ({-remaining:.3f}s past its deadline at flush)"
+                ))
+                continue
+            if remaining is not None:
+                budget = remaining if budget is None else min(budget, remaining)
+            live.append(r)
+        if not live:
+            return
+        reqs = live
         # The merged point union is shared by the router's point count
         # and the runner's slicing map — computed once per batch.
         union = (
@@ -358,9 +430,15 @@ class FrontDoor:
         with _tm.span("serving.execute", op=w.op, choice=decision.choice):
             with _tm.capture(ring=2048) as tel:
                 t0 = time.perf_counter()
-                results = self._run(
-                    reqs, decision.engine, decision.mode, union
-                )
+                # budget=None passes through (the env default keeps
+                # ruling); armed, every per-chunk device wait in this
+                # batch is bounded by the batch's tightest remaining wire
+                # deadline — the ISSUE 10 propagation: a wire deadline
+                # bounds device dispatch, not just the socket wait.
+                with _sv.deadline_scope(budget):
+                    results = self._run(
+                        reqs, decision.engine, decision.mode, union
+                    )
                 seconds = time.perf_counter() - t0
         self._learn(w, decision, seconds, tel)
         for r, value in zip(reqs, results):
@@ -417,7 +495,7 @@ class FrontDoor:
         elif self.robust:
             out = supervisor.full_domain_evaluate_robust(
                 dpf, keys, hl, key_chunk=ck, policy=self.policy,
-                pipeline=self.pipeline,
+                pipeline=self.pipeline, journal_dir=self.journal_dir,
             )
         else:
             prepared = self.cache.key_batch(dpf, keys, hl, key_chunk=ck)

@@ -1,0 +1,628 @@
+"""Length-prefixed socket framing + op payload codecs for the two-server
+RPC boundary (ISSUE 10).
+
+The FSS deployment model is two non-colluding *network* servers (Poplar,
+S&P 2021): each holds one key of every pair, and end-to-end reliability is
+dominated by the service boundary, not the kernels. This module is that
+boundary's wire layer — deliberately dependency-free (sockets + the
+existing protobuf-compatible key formats), so a conforming client in any
+language needs only the reference's proto definitions plus the 18-byte
+frame header below.
+
+Frame layout (all integers little-endian)::
+
+    magic    4 bytes  b"DPF1"
+    version  u8       PROTO_VERSION — checked on EVERY frame, pinned by
+                      the HELLO handshake
+    type     u8       frame type (T_*)
+    id       u64      request id; responses echo the request's id
+    body_len u32      bytes of body that follow (bounded by max_body)
+    body     ...      type-specific payload
+
+Body payloads reuse protos/wire.py's proto3 primitives, and key material
+crosses the wire in the byte-compatible protos/serialization messages
+(DpfKey / DcfKey / MicKey) — the same blobs the reference library parses.
+Request bodies carry an explicit **deadline_ms** (remaining budget, not an
+absolute time: the two ends' clocks never need agreement); the server
+re-anchors it on receipt and propagates the remainder into the
+supervisor's ``deadline_scope`` so a wire deadline bounds device dispatch
+too.
+
+Robustness contract (pinned by tests/test_wire.py):
+
+* a frame with a bad magic, a truncated header/body, or a body over
+  ``max_body`` raises :class:`FrameError` (a ``DataLossError``) — the
+  stream is unrecoverable past it and the connection must be dropped;
+* a clean EOF at a frame boundary reads as ``None`` (orderly close);
+* a version mismatch is detected on the first frame and answered with
+  ``FAILED_PRECONDITION`` before any payload is parsed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.params import DpfParameters
+from ..protos import serialization
+from ..protos import wire as pb
+from ..utils.errors import (
+    DataLossError,
+    DpfError,
+    FailedPreconditionError,
+    InternalError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+# ---------------------------------------------------------------------------
+# Frame header
+# ---------------------------------------------------------------------------
+
+MAGIC = b"DPF1"
+PROTO_VERSION = 1
+
+_HEADER = struct.Struct("<4sBBQI")
+HEADER_BYTES = _HEADER.size  # 18
+
+#: Default body-size bound. Responses carry limb arrays (a full-domain
+#: answer at 2^20 x u128 is 16 MiB); requests are key blobs. 64 MiB keeps
+#: a garbage length prefix from allocating the machine away while leaving
+#: every real payload comfortable headroom.
+DEFAULT_MAX_BODY = 64 << 20
+
+# Frame types.
+T_HELLO = 1       # client -> server: version handshake
+T_HELLO_OK = 2    # server -> client: handshake accepted
+T_REQUEST = 3     # client -> server: one op request
+T_RESPONSE = 4    # server -> client: the op's result arrays
+T_ERROR = 5       # server -> client: structured failure (code + message)
+T_HEALTH = 6      # client -> server: health/readiness probe
+T_HEALTH_OK = 7   # server -> client: JSON health body
+T_STATS = 8       # client -> server: telemetry-counter probe
+T_STATS_OK = 9    # server -> client: JSON counters body
+
+FRAME_TYPES = (
+    T_HELLO, T_HELLO_OK, T_REQUEST, T_RESPONSE, T_ERROR,
+    T_HEALTH, T_HEALTH_OK, T_STATS, T_STATS_OK,
+)
+
+# Status codes on T_ERROR frames (the gRPC/absl numbering, matching
+# utils/errors.py's absl mirrors).
+OK = 0
+INVALID_ARGUMENT = 3
+DEADLINE_EXCEEDED = 4
+RESOURCE_EXHAUSTED = 8
+FAILED_PRECONDITION = 9
+INTERNAL = 13
+UNAVAILABLE = 14
+DATA_LOSS = 15
+
+_CODE_TO_ERROR = {
+    INVALID_ARGUMENT: InvalidArgumentError,
+    DEADLINE_EXCEEDED: UnavailableError,  # message keeps DEADLINE_EXCEEDED
+    RESOURCE_EXHAUSTED: ResourceExhaustedError,
+    FAILED_PRECONDITION: FailedPreconditionError,
+    INTERNAL: InternalError,
+    UNAVAILABLE: UnavailableError,
+    DATA_LOSS: DataLossError,
+}
+
+
+class FrameError(DataLossError):
+    """The byte stream is no longer a valid frame sequence (bad magic,
+    truncation mid-frame, oversized body, unknown type). The only safe
+    recovery is dropping the connection — framing has no resync point."""
+
+
+def status_for_exception(exc: BaseException) -> int:
+    """Wire status code for a library exception (server-side mapping).
+    Deadline expiries travel as UnavailableError with a DEADLINE_EXCEEDED
+    prefix (the supervisor's watchdog convention) — give them their own
+    code so clients can fail fast instead of retrying a lost cause."""
+    if isinstance(exc, UnavailableError):
+        if "DEADLINE_EXCEEDED" in str(exc):
+            return DEADLINE_EXCEEDED
+        return UNAVAILABLE
+    if isinstance(exc, ResourceExhaustedError):
+        return RESOURCE_EXHAUSTED
+    if isinstance(exc, InvalidArgumentError):
+        return INVALID_ARGUMENT
+    if isinstance(exc, FailedPreconditionError):
+        return FAILED_PRECONDITION
+    if isinstance(exc, DataLossError):
+        return DATA_LOSS
+    return INTERNAL
+
+
+def exception_for_status(code: int, message: str) -> DpfError:
+    """Client-side inverse of :func:`status_for_exception`."""
+    cls = _CODE_TO_ERROR.get(code, InternalError)
+    exc = cls(message)
+    exc.wire_status = code  # type: ignore[attr-defined]
+    return exc
+
+
+#: Status codes a client may retry (with backoff). RESOURCE_EXHAUSTED is
+#: the server's explicit backpressure signal — admission control said
+#: "later", not "never". DEADLINE_EXCEEDED, INVALID_ARGUMENT etc. fail
+#: fast: retrying cannot change the outcome.
+RETRYABLE_STATUSES = frozenset({UNAVAILABLE, RESOURCE_EXHAUSTED})
+
+
+@dataclasses.dataclass
+class Frame:
+    ftype: int
+    request_id: int
+    body: bytes = b""
+    version: int = PROTO_VERSION
+
+
+def encode_frame(
+    ftype: int, request_id: int, body: bytes = b"",
+    version: int = PROTO_VERSION,
+) -> bytes:
+    if ftype not in FRAME_TYPES:
+        raise InvalidArgumentError(f"unknown frame type {ftype}")
+    return _HEADER.pack(MAGIC, version, ftype, request_id, len(body)) + body
+
+
+def write_frame(
+    sock: socket.socket, ftype: int, request_id: int, body: bytes = b"",
+    version: int = PROTO_VERSION,
+) -> None:
+    sock.sendall(encode_frame(ftype, request_id, body, version=version))
+
+
+def _recv_exact(sock: socket.socket, n: int, what: str, any_read: bool):
+    """Reads exactly n bytes; returns None on clean EOF at offset 0 when
+    ``any_read`` is False (frame boundary), raises FrameError on EOF
+    mid-way (a torn frame — the peer died or sent garbage lengths)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0 and not any_read:
+                return None
+            raise FrameError(
+                f"connection closed mid-frame while reading {what} "
+                f"({got}/{n} bytes)"
+            )
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(
+    sock: socket.socket, max_body: int = DEFAULT_MAX_BODY,
+    check_version: bool = True,
+) -> Optional[Frame]:
+    """One frame off the socket, or None on orderly EOF. FrameError on
+    any framing violation; socket timeouts propagate as socket.timeout
+    (the caller's per-attempt timeout seam)."""
+    raw = _recv_exact(sock, HEADER_BYTES, "frame header", any_read=False)
+    if raw is None:
+        return None
+    magic, version, ftype, request_id, body_len = _HEADER.unpack(raw)
+    if magic != MAGIC:
+        raise FrameError(
+            f"bad frame magic {magic!r} (expected {MAGIC!r}): peer is not "
+            "speaking the DPF wire protocol, or the stream lost sync"
+        )
+    if ftype not in FRAME_TYPES:
+        raise FrameError(f"unknown frame type {ftype}")
+    if body_len > max_body:
+        raise FrameError(
+            f"frame body of {body_len} bytes exceeds the {max_body}-byte "
+            "bound (oversized-frame rejection)"
+        )
+    if check_version and version != PROTO_VERSION:
+        raise FrameError(
+            f"frame version {version} != supported {PROTO_VERSION}"
+        )
+    body = b"" if body_len == 0 else _recv_exact(
+        sock, body_len, "frame body", any_read=True
+    )
+    return Frame(ftype=ftype, request_id=request_id, body=body,
+                 version=version)
+
+
+# ---------------------------------------------------------------------------
+# Op identifiers
+# ---------------------------------------------------------------------------
+
+#: The six bulk entry points served over the wire (the generic in-process
+#: ``gate`` op needs a per-class config codec and stays in-process; MIC —
+#: the reference's own gate message — rides the wire).
+WIRE_OPS = ("full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical")
+
+_OP_TO_ID = {name: i + 1 for i, name in enumerate(WIRE_OPS)}
+_ID_TO_OP = {i: name for name, i in _OP_TO_ID.items()}
+
+
+# ---------------------------------------------------------------------------
+# Request / response envelope bodies
+# ---------------------------------------------------------------------------
+
+
+def encode_request_body(op: str, payload: bytes, deadline_ms: int = 0) -> bytes:
+    """T_REQUEST body: op id (1), deadline_ms remaining (2), payload (3).
+    deadline_ms=0 means no deadline."""
+    if op not in _OP_TO_ID:
+        raise InvalidArgumentError(
+            f"op {op!r} is not servable over the wire (one of {WIRE_OPS})"
+        )
+    if deadline_ms < 0:
+        raise InvalidArgumentError("deadline_ms must be >= 0")
+    out = pb.uint64_field(1, _OP_TO_ID[op])
+    out += pb.uint64_field(2, int(deadline_ms))
+    out += pb.len_field(3, payload)
+    return out
+
+
+def decode_request_body(buf: bytes) -> Tuple[str, int, bytes]:
+    op_id = deadline_ms = 0
+    payload = b""
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            op_id = value
+        elif field == 2:
+            deadline_ms = value
+        elif field == 3:
+            payload = value
+    op = _ID_TO_OP.get(op_id)
+    if op is None:
+        raise InvalidArgumentError(f"request carries unknown op id {op_id}")
+    return op, int(deadline_ms), payload
+
+
+def encode_error_body(code: int, message: str) -> bytes:
+    return pb.uint64_field(1, code) + pb.len_field(
+        2, message.encode("utf-8", "replace")
+    )
+
+
+def decode_error_body(buf: bytes) -> Tuple[int, str]:
+    code = 0
+    message = b""
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            code = value
+        elif field == 2:
+            message = value
+    return int(code), message.decode("utf-8", "replace")
+
+
+# ---------------------------------------------------------------------------
+# Arrays (response payloads)
+# ---------------------------------------------------------------------------
+
+
+def _encode_array(a: np.ndarray) -> bytes:
+    """Array message: dtype (1), shape packed varints (2), raw
+    little-endian bytes (3) for numeric dtypes, repeated value-integers
+    (4) for object arrays (the gate ops' exact-int share values)."""
+    a = np.asarray(a)
+    shape = b"".join(pb.encode_varint(int(d)) for d in a.shape)
+    if a.dtype == object:
+        out = pb.len_field(1, b"object")
+        out += pb.len_field(2, shape)
+        for v in a.reshape(-1):
+            out += pb.len_field(4, serialization._encode_value_integer(int(v)))
+        return out
+    data = np.ascontiguousarray(a)
+    if data.dtype.byteorder == ">":  # wire format is little-endian
+        data = data.astype(data.dtype.newbyteorder("<"))
+    out = pb.len_field(1, data.dtype.str.encode("ascii"))
+    out += pb.len_field(2, shape)
+    out += pb.len_field(3, data.tobytes())
+    return out
+
+
+def _decode_shape(buf: bytes) -> Tuple[int, ...]:
+    shape = []
+    pos = 0
+    while pos < len(buf):
+        d, pos = pb.decode_varint(buf, pos)
+        shape.append(d)
+    return tuple(shape)
+
+
+def _decode_array(buf: bytes) -> np.ndarray:
+    dtype_s = b""
+    shape: Tuple[int, ...] = ()
+    data = None
+    objs: List[int] = []
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            dtype_s = value
+        elif field == 2:
+            shape = _decode_shape(value)
+        elif field == 3:
+            data = value
+        elif field == 4:
+            objs.append(serialization._decode_value_integer(value))
+    if dtype_s == b"object":
+        out = np.empty(len(objs), dtype=object)
+        out[:] = objs
+        return out.reshape(shape)
+    if data is None:
+        raise DataLossError("array message has no data")
+    dtype = np.dtype(dtype_s.decode("ascii"))
+    expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if len(data) != expect:
+        raise DataLossError(
+            f"array data is {len(data)} bytes but shape {shape} x "
+            f"{dtype} needs {expect}"
+        )
+    return np.frombuffer(data, dtype=dtype).reshape(shape).copy()
+
+
+def encode_result_arrays(arrays: Sequence[np.ndarray]) -> bytes:
+    """T_RESPONSE body: repeated array messages (field 1) — a single
+    array for most ops, one per plan entry for hierarchical."""
+    return b"".join(pb.len_field(1, _encode_array(a)) for a in arrays)
+
+
+def decode_result_arrays(buf: bytes) -> List[np.ndarray]:
+    return [
+        _decode_array(v) for f, _, v in pb.iter_fields(buf) if f == 1
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Op payload codecs
+# ---------------------------------------------------------------------------
+#
+# Every payload that carries DPF keys also carries the full DpfParameters
+# list (repeated field 1) — the server reconstructs the cryptographic
+# object from parameters alone (pure validator construction; keygen never
+# happens server-side), and the key blobs are the byte-compatible
+# serialization messages the reference library produces.
+
+
+def _encode_params(parameters: Sequence[DpfParameters]) -> bytes:
+    return b"".join(
+        pb.len_field(1, serialization.encode_dpf_parameters(p))
+        for p in parameters
+    )
+
+
+def _encode_points(field: int, points: Sequence[int]) -> bytes:
+    return b"".join(
+        pb.len_field(field, serialization._encode_value_integer(int(x)))
+        for x in points
+    )
+
+
+def _int32_field_explicit(field: int, value: int) -> bytes:
+    """int32 with EXPLICIT presence — emitted even when 0. The API
+    default for hierarchy_level is -1 (last level), so an absent field
+    decodes as -1; a client that means level 0 must say so. Plain
+    proto3 `int32_field` omits 0, which here would silently flip a
+    level-0 request to last-level."""
+    if value < 0:
+        value += 1 << 64
+    return pb.tag(field, pb.VARINT) + pb.encode_varint(value)
+
+
+def encode_full_domain(
+    parameters: Sequence[DpfParameters], keys: Sequence,
+    hierarchy_level: int = -1,
+) -> bytes:
+    out = _encode_params(parameters)
+    for k in keys:
+        out += pb.len_field(2, serialization.serialize_dpf_key(k, parameters))
+    out += _int32_field_explicit(3, hierarchy_level)
+    return out
+
+
+def decode_full_domain(buf: bytes):
+    parameters: List[DpfParameters] = []
+    keys = []
+    hierarchy_level = -1  # absent field = the API default (last level)
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            parameters.append(serialization.decode_dpf_parameters(value))
+        elif field == 2:
+            keys.append(serialization.parse_dpf_key(value))
+        elif field == 3:
+            hierarchy_level = pb.decode_int32(value)
+    if not parameters or not keys:
+        raise InvalidArgumentError("full_domain payload needs params + keys")
+    return parameters, keys, hierarchy_level
+
+
+def encode_evaluate_at(
+    parameters: Sequence[DpfParameters], keys: Sequence,
+    points: Sequence[int], hierarchy_level: int = -1,
+) -> bytes:
+    out = encode_full_domain(parameters, keys, hierarchy_level)
+    out += _encode_points(4, points)
+    return out
+
+
+def decode_evaluate_at(buf: bytes):
+    # evaluate_at extends full_domain's fields with the point list (4).
+    parameters, keys, points = [], [], []
+    hierarchy_level = -1  # absent field = the API default (last level)
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            parameters.append(serialization.decode_dpf_parameters(value))
+        elif field == 2:
+            keys.append(serialization.parse_dpf_key(value))
+        elif field == 3:
+            hierarchy_level = pb.decode_int32(value)
+        elif field == 4:
+            points.append(serialization._decode_value_integer(value))
+    if not parameters or not keys:
+        raise InvalidArgumentError("evaluate_at payload needs params + keys")
+    return parameters, keys, points, hierarchy_level
+
+
+def encode_dcf(
+    log_domain_size: int, value_type, keys: Sequence, xs: Sequence[int],
+) -> bytes:
+    """DCF request: the (log_domain_size, value_type) pair reconstructs
+    the DistributedComparisonFunction (its per-level DpfParameters are
+    derived, the reference's DcfParameters message —
+    protos/serialization.serialize_dcf_parameters); keys are DcfKey
+    messages against the derived parameter list."""
+    parameters = [
+        DpfParameters(i, value_type) for i in range(log_domain_size)
+    ]
+    out = pb.len_field(
+        1, serialization.serialize_dcf_parameters(log_domain_size, value_type)
+    )
+    for k in keys:
+        out += pb.len_field(2, serialization.serialize_dcf_key(k, parameters))
+    out += _encode_points(3, xs)
+    return out
+
+
+def decode_dcf(buf: bytes):
+    log_domain_size = None
+    value_type = None
+    key_blobs: List[bytes] = []
+    xs: List[int] = []
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            log_domain_size, value_type = serialization.parse_dcf_parameters(
+                value
+            )
+        elif field == 2:
+            key_blobs.append(value)
+        elif field == 3:
+            xs.append(serialization._decode_value_integer(value))
+    if log_domain_size is None or not key_blobs:
+        raise InvalidArgumentError("dcf payload needs parameters + keys")
+    keys = [serialization.parse_dcf_key(b) for b in key_blobs]
+    return log_domain_size, value_type, keys, xs
+
+
+def encode_mic(
+    log_group_size: int, intervals, key, xs: Sequence[int],
+) -> bytes:
+    """MIC request: MicParameters (1) + MicKey (2) + masked inputs (3).
+    The MicKey message needs the gate's derived DCF parameter list, which
+    MicParameters fully determines (log_group_size -> per-level params)."""
+    from ..gates.mic import MultipleIntervalContainmentGate
+
+    dcf = MultipleIntervalContainmentGate._create_dcf(log_group_size)
+    parameters = dcf.dpf.validator.parameters
+    out = pb.len_field(
+        1, serialization.encode_mic_parameters(log_group_size, intervals)
+    )
+    out += pb.len_field(2, serialization.serialize_mic_key(key, parameters))
+    out += _encode_points(3, xs)
+    return out
+
+
+def decode_mic(buf: bytes):
+    log_group_size = None
+    intervals = []
+    key = None
+    xs: List[int] = []
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            log_group_size, intervals = serialization.decode_mic_parameters(
+                value
+            )
+        elif field == 2:
+            key = serialization.parse_mic_key(value)
+        elif field == 3:
+            xs.append(serialization._decode_value_integer(value))
+    if log_group_size is None or key is None:
+        raise InvalidArgumentError("mic payload needs parameters + key")
+    return log_group_size, intervals, key, xs
+
+
+def encode_pir(
+    parameters: Sequence[DpfParameters], keys: Sequence, db_name: str,
+) -> bytes:
+    """PIR request: the database never crosses the wire — it is
+    registered server-side under a name at deployment (the two servers
+    hold replicas by construction); the request names it."""
+    out = _encode_params(parameters)
+    for k in keys:
+        out += pb.len_field(2, serialization.serialize_dpf_key(k, parameters))
+    out += pb.len_field(3, db_name.encode("utf-8"))
+    return out
+
+
+def decode_pir(buf: bytes):
+    parameters: List[DpfParameters] = []
+    keys = []
+    db_name = ""
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            parameters.append(serialization.decode_dpf_parameters(value))
+        elif field == 2:
+            keys.append(serialization.parse_dpf_key(value))
+        elif field == 3:
+            db_name = value.decode("utf-8")
+    if not parameters or not keys or not db_name:
+        raise InvalidArgumentError("pir payload needs params + keys + db name")
+    return parameters, keys, db_name
+
+
+def _encode_plan_entry(hierarchy_level: int, prefixes) -> bytes:
+    if isinstance(prefixes, np.ndarray) and prefixes.dtype.fields:
+        raise InvalidArgumentError(
+            "structured prefix arrays are host-internal; send prefixes as "
+            "python ints (value-integers carry up to 128 bits)"
+        )
+    out = pb.int32_field(1, int(hierarchy_level))
+    out += _encode_points(2, [int(p) for p in prefixes])
+    return out
+
+
+def _decode_plan_entry(buf: bytes):
+    level = 0
+    prefixes: List[int] = []
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            level = pb.decode_int32(value)
+        elif field == 2:
+            prefixes.append(serialization._decode_value_integer(value))
+    return level, prefixes
+
+
+def encode_hierarchical(
+    parameters: Sequence[DpfParameters], keys: Sequence, plan,
+    group: int = 16,
+) -> bytes:
+    out = _encode_params(parameters)
+    for k in keys:
+        out += pb.len_field(2, serialization.serialize_dpf_key(k, parameters))
+    for level, prefixes in plan:
+        out += pb.len_field(3, _encode_plan_entry(level, prefixes))
+    out += pb.uint64_field(4, int(group))
+    return out
+
+
+def decode_hierarchical(buf: bytes):
+    parameters: List[DpfParameters] = []
+    keys = []
+    plan = []
+    group = 16
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            parameters.append(serialization.decode_dpf_parameters(value))
+        elif field == 2:
+            keys.append(serialization.parse_dpf_key(value))
+        elif field == 3:
+            plan.append(_decode_plan_entry(value))
+        elif field == 4:
+            group = int(value)
+    if not parameters or not keys or not plan:
+        raise InvalidArgumentError(
+            "hierarchical payload needs params + keys + plan"
+        )
+    return parameters, keys, plan, group

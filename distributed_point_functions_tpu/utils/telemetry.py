@@ -711,6 +711,21 @@ class JsonlSink(Collector):
                 pass  # already closed
 
 
+def attach_collector(ring: int = 65536) -> "Collector":
+    """A long-lived Collector subscribed to the bus until
+    :func:`detach_collector` — the RPC server's stats endpoint holds one
+    across its whole lifetime (``capture()`` is scoped to a with-block;
+    a server's counters must span requests). The caller owns detachment."""
+    c = Collector(ring)
+    _add_collector(c)
+    return c
+
+
+def detach_collector(c: "Collector") -> None:
+    c._t_end = time.perf_counter()
+    _remove_collector(c)
+
+
 @contextlib.contextmanager
 def capture(ring: int = 65536):
     """Collects events + metrics for the with-block — the test and router

@@ -659,3 +659,64 @@ def test_serving_frontdoor_adds_zero_programs(program_counter):
         f"programs vs {direct_count} for the direct merged call — "
         "routing must add zero dispatches"
     )
+
+
+def test_serving_wire_adds_zero_programs(program_counter):
+    """ISSUE 10 acceptance pin: the SOCKET boundary — framing, the
+    server's request decode/reconstruct, deadline plumbing, response
+    encode — adds zero device programs over the in-process front door.
+    Four concurrent client threads land the same merged 4-key batch the
+    in-process reference serves (same lds-10 chunk-2 family as the
+    ISSUE 8 pin: no new compiles), and the warm program counts must be
+    EQUAL."""
+    import threading
+
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.ops import supervisor
+
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9, 44, 77], [[1, 2, 3, 4]])
+    params = [DpfParameters(10, Int(64))]
+
+    def direct():
+        supervisor.full_domain_evaluate_robust(
+            dpf, list(keys), key_chunk=2, pipeline=False
+        )
+
+    direct()  # warm
+    program_counter["programs"] = 0
+    direct()
+    direct_count = program_counter["programs"]
+    assert direct_count >= 1
+
+    with serving.DpfServer(
+        engine="device", max_wait_ms=10_000.0, width_target=4, key_chunk=2,
+        pipeline=False,
+    ) as srv:
+        def wire_pass():
+            # One key per client connection; the width target of 4
+            # flushes them as ONE merged batch — the same program
+            # profile as the direct merged call.
+            def one(k):
+                cli = serving.DpfClient("127.0.0.1", srv.port)
+                try:
+                    cli.full_domain(params, [k], deadline=300)
+                finally:
+                    cli.close()
+
+            threads = [
+                threading.Thread(target=one, args=(k,)) for k in keys
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        wire_pass()  # warm (serialization caches, server object caches)
+        program_counter["programs"] = 0
+        wire_pass()
+        assert program_counter["programs"] == direct_count, (
+            f"the wire boundary launched {program_counter['programs']} "
+            f"device programs vs {direct_count} for the direct merged "
+            "call — framing and the server loop must add zero dispatches"
+        )

@@ -1,0 +1,561 @@
+"""Client/server robustness pins over real loopback sockets (ISSUE 10).
+
+All service tests run the REAL DpfServer + DpfClient/TwoServerClient pair
+on 127.0.0.1 with ``engine="host"`` — the full wire/batching/robustness
+path with zero XLA programs and zero new compiles (the compile-budget
+lesson); the zero-added-device-programs pin lives with the other audits
+in tests/test_dispatch_audit.py. Fake raw-socket servers pin the client's
+fault vocabulary deterministically: retry/backoff on UNAVAILABLE and
+RESOURCE_EXHAUSTED, request-id mismatch detection, fail-fast on
+FAILED_PRECONDITION and DEADLINE_EXCEEDED.
+"""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import serving
+from distributed_point_functions_tpu.core import host_eval
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
+from distributed_point_functions_tpu.serving import wire
+from distributed_point_functions_tpu.utils import telemetry
+from distributed_point_functions_tpu.utils.errors import (
+    FailedPreconditionError,
+    InternalError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+PARAMS = [DpfParameters(8, Int(64))]
+FAST = serving.RetryPolicy(
+    attempts=3, base_backoff=0.01, max_backoff=0.05, connect_attempts=3,
+    connect_backoff=0.05, attempt_timeout=10.0, seed=0,
+)
+
+
+@pytest.fixture(scope="module")
+def dpf():
+    return DistributedPointFunction.create(PARAMS[0])
+
+
+@pytest.fixture(scope="module")
+def keys(dpf):
+    return dpf.generate_keys_batch([3, 70, 201], [[5, 9, 40]])
+
+
+@pytest.fixture()
+def server():
+    with serving.DpfServer(engine="host", max_wait_ms=1.0) as srv:
+        yield srv
+
+
+@pytest.fixture()
+def client(server):
+    c = serving.DpfClient("127.0.0.1", server.port, policy=FAST)
+    yield c
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end over loopback
+# ---------------------------------------------------------------------------
+
+
+def test_evaluate_at_bit_exact_over_wire(server, client, dpf, keys):
+    k0s, _ = keys
+    pts = [0, 3, 70, 201, 255]
+    got = client.evaluate_at(PARAMS, k0s, pts, deadline=30)
+    want = host_eval.values_to_limbs(
+        host_eval.evaluate_at_host(dpf, list(k0s), pts, 0), 64
+    )
+    assert np.array_equal(got, want)
+
+
+def test_two_server_pir_reconstructs(dpf):
+    pparams = [DpfParameters(8, XorWrapper(128))]
+    pdpf = DistributedPointFunction.create(pparams[0])
+    rng = np.random.default_rng(3)
+    db = rng.integers(0, 2**32, size=(1 << 8, 4), dtype=np.uint32)
+    alpha = 137
+    k0, k1 = pdpf.generate_keys(alpha, (1 << 128) - 1)
+    with serving.DpfServer(engine="host", max_wait_ms=1.0) as s0, \
+            serving.DpfServer(engine="host", max_wait_ms=1.0) as s1:
+        s0.register_db("db", db)
+        s1.register_db("db", db)
+        with serving.TwoServerClient(
+            [("127.0.0.1", s0.port), ("127.0.0.1", s1.port)], policy=FAST,
+        ) as tsc:
+            a0, a1 = tsc.pir(pparams, ([k0], [k1]), "db", deadline=30)
+    record = np.asarray(a0)[0] ^ np.asarray(a1)[0]
+    assert np.array_equal(record, db[alpha])
+
+
+def test_two_server_partial_failure_names_dead_party(dpf, keys):
+    """A reconstruct op with one party down fails FAST with the dead
+    party named — never a hang on the surviving share."""
+    k0s, k1s = keys
+    with serving.DpfServer(engine="host", max_wait_ms=1.0) as s0:
+        # Party 1's endpoint: a bound-but-never-started port (refused).
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        with serving.TwoServerClient(
+            [("127.0.0.1", s0.port), ("127.0.0.1", dead_port)], policy=FAST,
+        ) as tsc:
+            t0 = time.perf_counter()
+            with pytest.raises(serving.PartyUnavailableError) as ei:
+                tsc.evaluate_at(PARAMS, (k0s, k1s), [0, 3], deadline=30)
+            assert ei.value.party == 1
+            assert str(dead_port) in str(ei.value)
+            # capped reconnect budget, not a 30 s deadline wait
+            assert time.perf_counter() - t0 < 10
+
+
+def test_dead_party_reported_before_survivor_finishes(dpf, keys):
+    """The partial-failure contract is fail-FAST: a dead party surfaces
+    the moment ITS budget exhausts, not after the surviving party's
+    (possibly long) call returns (review catch — _both was
+    join-both-then-check)."""
+    k0s, k1s = keys
+    # Party 0: accepts and handshakes, then sits on the request far
+    # longer than party 1's whole failure budget.
+    slow = socket.socket()
+    slow.bind(("127.0.0.1", 0))
+    slow.listen(1)
+    slow_port = slow.getsockname()[1]
+
+    def _slow_server():
+        conn, _ = slow.accept()
+        conn.settimeout(30)
+        hello = wire.read_frame(conn)
+        wire.write_frame(conn, wire.T_HELLO_OK, hello.request_id, b"{}")
+        try:
+            wire.read_frame(conn)  # the request: swallow it, never answer
+            time.sleep(20)
+        except Exception:
+            pass
+        conn.close()
+
+    threading.Thread(target=_slow_server, daemon=True).start()
+    # Party 1: dead (refused).
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()
+    with serving.TwoServerClient(
+        [("127.0.0.1", slow_port), ("127.0.0.1", dead_port)], policy=FAST,
+    ) as tsc:
+        t0 = time.perf_counter()
+        with pytest.raises(serving.PartyUnavailableError) as ei:
+            tsc.evaluate_at(PARAMS, (k0s, k1s), [1], deadline=30)
+        # Party 1's budget is < 1 s under FAST; party 0's attempt_timeout
+        # is 10 s. Fail-fast means we beat the slow survivor by a mile.
+        assert time.perf_counter() - t0 < 5
+        assert ei.value.party == 1
+    slow.close()
+
+
+def test_server_object_cache_is_bounded():
+    """The crypto-object cache keys are client-controlled: it must evict
+    (LRU), not grow one pinned object per distinct config forever
+    (review catch)."""
+    srv = serving.DpfServer(engine="host")
+    try:
+        for i in range(srv.MAX_CACHED_OBJS + 40):
+            srv._cached(("probe", i), lambda: object())
+        assert len(srv._objs) == srv.MAX_CACHED_OBJS
+        # LRU: the most recent keys survive, the oldest were evicted.
+        assert ("probe", 0) not in srv._objs
+        assert ("probe", srv.MAX_CACHED_OBJS + 39) in srv._objs
+    finally:
+        srv.stop()
+
+
+def test_health_stats_and_drain(server, client, dpf, keys):
+    h = client.health()
+    assert h["status"] == "serving" and h["ready"]
+    k0s, _ = keys
+    client.evaluate_at(PARAMS, k0s, [1, 2], deadline=30)
+    stats = client.stats()
+    assert stats["counters"].get("rpc.server.requests[evaluate_at]", 0) >= 1
+    server.drain(timeout=5)
+    # draining: health says so, ops are refused as UNAVAILABLE (client
+    # retries then gives up), new connections are refused.
+    assert client.health()["status"] == "draining"
+    with pytest.raises(UnavailableError):
+        client.evaluate_at(PARAMS, k0s, [1], deadline=5)
+    # New connections are refused. Some sandboxed network stacks report
+    # connect() success against a closed port with the socket actually
+    # unconnected — so "refused" is pinned at first use, not at connect.
+    with pytest.raises((ConnectionError, OSError)):
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=0.5)
+        try:
+            s.getpeername()  # unconnected socket -> ENOTCONN
+            s.settimeout(0.5)
+            wire.write_frame(s, wire.T_HELLO, 1)
+            if not s.recv(1):
+                raise ConnectionResetError("EOF: listener is gone")
+        finally:
+            s.close()
+
+
+def test_slow_mid_frame_request_is_served_not_torn(server, dpf, keys):
+    """A request that stalls >0.5 s BETWEEN header and body must be
+    served: the 0.5 s idle poll may not tear an in-progress frame (the
+    review catch — a timeout inside _recv_exact discards consumed bytes
+    and desyncs the stream permanently)."""
+    k0s, _ = keys
+    sock = socket.create_connection(("127.0.0.1", server.port), timeout=30)
+    sock.settimeout(30)
+    try:
+        wire.write_frame(sock, wire.T_HELLO, 1)
+        assert wire.read_frame(sock).ftype == wire.T_HELLO_OK
+        body = wire.encode_request_body(
+            "evaluate_at",
+            wire.encode_evaluate_at(PARAMS, k0s, [1, 2]),
+            deadline_ms=30000,
+        )
+        raw = wire.encode_frame(wire.T_REQUEST, 2, body)
+        sock.sendall(raw[: wire.HEADER_BYTES + 3])  # header + a body sliver
+        time.sleep(0.8)  # > the 0.5 s idle poll interval
+        sock.sendall(raw[wire.HEADER_BYTES + 3:])
+        reply = wire.read_frame(sock, max_body=wire.DEFAULT_MAX_BODY)
+        assert reply is not None and reply.ftype == wire.T_RESPONSE
+        assert reply.request_id == 2
+    finally:
+        sock.close()
+
+
+def test_derived_journal_cleaned_up_after_success(dpf, keys, tmp_path):
+    """The journal_dir (fingerprint-derived) form unlinks its journal on
+    success — a long-lived server must not grow one result-sized file
+    per distinct client batch forever (review catch)."""
+    from distributed_point_functions_tpu.ops import supervisor
+
+    k0s, _ = keys
+    jd = tmp_path / "journals"
+    out = supervisor.full_domain_evaluate_robust(
+        dpf, list(k0s), key_chunk=2, journal_dir=str(jd)
+    )
+    assert out is not None
+    assert list(jd.glob("*.journal")) == []
+
+
+def test_reconnect_time_counts_against_deadline(server, dpf, keys,
+                                                monkeypatch):
+    """Budget spent redialing is deducted before the attempt sends: a
+    call whose deadline died in the reconnect loop fails fast as
+    DEADLINE_EXCEEDED instead of handing the server the original
+    budget and overrunning (review catch)."""
+    k0s, _ = keys
+    cli = serving.DpfClient("127.0.0.1", server.port, policy=FAST)
+    orig = cli._ensure_connected
+
+    def slow_connect(deadline):
+        time.sleep(0.25)
+        return orig(deadline)
+
+    monkeypatch.setattr(cli, "_ensure_connected", slow_connect)
+    t0 = time.perf_counter()
+    with pytest.raises(UnavailableError, match="DEADLINE"):
+        cli.evaluate_at(PARAMS, k0s, [1], deadline=0.2)
+    assert time.perf_counter() - t0 < 5  # failed fast, no server wait
+    cli.close()
+
+
+def test_version_mismatch_handshake_rejected(server):
+    sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    sock.settimeout(5)
+    wire.write_frame(sock, wire.T_HELLO, 1, version=wire.PROTO_VERSION + 1)
+    reply = wire.read_frame(sock, check_version=False)
+    assert reply.ftype == wire.T_ERROR
+    code, message = wire.decode_error_body(reply.body)
+    assert code == wire.FAILED_PRECONDITION
+    assert "version" in message
+    sock.close()
+
+
+def test_garbage_opening_bytes_drop_connection(server):
+    """A peer that isn't speaking the protocol is dropped without an
+    answer — framing has no resync point."""
+    sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    sock.settimeout(5)
+    sock.sendall(b"GET / HTTP/1.1\r\n\r\n" + b"\x00" * 16)
+    try:
+        got = sock.recv(1024)
+    except ConnectionResetError:
+        got = b""  # RST (close with unread bytes pending) = dropped too
+    assert got == b""  # dropped, nothing answered
+    sock.close()
+
+
+def test_malformed_payload_answers_invalid_argument(server, client):
+    """Payload-level garbage inside a valid frame keeps the connection
+    and answers INVALID_ARGUMENT — unlike frame-level garbage."""
+    with pytest.raises(InvalidArgumentError):
+        client.call("evaluate_at", b"\xff\xfe\xfd", deadline=5)
+    assert client.health()["ready"]  # same connection still serves
+
+
+def test_wire_deadline_sheds_at_admission(server, client, dpf, keys):
+    """An unmeetable wire deadline is shed server-side (the
+    serving.shed_deadline counter) and fails fast client-side as
+    DEADLINE_EXCEEDED — never retried, never hung."""
+    k0s, _ = keys
+    with pytest.raises(UnavailableError, match="DEADLINE_EXCEEDED"):
+        client.evaluate_at(PARAMS, k0s, [1, 2], deadline=0.002)
+    counters = client.stats()["counters"]
+    assert counters.get("serving.shed_deadline[evaluate_at]", 0) >= 1
+
+
+def test_worker_death_visible_over_wire(server, client, dpf, keys,
+                                        monkeypatch):
+    """ISSUE 10 satellite end-to-end: a dead batcher worker turns into
+    INTERNAL answers and a not-ready health probe, not a hang."""
+    k0s, _ = keys
+    client.evaluate_at(PARAMS, k0s, [1], deadline=30)  # healthy first
+    # worker dies on next wake (monkeypatch: restored before teardown's
+    # stop() has to pump)
+    monkeypatch.setattr(server.door.batcher, "_take_ripe", None)
+    server.door.submit(serving.Request.evaluate_at(dpf, list(k0s), [2]))
+    deadline = time.perf_counter() + 5
+    while server.door.batcher.dead is None:
+        assert time.perf_counter() < deadline, "worker never died"
+        time.sleep(0.01)
+    with pytest.raises(InternalError):
+        client.evaluate_at(PARAMS, k0s, [3], deadline=5)
+    assert client.health()["ready"] is False
+
+
+# ---------------------------------------------------------------------------
+# Client fault vocabulary against scripted fake servers
+# ---------------------------------------------------------------------------
+
+
+class _FakeServer:
+    """A raw-socket server running a per-connection script: each entry
+    answers one incoming T_REQUEST (after a normal HELLO handshake)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self._listener = socket.socket()
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(8)
+        self._listener.settimeout(5)
+        self.port = self._listener.getsockname()[1]
+        self.requests_seen = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        try:
+            while self.script:
+                conn, _ = self._listener.accept()
+                conn.settimeout(5)
+                try:
+                    self._serve(conn)
+                except (OSError, wire.FrameError):
+                    pass
+                finally:
+                    conn.close()
+        except OSError:  # accept timeout, or the listener closed under us
+            pass
+
+    def _serve(self, conn):
+        hello = wire.read_frame(conn, check_version=False)
+        if hello is None:
+            return
+        wire.write_frame(conn, wire.T_HELLO_OK, hello.request_id, b"{}")
+        while self.script:
+            frame = wire.read_frame(conn)
+            if frame is None:
+                return
+            self.requests_seen += 1
+            action = self.script.pop(0)
+            if action == "drop":
+                return  # close without answering
+            if action == "wrong_id":
+                wire.write_frame(
+                    conn, wire.T_RESPONSE, frame.request_id + 1,
+                    wire.encode_result_arrays(
+                        [np.zeros((1, 1), dtype=np.uint32)]
+                    ),
+                )
+                return
+            if isinstance(action, int):  # an error status to answer
+                wire.write_frame(
+                    conn, wire.T_ERROR, frame.request_id,
+                    wire.encode_error_body(action, f"scripted {action}"),
+                )
+                continue
+            # "ok": a real response
+            wire.write_frame(
+                conn, wire.T_RESPONSE, frame.request_id,
+                wire.encode_result_arrays(
+                    [np.arange(4, dtype=np.uint32).reshape(1, 4)]
+                ),
+            )
+
+    def close(self):
+        self._listener.close()
+        self._thread.join(timeout=5)
+
+
+def _payload(dpf):
+    k0, _ = dpf.generate_keys(1, 2)
+    return wire.encode_evaluate_at(PARAMS, [k0], [0, 1], -1)
+
+
+def test_client_retries_unavailable_and_resource_exhausted(dpf):
+    """UNAVAILABLE and RESOURCE_EXHAUSTED (backpressure) are retried
+    with backoff and the call still succeeds; both retries are counted."""
+    fake = _FakeServer([wire.UNAVAILABLE, wire.RESOURCE_EXHAUSTED, "ok"])
+    cli = serving.DpfClient("127.0.0.1", fake.port, policy=FAST)
+    with telemetry.capture() as cap:
+        out = cli.call("evaluate_at", _payload(dpf), deadline=10)
+    assert out[0].shape == (1, 4)
+    assert fake.requests_seen == 3
+    snap = cap.snapshot()
+    assert snap["counters"].get("rpc.client.retries[evaluate_at]") == 2
+    assert snap["histograms"]["rpc.client.backoff_ms"]["count"] == 2
+    cli.close(), fake.close()
+
+
+def test_client_fails_fast_on_nonretryable(dpf):
+    for status, exc_type in [
+        (wire.INVALID_ARGUMENT, InvalidArgumentError),
+        (wire.DEADLINE_EXCEEDED, UnavailableError),
+        (wire.FAILED_PRECONDITION, FailedPreconditionError),
+        (wire.INTERNAL, InternalError),
+    ]:
+        fake = _FakeServer([status, "ok"])
+        cli = serving.DpfClient("127.0.0.1", fake.port, policy=FAST)
+        with pytest.raises(exc_type):
+            cli.call("evaluate_at", _payload(dpf), deadline=10)
+        assert fake.requests_seen == 1, f"status {status} was retried"
+        cli.close(), fake.close()
+
+
+def test_client_detects_request_id_mismatch(dpf):
+    """A response with the wrong request id is a desynchronized stream:
+    dropped + retried, never trusted as an answer."""
+    fake = _FakeServer(["wrong_id", "ok"])
+    cli = serving.DpfClient("127.0.0.1", fake.port, policy=FAST)
+    with telemetry.capture() as cap:
+        out = cli.call("evaluate_at", _payload(dpf), deadline=10)
+    assert out[0].shape == (1, 4)
+    snap = cap.snapshot()
+    assert snap["counters"].get("rpc.client.id_mismatch[evaluate_at]") == 1
+    assert snap["counters"].get("rpc.client.retries[evaluate_at]") == 1
+    cli.close(), fake.close()
+
+
+def test_client_retries_connection_drop(dpf):
+    fake = _FakeServer(["drop", "ok"])
+    cli = serving.DpfClient("127.0.0.1", fake.port, policy=FAST)
+    out = cli.call("evaluate_at", _payload(dpf), deadline=10)
+    assert out[0].shape == (1, 4)
+    assert fake.requests_seen == 2
+    cli.close(), fake.close()
+
+
+def test_client_exhausts_retry_budget(dpf):
+    fake = _FakeServer([wire.UNAVAILABLE] * 10)
+    cli = serving.DpfClient("127.0.0.1", fake.port, policy=FAST)
+    with pytest.raises(UnavailableError):
+        cli.call("evaluate_at", _payload(dpf), deadline=10)
+    assert fake.requests_seen == FAST.attempts
+    cli.close(), fake.close()
+
+
+# ---------------------------------------------------------------------------
+# Front-door deadline mechanics (in-process: the server-side seams)
+# ---------------------------------------------------------------------------
+
+
+def test_request_expired_in_queue_rejected_at_flush(dpf, keys):
+    """A deadline that passes while queued rejects at flush (counted as
+    a shed) instead of spending device time on an unusable answer."""
+    k0s, _ = keys
+    door = serving.FrontDoor(engine="host", max_wait_ms=1.0)
+    # No worker: the queue sits until we pump, past the deadline.
+    live = door.submit(
+        serving.Request.evaluate_at(dpf, list(k0s), [1]).with_deadline(30)
+    )
+    doomed = door.submit(
+        serving.Request.evaluate_at(dpf, list(k0s), [2]).with_deadline(0.03)
+    )
+    time.sleep(0.06)
+    with telemetry.capture() as cap:
+        door.batcher.pump(force=True)
+    assert live.result(timeout=5) is not None
+    with pytest.raises(UnavailableError, match="expired while queued"):
+        doomed.result(timeout=5)
+    snap = cap.snapshot()
+    assert snap["counters"].get("serving.shed_deadline[evaluate_at]") == 1
+
+
+def test_deadline_propagates_into_supervisor_scope(dpf, keys, monkeypatch):
+    """The batch's minimum remaining wire budget arms
+    supervisor.deadline_scope around execution — the wire deadline
+    bounds device dispatch, not just the socket wait."""
+    from distributed_point_functions_tpu.ops import supervisor
+
+    k0s, _ = keys
+    seen = {}
+    door = serving.FrontDoor(engine="host", max_wait_ms=1.0)
+    orig = door._run_evaluate_at
+
+    def spy(*args, **kw):
+        seen["deadline"] = supervisor.current_deadline()
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(door, "_run_evaluate_at", spy)
+    door.submit(
+        serving.Request.evaluate_at(dpf, list(k0s), [1]).with_deadline(30)
+    )
+    door.submit(
+        serving.Request.evaluate_at(dpf, list(k0s), [2]).with_deadline(7)
+    )
+    door.batcher.pump(force=True)
+    assert seen["deadline"] is not None and 5 < seen["deadline"] <= 7
+    # And without deadlines: pass-through (no scope armed).
+    seen.clear()
+    door.submit(serving.Request.evaluate_at(dpf, list(k0s), [3]))
+    door.batcher.pump(force=True)
+    assert seen["deadline"] is None
+
+
+def test_batcher_backpressure_travels_as_resource_exhausted(dpf, keys):
+    """Bounded-depth admission over the wire: the client sees
+    RESOURCE_EXHAUSTED (retryable backoff), and once the queue drains the
+    retry succeeds — the shed-and-recover loop, end to end."""
+    k0s, _ = keys
+    with serving.DpfServer(
+        engine="host", max_wait_ms=40.0, max_queue_depth=1,
+    ) as srv:
+        cli = serving.DpfClient(
+            "127.0.0.1", srv.port,
+            policy=serving.RetryPolicy(
+                attempts=4, base_backoff=0.05, max_backoff=0.2,
+                attempt_timeout=10.0, seed=0,
+            ),
+        )
+        filler = serving.Request.evaluate_at(dpf, list(k0s), [9])
+        srv.door.submit(filler)  # occupies the whole depth-1 queue
+        with telemetry.capture() as cap:
+            got = cli.evaluate_at(PARAMS, k0s, [1, 2], deadline=30)
+        assert got is not None
+        snap = cap.snapshot()
+        assert snap["counters"].get("rpc.client.retries[evaluate_at]", 0) >= 1
+        assert (
+            snap["counters"].get("rpc.server.status_8[evaluate_at]", 0) >= 1
+        ), "no RESOURCE_EXHAUSTED answer recorded"
+        cli.close()

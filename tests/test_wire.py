@@ -1,0 +1,341 @@
+"""Wire-level robustness pins for the RPC framing + op codecs (ISSUE 10).
+
+Everything here is pure host code (sockets + byte codecs — no device
+work, no XLA programs): the contract that serving/wire.py's docstring
+promises, pinned the way test_serialization.py pins the key formats.
+
+* framing: bad magic / truncated header / truncated body / oversized
+  body / unknown type all raise FrameError; clean EOF reads as None;
+  version mismatch is caught on every frame;
+* envelope codecs: request (op, deadline_ms, payload) and error
+  (code, message) bodies round-trip; unknown op ids are rejected;
+* the status taxonomy round-trips client<->server, with the
+  DEADLINE_EXCEEDED convention (an UnavailableError whose message the
+  supervisor's watchdog prefixed) given its own non-retryable code;
+* a frame-level round-trip property over ALL SIX op payloads: encode ->
+  decode -> re-encode is byte-identical, so every field survives the
+  wire exactly (keys compare through their canonical serialized form).
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
+from distributed_point_functions_tpu.dcf.dcf import DistributedComparisonFunction
+from distributed_point_functions_tpu.gates.mic import (
+    MultipleIntervalContainmentGate,
+)
+from distributed_point_functions_tpu.protos import wire as pb
+from distributed_point_functions_tpu.serving import wire
+from distributed_point_functions_tpu.utils.errors import (
+    DataLossError,
+    FailedPreconditionError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+
+def _pipe():
+    a, b = socket.socketpair()
+    a.settimeout(5)
+    b.settimeout(5)
+    return a, b
+
+
+# ---------------------------------------------------------------------------
+# Framing
+# ---------------------------------------------------------------------------
+
+
+def test_frame_round_trip_and_clean_eof():
+    a, b = _pipe()
+    wire.write_frame(a, wire.T_REQUEST, 42, b"payload")
+    wire.write_frame(a, wire.T_HEALTH, 43)
+    a.close()  # orderly close at a frame boundary
+    f1 = wire.read_frame(b)
+    assert (f1.ftype, f1.request_id, f1.body) == (wire.T_REQUEST, 42, b"payload")
+    f2 = wire.read_frame(b)
+    assert (f2.ftype, f2.request_id, f2.body) == (wire.T_HEALTH, 43, b"")
+    assert wire.read_frame(b) is None
+    b.close()
+
+
+def test_bad_magic_rejected():
+    a, b = _pipe()
+    a.sendall(b"HTTP" + b"\x00" * (wire.HEADER_BYTES - 4))
+    with pytest.raises(wire.FrameError, match="magic"):
+        wire.read_frame(b)
+    a.close(), b.close()
+
+
+def test_truncated_header_rejected():
+    a, b = _pipe()
+    a.sendall(wire.encode_frame(wire.T_REQUEST, 7, b"xy")[: wire.HEADER_BYTES - 3])
+    a.close()
+    with pytest.raises(wire.FrameError, match="mid-frame"):
+        wire.read_frame(b)
+    b.close()
+
+
+def test_truncated_body_rejected():
+    a, b = _pipe()
+    a.sendall(wire.encode_frame(wire.T_REQUEST, 7, b"0123456789")[:-4])
+    a.close()
+    with pytest.raises(wire.FrameError, match="mid-frame"):
+        wire.read_frame(b)
+    b.close()
+
+
+def test_oversized_body_rejected_before_allocation():
+    a, b = _pipe()
+    # A garbage length prefix claiming 1 GiB: rejected from the header
+    # alone — no body bytes are read, let alone allocated.
+    hdr = struct.Struct("<4sBBQI").pack(
+        wire.MAGIC, wire.PROTO_VERSION, wire.T_REQUEST, 1, 1 << 30
+    )
+    a.sendall(hdr)
+    with pytest.raises(wire.FrameError, match="exceeds"):
+        wire.read_frame(b, max_body=1 << 20)
+    a.close(), b.close()
+
+
+def test_unknown_frame_type_rejected():
+    a, b = _pipe()
+    a.sendall(struct.Struct("<4sBBQI").pack(
+        wire.MAGIC, wire.PROTO_VERSION, 99, 1, 0
+    ))
+    with pytest.raises(wire.FrameError, match="unknown frame type"):
+        wire.read_frame(b)
+    a.close(), b.close()
+
+
+def test_version_mismatch_detected_per_frame():
+    a, b = _pipe()
+    a.sendall(wire.encode_frame(wire.T_HELLO, 1, version=wire.PROTO_VERSION + 1))
+    with pytest.raises(wire.FrameError, match="version"):
+        wire.read_frame(b)
+    # The handshake path reads with check_version=False so it can ANSWER
+    # the mismatch (FAILED_PRECONDITION) instead of dropping silently.
+    a.sendall(wire.encode_frame(wire.T_HELLO, 2, version=wire.PROTO_VERSION + 1))
+    f = wire.read_frame(b, check_version=False)
+    assert f.version == wire.PROTO_VERSION + 1
+    a.close(), b.close()
+
+
+# ---------------------------------------------------------------------------
+# Envelope codecs + status taxonomy
+# ---------------------------------------------------------------------------
+
+
+def test_request_body_round_trip():
+    body = wire.encode_request_body("dcf", b"\x01\x02", deadline_ms=1500)
+    assert wire.decode_request_body(body) == ("dcf", 1500, b"\x01\x02")
+    body = wire.encode_request_body("pir", b"", deadline_ms=0)
+    assert wire.decode_request_body(body) == ("pir", 0, b"")
+
+
+def test_request_body_rejects_unknown_op():
+    with pytest.raises(InvalidArgumentError, match="not servable"):
+        wire.encode_request_body("keygen", b"")
+    from distributed_point_functions_tpu.protos import wire as pb
+
+    bogus = pb.uint64_field(1, 99) + pb.len_field(3, b"x")
+    with pytest.raises(InvalidArgumentError, match="unknown op id"):
+        wire.decode_request_body(bogus)
+
+
+def test_error_body_round_trip():
+    body = wire.encode_error_body(wire.RESOURCE_EXHAUSTED, "queue full — héllo")
+    assert wire.decode_error_body(body) == (
+        wire.RESOURCE_EXHAUSTED, "queue full — héllo"
+    )
+
+
+@pytest.mark.parametrize("exc,code", [
+    (InvalidArgumentError("x"), wire.INVALID_ARGUMENT),
+    (ResourceExhaustedError("x"), wire.RESOURCE_EXHAUSTED),
+    (FailedPreconditionError("x"), wire.FAILED_PRECONDITION),
+    (UnavailableError("UNAVAILABLE: x"), wire.UNAVAILABLE),
+    (UnavailableError("DEADLINE_EXCEEDED: x"), wire.DEADLINE_EXCEEDED),
+    (DataLossError("x"), wire.DATA_LOSS),
+    (RuntimeError("x"), wire.INTERNAL),
+])
+def test_status_taxonomy_round_trips(exc, code):
+    assert wire.status_for_exception(exc) == code
+    back = wire.exception_for_status(code, str(exc))
+    assert back.wire_status == code
+    # Retry semantics survive the round trip: only UNAVAILABLE and
+    # RESOURCE_EXHAUSTED (backpressure) are retryable.
+    assert (code in wire.RETRYABLE_STATUSES) == (
+        code in (wire.UNAVAILABLE, wire.RESOURCE_EXHAUSTED)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Array codec
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("arr", [
+    np.arange(24, dtype=np.uint32).reshape(2, 3, 4),
+    np.arange(6, dtype=np.uint64).reshape(3, 2),
+    np.array([], dtype=np.uint32).reshape(0, 4),
+    np.array([[1, (1 << 127) + 5], [0, 3]], dtype=object),
+])
+def test_array_codec_round_trip(arr):
+    out = wire.decode_result_arrays(wire.encode_result_arrays([arr]))
+    assert len(out) == 1
+    assert out[0].shape == arr.shape
+    assert out[0].dtype == arr.dtype
+    assert (np.asarray(out[0]) == arr).all() or arr.size == 0
+
+
+def test_array_codec_rejects_shape_data_mismatch():
+    body = wire.encode_result_arrays([np.arange(8, dtype=np.uint32)])
+    # Corrupt: reuse the body but lie about the shape via a re-encode of
+    # a different array's header with this data length.
+    from distributed_point_functions_tpu.protos import wire as pb
+
+    bad = pb.len_field(1, pb.len_field(1, b"<u4") + pb.len_field(
+        2, pb.encode_varint(5)
+    ) + pb.len_field(3, b"\x00" * 8))
+    with pytest.raises(DataLossError, match="bytes"):
+        wire.decode_result_arrays(bad)
+    assert wire.decode_result_arrays(body)[0].size == 8
+
+
+def test_hierarchy_level_wire_presence_semantics():
+    """hierarchy_level is EXPLICIT-presence on the wire: an absent field
+    decodes as the API default -1 (last level) — a conforming proto3
+    client that leaves it unset must not silently get level 0 — and an
+    explicit 0 is emitted and round-trips as 0 (review catch)."""
+    params = [DpfParameters(4, Int(64))]
+    dpf = DistributedPointFunction.create(params[0])
+    k0, _ = dpf.generate_keys(3, 7)
+
+    # A third-party payload omitting field 3 entirely:
+    stripped = b"".join(
+        pb.tag(f, w) + (pb.encode_varint(v) if w == pb.VARINT
+                        else pb.encode_varint(len(v)) + v)
+        for f, w, v in pb.iter_fields(
+            wire.encode_full_domain(params, [k0], -1)
+        )
+        if f != 3
+    )
+    assert wire.decode_full_domain(stripped)[2] == -1
+
+    # Explicit levels (0 included) are emitted and survive:
+    for lvl in (0, 1, -1):
+        enc = wire.encode_full_domain(params, [k0], lvl)
+        assert wire.decode_full_domain(enc)[2] == lvl
+        enc = wire.encode_evaluate_at(params, [k0], [1, 2], lvl)
+        assert wire.decode_evaluate_at(enc)[3] == lvl
+
+
+# ---------------------------------------------------------------------------
+# Op payload round-trip property (all six ops)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def op_payloads():
+    """One representative encoded payload per wire op. Deterministic
+    tiny shapes; keygen only (no evaluation, no device work)."""
+    params = [DpfParameters(6, Int(64))]
+    dpf = DistributedPointFunction.create(params[0])
+    k0, k1 = dpf.generate_keys(13, 99)
+
+    hp = [DpfParameters(i + 1, Int(32)) for i in range(3)]
+    hdpf = DistributedPointFunction.create_incremental(hp)
+    hk0, _ = hdpf.generate_keys_incremental(5, [3, 3, 3])
+
+    dcf = DistributedComparisonFunction.create(6, Int(64))
+    dk0, _ = dcf.generate_keys(17, 4242)
+
+    gate = MultipleIntervalContainmentGate.create(5, [(1, 4), (9, 20)])
+    mk0, _ = gate.gen(3, [7, 11])
+
+    pparams = [DpfParameters(6, XorWrapper(128))]
+    pdpf = DistributedPointFunction.create(pparams[0])
+    pk0, _ = pdpf.generate_keys(9, (1 << 128) - 1)
+
+    return {
+        "full_domain": wire.encode_full_domain(params, [k0, k1], -1),
+        "evaluate_at": wire.encode_evaluate_at(
+            params, [k0], [0, 13, 63], -1
+        ),
+        "dcf": wire.encode_dcf(6, Int(64), [dk0], [1, 17, 40]),
+        "mic": wire.encode_mic(5, [(1, 4), (9, 20)], mk0, [2, 30]),
+        "pir": wire.encode_pir(pparams, [pk0], "db-name"),
+        "hierarchical": wire.encode_hierarchical(
+            hp, [hk0], [(0, [0, 1]), (2, [4, 5, 6])], group=4
+        ),
+    }
+
+
+@pytest.mark.parametrize("op", wire.WIRE_OPS)
+def test_op_payload_reencode_is_byte_identical(op, op_payloads):
+    """encode -> decode -> re-encode must reproduce the exact bytes:
+    every field (params, key material, points, plans, names, levels)
+    survives the wire with nothing silently dropped or defaulted."""
+    payload = op_payloads[op]
+    if op == "full_domain":
+        params, keys, hl = wire.decode_full_domain(payload)
+        again = wire.encode_full_domain(params, keys, hl)
+    elif op == "evaluate_at":
+        params, keys, points, hl = wire.decode_evaluate_at(payload)
+        again = wire.encode_evaluate_at(params, keys, points, hl)
+    elif op == "dcf":
+        lds, vt, keys, xs = wire.decode_dcf(payload)
+        again = wire.encode_dcf(lds, vt, keys, xs)
+    elif op == "mic":
+        lgs, intervals, key, xs = wire.decode_mic(payload)
+        again = wire.encode_mic(lgs, intervals, key, xs)
+    elif op == "pir":
+        params, keys, name = wire.decode_pir(payload)
+        again = wire.encode_pir(params, keys, name)
+    else:
+        params, keys, plan, group = wire.decode_hierarchical(payload)
+        again = wire.encode_hierarchical(params, keys, plan, group)
+    assert again == payload, f"{op}: re-encoded payload differs"
+
+
+@pytest.mark.parametrize("op", wire.WIRE_OPS)
+def test_op_payload_survives_a_real_socket(op, op_payloads):
+    """The full envelope (frame + request body + payload) through an
+    actual socket pair, with a concurrent writer — the exact bytes the
+    server's handler sees are the bytes the client's encoder produced."""
+    payload = op_payloads[op]
+    a, b = _pipe()
+    body = wire.encode_request_body(op, payload, deadline_ms=250)
+    t = threading.Thread(
+        target=wire.write_frame, args=(a, wire.T_REQUEST, 7, body)
+    )
+    t.start()
+    frame = wire.read_frame(b)
+    t.join()
+    assert frame.ftype == wire.T_REQUEST and frame.request_id == 7
+    got_op, got_deadline, got_payload = wire.decode_request_body(frame.body)
+    assert (got_op, got_deadline) == (op, 250)
+    assert got_payload == payload
+    a.close(), b.close()
+
+
+def test_payloads_reject_missing_fields():
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_full_domain(b"")
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_dcf(b"")
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_mic(b"")
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_pir(b"")
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_hierarchical(b"")
