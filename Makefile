@@ -2,9 +2,12 @@
 # The analog of the reference's `bazel test //...` entry point
 # (/root/reference/.bazelci/presubmit.yml); ci.sh holds the tier logic.
 
-.PHONY: test slow smoke device ci bench headline watch measure
+.PHONY: lint test slow smoke device ci bench headline watch measure
 
-test:            ## fast tier: default pytest suite (CPU, virtual 8-device mesh)
+lint:            ## static analysis: AST-enforced repo invariants (tools/dpflint)
+	./ci.sh lint
+
+test:            ## fast tier: dpflint + default pytest suite (CPU, virtual 8-device mesh)
 	./ci.sh fast
 
 slow:            ## weekly tier: full suite incl. --runslow parametrizations

@@ -2,8 +2,14 @@
 # CI entry point — the analog of the reference's pinned test matrix
 # (/root/reference/.bazelci/presubmit.yml). Tiers:
 #
-#   ./ci.sh            fast tier: the default pytest suite (slow-marked
-#                      compile-heavy tests excluded), CPU-only.
+#   ./ci.sh            fast tier: dpflint (seconds, fail-fast before the
+#                      pytest spend) + the default pytest suite
+#                      (slow-marked compile-heavy tests excluded),
+#                      CPU-only.
+#   ./ci.sh lint       static analysis only: tools/dpflint — AST-enforced
+#                      repo invariants (Mosaic op-surface, replay parity,
+#                      error taxonomy, env/lock/compile-budget
+#                      discipline). Pure stdlib ast; never imports jax.
 #   ./ci.sh slow       weekly tier: the full suite including --runslow.
 #   ./ci.sh smoke      application smokes: experiments CLI + both demos
 #                      on reduced configs.
@@ -12,7 +18,7 @@
 #   ./ci.sh faults     integrity tier: the runtime-integrity /
 #                      fault-injection suite (tests marked 'faults'),
 #                      forced onto XLA:CPU.
-#   ./ci.sh all        fast + smoke.
+#   ./ci.sh all        lint + fast + smoke.
 #
 # Every tier exits nonzero on the first failure. Tests force a virtual
 # 8-device CPU platform themselves (tests/conftest.py); the smokes force
@@ -21,6 +27,15 @@ set -euo pipefail
 cd "$(dirname "$0")"
 
 tier="${1:-fast}"
+
+run_lint() {
+  # ISSUE 11: AST-enforced repo invariants. Runs at the top of the fast
+  # tier so an invariant violation fails in seconds instead of after the
+  # ~800 s pytest spend. JAX_PLATFORMS pinned out of uniformity with the
+  # other tiers; dpflint itself never imports jax (pure stdlib ast —
+  # tests/test_lint.py pins that).
+  JAX_PLATFORMS=cpu python -m tools.dpflint
+}
 
 run_fast() {
   # The fast tier includes the pipelined-executor suite
@@ -103,12 +118,13 @@ run_faults() {
 }
 
 case "$tier" in
-  fast) run_fast ;;
+  lint) run_lint ;;
+  fast) run_lint; run_fast ;;
   slow) run_slow ;;
   smoke) run_smoke ;;
   device) run_device ;;
   faults) run_faults ;;
-  all) run_fast; run_smoke ;;
-  *) echo "unknown tier: $tier (fast|slow|smoke|device|faults|all)" >&2; exit 2 ;;
+  all) run_lint; run_fast; run_smoke ;;
+  *) echo "unknown tier: $tier (lint|fast|slow|smoke|device|faults|all)" >&2; exit 2 ;;
 esac
 echo "ci: tier '$tier' passed"

@@ -17,6 +17,8 @@ import threading
 
 import numpy as np
 
+from ..utils import envflags
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "dpf_native.cc")
 _LIB = os.path.join(_HERE, "libdpf_native.so")
@@ -43,8 +45,12 @@ def _load():
     with _lock:
         if _tried:
             return _lib
+        # Parse the flag BEFORE latching _tried: a strict-parse failure
+        # must raise on every call, not raise once and then silently
+        # disable the native engine forever.
+        no_native = envflags.env_bool("DPF_TPU_NO_NATIVE", default=False)
         _tried = True
-        if os.environ.get("DPF_TPU_NO_NATIVE"):
+        if no_native:
             return None
         try:
             stale = (not os.path.exists(_LIB)) or (

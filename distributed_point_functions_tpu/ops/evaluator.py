@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -42,7 +41,11 @@ from ..core.keys import DpfKey
 from ..core.value_types import Int, XorWrapper
 from ..utils import faultinject
 from ..utils import telemetry as _tm
-from ..utils.envflags import env_bool as _env_bool
+from ..utils.envflags import (
+    env_bool as _env_bool,
+    env_int as _env_int,
+    env_opt_bool as _env_opt_bool,
+)
 from ..utils.errors import InvalidArgumentError
 from . import aes_jax, backend_jax, value_codec
 from . import pipeline as _pl
@@ -936,8 +939,9 @@ def _pallas_default() -> bool:
     (1/true/yes/on vs 0/false/no/off), else ON exactly for real TPU
     backends (PERF.md "Pallas vs XLA bitslice" — ~12x; CPU/interpret
     platforms keep the XLA path)."""
-    if "DPF_TPU_PALLAS" in os.environ:
-        return _env_bool("DPF_TPU_PALLAS")
+    env = _env_opt_bool("DPF_TPU_PALLAS")
+    if env is not None:
+        return env
     return jax.default_backend() == "tpu"
 
 
@@ -1287,7 +1291,7 @@ def full_domain_evaluate_chunks(
         # user-pinned splits keep user control. Sized by the ACTUAL program
         # key count: chunks() does not pad when the batch is smaller than
         # key_chunk.
-        budget = int(os.environ.get("DPF_TPU_MAX_PROGRAM_BYTES", "0"))
+        budget = _env_int("DPF_TPU_MAX_PROGRAM_BYTES", 0)
         if (
             budget > 0
             and mode == "fused"
@@ -1651,9 +1655,7 @@ def plan_megakernel(
             f"<= host_levels {host_levels}); use mode='fold' for tiny domains"
         )
     if vmem_budget is None:
-        vmem_budget = int(
-            os.environ.get("DPF_TPU_MEGAKERNEL_VMEM", str(8 << 20))
-        )
+        vmem_budget = _env_int("DPF_TPU_MEGAKERNEL_VMEM", 8 << 20)
     # Leaf-level slab: 128 rows x w_f x 4 B, ~4x live temporaries in the
     # traced AES circuit; mid scratch: 129 rows x w_v x 4 B.
     w_f_max = _floor_pow2(max(1, (vmem_budget // 2) // (128 * 4 * 4)))
@@ -1929,9 +1931,7 @@ def plan_walkkernel(
             f"walk megakernel needs at least one tree level, got {levels}"
         )
     if vmem_budget is None:
-        vmem_budget = int(
-            os.environ.get("DPF_TPU_WALKKERNEL_VMEM", str(8 << 20))
-        )
+        vmem_budget = _env_int("DPF_TPU_WALKKERNEL_VMEM", 8 << 20)
     w = -(-max(1, num_points) // 32)
     per_word = 4 * (128 * 4 + 32 * max(1, lpe) * (3 if captures else 2) + levels)
     cap = _floor_pow2(max(128, vmem_budget // per_word))
@@ -2009,9 +2009,7 @@ def plan_hierkernel(
             f"got {levels}"
         )
     if vmem_budget is None:
-        vmem_budget = int(
-            os.environ.get("DPF_TPU_HIERKERNEL_VMEM", str(8 << 20))
-        )
+        vmem_budget = _env_int("DPF_TPU_HIERKERNEL_VMEM", 8 << 20)
     w = -(-max(1, num_lanes) // 32)
     per_word = 4 * (
         128 * 5 + 32 * max(1, lpe) * max(1, keep) * 2 + levels + n_rows + 8

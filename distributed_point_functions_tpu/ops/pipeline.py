@@ -56,7 +56,6 @@ from __future__ import annotations
 
 import functools
 import itertools
-import os
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import wait as _futures_wait
@@ -66,7 +65,8 @@ import numpy as np
 
 from ..utils import faultinject
 from ..utils import telemetry as _tm
-from ..utils.envflags import env_bool as _env_bool
+from ..utils import envflags as _envflags
+from ..utils.errors import InvalidArgumentError
 
 T = TypeVar("T")
 R = TypeVar("R")
@@ -87,8 +87,9 @@ def pipeline_default() -> bool:
     exactly for non-CPU JAX backends. XLA:CPU computes on the very cores
     the launch/finalize stages would overlap on, so pipelining there buys
     nothing and costs a thread; tests opt in explicitly."""
-    if "DPF_TPU_PIPELINE" in os.environ:
-        return _env_bool("DPF_TPU_PIPELINE")
+    env = _envflags.env_opt_bool("DPF_TPU_PIPELINE")
+    if env is not None:
+        return env
     import jax
 
     return jax.default_backend() != "cpu"
@@ -104,8 +105,8 @@ def depth_default() -> int:
     holds). DPF_TPU_PIPELINE_DEPTH, floor 1, default 2 (double buffering:
     one uploading/computing, one computed awaiting pull)."""
     try:
-        depth = int(os.environ.get("DPF_TPU_PIPELINE_DEPTH", "2"))
-    except ValueError:
+        depth = _envflags.env_int("DPF_TPU_PIPELINE_DEPTH", 2)
+    except InvalidArgumentError:
         depth = 2
     return max(1, depth)
 
@@ -114,8 +115,9 @@ def donate_default() -> bool:
     """Input-buffer donation default: DPF_TPU_DONATE when set, else ON for
     real TPU backends only (XLA:CPU does not implement donation and warns
     once per donated program)."""
-    if "DPF_TPU_DONATE" in os.environ:
-        return _env_bool("DPF_TPU_DONATE")
+    env = _envflags.env_opt_bool("DPF_TPU_DONATE")
+    if env is not None:
+        return env
     import jax
 
     return jax.default_backend() == "tpu"
@@ -294,8 +296,8 @@ def drain_timeout_default() -> float:
     """Bound on the drain-on-error wait (seconds): DPF_TPU_DRAIN_TIMEOUT,
     default 60 — the pre-ISSUE-7 hardcoded constant, now a knob."""
     try:
-        return float(os.environ.get("DPF_TPU_DRAIN_TIMEOUT", "60"))
-    except ValueError:
+        return _envflags.env_float("DPF_TPU_DRAIN_TIMEOUT", 60.0)
+    except InvalidArgumentError:
         return 60.0
 
 

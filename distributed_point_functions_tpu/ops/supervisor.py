@@ -71,7 +71,7 @@ from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..utils import faultinject, integrity
+from ..utils import envflags, faultinject, integrity
 from ..utils import telemetry as _tm
 from ..utils.errors import (
     DataCorruptionError,
@@ -98,15 +98,9 @@ _UNSET = object()
 
 def deadline_default() -> Optional[float]:
     """DPF_TPU_DEADLINE seconds (float), None/unset/<=0 = no deadline."""
-    raw = os.environ.get("DPF_TPU_DEADLINE")
-    if not raw or not raw.strip():
+    seconds = envflags.env_float("DPF_TPU_DEADLINE", None)
+    if seconds is None:
         return None
-    try:
-        seconds = float(raw)
-    except ValueError:
-        raise InvalidArgumentError(
-            f"DPF_TPU_DEADLINE must be seconds (float), got {raw!r}"
-        )
     return seconds if seconds > 0 else None
 
 

@@ -34,7 +34,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..ops import aes_jax, backend_jax, evaluator
-from ..utils import errors, faultinject
+from ..utils import envflags, errors, faultinject
 from ..utils import telemetry as _tm
 
 
@@ -350,7 +350,6 @@ def pir_query_batch(
     serving loops pay the pull once at setup.
     """
     import math
-    import os
     v = dpf.validator
     hierarchy_level = v.num_hierarchy_levels - 1
     keys, probe = _pir_probe(dpf, keys, integrity, "pir_query_batch", "jax")
@@ -401,7 +400,7 @@ def pir_query_batch(
             keys_local = -(-batch.seeds.shape[0] // mesh.shape["keys"])
             # ~16 B/leaf of plane state, ~4x for fusion temporaries.
             est = keys_local * (1 << max(expand_levels, 0)) * 16 * 4
-            budget = int(os.environ.get("DPF_TPU_PIR_SLAB_BUDGET", 2 << 30))
+            budget = envflags.env_int("DPF_TPU_PIR_SLAB_BUDGET", 2 << 30)
             if est > budget:
                 slab_levels = min(
                     max(expand_levels, 0), math.ceil(math.log2(est / budget))

@@ -52,6 +52,7 @@ from ..utils import telemetry as _tm
 from ..utils.errors import (
     DpfError,
     FailedPreconditionError,
+    InvalidArgumentError,
     UnavailableError,
 )
 from . import wire
@@ -478,7 +479,9 @@ class TwoServerClient:
         policy: Optional[RetryPolicy] = None,
     ):
         if len(endpoints) != 2:
-            raise ValueError("TwoServerClient needs exactly two endpoints")
+            raise InvalidArgumentError(
+                "TwoServerClient needs exactly two endpoints"
+            )
         self.clients = [
             DpfClient(host, port, policy=policy) for host, port in endpoints
         ]

@@ -54,6 +54,7 @@ import os
 import threading
 from typing import Dict, Optional, Tuple
 
+from ..utils import envflags
 from ..utils import telemetry as _tm
 from ..utils.errors import InvalidArgumentError
 
@@ -508,7 +509,7 @@ class Router:
         self.calibration = (
             calibration
             if calibration is not None
-            else os.environ.get("DPF_TPU_ROUTER_CALIB") or None
+            else envflags.env_str("DPF_TPU_ROUTER_CALIB") or None
         )
         if self.calibration and os.path.exists(self.calibration):
             try:

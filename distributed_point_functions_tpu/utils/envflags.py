@@ -1,13 +1,22 @@
 """Shared environment-flag parsing.
 
-One canonical parser for the library's boolean env switches
+One canonical parser per flag *type* for the library's env switches
 (DPF_TPU_PALLAS, DPF_TPU_FUSE_LAST_HASH, DPF_TPU_INTEGRITY, ...): two
-copies could drift and silently make two flags parse differently.
+copies could drift and silently make two flags parse differently — a
+typo in an A/B benchmark flag must not measure the same path twice.
+
+This is the ONLY module in the library allowed to touch ``os.environ``
+directly (enforced by ``tools/dpflint``'s env-discipline checker); every
+other module reads flags through these helpers. Parsing is STRICT:
+unrecognized values raise ``InvalidArgumentError`` instead of silently
+picking a side. Unset — and, for the numeric helpers, blank — values
+resolve to the caller's default.
 """
 
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 from .errors import InvalidArgumentError
 
@@ -27,3 +36,47 @@ def env_bool(name: str, default: bool = False) -> bool:
     raise InvalidArgumentError(
         f"{name} must be a boolean-ish value, got {env!r}"
     )
+
+
+def env_opt_bool(name: str) -> Optional[bool]:
+    """Tri-state boolean: None when the flag is UNSET (callers fall back
+    to a platform-dependent default), else the strict env_bool parse —
+    an explicitly empty value parses False, matching the historical
+    ``if name in os.environ`` call sites."""
+    if name not in os.environ:
+        return None
+    return env_bool(name)
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer env flag: unset/blank -> default, anything unparsable
+    raises (strict, like env_bool)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return int(raw.strip())
+    except ValueError:
+        raise InvalidArgumentError(
+            f"{name} must be an integer, got {raw!r}"
+        )
+
+
+def env_float(name: str, default: Optional[float]) -> Optional[float]:
+    """Float env flag: unset/blank -> default, anything unparsable
+    raises (strict, like env_bool)."""
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        return float(raw.strip())
+    except ValueError:
+        raise InvalidArgumentError(
+            f"{name} must be a float, got {raw!r}"
+        )
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """String env flag (paths, addresses): unset -> default, no parsing.
+    Exists so non-envflags modules never touch os.environ directly."""
+    return os.environ.get(name, default)

@@ -63,6 +63,8 @@ from typing import FrozenSet, Optional
 
 import numpy as np
 
+from .errors import InvalidArgumentError
+
 #: Recognized injection stages (see module docstring).
 STAGES = (
     "seeds", "cw", "wire", "device_output", "device_call", "chunk_launch",
@@ -119,7 +121,9 @@ class FaultPlan:
 
     def __post_init__(self):
         if self.stage not in STAGES:
-            raise ValueError(f"unknown fault stage {self.stage!r}; one of {STAGES}")
+            raise InvalidArgumentError(
+                f"unknown fault stage {self.stage!r}; one of {STAGES}"
+            )
 
     def _matches(
         self, stage: str, backend: Optional[str], mode: Optional[str] = None
@@ -225,7 +229,7 @@ def corrupt_wire(blob: bytes, backend: Optional[str] = None) -> bytes:
         b = bytearray(blob)
         b[plan.wire_arg % len(b)] ^= 1 << (plan.bit % 8)
         return bytes(b)
-    raise ValueError(f"unknown wire_mode {plan.wire_mode!r}")
+    raise InvalidArgumentError(f"unknown wire_mode {plan.wire_mode!r}")
 
 
 def corrupt_output(values: np.ndarray, backend: Optional[str] = None) -> np.ndarray:
@@ -246,7 +250,7 @@ def corrupt_output(values: np.ndarray, backend: Optional[str] = None) -> np.ndar
     elif plan.pattern == "lane":
         idx = np.array([plan.lane % out.shape[1]])
     else:
-        raise ValueError(f"unknown output pattern {plan.pattern!r}")
+        raise InvalidArgumentError(f"unknown output pattern {plan.pattern!r}")
     out[row, idx] ^= np.uint32(plan.xor_mask)
     return out
 

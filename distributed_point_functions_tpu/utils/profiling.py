@@ -13,11 +13,10 @@ library's own phase structure appears on the timeline.
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 from typing import Iterator, Optional
 
-from . import telemetry
+from . import envflags, telemetry
 
 
 @contextlib.contextmanager
@@ -35,7 +34,7 @@ def trace(log_dir: Optional[str] = None) -> Iterator[None]:
     pipeline's launch/finalize stages and the bulk entry points appear as
     named regions in the captured timeline.
     """
-    log_dir = log_dir or os.environ.get("DPF_TPU_PROFILE_DIR")
+    log_dir = log_dir or envflags.env_str("DPF_TPU_PROFILE_DIR")
     if not log_dir:
         yield
         return
@@ -58,7 +57,7 @@ def annotate(name: str):
     :func:`trace` block active) — the old version imported jax and built
     a TraceAnnotation unconditionally, paying the annotation on every
     call with no profiler to receive it."""
-    if not (os.environ.get("DPF_TPU_PROFILE_DIR") or telemetry._profile_bridge):
+    if not (envflags.env_str("DPF_TPU_PROFILE_DIR") or telemetry._profile_bridge):
         return contextlib.nullcontext()
     import jax
 

@@ -38,6 +38,8 @@ import os
 
 import numpy as np
 
+from . import envflags, errors
+
 # v5e VPU: (8 sublanes, 128 lanes) x 4 ALUs x ~940 MHz. 32-bit ops.
 V5E_VPU_OPS_PER_SEC = 8 * 128 * 4 * 0.94e9
 
@@ -176,7 +178,7 @@ def hbm_bytes_per_eval(
       the database row (4*lpe B).
     """
     if strategy not in ("levels", "fused", "fold", "megakernel"):
-        raise ValueError(
+        raise errors.InvalidArgumentError(
             f"no HBM traffic model for strategy {strategy!r} (modeled: "
             "levels/fused/fold/megakernel)"
         )
@@ -251,7 +253,7 @@ def walk_hbm_bytes_per_point(
       the model for honesty at very deep trees).
     """
     if strategy not in ("walk", "walkkernel"):
-        raise ValueError(
+        raise errors.InvalidArgumentError(
             f"no walk HBM traffic model for strategy {strategy!r} "
             "(modeled: walk/walkkernel)"
         )
@@ -326,7 +328,7 @@ def hier_hbm_bytes_per_prefix_level(
       bandwidth — both strategies sit far under either wall.
     """
     if strategy not in ("fused", "hierkernel"):
-        raise ValueError(
+        raise errors.InvalidArgumentError(
             f"no hierarchical HBM traffic model for strategy {strategy!r} "
             "(modeled: fused/hierkernel)"
         )
@@ -396,12 +398,9 @@ def host_threads_default() -> int:
     """The host engine's worker count: DPF_TPU_THREADS (0 = all hardware
     threads, unset = the reference-parity 1) — the same resolution rule as
     native/dpf_native.cc."""
-    raw = os.environ.get("DPF_TPU_THREADS", "").strip()
-    if not raw:
-        return 1
     try:
-        n = int(raw)
-    except ValueError:
+        n = envflags.env_int("DPF_TPU_THREADS", 1)
+    except errors.InvalidArgumentError:
         return 1
     if n == 0:
         return os.cpu_count() or 1

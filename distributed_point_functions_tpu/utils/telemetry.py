@@ -64,13 +64,15 @@ import dataclasses
 import itertools
 import json
 import logging
-import os
 import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+
+from . import envflags
+from .errors import InvalidArgumentError
 
 _log = logging.getLogger("distributed_point_functions_tpu.telemetry")
 
@@ -756,7 +758,7 @@ def configure_from_env() -> None:
     and long-lived servers call it again after changing the environment."""
     global _jsonl, _global_ring, _profile_bridge
     with _lock:
-        path = os.environ.get("DPF_TPU_TELEMETRY_LOG") or None
+        path = envflags.env_str("DPF_TPU_TELEMETRY_LOG") or None
         if _jsonl is not None and _jsonl.path != path:
             _remove_collector(_jsonl)
             _jsonl.close()
@@ -768,18 +770,28 @@ def configure_from_env() -> None:
             except OSError:
                 _log.exception("cannot open DPF_TPU_TELEMETRY_LOG %r", path)
                 _jsonl = None
-        want_ring = os.environ.get("DPF_TPU_TELEMETRY", "").strip().lower() in (
-            "1", "true", "yes", "on",
-        )
+        try:
+            want_ring = envflags.env_bool("DPF_TPU_TELEMETRY", default=False)
+        except InvalidArgumentError:
+            # Called at import: an unparsable value must not wedge the
+            # process — log and leave the ring off (the historical
+            # lenient behavior of this one site).
+            _log.warning("unparsable DPF_TPU_TELEMETRY value; ring stays off")
+            want_ring = False
         if want_ring and _global_ring is None:
-            _global_ring = Collector(
-                ring=int(os.environ.get("DPF_TPU_TELEMETRY_RING", "4096"))
-            )
+            try:
+                ring = envflags.env_int("DPF_TPU_TELEMETRY_RING", 4096)
+            except InvalidArgumentError:
+                _log.warning(
+                    "unparsable DPF_TPU_TELEMETRY_RING value; using 4096"
+                )
+                ring = 4096
+            _global_ring = Collector(ring=ring)
             _add_collector(_global_ring)
         elif not want_ring and _global_ring is not None:
             _remove_collector(_global_ring)
             _global_ring = None
-        _profile_bridge = bool(os.environ.get("DPF_TPU_PROFILE_DIR"))
+        _profile_bridge = bool(envflags.env_str("DPF_TPU_PROFILE_DIR"))
         _recompute_enabled()
 
 
@@ -789,7 +801,7 @@ def set_profile_bridge(active: bool) -> None:
     global _profile_bridge
     with _lock:
         _profile_bridge = bool(active) or bool(
-            os.environ.get("DPF_TPU_PROFILE_DIR")
+            envflags.env_str("DPF_TPU_PROFILE_DIR")
         )
         _recompute_enabled()
 

@@ -1,0 +1,568 @@
+"""dpflint (ISSUE 11): fixture-driven fire/stay-quiet proofs per checker,
+plus the repo-wide gates — zero findings at HEAD against the committed
+baseline, the mosaic watch-list pinned EXACTLY (no grandfathered
+wildcards), all three megakernel families' replay-parity contracts, and
+the pure-AST / no-jax property of the CLI.
+
+Every fixture pair seeds one violation class (a new broadcasted_iota in
+a kernel body, a bare raise, an unlocked telemetry mutation, ...) into a
+throwaway tree and asserts the checker reports it with a file:line
+finding — and that the corresponding clean tree stays quiet. This is the
+acceptance demonstration that seeding a violation into the real tree
+would turn `./ci.sh lint` red.
+
+Pure host-side AST work: no device programs, no pallas configs, ~2 s.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import tools.dpflint as dpflint  # noqa: E402
+from tools.dpflint.core import PACKAGE, collect_modules, load_baseline  # noqa: E402
+
+PKG = PACKAGE
+
+_REPO_MODULES = None
+
+
+def repo_modules():
+    """Parse the real tree once per session — three tests walk it."""
+    global _REPO_MODULES
+    if _REPO_MODULES is None:
+        _REPO_MODULES = collect_modules(REPO_ROOT)
+    return _REPO_MODULES
+
+
+def write(root: Path, rel: str, text: str) -> None:
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(text))
+
+
+def run_checker(root: Path, checker: str, baseline=None):
+    findings, observed = dpflint.run(
+        root, baseline, checkers=(checker,)
+    )
+    return findings, observed
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide gates (the acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_clean_at_head():
+    """The whole tree lints clean against the committed baseline — the
+    in-process twin of `./ci.sh lint`."""
+    baseline = load_baseline(dpflint.DEFAULT_BASELINE)
+    findings, _ = dpflint.run(REPO_ROOT, baseline, modules=repo_modules())
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_mosaic_baseline_matches_watchlist_exactly():
+    """The mosaic-opset baseline pins the PERF.md watch-list sites in
+    ops/aes_pallas.py exactly: the slab kernel's 1-D jnp.concatenate
+    (child doubling) and broadcasted_iota (child key mask), the legacy
+    tensor kernel's reshape/hash_planes/iota, and the cross-grid-step
+    VMEM scratch — nothing more, nothing less, no wildcards."""
+    _, observed = dpflint.run(
+        REPO_ROOT, load_baseline(dpflint.DEFAULT_BASELINE),
+        checkers=("mosaic-opset",), modules=repo_modules(),
+    )
+    kp = f"{PKG}/ops/aes_pallas.py"
+    assert observed["mosaic-opset"] == {
+        f"{kp}::_expand_kernel::aes_jax.hash_planes": 1,
+        f"{kp}::_expand_kernel::jax.lax.broadcasted_iota": 1,
+        f"{kp}::_expand_kernel::method:reshape": 1,
+        f"{kp}::_expand_rows_double::jax.lax.broadcasted_iota": 1,
+        f"{kp}::_expand_rows_double::jnp.concatenate": 2,
+        f"{kp}::megakernel_fold_pallas_batched::pltpu.VMEM": 2,
+    }
+
+
+def test_replay_parity_covers_all_three_megakernel_families():
+    """Slab, walk and hier megakernels each share their core with the
+    replay — the structural form of the verbatim-sharing contract."""
+    _, observed = dpflint.run(
+        REPO_ROOT, load_baseline(dpflint.DEFAULT_BASELINE),
+        checkers=("replay-parity",), modules=repo_modules(),
+    )
+    kp = f"{PKG}/ops/aes_pallas.py"
+    assert observed["replay-parity"] == {
+        f"{kp}::megakernel_fold_pallas_batched~megakernel_reference_rows"
+        "::_megakernel_slab_tail": 1,
+        f"{kp}::walk_megakernel_pallas_batched~walk_megakernel_reference_rows"
+        "::_walk_megakernel_core": 1,
+        f"{kp}::hier_megakernel_pallas_batched~hier_megakernel_reference_rows"
+        "::_hier_megakernel_core": 1,
+    }
+
+
+def test_cli_clean_and_never_imports_jax():
+    """`python -m tools.dpflint` exits 0 at HEAD in seconds. main()
+    asserts jax is absent from sys.modules — a jax import anywhere in
+    the lint path would crash this subprocess."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.dpflint"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=30,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean (6 checkers" in r.stdout
+
+
+def test_cli_nonzero_on_violation(tmp_path):
+    """A seeded violation makes the CLI exit nonzero with a file:line
+    finding."""
+    write(
+        tmp_path, f"{PKG}/utils/broken.py",
+        '''
+        def f():
+            raise ValueError("nope")
+        ''',
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "tools.dpflint",
+            "--root", str(tmp_path),
+            "--baseline", str(tmp_path / "missing.json"),
+            "--checker", "error-taxonomy",
+        ],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=30,
+    )
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert f"{PKG}/utils/broken.py:3: [error-taxonomy]" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# mosaic-opset fixtures
+# ---------------------------------------------------------------------------
+
+_KERNEL_HEADER = '''
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+'''
+
+
+def _kernel_module(body: str) -> str:
+    return textwrap.dedent(_KERNEL_HEADER) + textwrap.dedent(body) + (
+        "\n\ndef entry(x):\n    return pl.pallas_call(_row_kernel)(x)\n"
+    )
+
+
+def test_mosaic_opset_fires_on_disallowed_op(tmp_path):
+    write(
+        tmp_path, f"{PKG}/ops/kern.py",
+        _kernel_module(
+            '''
+            def _row_kernel(x_ref, o_ref):
+                r = x_ref[0, :]
+                o_ref[0, :] = jnp.cumsum(r)
+            '''
+        ),
+    )
+    findings, _ = run_checker(tmp_path, "mosaic-opset")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.checker == "mosaic-opset" and "jnp.cumsum" in f.message
+    assert f.path == f"{PKG}/ops/kern.py" and f.line > 0
+
+
+def test_mosaic_opset_fires_on_new_watchlist_site(tmp_path):
+    """A NEW broadcasted_iota in a kernel body — allowed only at the
+    baseline-pinned sites — fails against a baseline that lacks it."""
+    write(
+        tmp_path, f"{PKG}/ops/kern.py",
+        _kernel_module(
+            '''
+            def _row_kernel(x_ref, o_ref):
+                r = x_ref[0, :]
+                pos = jax.lax.broadcasted_iota(jnp.uint32, (1, 8), 1)[0]
+                o_ref[0, :] = jnp.where(pos > 0, r, jnp.zeros_like(r))
+            '''
+        ),
+    )
+    findings, _ = run_checker(tmp_path, "mosaic-opset")
+    assert len(findings) == 1
+    assert "broadcasted_iota" in findings[0].message
+    assert "new occurrence" in findings[0].message
+    # ... and is quiet once pinned (the baseline tracks it exactly).
+    key = f"{PKG}/ops/kern.py::_row_kernel::jax.lax.broadcasted_iota"
+    findings, _ = run_checker(
+        tmp_path, "mosaic-opset", {"mosaic-opset": {key: 1}}
+    )
+    assert findings == []
+
+
+def test_mosaic_opset_quiet_on_proven_ops(tmp_path):
+    """A kernel (plus a helper it reaches, plus trace-time list building)
+    strictly inside the proven op set produces no findings."""
+    write(
+        tmp_path, f"{PKG}/ops/kern.py",
+        _kernel_module(
+            '''
+            def _helper_rows(rows):
+                out = []
+                for r in rows:
+                    out.append(jnp.where(r > 0, r, jnp.zeros_like(r)))
+                return out
+
+            def _row_kernel(x_ref, o_ref):
+                rows = [x_ref[0, p, :] for p in range(4)]
+                rows = _helper_rows(rows)
+                for p in range(4):
+                    o_ref[0, p, :] = rows[p]
+            '''
+        ),
+    )
+    findings, observed = run_checker(tmp_path, "mosaic-opset")
+    assert findings == [] and observed["mosaic-opset"] == {}
+
+
+def test_mosaic_opset_fires_on_scatter_method(tmp_path):
+    """`.at[...].set(...)` — the scatter Mosaic rejected on v5e — is a
+    method call outside the watch-list: hard violation."""
+    write(
+        tmp_path, f"{PKG}/ops/kern.py",
+        _kernel_module(
+            '''
+            def _row_kernel(x_ref, o_ref):
+                h = x_ref[0, :]
+                o_ref[0, :] = h.at[0].set(jnp.uint32(0))
+            '''
+        ),
+    )
+    findings, _ = run_checker(tmp_path, "mosaic-opset")
+    assert any(".set" in f.message for f in findings), findings
+
+
+# ---------------------------------------------------------------------------
+# replay-parity fixtures
+# ---------------------------------------------------------------------------
+
+_PARITY_SHARED = '''
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def _foo_core(rows):
+        return [jnp.zeros_like(r) for r in rows]
+
+    def _foo_body():
+        def kernel(x_ref, o_ref):
+            o_ref[0, :] = _foo_core([x_ref[0, :]])[0]
+        return kernel
+
+    def foo_megakernel_pallas_batched(x):
+        return pl.pallas_call(_foo_body())(x)
+'''
+
+
+def test_replay_parity_quiet_when_core_shared(tmp_path):
+    write(
+        tmp_path, f"{PKG}/ops/kern.py",
+        _PARITY_SHARED + '''
+    def foo_megakernel_reference_rows(x):
+        return _foo_core([x])[0]
+''',
+    )
+    key = (
+        f"{PKG}/ops/kern.py::foo_megakernel_pallas_batched~"
+        "foo_megakernel_reference_rows::_foo_core"
+    )
+    findings, observed = run_checker(
+        tmp_path, "replay-parity", {"replay-parity": {key: 1}}
+    )
+    assert findings == []
+    assert observed["replay-parity"] == {key: 1}
+
+
+def test_replay_parity_fires_when_replay_diverges(tmp_path):
+    """A replay that stops calling the shared core (a maintained-
+    in-parallel copy) breaks the contract."""
+    write(
+        tmp_path, f"{PKG}/ops/kern.py",
+        _PARITY_SHARED + '''
+    def foo_megakernel_reference_rows(x):
+        return [jnp.zeros_like(x)]
+''',
+    )
+    findings, _ = run_checker(tmp_path, "replay-parity")
+    assert len(findings) == 1
+    assert "share no `_*_core`" in findings[0].message
+
+
+def test_replay_parity_fires_on_replayless_megakernel(tmp_path):
+    write(tmp_path, f"{PKG}/ops/kern.py", _PARITY_SHARED)
+    findings, _ = run_checker(tmp_path, "replay-parity")
+    assert len(findings) == 1
+    assert "no *_reference_rows replay" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# error-taxonomy fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_taxonomy_fires_and_stays_quiet(tmp_path):
+    write(
+        tmp_path, f"{PKG}/utils/thing.py",
+        '''
+        from .errors import InvalidArgumentError
+
+        def bad(x):
+            raise RuntimeError("boom")
+
+        def good(x):
+            raise InvalidArgumentError("bad x")
+        ''',
+    )
+    findings, _ = run_checker(tmp_path, "error-taxonomy")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "raise RuntimeError" in f.message and f.line == 5
+    # tests/benchmarks are out of scope
+    write(tmp_path, "tests/test_whatever.py", "def f():\n    raise ValueError('x')\n")
+    findings, _ = run_checker(tmp_path, "error-taxonomy")
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------------------------------
+# env-discipline fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_env_discipline_fires_on_direct_dpf_read(tmp_path):
+    """All three stdlib idioms are caught: os.environ, os.getenv, and a
+    bare `environ` imported from os — none bypasses the discipline."""
+    write(
+        tmp_path, f"{PKG}/utils/knobs.py",
+        '''
+        import os
+        from os import environ
+
+        def f():
+            return os.environ.get("DPF_TPU_FIXTURE_FLAG", "0")
+
+        def g():
+            return os.getenv("DPF_TPU_FIXTURE_FLAG")
+
+        def h():
+            return environ["DPF_TPU_FIXTURE_FLAG"]
+        ''',
+    )
+    write(tmp_path, "README.md", "knobs: DPF_TPU_FIXTURE_FLAG\n")
+    findings, _ = run_checker(tmp_path, "env-discipline")
+    assert len(findings) == 3, findings
+    assert all(
+        "direct os.environ read of DPF_TPU_FIXTURE_FLAG" in f.message
+        for f in findings
+    )
+    assert sorted(f.line for f in findings) == [6, 9, 12]
+
+
+def test_env_discipline_fires_on_undocumented_flag_and_foreign_env(tmp_path):
+    write(
+        tmp_path, f"{PKG}/utils/knobs.py",
+        '''
+        import os
+        from . import envflags
+
+        def f():
+            return envflags.env_str("DPF_TPU_UNDOCUMENTED")
+
+        def g():
+            return os.environ.get("SOME_OTHER_VAR")
+        ''',
+    )
+    write(tmp_path, "README.md", "no flags here\n")
+    findings, _ = run_checker(tmp_path, "env-discipline")
+    msgs = [f.message for f in findings]
+    assert any("missing from README" in m for m in msgs), msgs
+    # the non-DPF touch is a NEW pin vs the empty baseline
+    assert any("environ[SOME_OTHER_VAR]" in m for m in msgs), msgs
+
+
+def test_env_discipline_quiet_when_disciplined(tmp_path):
+    write(
+        tmp_path, f"{PKG}/utils/knobs.py",
+        '''
+        from . import envflags
+
+        def f():
+            return envflags.env_int("DPF_TPU_FIXTURE_FLAG", 2)
+        ''',
+    )
+    write(tmp_path, "README.md", "knobs: `DPF_TPU_FIXTURE_FLAG` (default 2)\n")
+    findings, observed = run_checker(tmp_path, "env-discipline")
+    assert findings == [] and observed["env-discipline"] == {}
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_lock_discipline_fires_on_unlocked_module_mutation(tmp_path):
+    """The literal ISSUE-6 shape: an unlocked module list mutated while a
+    worker thread iterates. The fixture file sits at the telemetry
+    module's path — the checker scopes to the threaded modules."""
+    write(
+        tmp_path, f"{PKG}/utils/telemetry.py",
+        '''
+        import threading
+
+        _lock = threading.Lock()
+        _hooks = []
+
+        def add_hook(h):
+            _hooks.append(h)
+
+        def remove_hook(h):
+            with _lock:
+                _hooks.remove(h)
+        ''',
+    )
+    findings, _ = run_checker(tmp_path, "lock-discipline")
+    assert len(findings) == 1
+    f = findings[0]
+    assert "unlocked:_hooks" in f.message and f.line == 8
+
+
+def test_lock_discipline_fires_on_unlocked_instance_mutation(tmp_path):
+    write(
+        tmp_path, f"{PKG}/serving/batcher.py",
+        '''
+        import threading
+
+        class Queue:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []
+
+            def add(self, x):
+                with self._lock:
+                    self._items.append(x)
+
+            def sneaky(self, x):
+                self._items.append(x)
+        ''',
+    )
+    findings, _ = run_checker(tmp_path, "lock-discipline")
+    assert len(findings) == 1
+    assert "unlocked:self._items" in findings[0].message
+    assert findings[0].line == 14
+
+
+def test_lock_discipline_quiet_when_locked(tmp_path):
+    write(
+        tmp_path, f"{PKG}/utils/telemetry.py",
+        '''
+        import threading
+
+        _lock = threading.Lock()
+        _hooks = []
+
+        def add_hook(h):
+            with _lock:
+                _hooks.append(h)
+
+        class Bus:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._pending = {}
+
+            def put(self, k, v):
+                with self._cond:
+                    self._pending[k] = v
+
+            def local_scratch(self):
+                pending = []
+                pending.append(1)  # a LOCAL, not the module state
+                return pending
+        ''',
+    )
+    findings, observed = run_checker(tmp_path, "lock-discipline")
+    assert findings == [] and observed["lock-discipline"] == {}
+
+
+# ---------------------------------------------------------------------------
+# compile-budget fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_compile_budget_fires_on_config_scatter(tmp_path):
+    write(
+        tmp_path, "tests/test_kernels.py",
+        '''
+        def test_a(run):
+            run(block_w=128, interpret=True)
+
+        def test_b(run):
+            run(block_w=256, interpret=True)
+        ''',
+    )
+    findings, _ = run_checker(tmp_path, "compile-budget")
+    assert len(findings) == 1
+    assert "interpret-configs" in findings[0].message
+    # pinned ceiling makes it pass; shrinking below the pin stays legal
+    key = "tests/test_kernels.py::interpret-configs"
+    findings, _ = run_checker(
+        tmp_path, "compile-budget", {"compile-budget": {key: 2}}
+    )
+    assert findings == []
+
+
+def test_compile_budget_quiet_on_shared_config(tmp_path):
+    """Equivalence variants through the SAME config (the walkkernel
+    lesson) stay under the default budget — including entry-point calls
+    that pin a staged kernel mode."""
+    write(
+        tmp_path, "tests/test_kernels.py",
+        '''
+        def test_a(run):
+            run(block_w=128, interpret=True)
+
+        def test_b(run):
+            run(block_w=128, interpret=True)  # same signature = same config
+
+        def test_c(entry):
+            entry(mode="walkkernel", key_chunk=2, pipeline=False)
+            entry(mode="walkkernel", key_chunk=2, pipeline=True)
+        ''',
+    )
+    findings, observed = run_checker(tmp_path, "compile-budget")
+    # run+interpret and entry+walkkernel are 2 distinct families -> over
+    # the default budget of 1... unless they are the same callee. They
+    # are not, so this module needs a pin of 2:
+    key = "tests/test_kernels.py::interpret-configs"
+    assert observed["compile-budget"] == {key: 2}
+    findings, _ = run_checker(
+        tmp_path, "compile-budget", {"compile-budget": {key: 2}}
+    )
+    assert findings == []
+
+
+def test_compile_budget_single_config_needs_no_pin(tmp_path):
+    write(
+        tmp_path, "tests/test_kernels.py",
+        '''
+        def test_a(run):
+            run(block_w=128, interpret=True)
+
+        def test_b(run):
+            run(block_w=128, interpret=True)
+        ''',
+    )
+    findings, observed = run_checker(tmp_path, "compile-budget")
+    assert findings == [] and observed["compile-budget"] == {}

@@ -1,0 +1,110 @@
+"""dpflint — AST-enforced repo invariants (ISSUE 11).
+
+Six checkers, each encoding a discipline accumulated across PRs 1-10
+that previously lived only in CHANGES.md prose and reviewer memory:
+
+  mosaic-opset    kernel bodies stay inside the hardware-proven op set;
+                  Mosaic watch-list constructs pinned to exact sites
+  replay-parity   every megakernel shares its _*_core verbatim with its
+                  *_reference_rows replay
+  error-taxonomy  no bare RuntimeError/ValueError in the library
+  env-discipline  DPF_TPU_* reads go through utils/envflags; every flag
+                  documented in README; other os.environ touches pinned
+  lock-discipline shared mutable state in the threaded modules mutated
+                  only under the owning lock
+  compile-budget  one interpret-pallas config per test suite (the
+                  walkkernel ~40-115 s/config lesson)
+
+Run: ``python -m tools.dpflint`` (pure stdlib ast — never imports jax).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from . import compilebudget, envdiscipline, lockdiscipline, mosaic, taxonomy
+from .core import (
+    Baseline,
+    Finding,
+    Module,
+    Pins,
+    collect_modules,
+    compare_pins,
+    load_baseline,
+    save_baseline,
+)
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+#: checker name -> (runner, new-occurrence hint, over_budget semantics)
+_CHECKERS = {
+    mosaic.NAME: (
+        lambda mods, root: mosaic.check_opset(mods),
+        "Mosaic watch-list constructs are pinned to their exact current "
+        "sites; do not add new ones without a recorded hardware compile",
+        False,
+    ),
+    mosaic.PARITY_NAME: (
+        lambda mods, root: mosaic.check_parity(mods),
+        "each megakernel family's kernel<->replay core-sharing contract "
+        "is pinned; update the baseline when adding a family",
+        False,
+    ),
+    taxonomy.NAME: (
+        lambda mods, root: taxonomy.check(mods),
+        "",
+        False,
+    ),
+    envdiscipline.NAME: (
+        lambda mods, root: envdiscipline.check(mods, root),
+        "non-DPF os.environ touches are pinned; migrate to utils/envflags "
+        "or pin deliberately",
+        False,
+    ),
+    lockdiscipline.NAME: (
+        lambda mods, root: lockdiscipline.check(mods),
+        "mutate shared state under the owning lock's `with` block (the "
+        "ISSUE-6 _hooks race class)",
+        False,
+    ),
+    compilebudget.NAME: (
+        lambda mods, root: compilebudget.check(mods),
+        "one interpret-pallas config per suite — drive equivalence "
+        "variants through the SAME shapes (~40-115 s XLA-CPU compile per "
+        "distinct config under the 870 s tier-1 gate)",
+        True,
+    ),
+}
+
+CHECKER_NAMES = tuple(_CHECKERS)
+
+
+def run(
+    root: Path,
+    baseline: Optional[Baseline] = None,
+    checkers: Optional[Tuple[str, ...]] = None,
+    modules: Optional[List[Module]] = None,
+) -> Tuple[List[Finding], Baseline]:
+    """Runs the checkers over `root`. Returns (findings, observed pins).
+    `baseline=None` compares against empty pins (everything new fails);
+    pass {} per checker to the same effect. Fixture tests pass explicit
+    mini-baselines."""
+    baseline = baseline or {}
+    if modules is None:
+        modules = collect_modules(root)
+    findings: List[Finding] = []
+    observed: Baseline = {}
+    for name in checkers or CHECKER_NAMES:
+        runner, hint, over_budget = _CHECKERS[name]
+        violations, pins, pin_lines = runner(modules, root)
+        findings.extend(violations)
+        observed[name] = pins
+        findings.extend(
+            compare_pins(
+                name, pins, baseline.get(name, {}), pin_lines, hint,
+                over_budget=over_budget,
+            )
+        )
+    findings.sort(key=lambda f: (f.path, f.line, f.checker))
+    return findings, observed

@@ -1,0 +1,87 @@
+"""compile-budget checker.
+
+The walkkernel lesson (memory + test docstrings): every DISTINCT
+interpret-mode pallas config — shape plus static args — costs ~40-115 s
+of XLA-CPU compile under the tier-1 gate, and the gate has ~60 s of
+headroom left. Kernel suites therefore funnel every equivalence variant
+(chunking, pipeline on/off, env default, device_output, prepared replay)
+through ONE compiled config per entry point.
+
+This checker counts, statically per test module, the distinct
+interpret-pallas config *constructions*:
+
+* direct kernel calls passing ``interpret=True`` — keyed by (callee,
+  static-config literals: block_w / key_tile / mode);
+* entry-point calls passing a staged kernel ``mode=`` literal
+  ("megakernel" / "walkkernel" / "hierkernel") — keyed by (callee,
+  mode); the suites deliberately share shapes across such calls, so
+  each (callee, mode) pair is one config family.
+
+A module may construct DEFAULT_BUDGET distinct configs freely; anything
+above that must be pinned in the baseline (the pin is a ceiling:
+dropping below it is fine, exceeding it fails). New test modules that
+scatter configs fail immediately.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import TESTS, Finding, Module, Pins, dotted_name
+
+NAME = "compile-budget"
+
+#: Distinct interpret configs a test module may construct without a pin.
+DEFAULT_BUDGET = 1
+
+KERNEL_MODES = {"megakernel", "walkkernel", "hierkernel"}
+CONFIG_KWARGS = ("block_w", "key_tile", "mode")
+
+
+def _literal(node: ast.AST):
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        return f"${node.id}"  # symbolic: same name = same config constant
+    return "<dynamic>"
+
+
+def _signatures(mod: Module) -> Set[Tuple]:
+    sigs: Set[Tuple] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kwargs = {k.arg: k.value for k in node.keywords if k.arg}
+        callee = dotted_name(node.func) or "<dynamic>"
+        callee = ".".join(callee.split(".")[-2:])  # suffix: module.fn
+        interp = kwargs.get("interpret")
+        if isinstance(interp, ast.Constant) and interp.value is True:
+            cfg = tuple(
+                (k, _literal(kwargs[k])) for k in CONFIG_KWARGS if k in kwargs
+            )
+            sigs.add((callee, cfg))
+            continue
+        mode = kwargs.get("mode")
+        if (
+            isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and mode.value in KERNEL_MODES
+        ):
+            sigs.add((callee, (("mode", mode.value),)))
+    return sigs
+
+
+def check(modules: List[Module]) -> Tuple[List[Finding], Pins, Dict[str, int]]:
+    violations: List[Finding] = []
+    pins: Pins = {}
+    pin_lines: Dict[str, int] = {}
+    for mod in modules:
+        if not mod.rel.startswith(TESTS + "/") or "/data/" in mod.rel:
+            continue
+        sigs = _signatures(mod)
+        if len(sigs) > DEFAULT_BUDGET:
+            key = f"{mod.rel}::interpret-configs"
+            pins[key] = len(sigs)
+            pin_lines[key] = 1
+    return violations, pins, pin_lines

@@ -1,0 +1,224 @@
+"""dpflint core: module collection, findings, and baseline semantics.
+
+The repo's cross-cutting invariants (Mosaic op-surface, replay parity,
+error taxonomy, env/lock/compile-budget discipline) accumulated across
+PRs 1-10 as CHANGES.md prose and reviewer memory; this package encodes
+them as AST checks so a violation is a red build, not a review comment.
+
+Pure stdlib `ast` on purpose: the lint tier must cost seconds and must
+never import jax (or anything else heavy) — it runs before the 800 s
+pytest spend in `ci.sh fast` and in environments with no accelerator
+stack at all.
+
+Baseline semantics
+------------------
+Checkers report two kinds of results:
+
+* **violations** — hard failures (a bare ``raise ValueError`` in the
+  library, an op outside the Mosaic allowlist). Always nonzero.
+* **pins** — watch-list occurrences that are *known and deliberate*
+  (the slab kernel's 1-D ``jnp.concatenate``, the multihost JAX_* env
+  reads). Pins are compared EXACTLY against ``baseline.json``:
+
+    - a pin absent from the baseline (or a count above it) is a NEW
+      occurrence -> finding;
+    - a baseline entry that no longer matches the tree (or a count
+      below it) is STALE -> finding, forcing the baseline to track the
+      tree instead of grandfathering wildcards.
+
+  ``python -m tools.dpflint --update-baseline`` rewrites the baseline
+  from the current tree after a reviewed change.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Library package root (relative to the repo root) most checkers scope to.
+PACKAGE = "distributed_point_functions_tpu"
+
+#: Test tree the compile-budget checker scopes to.
+TESTS = "tests"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding: file:line, the checker that fired, what and how
+    to fix. `key` carries the pin key for baseline-related findings."""
+
+    checker: str
+    path: str  # repo-root-relative posix path
+    line: int
+    message: str
+    hint: str = ""
+    key: Optional[str] = None
+
+    def render(self) -> str:
+        out = f"{self.path}:{self.line}: [{self.checker}] {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclasses.dataclass
+class Module:
+    """A parsed source module. `tree` nodes carry `.parent` links and
+    functions carry `.qualname` (dotted from module scope)."""
+
+    path: Path
+    rel: str
+    source: str
+    tree: ast.Module
+
+    @property
+    def lines(self) -> List[str]:
+        return self.source.splitlines()
+
+
+def _annotate(tree: ast.Module) -> None:
+    """Adds .parent links to every node and .qualname to every function/
+    class def (dotted path of enclosing defs, module scope = "")."""
+    tree.parent = None  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            parts = [node.name]
+            p = getattr(node, "parent", None)
+            while p is not None:
+                if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    parts.append(p.name)
+                p = getattr(p, "parent", None)
+            node.qualname = ".".join(reversed(parts))  # type: ignore[attr-defined]
+
+
+def parse_module(path: Path, root: Path) -> Optional[Module]:
+    try:
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+    except (SyntaxError, UnicodeDecodeError, OSError):
+        return None
+    _annotate(tree)
+    return Module(path=path, rel=path.relative_to(root).as_posix(), source=source, tree=tree)
+
+
+def collect_modules(root: Path, subdirs: Iterable[str] = (PACKAGE, TESTS)) -> List[Module]:
+    """Parses every .py under the given repo-root subdirs (skipping
+    __pycache__). Missing subdirs are skipped so fixture roots can carry
+    only the tree a test needs."""
+    modules: List[Module] = []
+    for sub in subdirs:
+        base = root / sub
+        if not base.is_dir():
+            continue
+        for path in sorted(base.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            mod = parse_module(path, root)
+            if mod is not None:
+                modules.append(mod)
+    return modules
+
+
+def enclosing_qualname(node: ast.AST) -> str:
+    """Dotted qualname of the innermost def/class containing `node`
+    ("<module>" at module scope)."""
+    p = getattr(node, "parent", None)
+    while p is not None:
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return p.qualname  # type: ignore[attr-defined]
+        p = getattr(p, "parent", None)
+    return "<module>"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Name/Attribute chain -> "a.b.c"; None for anything else (a method
+    call on a computed value, a subscripted callee, ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+Pins = Dict[str, int]
+Baseline = Dict[str, Pins]
+
+
+def load_baseline(path: Path) -> Baseline:
+    with open(path) as f:
+        data = json.load(f)
+    return {
+        checker: {str(k): int(v) for k, v in pins.items()}
+        for checker, pins in data.items()
+    }
+
+
+def save_baseline(path: Path, baseline: Baseline) -> None:
+    ordered = {
+        checker: dict(sorted(pins.items()))
+        for checker, pins in sorted(baseline.items())
+    }
+    path.write_text(json.dumps(ordered, indent=2) + "\n")
+
+
+def compare_pins(
+    checker: str,
+    observed: Pins,
+    pinned: Pins,
+    lines: Dict[str, int],
+    new_hint: str,
+    over_budget: bool = False,
+) -> List[Finding]:
+    """EXACT baseline comparison (see module docstring). `lines` maps pin
+    key -> a representative line for the report. With `over_budget`,
+    observed counts BELOW the pin are allowed without staleness (the pin
+    is a ceiling, e.g. a per-module compile budget), while counts above
+    it still fail."""
+    findings: List[Finding] = []
+    for key, count in sorted(observed.items()):
+        allowed = pinned.get(key, 0)
+        if count > allowed:
+            findings.append(
+                Finding(
+                    checker=checker,
+                    path=key.split("::", 1)[0],
+                    line=lines.get(key, 1),
+                    message=(
+                        f"new occurrence of pinned construct {key!r} "
+                        f"(observed {count}, baseline {allowed})"
+                    ),
+                    hint=new_hint,
+                    key=key,
+                )
+            )
+    for key, allowed in sorted(pinned.items()):
+        count = observed.get(key, 0)
+        if count < allowed and not over_budget:
+            findings.append(
+                Finding(
+                    checker=checker,
+                    path=key.split("::", 1)[0],
+                    line=1,
+                    message=(
+                        f"stale baseline entry {key!r} (observed {count}, "
+                        f"baseline {allowed}) — the tree moved; update the "
+                        "baseline so it stays exact"
+                    ),
+                    hint="run: python -m tools.dpflint --update-baseline",
+                    key=key,
+                )
+            )
+    return findings

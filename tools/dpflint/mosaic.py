@@ -1,0 +1,436 @@
+"""mosaic-opset and replay-parity checkers.
+
+Every staged Mosaic kernel in this repo is "bit-exact but never run on
+hardware" (the tunnel has been dead since round 5), so the only thing
+standing between the megakernels and a silent Mosaic miscompile at the
+next hardware window is discipline:
+
+* **mosaic-opset** — kernel bodies (the inner ``def kernel(...)``
+  closures with ``*_ref`` params, plus every module-local helper they
+  reach: ``_aes_rows``, ``_transpose32_rows``, the ``_*_core`` symbols)
+  may only call an explicit allowlist of ops that the per-level row
+  kernels already proved on v5e. The known exceptions — the slab
+  kernel's 1-D ``jnp.concatenate`` and ``broadcasted_iota``, the legacy
+  tensor kernel's reshape/``hash_planes``, the cross-grid-step VMEM
+  scratch — are the PERF.md Mosaic watch-list, pinned to their exact
+  current sites via the baseline; any NEW occurrence fails the build.
+
+* **replay-parity** — each ``*megakernel*_pallas_batched`` kernel body
+  and its ``*_reference_rows`` eager replay must reach the same shared
+  ``_*_core`` / ``_megakernel_slab_tail`` symbol. That verbatim-sharing
+  contract is what makes the replays (the only real-circuit coverage
+  the kernels get without hardware) meaningful; this checks it
+  structurally instead of by docstring.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, Module, Pins, dotted_name
+
+NAME = "mosaic-opset"
+PARITY_NAME = "replay-parity"
+
+#: Ops the per-level row kernels already proved on hardware (PERF.md
+#: "Pallas vs XLA bitslice"), plus the Pallas structural primitives.
+ALLOWED_OPS = frozenset(
+    {
+        "pl.program_id",
+        "pl.when",
+        "pl.ds",
+        "jnp.where",
+        "jnp.broadcast_to",
+        "jnp.zeros_like",
+        "jnp.zeros",
+        "jnp.full",
+        "jnp.uint32",
+        "np.uint32",
+        "aes_jax._bp_sbox",
+        "value_codec.rows_correct_element",
+        "value_codec.rows_limb_add",
+        "value_codec.rows_limb_neg",
+    }
+)
+
+#: Python builtins that appear in trace-time (unrolled) control flow.
+ALLOWED_BUILTINS = frozenset(
+    {
+        "range", "len", "list", "tuple", "zip", "enumerate", "divmod",
+        "min", "max", "abs", "int", "reversed", "sorted", "sum",
+        "isinstance", "any", "all",
+    }
+)
+
+#: Methods on trace-time Python values (row lists) — pure unrolling.
+TRACE_LIST_METHODS = frozenset({"append", "extend", "insert"})
+
+#: Constructs Mosaic has NOT proven (or has rejected) that are
+#: deliberately present today — pinned per enclosing function via the
+#: baseline; any new site fails.
+WATCHLIST_OPS = frozenset(
+    {
+        "jnp.concatenate",  # slab kernel child doubling (1-D concat)
+        "jax.lax.broadcasted_iota",  # child key masks
+        "aes_jax.hash_planes",  # legacy tensor kernel (Mosaic rejects)
+        "pltpu.VMEM",  # cross-grid-step scratch (slab mid state)
+    }
+)
+
+#: Method calls allowed only as pinned watch-list sites (the legacy
+#: tensor kernel's `.reshape`; scatter-ish `.at[...].set` never).
+WATCHLIST_METHODS = frozenset({"reshape"})
+
+_CORE_RE = re.compile(r"^_\w*(_core|_slab_tail)$")
+
+
+def is_kernel_module(mod: Module) -> bool:
+    return "pallas_call(" in mod.source
+
+
+def _function_index(mod: Module) -> Dict[str, ast.FunctionDef]:
+    """Module-level function defs by name."""
+    return {
+        n.name: n
+        for n in mod.tree.body
+        if isinstance(n, ast.FunctionDef)
+    }
+
+
+def _has_ref_params(fn: ast.FunctionDef) -> bool:
+    args = fn.args
+    names = [a.arg for a in args.args + args.posonlyargs + args.kwonlyargs]
+    return any(n.endswith("_ref") for n in names)
+
+
+def _param_names(fn: ast.FunctionDef) -> Set[str]:
+    a = fn.args
+    names = {x.arg for x in a.args + a.posonlyargs + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _local_defs(fn: ast.FunctionDef) -> Set[str]:
+    """Names bound inside `fn` (nested defs, assignments, tuple unpacks,
+    comprehension targets) — calls to these are local wiring, not ops."""
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node is not fn:
+            names.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for t in targets:
+                if t is None:
+                    continue
+                for leaf in ast.walk(t):
+                    if isinstance(leaf, ast.Name):
+                        names.add(leaf.id)
+        elif isinstance(node, ast.comprehension):
+            for leaf in ast.walk(node.target):
+                if isinstance(leaf, ast.Name):
+                    names.add(leaf.id)
+        elif isinstance(node, ast.For):
+            for leaf in ast.walk(node.target):
+                if isinstance(leaf, ast.Name):
+                    names.add(leaf.id)
+    return names
+
+
+def _called_module_functions(fn: ast.FunctionDef, index: Dict[str, ast.FunctionDef]) -> Set[str]:
+    """Module-level function names called (or referenced — a builder may
+    pass a row helper along) anywhere inside `fn`."""
+    out: Set[str] = set()
+    params = _param_names(fn)
+    locals_ = _local_defs(fn)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in index:
+            if node.id not in params and node.id not in locals_:
+                out.add(node.id)
+    return out
+
+
+def kernel_roots(mod: Module) -> List[ast.FunctionDef]:
+    """Kernel bodies: any function (at any nesting depth) with a *_ref
+    parameter — the inner ``def kernel`` closures and the legacy
+    tensor-shaped kernels."""
+    return [
+        n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, ast.FunctionDef) and _has_ref_params(n)
+    ]
+
+
+def kernel_surface(mod: Module) -> Tuple[Set[str], List[ast.FunctionDef]]:
+    """The op surface: kernel roots plus the closure of module-level
+    helpers they reach. Returns (names of module-level helpers in the
+    closure, function nodes to scan)."""
+    index = _function_index(mod)
+    roots = kernel_roots(mod)
+    scan: List[ast.FunctionDef] = list(roots)
+    seen: Set[str] = set()
+    frontier: Set[str] = set()
+    for r in roots:
+        frontier |= _called_module_functions(r, index)
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        fn = index[name]
+        scan.append(fn)
+        frontier |= _called_module_functions(fn, index) - seen
+    return seen, scan
+
+
+def _enclosing_chain_params(node: ast.AST) -> Set[str]:
+    """Union of parameter names and locally-bound names of every def
+    enclosing `node` (calls to these are wiring, not ops)."""
+    out: Set[str] = set()
+    p = getattr(node, "parent", None)
+    while p is not None:
+        if isinstance(p, ast.FunctionDef):
+            out |= _param_names(p)
+            out |= {
+                n.name
+                for n in ast.walk(p)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+        p = getattr(p, "parent", None)
+    return out
+
+
+def _walk_pruned(fn: ast.FunctionDef, skip_ids: Set[int]):
+    """Like ast.walk over fn's body, but does NOT descend into nested
+    FunctionDefs that are scanned in their own right (skip_ids) — their
+    calls must count once, under their own qualname."""
+    stack: List[ast.AST] = [fn]
+    while stack:
+        node = stack.pop()
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node is not fn
+            and id(node) in skip_ids
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_opset(modules: List[Module]) -> Tuple[List[Finding], Pins, Dict[str, int]]:
+    violations: List[Finding] = []
+    pins: Pins = {}
+    pin_lines: Dict[str, int] = {}
+
+    def pin(mod: Module, qual: str, construct: str, line: int) -> None:
+        key = f"{mod.rel}::{qual}::{construct}"
+        pins[key] = pins.get(key, 0) + 1
+        pin_lines.setdefault(key, line)
+
+    for mod in modules:
+        if not is_kernel_module(mod):
+            continue
+        index = _function_index(mod)
+        closure, scan = kernel_surface(mod)
+        scanned_funcs = {id(fn) for fn in scan}
+        # Dedup: nested kernels are reachable from their builder walk too.
+        done: Set[int] = set()
+        for fn in scan:
+            if id(fn) in done:
+                continue
+            done.add(id(fn))
+            qual = fn.qualname  # type: ignore[attr-defined]
+            for node in _walk_pruned(fn, scanned_funcs):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                if name is None:
+                    # Method call on a computed value: `x.reshape(...)`,
+                    # `h.at[0].set(...)` — allowed only via watch-list.
+                    attr = node.func.attr if isinstance(node.func, ast.Attribute) else "?"
+                    if attr in WATCHLIST_METHODS:
+                        pin(mod, qual, f"method:{attr}", node.lineno)
+                    else:
+                        violations.append(
+                            Finding(
+                                NAME, mod.rel, node.lineno,
+                                f"method call `.{attr}(...)` inside the Mosaic "
+                                f"kernel surface ({qual}) is outside the "
+                                "hardware-proven op set",
+                                hint="express it with the row-kernel vocabulary "
+                                "(elementwise vector ops, static row "
+                                "loads/stores) or extend the allowlist with a "
+                                "hardware measurement",
+                            )
+                        )
+                    continue
+                if name in WATCHLIST_OPS:
+                    pin(mod, qual, name, node.lineno)
+                    continue
+                if name in ALLOWED_OPS:
+                    continue
+                if name in ALLOWED_BUILTINS:
+                    continue
+                if name in index or name in closure:
+                    continue  # module-local helper (scanned itself)
+                if "." not in name and (
+                    name in _enclosing_chain_params(node)
+                    or name in _local_defs(fn)
+                ):
+                    continue  # parameter callable / nested def / local binding
+                if "." in name:
+                    head, attr = name.split(".", 1)[0], name.rsplit(".", 1)[1]
+                    if head in _enclosing_chain_params(node) or head in _local_defs(fn):
+                        # Method on a trace-time local (a Python row list).
+                        if attr in TRACE_LIST_METHODS:
+                            continue
+                        if attr in WATCHLIST_METHODS:
+                            pin(mod, qual, f"method:{attr}", node.lineno)
+                            continue
+                violations.append(
+                    Finding(
+                        NAME, mod.rel, node.lineno,
+                        f"op `{name}` inside the Mosaic kernel surface "
+                        f"({qual}) is not in the hardware-proven allowlist",
+                        hint="kernel bodies may only use the proven row-kernel "
+                        "op set; add a watch-list pin ONLY with a recorded "
+                        "Mosaic compile (PERF.md watch-list)",
+                        key=f"{mod.rel}::{qual}::{name}",
+                    )
+                )
+        # Cross-grid-step scratch lives in the pallas_call scaffolding
+        # (scratch_shapes=[pltpu.VMEM(...)]), outside kernel bodies —
+        # scan the whole module for it.
+        from .core import enclosing_qualname
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and dotted_name(node.func) == "pltpu.VMEM":
+                pin(mod, enclosing_qualname(node), "pltpu.VMEM", node.lineno)
+    return violations, pins, pin_lines
+
+
+# ---------------------------------------------------------------------------
+# replay-parity
+# ---------------------------------------------------------------------------
+
+
+def _call_closure(fn: ast.FunctionDef, index: Dict[str, ast.FunctionDef]) -> Set[str]:
+    out: Set[str] = set()
+    frontier = _called_module_functions(fn, index)
+    while frontier:
+        name = frontier.pop()
+        if name in out:
+            continue
+        out.add(name)
+        frontier |= _called_module_functions(index[name], index) - out
+    return out
+
+
+def _kernel_body_for_entry(
+    entry: ast.FunctionDef, index: Dict[str, ast.FunctionDef]
+) -> Optional[ast.FunctionDef]:
+    """The kernel fn an entry point dispatches: a nested *_ref def in the
+    entry itself or in a builder the entry calls."""
+    candidates = [entry] + [
+        index[n] for n in _called_module_functions(entry, index)
+    ]
+    for holder in candidates:
+        for node in ast.walk(holder):
+            if (
+                isinstance(node, ast.FunctionDef)
+                and node is not holder
+                and _has_ref_params(node)
+            ):
+                return node
+    return None
+
+
+def check_parity(modules: List[Module]) -> Tuple[List[Finding], Pins, Dict[str, int]]:
+    violations: List[Finding] = []
+    pins: Pins = {}
+    pin_lines: Dict[str, int] = {}
+    for mod in modules:
+        if not is_kernel_module(mod):
+            continue
+        index = _function_index(mod)
+        references = {
+            name: fn
+            for name, fn in index.items()
+            if name.endswith("_reference_rows")
+        }
+        entries = {
+            name: fn
+            for name, fn in index.items()
+            if name.endswith("_pallas_batched")
+        }
+        paired_entries: Set[str] = set()
+        for ref_name, ref_fn in sorted(references.items()):
+            base = ref_name[: -len("_reference_rows")]
+            entry_name = next(
+                (n for n in sorted(entries) if n.startswith(base)), None
+            )
+            if entry_name is None:
+                violations.append(
+                    Finding(
+                        PARITY_NAME, mod.rel, ref_fn.lineno,
+                        f"replay `{ref_name}` has no `{base}*_pallas_batched` "
+                        "kernel entry point",
+                        hint="a replay without a kernel (or vice versa) breaks "
+                        "the verbatim-sharing contract the megakernel test "
+                        "split relies on",
+                    )
+                )
+                continue
+            paired_entries.add(entry_name)
+            kernel = _kernel_body_for_entry(entries[entry_name], index)
+            if kernel is None:
+                violations.append(
+                    Finding(
+                        PARITY_NAME, mod.rel, entries[entry_name].lineno,
+                        f"kernel entry `{entry_name}` has no reachable kernel "
+                        "body (no nested *_ref function)",
+                        hint="the checker finds the body via the builder the "
+                        "entry calls; keep that structure",
+                    )
+                )
+                continue
+            kernel_calls = _called_module_functions(kernel, index)
+            kernel_calls |= _call_closure(kernel, index)
+            ref_calls = _called_module_functions(ref_fn, index)
+            ref_calls |= _call_closure(ref_fn, index)
+            shared = sorted(
+                n for n in (kernel_calls & ref_calls) if _CORE_RE.match(n)
+            )
+            if not shared:
+                violations.append(
+                    Finding(
+                        PARITY_NAME, mod.rel, entries[entry_name].lineno,
+                        f"kernel `{entry_name}` and replay `{ref_name}` share "
+                        "no `_*_core` / `_*_slab_tail` symbol — the replay no "
+                        "longer pins the kernel's computation",
+                        hint="both must call the same shared core verbatim "
+                        "(kernel body reads refs, replay reads arrays)",
+                    )
+                )
+                continue
+            key = f"{mod.rel}::{entry_name}~{ref_name}::{shared[0]}"
+            pins[key] = 1
+            pin_lines[key] = entries[entry_name].lineno
+        # Megakernel-family entries MUST carry a replay: that is the only
+        # real-circuit coverage a staged kernel gets without hardware.
+        for entry_name, fn in sorted(entries.items()):
+            if "megakernel" in entry_name and entry_name not in paired_entries:
+                violations.append(
+                    Finding(
+                        PARITY_NAME, mod.rel, fn.lineno,
+                        f"megakernel entry `{entry_name}` has no "
+                        "*_reference_rows replay",
+                        hint="add a pure-array replay sharing the kernel's "
+                        "_*_core symbol (the megakernel test-split pattern)",
+                    )
+                )
+    return violations, pins, pin_lines

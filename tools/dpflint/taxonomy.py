@@ -1,0 +1,60 @@
+"""error-taxonomy checker.
+
+The library mirrors the reference's absl::Status categories as exception
+classes (utils/errors.py) so callers — the degradation chains, the wire
+protocol's status codes, the tests — can dispatch on failure *category*.
+A bare ``raise RuntimeError`` / ``raise ValueError`` silently opts out of
+that contract: the supervisor can't classify it, the wire maps it to
+UNKNOWN, and `except DpfError` handlers miss it. PR 1 converted the
+then-existing sites; this checker keeps the library at zero.
+
+Scope: the library package only (tests, benchmarks and tools may raise
+whatever they like). utils/errors.py itself is exempt (it *defines* the
+taxonomy).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .core import PACKAGE, Finding, Module, Pins, enclosing_qualname
+
+NAME = "error-taxonomy"
+
+BARE = {"RuntimeError", "ValueError"}
+
+_HINTS = {
+    "ValueError": "InvalidArgumentError (caller handed bad input) — it "
+    "subclasses ValueError, so `except ValueError` callers keep working",
+    "RuntimeError": "FailedPreconditionError / InternalError / "
+    "UnavailableError by category — all subclass RuntimeError",
+}
+
+
+def check(modules: List[Module]) -> Tuple[List[Finding], Pins, Dict[str, int]]:
+    violations: List[Finding] = []
+    for mod in modules:
+        if not mod.rel.startswith(PACKAGE + "/"):
+            continue
+        if mod.rel.endswith("utils/errors.py"):
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            name = None
+            if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                name = exc.func.id
+            elif isinstance(exc, ast.Name):
+                name = exc.id
+            if name in BARE:
+                violations.append(
+                    Finding(
+                        NAME, mod.rel, node.lineno,
+                        f"bare `raise {name}` in {enclosing_qualname(node)} "
+                        "bypasses the utils/errors.py absl taxonomy",
+                        hint=f"use {_HINTS[name]}",
+                    )
+                )
+    return violations, {}, {}
