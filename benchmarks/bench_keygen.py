@@ -1,12 +1,22 @@
-"""Key-generation throughput (host, batched level-major numpy AES).
+"""Key-generation throughput: scalar dealer loop vs the batched paths.
 
 Methodology of BM_KeyGeneration
 (/root/reference/dpf/distributed_point_function_benchmark.cc:228-260):
-single-level DPFs across tree depths. Keygen stays on CPU by design
-(SURVEY.md north star) — sequential in depth, vectorized across the batch.
+single-level DPFs across tree depths. The primary record is the batched
+level-major path at BENCH_KEYGEN_MODE ("numpy" = the vectorized host
+batch, the production default; "jax"/"pallas" = the device circuits of
+ops/keygen_batch.py — device strategies, staged-for-tunnel), A/B'd
+against the scalar per-key loop (the reference's shape) on a sampled
+prefix, plus a batch-size sweep at the headline depth.
+
+The `verified` flag — spot keys byte-compared (serialized) against the
+scalar oracle from the same seeds — is what lets run_bench_stage.py's
+SUPERSEDES retire a beaten record; an unverified device-mode number
+must never supersede anything.
 """
 
 import os
+import time
 
 import numpy as np
 
@@ -17,11 +27,24 @@ def bench(jax, smoke):
     from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
     from distributed_point_functions_tpu.core.params import DpfParameters
     from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.ops import keygen_batch
+    from distributed_point_functions_tpu.protos import serialization
 
     num_keys = int(os.environ.get("BENCH_KEYS", 64 if smoke else 1024))
     depths = [20, 64, 128]
+    mode = os.environ.get("BENCH_KEYGEN_MODE", "numpy")
+    # The scalar-loop A/B arm samples this many keys and extrapolates —
+    # the loop is the ~1 ms/key reference shape being beaten.
+    scalar_sample = min(
+        num_keys,
+        int(os.environ.get("BENCH_SCALAR_SAMPLE", 8 if smoke else 64)),
+    )
+    sweep = [64, 256, 1024] if not smoke else [16, 64]
+
     rng = np.random.default_rng(23)
     per_depth = {}
+    scalar_per_depth = {}
+    verified = True
     for depth in depths:
         dpf = DistributedPointFunction.create(DpfParameters(depth, Int(64)))
         alphas = [
@@ -29,16 +52,82 @@ def bench(jax, smoke):
             for _ in range(num_keys)
         ]
         betas = [int(x) for x in rng.integers(1, 1 << 62, size=num_keys)]
+        seeds = rng.integers(0, 2**32, size=(num_keys, 2, 4), dtype=np.uint32)
+        # warm at the FULL batch shape: the device modes compile one
+        # program per (2K, want_value) signature, and a narrower warm
+        # batch would leave the timed pass paying the compile.
+        keygen_batch.generate_keys_batch(
+            dpf, alphas, [betas], mode=mode, seeds=seeds
+        )
         with Timer() as t:
-            dpf.generate_keys_batch(alphas, [betas])
+            keys_0, keys_1 = keygen_batch.generate_keys_batch(
+                dpf, alphas, [betas], mode=mode, seeds=seeds
+            )
         per_depth[depth] = round(num_keys / t.elapsed)
-        log(f"depth {depth}: {per_depth[depth]} keys/s")
+        # scalar A/B arm: sampled prefix, same seeds.
+        t0 = time.perf_counter()
+        scalar_keys = [
+            dpf.generate_keys(
+                alphas[i], betas[i],
+                seeds=(
+                    int.from_bytes(seeds[i, 0].tobytes(), "little"),
+                    int.from_bytes(seeds[i, 1].tobytes(), "little"),
+                ),
+            )
+            for i in range(scalar_sample)
+        ]
+        scalar_per_depth[depth] = round(
+            scalar_sample / (time.perf_counter() - t0)
+        )
+        # Host-oracle verification: the sampled scalar keys must match
+        # the batched output byte for byte, both parties.
+        params = dpf.validator.parameters
+        for i, (want_0, want_1) in enumerate(scalar_keys):
+            for got, want in ((keys_0[i], want_0), (keys_1[i], want_1)):
+                if serialization.serialize_dpf_key(
+                    got, params
+                ) != serialization.serialize_dpf_key(want, params):
+                    verified = False
+        log(
+            f"depth {depth}: {per_depth[depth]} keys/s batched[{mode}] vs "
+            f"{scalar_per_depth[depth]} keys/s scalar "
+            f"({per_depth[depth] / max(1, scalar_per_depth[depth]):.1f}x, "
+            f"{scalar_sample} keys byte-checked)"
+        )
+
+    # Batch-size sweep at the headline depth: where amortization lands.
+    sweep_rates = {}
+    dpf = DistributedPointFunction.create(DpfParameters(20, Int(64)))
+    for k in sweep:
+        alphas = [int(x) for x in rng.integers(0, 1 << 20, size=k)]
+        betas = [int(x) for x in rng.integers(1, 1 << 62, size=k)]
+        keygen_batch.generate_keys_batch(dpf, alphas, [betas], mode=mode)
+        with Timer() as t:
+            keygen_batch.generate_keys_batch(dpf, alphas, [betas], mode=mode)
+        sweep_rates[k] = round(k / t.elapsed)
+    log(f"batch sweep depth 20 [{mode}]: " + ", ".join(
+        f"{k}: {v} keys/s" for k, v in sweep_rates.items()
+    ))
+    if not verified:
+        log("VERIFICATION FAILED: batched keys differ from the scalar oracle")
+
     return {
         "bench": "keygen",
-        "metric": f"batched key generation, {num_keys} keys, depth 20",
+        "metric": f"batched key generation [{mode}], {num_keys} keys, depth 20",
         "value": per_depth[20],
         "unit": "keys/s",
-        "config": {"num_keys": num_keys, "keys_per_s_by_depth": per_depth},
+        "verified": verified,
+        "config": {
+            "num_keys": num_keys,
+            "mode": mode,
+            "keys_per_s_by_depth": per_depth,
+            "scalar_keys_per_s_by_depth": scalar_per_depth,
+            "scalar_sample": scalar_sample,
+            "speedup_vs_scalar_depth20": round(
+                per_depth[20] / max(1, scalar_per_depth[20]), 1
+            ),
+            "batch_sweep_keys_per_s": sweep_rates,
+        },
     }
 
 

@@ -100,13 +100,17 @@ class DistributedPointFunction:
     def generate_keys(self, alpha: int, beta, seeds=None) -> Tuple[DpfKey, DpfKey]:
         return self.generate_keys_incremental(alpha, [beta], seeds=seeds)
 
-    def generate_keys_batch(self, alphas, betas, seeds=None):
+    def generate_keys_batch(self, alphas, betas, seeds=None, prg=None):
         """K key pairs at once; one vectorized AES call per tree level.
 
         `betas` is per hierarchy level, scalar or length-K. See
-        KeyGenerator.generate_keys_batch.
+        KeyGenerator.generate_keys_batch. `prg` overrides the AES
+        provider (core/keygen.KeygenPrg; ops/keygen_batch.py supplies
+        device-backed providers — byte-identical keys by construction).
         """
-        return self._keygen.generate_keys_batch(alphas, betas, seeds=seeds)
+        return self._keygen.generate_keys_batch(
+            alphas, betas, seeds=seeds, prg=prg
+        )
 
     def generate_keys_incremental(
         self, alpha: int, betas: Sequence, seeds=None

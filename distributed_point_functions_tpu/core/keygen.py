@@ -24,7 +24,7 @@ from .aes_numpy import Aes128FixedKeyHash
 from .keys import CorrectionWord, DpfKey
 from .params import ParameterValidator
 from .uint128 import MASK128
-from .value_types import compute_value_correction
+from .value_types import Int, XorWrapper, compute_value_correction
 
 
 def _extract_and_clear_lowest_bit(x: int) -> Tuple[int, int]:
@@ -32,6 +32,137 @@ def _extract_and_clear_lowest_bit(x: int) -> Tuple[int, int]:
     dpf_internal::ExtractAndClearLowestBit
     (/root/reference/dpf/internal/evaluate_prg_hwy.h:31-35)."""
     return x & 1, x & ~1
+
+
+# ---------------------------------------------------------------------------
+# The batched-keygen PRG seam
+# ---------------------------------------------------------------------------
+
+
+class KeygenPrg:
+    """The PRG provider of the batched keygen level loop.
+
+    ``generate_keys_batch`` is pure level-major algebra around three AES
+    fixed-key hashes; this seam is the ONLY place those hashes run, so a
+    provider that computes the same circuits elsewhere (the batched
+    plane-space XLA / Pallas row circuits of ops/keygen_batch.py) yields
+    byte-identical keys by construction — the correction-word algebra is
+    literally the same code.
+    """
+
+    def __init__(
+        self,
+        left: Aes128FixedKeyHash,
+        right: Aes128FixedKeyHash,
+        value: Aes128FixedKeyHash,
+    ):
+        self._left = left
+        self._right = right
+        self._value = value
+
+    def expand(self, flat: np.ndarray, want_value: bool):
+        """Expands parent seeds under both branch PRGs.
+
+        Args:
+          flat: uint32[N, 4] parent seed limb rows (N = 2K, party-pairwise).
+          want_value: also hash `flat` under the value PRG — the value-
+            correction inputs for a blocks_needed==1 output level are
+            exactly the parent seeds (seed + j for j < 1), so a fused
+            provider can serve all three hashes from one dispatch.
+        Returns: (left, right, value_or_None), each uint32[N, 4] raw hash
+        outputs (control bit still in bit 0 of limb 0).
+        """
+        left = self._left.evaluate_limbs(flat)
+        right = self._right.evaluate_limbs(flat)
+        value = self._value.evaluate_limbs(flat) if want_value else None
+        return left, right, value
+
+    def value_hash(self, inputs: np.ndarray) -> np.ndarray:
+        """Value-PRG hash of uint32[M, 4] blocks (the blocks_needed > 1
+        output-level inputs and the final-level correction)."""
+        return self._value.evaluate_limbs(inputs)
+
+
+def _value_hash_inputs(seeds_l: np.ndarray, blocks_needed: int) -> np.ndarray:
+    """Builds the value-PRG inputs seeds[i, party] + j for j < blocks_needed
+    (uint128 limb addition), vectorized: uint32[K*2*blocks_needed, 4]."""
+    inputs = np.repeat(
+        seeds_l[:, :, None, :], blocks_needed, axis=2
+    ).astype(np.uint64)  # widen to u64 for carry math
+    offs = np.arange(blocks_needed, dtype=np.uint64)
+    inputs[..., 0] += offs[None, None, :]
+    for limb in range(3):
+        carry = inputs[..., limb] >> 32
+        inputs[..., limb] &= 0xFFFFFFFF
+        inputs[..., limb + 1] += carry
+    inputs[..., 3] &= 0xFFFFFFFF
+    return inputs.astype(np.uint32).reshape(-1, 4)
+
+
+def batch_level_step(
+    left: np.ndarray,  # uint32[K, 2, 4] raw left-PRG outputs per party
+    right: np.ndarray,  # uint32[K, 2, 4] raw right-PRG outputs per party
+    control: np.ndarray,  # bool[K, 2] current control bits
+    current_bit: np.ndarray,  # int64[K] alpha bit at this level
+):
+    """One Fig.-11 level of correction-word algebra on expanded planes
+    (lines 5-12), vectorized over keys. The level-step seam shared by the
+    host batched path and the device paths (ops/keygen_batch.py): both
+    compute `left`/`right` with their own AES engine and feed the SAME
+    algebra, so correction words are byte-identical by construction.
+
+    Returns (new_seeds uint32[K, 2, 4], new_control bool[K, 2],
+    seed_correction uint32[K, 4], control_correction bool[K, 2])."""
+    k = left.shape[0]
+    exp = np.stack([left, right], axis=1).astype(np.uint32)  # [K, br, party, 4]
+    exp_bits = (exp[..., 0] & 1).astype(bool)  # [K, branch, party]
+    exp[..., 0] &= np.uint32(0xFFFFFFFE)
+
+    keep = current_bit  # [K]
+    lose = 1 - keep
+    rows = np.arange(k)
+    lose_seeds = exp[rows, lose]  # [K, party, 4]
+    seed_correction = lose_seeds[:, 0] ^ lose_seeds[:, 1]  # [K, 4]
+    # control_correction[:, branch] (lines 9-10)
+    cc = np.empty((k, 2), dtype=bool)
+    cc[:, 0] = exp_bits[:, 0, 0] ^ exp_bits[:, 0, 1] ^ (current_bit == 1) ^ True
+    cc[:, 1] = exp_bits[:, 1, 0] ^ exp_bits[:, 1, 1] ^ (current_bit == 1)
+
+    keep_seeds = exp[rows, keep]  # [K, party, 4]
+    corr = np.where(control[:, :, None], seed_correction[:, None, :], 0)
+    new_seeds = (keep_seeds ^ corr).astype(np.uint32)
+    keep_cc = cc[rows, keep]  # [K]
+    new_control = exp_bits[rows, keep] ^ (control & keep_cc[:, None])
+    return new_seeds, new_control, seed_correction, cc
+
+
+#: numpy view dtypes for the vectorized value-correction fast path.
+_DIRECT_DTYPES = {8: "<u1", 16: "<u2", 32: "<u4", 64: "<u8"}
+
+
+def normalize_beta_cols(
+    betas: Sequence, k: int, num_levels: Optional[int] = None
+) -> List[list]:
+    """Per-level beta columns for a K-key batch: each level is a scalar
+    (broadcast over keys) or a length-K sequence. THE broadcast rule —
+    every layer that accepts batched betas (this module, the robust
+    wrapper, the serving request, the wire codec, the two-server client)
+    normalizes through here so they cannot diverge on which inputs they
+    accept."""
+    if num_levels is not None and len(betas) != num_levels:
+        raise InvalidArgumentError(
+            "`beta` has to have the same size as `parameters` passed at "
+            "construction"
+        )
+    cols: List[list] = []
+    for level, b in enumerate(betas):
+        col = list(b) if isinstance(b, (list, tuple, np.ndarray)) else [b] * k
+        if len(col) != k:
+            raise InvalidArgumentError(
+                f"betas[{level}] must be a scalar or have one value per key"
+            )
+        cols.append(col)
+    return cols
 
 
 class KeyGenerator:
@@ -120,6 +251,7 @@ class KeyGenerator:
         alphas: Sequence[int],
         betas: Sequence[Sequence],
         seeds: Optional[np.ndarray] = None,
+        prg: Optional[KeygenPrg] = None,
     ) -> Tuple[List[DpfKey], List[DpfKey]]:
         """Generates K key pairs at once, level-major.
 
@@ -134,25 +266,19 @@ class KeyGenerator:
           betas: per hierarchy level, either a scalar (broadcast over keys) or
             a length-K sequence of values.
           seeds: optional uint32[K, 2, 4] CSPRNG override (tests only).
+          prg: the AES provider (:class:`KeygenPrg`; None = this
+            generator's host hashes). ops/keygen_batch.py passes providers
+            that run the same circuits on the batched device kernels —
+            everything outside the provider is shared, so keys are
+            byte-identical across providers by construction.
         Returns: (keys of party 0, keys of party 1), each a length-K list.
         """
         v = self._v
         k = len(alphas)
-        if len(betas) != v.num_hierarchy_levels:
-            raise InvalidArgumentError(
-                "`beta` has to have the same size as `parameters` passed at "
-                "construction"
-            )
-        beta_cols: List[list] = []
-        for level, b in enumerate(betas):
-            col = list(b) if isinstance(b, (list, tuple, np.ndarray)) else [b] * k
-            if len(col) != k:
-                raise InvalidArgumentError(
-                    f"betas[{level}] must be a scalar or have one value per key"
-                )
+        beta_cols = normalize_beta_cols(betas, k, v.num_hierarchy_levels)
+        for level, col in enumerate(beta_cols):
             for val in col:
                 v.validate_value(val, level)
-            beta_cols.append(col)
         last_log_domain_size = v.parameters[-1].log_domain_size
         alphas = [int(a) for a in alphas]
         for alpha in alphas:
@@ -168,9 +294,11 @@ class KeyGenerator:
             seeds_l = np.frombuffer(raw, dtype=np.uint32).reshape(k, 2, 4).copy()
         else:
             seeds_l = np.array(seeds, dtype=np.uint32).reshape(k, 2, 4)
+        if prg is None:
+            prg = KeygenPrg(self._prg_left, self._prg_right, self._prg_value)
         control = np.zeros((k, 2), dtype=bool)
         control[:, 1] = True
-        alpha_limbs = uint128.array_to_limbs(alphas)  # uint32[K, 4]
+        alpha_limbs = uint128.u128_to_limb_rows(uint128.u128_array(alphas))
 
         out_keys: Tuple[List[DpfKey], List[DpfKey]] = (
             [DpfKey(seed=uint128.from_limbs(seeds_l[i, 0]), correction_words=[], party=0)
@@ -180,22 +308,34 @@ class KeyGenerator:
         )
 
         for tree_level in range(1, v.tree_levels_needed):
-            # Value correction for the previous level if it is an output level.
-            value_corrections: Optional[List[list]] = None
-            if (tree_level - 1) in v.tree_to_hierarchy:
-                hierarchy_level = v.tree_to_hierarchy[tree_level - 1]
-                value_corrections = self._batch_value_correction(
-                    hierarchy_level, seeds_l, control, alphas,
-                    beta_cols[hierarchy_level],
-                )
+            # Value correction for the previous level if it is an output
+            # level: its PRG inputs are derived from the seeds BEFORE this
+            # level's expansion, so both hashes can share one provider call
+            # when blocks_needed == 1 (the inputs ARE the seeds).
+            hierarchy_level = v.tree_to_hierarchy.get(tree_level - 1)
+            blocks_needed = (
+                v.blocks_needed[hierarchy_level]
+                if hierarchy_level is not None
+                else 0
+            )
 
             # Expand all 2K seeds under both PRGs (Fig. 11 line 5).
             flat = seeds_l.reshape(2 * k, 4)
-            left = self._prg_left.evaluate_limbs(flat).reshape(k, 2, 4)
-            right = self._prg_right.evaluate_limbs(flat).reshape(k, 2, 4)
-            exp = np.stack([left, right], axis=1)  # [K, branch, party, 4]
-            exp_bits = (exp[..., 0] & 1).astype(bool)  # [K, branch, party]
-            exp[..., 0] &= np.uint32(0xFFFFFFFE)
+            left, right, value_hashed = prg.expand(
+                flat, want_value=blocks_needed == 1
+            )
+            value_corrections: Optional[List[list]] = None
+            if hierarchy_level is not None:
+                if value_hashed is not None:
+                    hashed = value_hashed.reshape(k, 2, 1, 4)
+                else:
+                    hashed = prg.value_hash(
+                        _value_hash_inputs(seeds_l, blocks_needed)
+                    ).reshape(k, 2, blocks_needed, 4)
+                value_corrections = self._value_corrections_from_hashed(
+                    hierarchy_level, hashed, control, alphas,
+                    beta_cols[hierarchy_level],
+                )
 
             bit_index = last_log_domain_size - tree_level
             if bit_index < 128:
@@ -204,22 +344,11 @@ class KeyGenerator:
                 ).astype(np.int64)  # [K]
             else:
                 current_bit = np.zeros(k, dtype=np.int64)
-            keep = current_bit  # [K]
-            lose = 1 - keep
 
-            rows = np.arange(k)
-            lose_seeds = exp[rows, lose]  # [K, party, 4]
-            seed_correction = lose_seeds[:, 0] ^ lose_seeds[:, 1]  # [K, 4]
-            # control_correction[:, branch] (lines 9-10)
-            cc = np.empty((k, 2), dtype=bool)
-            cc[:, 0] = exp_bits[:, 0, 0] ^ exp_bits[:, 0, 1] ^ (current_bit == 1) ^ True
-            cc[:, 1] = exp_bits[:, 1, 0] ^ exp_bits[:, 1, 1] ^ (current_bit == 1)
-
-            keep_seeds = exp[rows, keep]  # [K, party, 4]
-            corr = np.where(control[:, :, None], seed_correction[:, None, :], 0)
-            seeds_l = (keep_seeds ^ corr).astype(np.uint32)
-            keep_cc = cc[rows, keep]  # [K]
-            control = exp_bits[rows, keep] ^ (control & keep_cc[:, None])
+            seeds_l, control, seed_correction, cc = batch_level_step(
+                left.reshape(k, 2, 4), right.reshape(k, 2, 4),
+                control, current_bit,
+            )
 
             for i in range(k):
                 vc = value_corrections[i] if value_corrections is not None else []
@@ -234,47 +363,79 @@ class KeyGenerator:
                         )
                     )
 
-        last_cw = self._batch_value_correction(
-            v.num_hierarchy_levels - 1, seeds_l, control, alphas, beta_cols[-1]
+        last_level = v.num_hierarchy_levels - 1
+        blocks_needed = v.blocks_needed[last_level]
+        hashed = prg.value_hash(
+            _value_hash_inputs(seeds_l, blocks_needed)
+        ).reshape(k, 2, blocks_needed, 4)
+        last_cw = self._value_corrections_from_hashed(
+            last_level, hashed, control, alphas, beta_cols[-1]
         )
         for i in range(k):
             out_keys[0][i].last_level_value_correction = list(last_cw[i])
             out_keys[1][i].last_level_value_correction = list(last_cw[i])
         return out_keys
 
-    def _batch_value_correction(
+    def _value_corrections_from_hashed(
         self,
         hierarchy_level: int,
-        seeds_l: np.ndarray,  # uint32[K, 2, 4]
+        hashed: np.ndarray,  # uint32[K, 2, blocks_needed, 4] value-PRG outputs
         control: np.ndarray,  # bool[K, 2]
         alphas: Sequence[int],
         beta_col: Sequence,
     ) -> List[list]:
-        """Value corrections for all K keys with one batched value-PRG call."""
-        v = self._v
-        k = seeds_l.shape[0]
-        blocks_needed = v.blocks_needed[hierarchy_level]
-        # inputs[i, party, j] = seeds[i, party] + j  (uint128 limb addition)
-        inputs = np.repeat(seeds_l[:, :, None, :], blocks_needed, axis=2).astype(
-            np.uint64
-        )  # widen to u64 for carry math
-        offs = np.arange(blocks_needed, dtype=np.uint64)
-        inputs[..., 0] += offs[None, None, :]
-        for limb in range(3):
-            carry = inputs[..., limb] >> 32
-            inputs[..., limb] &= 0xFFFFFFFF
-            inputs[..., limb + 1] += carry
-        inputs[..., 3] &= 0xFFFFFFFF
-        hashed = self._prg_value.evaluate_limbs(
-            inputs.astype(np.uint32).reshape(k * 2 * blocks_needed, 4)
-        ).reshape(k, 2, blocks_needed, 4)
-        hashed_bytes = np.ascontiguousarray(hashed).view(np.uint8)
+        """Typed value corrections for all K keys from the hashed blocks.
 
+        Scalar Int/XorWrapper types up to 64 bits take a fully vectorized
+        numpy path (the per-key ``compute_value_correction`` calls were
+        the dominant host cost of a <=64-bit keygen pass — the same
+        host-prep-not-AES waste class PERF.md's eval-prep record
+        documents); wider and sampled types (u128, IntModN, tuples) keep
+        the exact-Python-int path."""
+        v = self._v
+        k = hashed.shape[0]
         shift = (
             v.parameters[-1].log_domain_size
             - v.parameters[hierarchy_level].log_domain_size
         )
         value_type = v.parameters[hierarchy_level].value_type
+
+        direct = (
+            isinstance(value_type, (Int, XorWrapper))
+            and value_type.bitsize <= 64
+        )
+        if direct:
+            # index_in_block = (alpha >> shift) & (epb - 1): low bits only,
+            # so the U128 limb forms cover every domain width vectorized.
+            prefixes = uint128.u128_rshift(
+                uint128.u128_array(alphas), min(shift, 128)
+            )
+            idx = uint128.u128_and_low(
+                prefixes, min(64, v.block_index_bits(hierarchy_level))
+            ).astype(np.int64)
+            bits = value_type.bitsize
+            vals = (
+                np.ascontiguousarray(hashed[:, :, 0, :])
+                .view(_DIRECT_DTYPES[bits])
+                .reshape(k, 2, 128 // bits)
+            )
+            a = vals[:, 0]
+            b = vals[:, 1].copy()
+            beta_arr = np.array(
+                [int(x) for x in beta_col], dtype=np.uint64
+            ).astype(a.dtype)
+            rows = np.arange(k)
+            if isinstance(value_type, XorWrapper):
+                b[rows, idx] ^= beta_arr
+                corr = b ^ a  # XOR group: sub == add, neg == identity
+            else:
+                b[rows, idx] += beta_arr
+                corr = b - a  # mod 2^bits via natural uint wraparound
+                invert = control[:, 1]
+                corr[invert] = (-corr[invert].astype(a.dtype)).astype(a.dtype)
+            return corr.tolist()
+
+        hashed_bytes = np.ascontiguousarray(hashed).view(np.uint8)
         out = []
         for i in range(k):
             alpha_prefix = alphas[i] >> shift if shift < 128 else 0

@@ -37,6 +37,7 @@ from ..core.dpf import DistributedPointFunction
 from ..core.keys import DpfKey
 from ..core.params import DpfParameters
 from ..core.value_types import ValueType
+from ..utils import envflags
 from ..utils.errors import InvalidArgumentError
 
 
@@ -92,7 +93,7 @@ class DistributedComparisonFunction:
         return DcfKey(key_a), DcfKey(key_b)
 
     def generate_keys_batch(
-        self, alphas: Sequence[int], betas, seeds=None
+        self, alphas: Sequence[int], betas, seeds=None, mode: Optional[str] = None
     ) -> Tuple[List[DcfKey], List[DcfKey]]:
         """K DCF key pairs at once through the level-major batched DPF
         keygen (one vectorized AES call per tree level across all keys).
@@ -100,6 +101,10 @@ class DistributedComparisonFunction:
         `betas` is one value (broadcast) or a length-K sequence. A value
         that is itself valid for the output type (e.g. a tuple for a
         TupleType DCF) is always treated as the broadcast form.
+
+        `mode` selects the keygen engine ("numpy" / "jax" / "pallas";
+        None = the host batched path unless DPF_TPU_KEYGEN overrides) —
+        all modes are byte-identical, see ops/keygen_batch.py.
         """
         n = self.log_domain_size
         k = len(alphas)
@@ -125,9 +130,19 @@ class DistributedComparisonFunction:
             ]
             for i in range(n)
         ]
-        keys_a, keys_b = self._dpf.generate_keys_batch(
-            [a >> 1 for a in alphas], per_level, seeds=seeds
-        )
+        shifted = [a >> 1 for a in alphas]
+        if mode is None and not envflags.env_str("DPF_TPU_KEYGEN", None):
+            # The pure host path stays import-light (no jax): servers and
+            # benches that never touch a device mode pay nothing for it.
+            keys_a, keys_b = self._dpf.generate_keys_batch(
+                shifted, per_level, seeds=seeds
+            )
+        else:
+            from ..ops import keygen_batch
+
+            keys_a, keys_b = keygen_batch.generate_keys_batch(
+                self._dpf, shifted, per_level, mode=mode, seeds=seeds
+            )
         return [DcfKey(x) for x in keys_a], [DcfKey(x) for x in keys_b]
 
     def evaluate(self, key: DcfKey, x: int):

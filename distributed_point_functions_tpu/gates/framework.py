@@ -50,10 +50,12 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import secrets
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..core import uint128
 from ..dcf.dcf import DcfKey
 from ..utils import telemetry as _tm
 from ..utils.errors import InvalidArgumentError
@@ -333,29 +335,13 @@ class MaskedGate(abc.ABC):
                     "Masked input should be between 0 and 2^log_group_size"
                 )
 
-    def gen(
-        self,
-        r_in: int,
-        r_outs: Sequence[int],
-        prng: Optional[SecurePrng] = None,
-        dcf_seeds=None,
-    ):
-        """Dealer keygen for masks ``r_in`` / ``r_outs``: component DCF
-        key pairs + additively split mask values. ``prng`` supplies the
-        share randomness (one rand128 per mask value, in
-        ``_mask_values`` order — the draw order golden-key tests pin);
-        ``dcf_seeds`` optionally pins the component DCF keygen seeds (a
-        single (s0, s1) pair for one-component gates, else one pair per
-        component) — together they make ``gen`` fully deterministic."""
-        if prng is None:
-            prng = BasicRng()
-        n = self.n
+    def _check_masks(self, r_in: int, r_outs: Sequence[int]) -> None:
         if len(r_outs) != self.num_outputs:
             raise InvalidArgumentError(
                 "Count of output masks should be equal to the number of "
                 "gate outputs"
             )
-        if not 0 <= r_in < n:
+        if not 0 <= r_in < self.n:
             raise InvalidArgumentError(
                 "Input mask should be between 0 and 2^log_group_size"
             )
@@ -364,29 +350,89 @@ class MaskedGate(abc.ABC):
                 raise InvalidArgumentError(
                     "Output mask outside the gate's output group"
                 )
-        specs = self._component_specs(r_in)
+
+    def _normalize_dcf_seeds(self, num_components: int, dcf_seeds):
+        """None / one pair (one-component gates) / one pair per component
+        -> a list of Optional[(s0, s1)] of length num_components."""
         if dcf_seeds is None:
-            seeds_list = [None] * len(specs)
-        elif (
-            len(specs) == 1
+            return [None] * num_components
+        if (
+            num_components == 1
             and len(dcf_seeds) == 2
             and not hasattr(dcf_seeds[0], "__len__")
         ):
-            seeds_list = [tuple(dcf_seeds)]
-        else:
-            seeds_list = [tuple(s) for s in dcf_seeds]
-            if len(seeds_list) != len(specs):
-                raise InvalidArgumentError(
-                    f"dcf_seeds must carry one (s0, s1) pair per component "
-                    f"({len(specs)}), got {len(seeds_list)}"
-                )
-        keys_0: List[DcfKey] = []
-        keys_1: List[DcfKey] = []
-        for (alpha, beta), sd in zip(specs, seeds_list):
-            k0, k1 = self._dcf.generate_keys(alpha, beta, seeds=sd)
-            keys_0.append(k0)
-            keys_1.append(k1)
-        values = self._mask_values(r_in, [int(r) for r in r_outs])
+            return [tuple(dcf_seeds)]
+        seeds_list = [tuple(s) for s in dcf_seeds]
+        if len(seeds_list) != num_components:
+            raise InvalidArgumentError(
+                f"dcf_seeds must carry one (s0, s1) pair per component "
+                f"({num_components}), got {len(seeds_list)}"
+            )
+        return seeds_list
+
+    def _batch_component_keys(
+        self, specs, seeds_list, keygen_mode: Optional[str]
+    ) -> Tuple[List[DcfKey], List[DcfKey]]:
+        """ALL component DCF key pairs in ONE level-major batched keygen
+        pass (ops/keygen_batch.py via dcf.generate_keys_batch) — the
+        dealer analog of the fused evaluation pass. Byte-identical to the
+        per-component scalar loop given the same seeds; entries with no
+        pinned seed draw theirs from the CSPRNG here (the scalar path
+        drew inside `generate_keys`, same distribution)."""
+        seeds_arr = np.empty((len(specs), 2, 4), dtype=np.uint32)
+        for i, sd in enumerate(seeds_list):
+            if sd is None:
+                seeds_arr[i] = np.frombuffer(
+                    secrets.token_bytes(32), dtype=np.uint32
+                ).reshape(2, 4)
+            else:
+                seeds_arr[i, 0] = uint128.to_limbs(sd[0])
+                seeds_arr[i, 1] = uint128.to_limbs(sd[1])
+        return self._dcf.generate_keys_batch(
+            [alpha for alpha, _ in specs],
+            [beta for _, beta in specs],
+            seeds=seeds_arr,
+            mode=keygen_mode,
+        )
+
+    def gen(
+        self,
+        r_in: int,
+        r_outs: Sequence[int],
+        prng: Optional[SecurePrng] = None,
+        dcf_seeds=None,
+        keygen_mode: Optional[str] = None,
+    ):
+        """Dealer keygen for masks ``r_in`` / ``r_outs``: component DCF
+        key pairs + additively split mask values. ``prng`` supplies the
+        share randomness (one rand128 per mask value, in
+        ``_mask_values`` order — the draw order golden-key tests pin);
+        ``dcf_seeds`` optionally pins the component DCF keygen seeds (a
+        single (s0, s1) pair for one-component gates, else one pair per
+        component) — together they make ``gen`` fully deterministic.
+
+        All component keys are seeded through ONE batched level-major
+        keygen pass (ISSUE 13); ``keygen_mode`` selects its engine
+        ("numpy" / "jax" / "pallas", None = DPF_TPU_KEYGEN default) —
+        every mode produces byte-identical keys."""
+        if prng is None:
+            prng = BasicRng()
+        self._check_masks(r_in, r_outs)
+        specs = self._component_specs(r_in)
+        seeds_list = self._normalize_dcf_seeds(len(specs), dcf_seeds)
+        keys_0, keys_1 = self._batch_component_keys(
+            specs, seeds_list, keygen_mode
+        )
+        shares_0, shares_1 = self._split_mask_shares(r_in, r_outs, prng)
+        return self._make_key(keys_0, shares_0), self._make_key(keys_1, shares_1)
+
+    def _split_mask_shares(
+        self, r_in: int, r_outs: Sequence[int], prng: SecurePrng
+    ) -> Tuple[List[int], List[int]]:
+        """Dealer mask-value splitting (one rand128 per value, in
+        `_mask_values` order — the draw order golden-key tests pin);
+        shared by `gen` and `gen_bundle` so the sequence exists once."""
+        values = self._mask_values(int(r_in), [int(r) for r in r_outs])
         moduli = self._mask_moduli()
         shares_0: List[int] = []
         shares_1: List[int] = []
@@ -394,7 +440,69 @@ class MaskedGate(abc.ABC):
             s0, s1 = split_share(int(v), mod, prng)
             shares_0.append(s0)
             shares_1.append(s1)
-        return self._make_key(keys_0, shares_0), self._make_key(keys_1, shares_1)
+        return shares_0, shares_1
+
+    def gen_bundle(
+        self,
+        r_ins: Sequence[int],
+        r_outs_seq: Sequence[Sequence[int]],
+        prng: Optional[SecurePrng] = None,
+        dcf_seeds=None,
+        keygen_mode: Optional[str] = None,
+    ):
+        """Dealer keygen for a whole bundle: B independent (r_in, r_outs)
+        mask sets — the secure-ML layer / streaming-dealer shape — with
+        ALL B x num_components component DCF keys seeded in ONE batched
+        level-major keygen pass instead of B scalar gens. Bit-identical
+        to ``[gen(r_ins[b], r_outs_seq[b]) for b]`` given the same
+        ``prng`` and per-element ``dcf_seeds``: component key material
+        comes from the CSPRNG (never ``prng``), and the mask-share draws
+        happen in bundle order.
+
+        ``dcf_seeds``: None, or one per bundle element, each in ``gen``'s
+        ``dcf_seeds`` form. Returns (keys_0, keys_1), each a length-B
+        list of this gate's party keys (``bundle_eval``'s input shape)."""
+        if prng is None:
+            prng = BasicRng()
+        b_count = len(r_ins)
+        if len(r_outs_seq) != b_count:
+            raise InvalidArgumentError(
+                f"gen_bundle needs one r_outs per r_in, got {len(r_outs_seq)} "
+                f"for {b_count}"
+            )
+        if dcf_seeds is not None and len(dcf_seeds) != b_count:
+            raise InvalidArgumentError(
+                f"dcf_seeds must carry one entry per bundle element "
+                f"({b_count}), got {len(dcf_seeds)}"
+            )
+        all_specs = []
+        all_seeds = []
+        for b in range(b_count):
+            self._check_masks(int(r_ins[b]), r_outs_seq[b])
+            specs = self._component_specs(int(r_ins[b]))
+            all_specs.extend(specs)
+            all_seeds.extend(
+                self._normalize_dcf_seeds(
+                    len(specs),
+                    None if dcf_seeds is None else dcf_seeds[b],
+                )
+            )
+        flat_0, flat_1 = self._batch_component_keys(
+            all_specs, all_seeds, keygen_mode
+        )
+        c = self.num_components
+        keys_0, keys_1 = [], []
+        for b in range(b_count):
+            shares_0, shares_1 = self._split_mask_shares(
+                r_ins[b], r_outs_seq[b], prng
+            )
+            keys_0.append(
+                self._make_key(flat_0[b * c : (b + 1) * c], shares_0)
+            )
+            keys_1.append(
+                self._make_key(flat_1[b * c : (b + 1) * c], shares_1)
+            )
+        return keys_0, keys_1
 
     def eval(self, key, x: int) -> List[int]:
         """Host per-point evaluation (reference-parity DCF walks): this

@@ -1,0 +1,383 @@
+"""Batched two-party key generation on the batched AES kernels.
+
+Key generation was CPU-only by the paper's north star — fine until a
+serving system is keygen-bound: the dealer in every gate scenario (BGI
+2018/707's preprocessing model is *pure* keygen), Poplar-style streaming
+ingestion, and the keygen-offload wire op are all bottlenecked on one
+host core seeding trees. ``GenerateKeysIncremental``'s per-level PRG +
+correction-word circuit is the same circuit the evaluator kernels
+already run — just party-pairwise — so this module ports it onto the
+existing batched PRG row circuits.
+
+Three execution modes behind one entry point (``DPF_TPU_KEYGEN`` env
+default, "numpy" until a hardware window verifies the device modes):
+
+* ``"numpy"`` — the host batched path (core/keygen.py): one vectorized
+  numpy AES call per tree level over all 2K seeds. The production
+  default, ~10x the scalar per-key loop at 1024 keys (PERF.md
+  "Device-side keygen").
+* ``"jax"`` — the per-level expansion through the plane-space XLA
+  bitslice (ops/aes_jax): all 2K parent seeds pack into bit-planes on a
+  doubled key axis and ONE jitted program computes H_left, H_right (and,
+  on blocks_needed==1 output levels, H_value) of every seed — one device
+  program per tree level plus one final value hash.
+* ``"pallas"`` — the same loop with the expansion running through the
+  hardware-proven Mosaic row kernels, REUSED VERBATIM:
+  ``expand_one_level_pallas_batched`` with zeroed correction inputs IS
+  the keygen expansion (raw child hashes with the control bit split
+  out), and ``hash_value_planes_pallas_batched`` is the value PRG. No
+  new kernel body, no new Mosaic risk surface (dpflint's op-surface pins
+  are untouched). Staged-for-tunnel like every kernel since round 5.
+
+Every mode feeds the SAME level-step algebra (core/keygen.py's
+``KeygenPrg`` seam / ``batch_level_step``), so the assembled
+``DpfKey`` pairs are byte-identical across modes by construction —
+pinned by serialized-bytes tests against the scalar oracle.
+
+The correction-word computation between AES calls is vectorized
+numpy/XLA with no per-key Python loops (the host-prep waste class
+PERF.md's eval-prep record documents); key-object assembly is the only
+remaining per-key work.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import keygen as core_keygen
+from ..utils import envflags, faultinject
+from ..utils import telemetry as _tm
+from ..utils.errors import InvalidArgumentError
+
+#: Execution modes of the batched keygen entry points.
+KEYGEN_MODES = ("numpy", "jax", "pallas")
+
+#: The degradation ladder, fastest rung first —
+#: ops/supervisor.keygen_chain slices its rungs from here, so a new mode
+#: must take a position in BOTH tuples (a mode missing from the ladder
+#: fails loudly at chain build, never silently runs a different rung).
+KEYGEN_RUNG_ORDER = ("pallas", "jax", "numpy")
+
+
+def _keygen_mode_default() -> str:
+    """DPF_TPU_KEYGEN env resolution ("numpy" unset — the host batched
+    path is the production default until a hardware window verifies the
+    device modes, the same gating every staged kernel follows)."""
+    mode = envflags.env_str("DPF_TPU_KEYGEN", None)
+    if mode is None:
+        return "numpy"
+    if mode not in KEYGEN_MODES:
+        raise InvalidArgumentError(
+            f"DPF_TPU_KEYGEN must be one of {KEYGEN_MODES}, got {mode!r}"
+        )
+    return mode
+
+
+#: Lane floor of the pallas expansion: pad the doubled seed axis to full
+#: [*, 128, 32]-word planes. Near-width-1 lane blocks are a pathological
+#: grid for the row kernels (the _block_plan caveat — a W=1 interpret
+#: config ran 100x slower than W=32 on this container), and W=32 at
+#: block_w=32 is exactly the per-level kernel config the repo already
+#: compiles, so small keygen batches share it instead of adding one.
+_PALLAS_LANE_FLOOR = 1024
+
+
+def _pad_rows(flat: np.ndarray, mult: int) -> Tuple[np.ndarray, int]:
+    """Pads uint32[N, 4] seed rows to a multiple of `mult` (32 = the
+    plane-packing granularity; the pallas path pads to the lane floor);
+    returns (padded, original N)."""
+    n = flat.shape[0]
+    pad = (-n) % mult
+    if pad == 0:
+        return np.ascontiguousarray(flat), n
+    return np.concatenate(
+        [flat, np.zeros((pad, 4), dtype=np.uint32)], axis=0
+    ), n
+
+
+# ---------------------------------------------------------------------------
+# JAX (plane-space XLA) expansion programs
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _jax_expand_jit(want_value: bool):
+    """ONE program per level: pack 2K parent seeds to planes, hash under
+    the left/right (and optionally value) PRG keys, unpack to limb rows.
+    Shapes are level-independent, so a whole keygen pass reuses one
+    compiled program per `want_value` variant."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import constants
+    from . import aes_jax
+
+    rkl = aes_jax.round_key_planes(constants.PRG_KEY_LEFT)
+    rkr = aes_jax.round_key_planes(constants.PRG_KEY_RIGHT)
+    rkv = aes_jax.round_key_planes(constants.PRG_KEY_VALUE)
+
+    @jax.jit
+    def run(flat):
+        planes = aes_jax.pack_to_planes(flat)
+        out = [
+            aes_jax.unpack_from_planes(
+                aes_jax.hash_planes(planes, jnp.asarray(rkl))
+            ),
+            aes_jax.unpack_from_planes(
+                aes_jax.hash_planes(planes, jnp.asarray(rkr))
+            ),
+        ]
+        if want_value:
+            out.append(
+                aes_jax.unpack_from_planes(
+                    aes_jax.hash_planes(planes, jnp.asarray(rkv))
+                )
+            )
+        return tuple(out)
+
+    return run
+
+
+@functools.lru_cache(maxsize=None)
+def _jax_value_hash_jit():
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import constants
+    from . import aes_jax
+
+    rkv = aes_jax.round_key_planes(constants.PRG_KEY_VALUE)
+
+    @jax.jit
+    def run(flat):
+        planes = aes_jax.pack_to_planes(flat)
+        return aes_jax.unpack_from_planes(
+            aes_jax.hash_planes(planes, jnp.asarray(rkv))
+        )
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# Pallas (Mosaic row kernel) expansion programs — existing entries, reused
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _pack_planes_jit():
+    import jax
+
+    from . import aes_jax
+
+    return jax.jit(aes_jax.pack_to_planes)
+
+
+@functools.lru_cache(maxsize=None)
+def _unpack_planes_jit():
+    import jax
+
+    from . import aes_jax
+
+    return jax.jit(aes_jax.unpack_from_planes)
+
+
+def _restore_bit0_np(limbs, control_words: np.ndarray) -> np.ndarray:
+    """The kernel zeroes plane 0 and returns it as control lane masks
+    (bit i of word w = seed row 32w+i, the pack_bit_mask order); OR-ing
+    the bit back into limb 0 reconstructs the raw hash output."""
+    bits = (
+        (np.asarray(control_words)[:, None] >> np.arange(32, dtype=np.uint32))
+        & 1
+    ).reshape(-1)
+    out = np.array(limbs)
+    out[:, 0] |= bits.astype(np.uint32)
+    return out
+
+
+def _pallas_expand(
+    flat: np.ndarray, want_value: bool, block_w: int, interpret: bool
+):
+    """The pallas twin of :func:`_jax_expand_jit`: the keygen expansion
+    through ``expand_one_level_pallas_batched`` run as ONE "key" whose W
+    lane words are the 2K parent seeds. With zeroed control/correction
+    inputs the kernel computes exactly the raw child hashes — output
+    planes carry the hash with bit 0 cleared and the control row IS that
+    bit (:func:`_restore_bit0_np`). The pallas entries are their own
+    jitted programs (nesting an interpret-mode pallas_call inside an
+    enclosing jit re-traces the kernel emulation into the outer graph —
+    a 100x compile cliff found while staging this path), so the keygen
+    shapes here match the per-level kernel configs the repo already
+    compiles."""
+    from . import aes_pallas
+
+    planes = _pack_planes_jit()(flat)[None]  # [1, 128, W]
+    w = planes.shape[2]
+    zero_control = np.zeros((1, w), np.uint32)
+    zero_cw = np.zeros((1, 128), np.uint32)
+    zero_cc = np.zeros((1,), np.uint32)
+    out, control = aes_pallas.expand_one_level_pallas_batched(
+        planes, zero_control, zero_cw, zero_cc, zero_cc,
+        block_w=block_w, interpret=interpret,
+    )
+    unpack = _unpack_planes_jit()
+    control = np.asarray(control)
+    left = _restore_bit0_np(unpack(out[0, :, :w]), control[0, :w])
+    right = _restore_bit0_np(unpack(out[0, :, w:]), control[0, w:])
+    outs = [left, right]
+    if want_value:
+        hashed = aes_pallas.hash_value_planes_pallas_batched(
+            planes, block_w=block_w, interpret=interpret
+        )
+        outs.append(np.asarray(unpack(hashed[0])))
+    return tuple(outs)
+
+
+def _pallas_value_hash(
+    flat: np.ndarray, block_w: int, interpret: bool
+) -> np.ndarray:
+    from . import aes_pallas
+
+    planes = _pack_planes_jit()(flat)[None]
+    hashed = aes_pallas.hash_value_planes_pallas_batched(
+        planes, block_w=block_w, interpret=interpret
+    )
+    return np.asarray(_unpack_planes_jit()(hashed[0]))
+
+
+class DeviceKeygenPrg(core_keygen.KeygenPrg):
+    """A :class:`core.keygen.KeygenPrg` provider whose three fixed-key
+    hashes run on the batched device circuits (backend "jax" = plane-
+    space XLA, "pallas" = the Mosaic row kernels). Everything outside the
+    provider — validation, level-step algebra, correction typing, key
+    assembly — is the shared core path, so keys are byte-identical to
+    the host provider's by construction."""
+
+    def __init__(
+        self, backend: str, block_w: int = 32, interpret: bool = False
+    ):
+        if backend not in ("jax", "pallas"):
+            raise InvalidArgumentError(
+                f"DeviceKeygenPrg backend must be 'jax' or 'pallas', "
+                f"got {backend!r}"
+            )
+        self.backend = backend
+        self.block_w = block_w
+        self.interpret = interpret
+        self._row_mult = 32 if backend == "jax" else _PALLAS_LANE_FLOOR
+
+    def expand(self, flat: np.ndarray, want_value: bool):
+        padded, n = _pad_rows(flat, self._row_mult)
+        if self.backend == "jax":
+            outs = _jax_expand_jit(want_value)(padded)
+        else:
+            outs = _pallas_expand(
+                padded, want_value, self.block_w, self.interpret
+            )
+        left = np.asarray(outs[0])[:n]
+        right = np.asarray(outs[1])[:n]
+        value = np.asarray(outs[2])[:n] if want_value else None
+        # Chaos seam (utils/faultinject "device_output"): a corrupted
+        # expansion produces wrong correction words, which the robust
+        # wrapper's serialized spot check must catch and degrade around.
+        left = faultinject.corrupt_output(left, backend=self.backend)
+        return left, right, value
+
+    def value_hash(self, inputs: np.ndarray) -> np.ndarray:
+        padded, n = _pad_rows(inputs, self._row_mult)
+        if self.backend == "jax":
+            out = _jax_value_hash_jit()(padded)
+        else:
+            out = _pallas_value_hash(padded, self.block_w, self.interpret)
+        return np.asarray(out)[:n]
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def validated_mode(mode: Optional[str]) -> str:
+    """Explicit mode wins; None falls back to the DPF_TPU_KEYGEN env
+    default. THE membership check — the chain builder and the telemetry
+    resolver both go through here."""
+    resolved = mode if mode is not None else _keygen_mode_default()
+    if resolved not in KEYGEN_MODES:
+        raise InvalidArgumentError(
+            f"keygen mode must be one of {KEYGEN_MODES}, got {resolved!r}"
+        )
+    return resolved
+
+
+def resolve_mode(mode: Optional[str], op: str = "keygen") -> str:
+    """:func:`validated_mode` plus the engine-decision telemetry record
+    every entry-point resolution in this repo carries (the robust
+    chain's per-rung attempts bypass this — a rung is the CHAIN's
+    choice, recorded by its decision(source="degrade") stream)."""
+    resolved = validated_mode(mode)
+    _tm.decision(
+        op, resolved, "explicit" if mode is not None else "env-default"
+    )
+    return resolved
+
+
+def make_prg(
+    mode: str, block_w: int = 32, interpret: bool = False
+) -> Optional[core_keygen.KeygenPrg]:
+    """The PRG provider for a resolved mode (None = the core host
+    default)."""
+    if mode == "numpy":
+        return None
+    return DeviceKeygenPrg(mode, block_w=block_w, interpret=interpret)
+
+
+def generate_keys_batch(
+    dpf,
+    alphas: Sequence[int],
+    betas: Sequence,
+    mode: Optional[str] = None,
+    seeds: Optional[np.ndarray] = None,
+    block_w: int = 32,
+    interpret: bool = False,
+) -> Tuple[List, List]:
+    """K DPF key pairs at once on the selected engine.
+
+    Args/semantics match ``DistributedPointFunction.generate_keys_batch``
+    (alphas: K points; betas: per hierarchy level, scalar or length-K;
+    seeds: optional uint32[K, 2, 4] CSPRNG override) plus:
+
+    * ``mode`` — "numpy" / "jax" / "pallas" (None = DPF_TPU_KEYGEN env,
+      default "numpy"). All modes produce byte-identical keys.
+    * ``block_w`` / ``interpret`` — pallas lane-block width and the
+      interpret-mode escape hatch (tests; real hardware compiles Mosaic).
+
+    Returns (keys of party 0, keys of party 1), each length K.
+    """
+    resolved = resolve_mode(mode)
+    prg = make_prg(resolved, block_w=block_w, interpret=interpret)
+    return dpf.generate_keys_batch(alphas, betas, seeds=seeds, prg=prg)
+
+
+def generate_key_batches(
+    dpf,
+    alphas: Sequence[int],
+    betas: Sequence,
+    hierarchy_level: int = -1,
+    **kwargs,
+):
+    """The evaluator-facing form: generates K key pairs and packs each
+    party's keys into an ``ops.evaluator.KeyBatch`` ready for the batched
+    evaluation entry points (correction-word arrays packed once, the
+    PreparedKeyBatch upload shape). Returns (KeyBatch party 0, KeyBatch
+    party 1, keys_0, keys_1)."""
+    from .evaluator import KeyBatch
+
+    keys_0, keys_1 = generate_keys_batch(dpf, alphas, betas, **kwargs)
+    return (
+        KeyBatch.from_keys(dpf, keys_0, hierarchy_level),
+        KeyBatch.from_keys(dpf, keys_1, hierarchy_level),
+        keys_0,
+        keys_1,
+    )

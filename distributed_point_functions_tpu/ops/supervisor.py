@@ -334,6 +334,22 @@ def full_domain_chain() -> Tuple[Rung, ...]:
     return tuple((None, b) for b in degrade.fallback_chain())
 
 
+def keygen_chain(mode: Optional[str]) -> Tuple[Rung, ...]:
+    """The batched-keygen chain (ISSUE 13): keygen/pallas → keygen/jax →
+    keygen/numpy (the vectorized host batch) → numpy — the rung of last
+    resort being the SCALAR per-key oracle loop, the one keygen
+    implementation that shares no code with the batched paths. The
+    resolved mode decides the entry rung; every rung generates the same
+    bytes from the same seeds, so degradation is invisible to callers."""
+    from . import keygen_batch
+
+    resolved = keygen_batch.validated_mode(mode)
+    order = keygen_batch.KEYGEN_RUNG_ORDER
+    rungs = [("keygen", b) for b in order[order.index(resolved):]]
+    rungs.append((None, "numpy"))
+    return tuple(rungs)
+
+
 # ---------------------------------------------------------------------------
 # Chunk journal: crash-safe checkpoint/resume
 # ---------------------------------------------------------------------------
@@ -755,6 +771,137 @@ def mic_batch_eval_robust(
         gate, key, xs,
         policy=policy, key_chunk=key_chunk, pipeline=pipeline, mode=mode,
     )
+
+
+def _keygen_spot_check(
+    dpf, keys_0, keys_1, alphas, per_key_betas, seeds, backend: str
+) -> None:
+    """Serialized-bytes spot verification of batched keygen: the LAST key
+    pair is regenerated through the scalar per-key oracle (the one path
+    sharing no code with the batched level loop) from the same seeds, and
+    both parties' wire bytes must match exactly. One key's worth of
+    oracle work per call — the keygen analog of `_spot_check`."""
+    from ..core import uint128
+    from ..protos import serialization
+
+    i = len(alphas) - 1
+    with integrity._faults_suspended():
+        want_0, want_1 = dpf.generate_keys_incremental(
+            alphas[i], per_key_betas[i],
+            seeds=(
+                uint128.from_limbs(seeds[i, 0]),
+                uint128.from_limbs(seeds[i, 1]),
+            ),
+        )
+    params = dpf.validator.parameters
+    for party, got, want in ((0, keys_0[i], want_0), (1, keys_1[i], want_1)):
+        got_b = serialization.serialize_dpf_key(got, params)
+        want_b = serialization.serialize_dpf_key(want, params)
+        if got_b != want_b:
+            bad = [
+                j for j in range(min(len(got_b), len(want_b)))
+                if got_b[j] != want_b[j]
+            ]
+            raise DataCorruptionError(
+                f"keygen spot check failed (backend {backend!r}): key "
+                f"{i} party {party} serialized bytes disagree at "
+                f"{len(bad) or abs(len(got_b) - len(want_b))} positions "
+                f"vs the scalar oracle",
+                key_index=i,
+                lanes=bad[:32],
+                backend=backend,
+            )
+    integrity.emit_event(
+        "sentinel-ok",
+        f"generate_keys: scalar-oracle spot check verified key pair {i} "
+        "byte-exact (both parties)",
+        backend,
+        op="generate_keys",
+    )
+
+
+def generate_keys_robust(
+    dpf,
+    alphas: Sequence[int],
+    betas: Sequence,
+    mode: Optional[str] = None,
+    seeds: Optional[np.ndarray] = None,
+    policy: DegradationPolicy = DEFAULT_POLICY,
+) -> Tuple[list, list]:
+    """Batched two-party keygen behind the supervisor (ISSUE 13): the
+    chain walks keygen/pallas → keygen/jax → keygen/numpy → numpy (the
+    scalar per-key oracle). The CSPRNG seeds are drawn ONCE up front and
+    handed to every rung, so rungs are interchangeable — a degraded
+    retry produces the SAME key pairs, and each non-oracle rung is
+    spot-verified by regenerating the last key pair through the scalar
+    oracle and comparing serialized bytes. Resource exhaustion halves
+    the key chunk (the batch is seeded level-major per slice; slicing
+    changes nothing — each key's tree walk is independent).
+
+    Args match ``ops.keygen_batch.generate_keys_batch``. Returns
+    (keys_0, keys_1) lists of ``DpfKey``."""
+    import secrets as _secrets
+
+    from ..core import uint128
+    from . import keygen_batch
+
+    k = len(alphas)
+    if k == 0:
+        return [], []
+    if seeds is None:
+        raw = _secrets.token_bytes(16 * 2 * k)
+        seeds = np.frombuffer(raw, dtype=np.uint32).reshape(k, 2, 4).copy()
+    else:
+        seeds = np.array(seeds, dtype=np.uint32).reshape(k, 2, 4)
+    from ..core import keygen as core_keygen
+
+    v = dpf.validator
+    beta_cols = core_keygen.normalize_beta_cols(
+        betas, k, v.num_hierarchy_levels
+    )
+    per_key_betas = [[col[i] for col in beta_cols] for i in range(k)]
+    chain = keygen_chain(mode)
+    verify = policy.verify is not False
+
+    def attempt(mode_r: Optional[str], backend: str, chunk: Optional[int]):
+        if mode_r is None:
+            # Scalar oracle of last resort: the per-key reference loop.
+            out_0, out_1 = [], []
+            for i in range(k):
+                a, b = dpf.generate_keys_incremental(
+                    alphas[i], per_key_betas[i],
+                    seeds=(
+                        uint128.from_limbs(seeds[i, 0]),
+                        uint128.from_limbs(seeds[i, 1]),
+                    ),
+                )
+                out_0.append(a)
+                out_1.append(b)
+            return out_0, out_1
+        ck = chunk if chunk is not None else k
+        # Direct engine call (make_prg + the core path), NOT the
+        # resolve_mode entry point: a rung is the chain's choice — its
+        # decision(source="degrade") stream is the record — and a
+        # per-attempt decision(source="explicit") would inflate and
+        # mislabel the telemetry consumers count engines by.
+        prg = keygen_batch.make_prg(backend)
+        out_0, out_1 = [], []
+        for s in range(0, k, ck):
+            part_0, part_1 = dpf.generate_keys_batch(
+                alphas[s : s + ck],
+                [col[s : s + ck] for col in beta_cols],
+                seeds=seeds[s : s + ck], prg=prg,
+            )
+            out_0.extend(part_0)
+            out_1.extend(part_1)
+        if verify:
+            _keygen_spot_check(
+                dpf, out_0, out_1, alphas, per_key_betas, seeds, backend
+            )
+        return out_0, out_1
+
+    attempt.default_chunk = k
+    return degrade._run_chain("generate_keys", policy, attempt, chain=chain)
 
 
 def _ctx_snapshot(ctx) -> tuple:

@@ -248,9 +248,21 @@ def _output_level_types(parameters: Sequence[DpfParameters], num_cw: int):
     correction_words[i] belongs to tree level i+1 and carries the value
     correction of the hierarchy level output at tree level i (keygen.py
     _generate_next), so index i maps through tree_to_hierarchy[i]."""
+    import dataclasses
+
     from ..core.params import ParameterValidator
 
-    v = ParameterValidator(list(parameters))
+    # Accept RESOLVED parameter lists (validator.parameters): past 88
+    # domain bits the resolved default security parameter (40 + bits)
+    # exceeds the validator's [0, 128] input range, so re-validating it
+    # raised on every deep key. A value above 128 can only BE a resolved
+    # default (explicit ones are rejected at Create), so mapping it back
+    # to 0 round-trips to the identical resolution.
+    v = ParameterValidator([
+        dataclasses.replace(p, security_parameter=0.0)
+        if p.security_parameter > 128 else p
+        for p in parameters
+    ])
     return {
         tree_level: parameters[h].value_type
         for tree_level, h in v.tree_to_hierarchy.items()

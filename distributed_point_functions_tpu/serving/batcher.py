@@ -57,8 +57,13 @@ from ..utils.errors import (
 #: Ops the front door serves — the six bulk entry points plus the
 #: generic FSS gate family (ISSUE 9: any gates/framework.MaskedGate —
 #: DReLU/ReLU, splines, bit decomposition — served through its shared
-#: fused-DCF GatePlan; MIC predates the framework and keeps its own op).
-OPS = ("full_domain", "evaluate_at", "dcf", "mic", "gate", "pir", "hierarchical")
+#: fused-DCF GatePlan; MIC predates the framework and keeps its own op)
+#: plus "keygen", the dealer-offload op (ISSUE 13: batched two-party key
+#: generation; same-parameter requests merge into one level-major pass).
+OPS = (
+    "full_domain", "evaluate_at", "dcf", "mic", "gate", "pir",
+    "hierarchical", "keygen",
+)
 
 
 class ServedFuture:
@@ -152,6 +157,9 @@ class Request:
     plan: Optional[list] = None  # hierarchical (hierarchy_level, prefixes)
     group: int = 16
     db: object = None  # pir: shared database (array or PreparedPirDatabase)
+    #: keygen: per hierarchy level, one beta value per alpha (normalized
+    #: at construction so same-parameter batches merge by concatenation).
+    betas: Optional[list] = None
     hierarchy_level: int = -1
     future: ServedFuture = dataclasses.field(default_factory=ServedFuture)
     #: absolute completion deadline on the ``time.perf_counter`` clock,
@@ -232,6 +240,36 @@ class Request:
         return cls(op="pir", obj=dpf, keys=tuple(keys), db=db)
 
     @classmethod
+    def keygen(cls, dpf, alphas: Sequence[int], betas):
+        """Dealer keygen offload (ISSUE 13): K key pairs for `alphas`,
+        `betas` per hierarchy level (scalar broadcast or one per alpha;
+        normalized per-alpha here so same-parameter requests merge by
+        concatenation). Carries no keys — the RESULT is keys.
+
+        Alphas and beta values are FULLY validated here, not at flush:
+        keygen requests merge across connections on parameters alone, so
+        a deferred error would reject every co-merged request with one
+        client's INVALID_ARGUMENT."""
+        from ..core import keygen as core_keygen
+        from ..utils.errors import InvalidArgumentError as _IAE
+
+        alphas = tuple(int(a) for a in alphas)
+        v = dpf.validator
+        cols = core_keygen.normalize_beta_cols(
+            betas, len(alphas), v.num_hierarchy_levels
+        )
+        last_lds = v.parameters[-1].log_domain_size
+        for a in alphas:
+            if a < 0 or (last_lds < 128 and a >= (1 << last_lds)):
+                raise _IAE(
+                    "`alpha` must be smaller than the output domain size"
+                )
+        for level, col in enumerate(cols):
+            for val in col:
+                v.validate_value(val, level)
+        return cls(op="keygen", obj=dpf, points=alphas, betas=cols)
+
+    @classmethod
     def hierarchical(cls, dpf, keys: Sequence, plan, group: int = 16):
         return cls(
             op="hierarchical", obj=dpf, keys=tuple(keys),
@@ -252,6 +290,8 @@ class Request:
         return integrity._params_signature(self._validator())
 
     def party(self) -> int:
+        if self.op == "keygen":
+            return -1  # the dealer generates BOTH parties' keys
         k = self.keys[0]
         if self.op == "dcf":
             return k.key.party
@@ -270,6 +310,11 @@ class Request:
         key's gate evaluated at many masked inputs)."""
         if self.op not in OPS:
             raise InvalidArgumentError(f"unknown serving op {self.op!r}")
+        if self.op == "keygen":
+            # No keys and no party: any same-parameter keygen requests
+            # merge — the batch is one level-major pass over the
+            # concatenated alphas/beta columns.
+            return (self.op, self.params_signature())
         if not self.keys:
             raise InvalidArgumentError("request carries no keys")
         # Party rides every signature: a merged KeyBatch must be one
@@ -309,8 +354,10 @@ class Request:
     def width(self) -> int:
         """This request's contribution to the batch-width target: keys
         for the key-merged ops, evaluation points for the gate ops (one
-        key by construction)."""
-        return len(self.points) if self.op in ("mic", "gate") else len(self.keys)
+        key by construction), alphas for keygen (keys to produce)."""
+        if self.op in ("mic", "gate", "keygen"):
+            return len(self.points)
+        return len(self.keys)
 
 
 class _Queue:

@@ -462,6 +462,20 @@ class DpfClient:
             deadline=deadline, **kw,
         )
 
+    def keygen(
+        self, parameters, alphas: Sequence[int], betas,
+        deadline: Optional[float] = None, **kw,
+    ) -> tuple:
+        """Dealer keygen offload: the server generates K key pairs for
+        `alphas`/`betas` (per hierarchy level, scalar or one per alpha)
+        through its batched level-major keygen. Returns (keys_0, keys_1)
+        as parsed DpfKey lists."""
+        arrays = self.call(
+            "keygen", wire.encode_keygen(parameters, alphas, betas),
+            deadline=deadline, **kw,
+        )
+        return wire.keygen_keys_from_arrays(arrays)
+
 
 class TwoServerClient:
     """The FSS deployment shape: one client per non-colluding party,
@@ -588,6 +602,42 @@ class TwoServerClient:
                      **kw) -> tuple:
         return self._pair(
             "hierarchical", key_pair, parameters, None, plan, group, **kw
+        )
+
+    def generate_keys_batch(
+        self, parameters, alphas: Sequence[int], betas, **kw
+    ) -> tuple:
+        """Horizontal dealer scale-out (ISSUE 13): the batch SPLITS
+        across both servers — each acts as an independent dealer for its
+        half (keygen is pure preprocessing; any trusted dealer replica
+        can seed any key pair) — and the halves run concurrently behind
+        each client's own retry/reconnect/deadline machinery. A dealer
+        whose budget exhausts surfaces as PartyUnavailableError naming
+        it, like every other op. Returns (keys_0, keys_1) in `alphas`
+        order. `betas`: per hierarchy level, scalar or one value per
+        alpha."""
+        from ..core.keygen import normalize_beta_cols
+
+        alphas = [int(a) for a in alphas]
+        k = len(alphas)
+        cols = normalize_beta_cols(betas, k)
+        if k == 0:
+            return [], []
+        if k == 1:
+            # Too small to split: one dealer serves it whole.
+            return self.clients[0].keygen(parameters, alphas, cols, **kw)
+        half = (k + 1) // 2
+        parts = self._both([
+            lambda: self.clients[0].keygen(
+                parameters, alphas[:half], [c[:half] for c in cols], **kw
+            ),
+            lambda: self.clients[1].keygen(
+                parameters, alphas[half:], [c[half:] for c in cols], **kw
+            ),
+        ])
+        return (
+            parts[0][0] + parts[1][0],
+            parts[0][1] + parts[1][1],
         )
 
 

@@ -146,6 +146,7 @@ _DEGRADE_OPS = {
     "gate": ("dcf.batch_evaluate",),
     "pir": ("pir_query_batch",),
     "hierarchical": ("evaluate_levels_fused",),
+    "keygen": ("generate_keys",),
 }
 
 
@@ -340,6 +341,16 @@ class FrontDoor:
         hl = r0.hierarchy_level if r0.op in ("full_domain", "evaluate_at") else -1
         bits, kind = _value_meta(v, hl)
         lds = v.parameters[hl].log_domain_size
+        if r0.op == "keygen":
+            # Work = keys x tree levels (one level-major AES pass per
+            # level). Host-only until a hardware window verifies the
+            # device modes (router.UNVERIFIED_MODES), so no bucketed axes.
+            return Workload(
+                op="keygen",
+                num_keys=sum(len(r.points) for r in reqs),
+                levels=v.tree_levels_needed,
+                log_domain=lds, value_bits=bits, value_kind=kind,
+            )
         if r0.op == "hierarchical":
             total = sum(
                 max(1, len(np.atleast_1d(np.asarray(p, dtype=object))))
@@ -611,6 +622,50 @@ class FrontDoor:
             out = gate.batch_eval(key, xs, engine="device", mode=mode)
         out = np.asarray(out)
         return [out[cols] for cols in rows]
+
+    def _run_keygen(self, reqs, engine, mode, union=None):
+        """Dealer keygen offload (ISSUE 13): merged alphas/beta columns
+        run ONE level-major batched keygen pass (the robust chain spot-
+        verifies non-oracle rungs against the scalar oracle), and each
+        request's slice is answered as serialized key blobs — 2*Kr uint8
+        arrays, Kr party-0 then Kr party-1 (`wire.keygen_result_arrays`'
+        layout), so the RPC server's generic result-array path carries
+        them unchanged. Host engine = the vectorized numpy batch; device
+        = the "jax"/"pallas" plane-circuit modes (staged-for-tunnel)."""
+        del union
+        from ..ops import keygen_batch, supervisor
+        from . import wire
+
+        dpf = reqs[0].obj
+        alphas = [a for r in reqs for a in r.points]
+        levels = len(reqs[0].betas)
+        beta_cols = [
+            [b for r in reqs for b in r.betas[level]]
+            for level in range(levels)
+        ]
+        kg_mode = (mode or "jax") if engine == "device" else "numpy"
+        if self.robust:
+            keys_0, keys_1 = supervisor.generate_keys_robust(
+                dpf, alphas, beta_cols, mode=kg_mode, policy=self.policy,
+            )
+        else:
+            keys_0, keys_1 = keygen_batch.generate_keys_batch(
+                dpf, alphas, beta_cols, mode=kg_mode
+            )
+        blobs = wire.keygen_result_arrays(
+            keys_0, keys_1, dpf.validator.parameters
+        )
+        total = len(alphas)
+        results = []
+        offset = 0
+        for r in reqs:
+            kr = len(r.points)
+            results.append(
+                blobs[offset : offset + kr]
+                + blobs[total + offset : total + offset + kr]
+            )
+            offset += kr
+        return results
 
     def _run_pir(self, reqs, engine, mode, union=None):
         from ..ops import evaluator, supervisor

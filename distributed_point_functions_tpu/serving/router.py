@@ -115,6 +115,14 @@ ANCHORS: Dict[Tuple[str, str, Optional[str]], Dict[str, float]] = {
     # at the 2^24 config.
     ("pir", "host", None): {"u64": 25.2e6, "u128": 25.2e6},
     ("pir", "device", "fold"): {"u64": 357e6, "u128": 357e6},
+    # dealer keygen 1024 keys, depth 20 (PERF.md "Device-side keygen"):
+    # vectorized host batch ~9.6 K keys/s u64 = 1.93e5 key-level AES
+    # passes/s; u128 pays the exact-int value-correction path. Keygen is
+    # mostly single-core numpy (level-major, no native threading), so
+    # its rate is NOT host-thread scaled — see _rate.
+    ("keygen", "host", None): {
+        "u64": 1.93e5, "u128": 1.72e5, "codec": 2.03e5,
+    },
 }
 
 #: Device modes with NO verified measurement (staged-for-tunnel, ROADMAP):
@@ -126,6 +134,10 @@ UNVERIFIED_MODES: Dict[Tuple[str, str], Tuple[str, ...]] = {
     ("dcf", "device"): ("walkkernel",),
     ("hierarchical", "device"): ("hierkernel",),
     ("pir", "device"): ("megakernel",),
+    # ISSUE 13: device keygen (the plane-space XLA / Mosaic row-kernel
+    # modes of ops/keygen_batch.py) has never run on hardware — host
+    # wins every keygen batch until a measurement teaches it.
+    ("keygen", "device"): ("jax", "pallas"),
 }
 
 #: Fallback key chunking for standalone Workloads — the dispatch-count
@@ -136,7 +148,10 @@ UNVERIFIED_MODES: Dict[Tuple[str, str], Tuple[str, ...]] = {
 #: built by hand.
 _DEFAULT_KEY_CHUNK = {"full_domain": 32, "pir": 64}
 
-_OPS = ("full_domain", "evaluate_at", "dcf", "mic", "gate", "pir", "hierarchical")
+_OPS = (
+    "full_domain", "evaluate_at", "dcf", "mic", "gate", "pir",
+    "hierarchical", "keygen",
+)
 
 
 def _anchor_op(op: str) -> str:
@@ -198,6 +213,10 @@ class Workload:
                 * float(max(1, self.levels))
                 * float(max(1, self.avg_prefixes))
             )
+        if self.op == "keygen":
+            # One level-major AES pass per tree level per key (`levels`
+            # carries tree_levels_needed here).
+            return float(keys) * float(max(1, self.levels))
         raise InvalidArgumentError(f"unknown router op {self.op!r}")
 
     def dispatches(self, mode: Optional[str]) -> int:
@@ -208,6 +227,12 @@ class Workload:
         Counted on the device axes — only the device engine dispatches,
         and chunk-multiple padding never changes the count."""
         keys, _ = self._axes("device")
+        if self.op == "keygen":
+            # The keygen level loop is sequential in tree depth: one
+            # fused L/R/value program per level + the final value hash
+            # (tests/test_dispatch_audit's keygen pin), independent of
+            # the key count.
+            return max(1, self.levels)
         ck = self.key_chunk or _DEFAULT_KEY_CHUNK.get(self.op, keys)
         chunks = max(1, math.ceil(keys / max(1, ck)))
         if self.op == "hierarchical":
@@ -344,7 +369,11 @@ class CostModel:
         table = ANCHORS.get((anchor_op, engine, mode))
         if table is not None:
             rate = _kind_rate(table, kind, bits)
-            return rate * self._host_speedup() if engine == "host" else rate
+            # keygen's host batch is level-major single-core numpy — the
+            # native-engine thread-speedup model does not apply to it.
+            if engine == "host" and anchor_op != "keygen":
+                rate = rate * self._host_speedup()
+            return rate
         if (
             engine == "device"
             and mode in UNVERIFIED_MODES.get((anchor_op, "device"), ())

@@ -500,6 +500,14 @@ class DpfServer:
             return Request.hierarchical(
                 self._dpf(parameters), keys, plan, group
             )
+        if op == "keygen":
+            # Dealer offload (ISSUE 13): this server generates BOTH
+            # parties' keys from the client's points/values — the BGI
+            # preprocessing-dealer role. The response is the serialized
+            # key-blob stream (wire.keygen_result_arrays' layout), which
+            # rides the generic result-array path below.
+            parameters, alphas, betas = wire.decode_keygen(payload)
+            return Request.keygen(self._dpf(parameters), alphas, betas)
         raise InvalidArgumentError(f"unservable op {op!r}")
 
 

@@ -237,10 +237,18 @@ def read_frame(
 # Op identifiers
 # ---------------------------------------------------------------------------
 
-#: The six bulk entry points served over the wire (the generic in-process
+#: The bulk entry points served over the wire (the generic in-process
 #: ``gate`` op needs a per-class config codec and stays in-process; MIC —
-#: the reference's own gate message — rides the wire).
-WIRE_OPS = ("full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical")
+#: the reference's own gate message — rides the wire). "keygen" is the
+#: dealer-offload op (ISSUE 13): the client ships parameters + points +
+#: per-level values, the server runs the batched level-major keygen and
+#: answers with both parties' serialized key blobs — dealers scale
+#: horizontally behind the existing retry/deadline machinery. Appended
+#: LAST: op ids are positional and wire-stable.
+WIRE_OPS = (
+    "full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical",
+    "keygen",
+)
 
 _OP_TO_ID = {name: i + 1 for i, name in enumerate(WIRE_OPS)}
 _ID_TO_OP = {i: name for name, i in _OP_TO_ID.items()}
@@ -626,3 +634,92 @@ def decode_hierarchical(buf: bytes):
             "hierarchical payload needs params + keys + plan"
         )
     return parameters, keys, plan, group
+
+
+def encode_keygen(
+    parameters: Sequence[DpfParameters],
+    alphas: Sequence[int],
+    betas,
+) -> bytes:
+    """Keygen-offload request: the full DpfParameters list (1), K alpha
+    points (2), and one level message (3) per hierarchy level carrying
+    that level's K beta values (scalar betas broadcast here, so the wire
+    form is always explicit per key). The server is a DEALER in the BGI
+    preprocessing model — it learns alpha/beta by design; clients that
+    must hide them keep keygen local."""
+    from ..core.keygen import normalize_beta_cols
+
+    parameters = list(parameters)
+    cols = normalize_beta_cols(betas, len(alphas), len(parameters))
+    out = _encode_params(parameters)
+    out += _encode_points(2, alphas)
+    for level, col in enumerate(cols):
+        vt = parameters[level].value_type
+        body = b"".join(
+            pb.len_field(1, serialization.encode_value(vt, v)) for v in col
+        )
+        out += pb.len_field(3, body)
+    return out
+
+
+def decode_keygen(buf: bytes):
+    parameters: List[DpfParameters] = []
+    alphas: List[int] = []
+    level_blobs: List[bytes] = []
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            parameters.append(serialization.decode_dpf_parameters(value))
+        elif field == 2:
+            alphas.append(serialization._decode_value_integer(value))
+        elif field == 3:
+            level_blobs.append(value)
+    if not parameters or not alphas:
+        raise InvalidArgumentError("keygen payload needs params + alphas")
+    if len(level_blobs) != len(parameters):
+        raise InvalidArgumentError(
+            f"keygen payload needs one beta column per hierarchy level "
+            f"({len(parameters)}), got {len(level_blobs)}"
+        )
+    betas = []
+    for level, blob in enumerate(level_blobs):
+        col = [
+            serialization.decode_value(v)
+            for f, _, v in pb.iter_fields(blob)
+            if f == 1
+        ]
+        if len(col) != len(alphas):
+            raise InvalidArgumentError(
+                f"keygen betas[{level}] carries {len(col)} values for "
+                f"{len(alphas)} alphas"
+            )
+        betas.append(col)
+    return parameters, alphas, betas
+
+
+def keygen_result_arrays(
+    keys_0: Sequence, keys_1: Sequence, parameters: Sequence[DpfParameters]
+) -> List[np.ndarray]:
+    """Keygen response as the generic result-array stream: 2K uint8 blob
+    arrays — K party-0 serialized DpfKey messages, then K party-1 — so
+    the response rides `encode_result_arrays` unchanged."""
+    return [
+        np.frombuffer(
+            serialization.serialize_dpf_key(k, list(parameters)), np.uint8
+        )
+        for k in list(keys_0) + list(keys_1)
+    ]
+
+
+def keygen_keys_from_arrays(arrays: Sequence[np.ndarray]):
+    """Inverse of :func:`keygen_result_arrays`: (keys_0, keys_1)."""
+    if len(arrays) % 2:
+        raise DataLossError(
+            f"keygen response carries {len(arrays)} blobs (expected an "
+            "even count: K per party)"
+        )
+    k = len(arrays) // 2
+    keys = [
+        serialization.parse_dpf_key(np.asarray(a, dtype=np.uint8).tobytes())
+        for a in arrays
+    ]
+    return keys[:k], keys[k:]

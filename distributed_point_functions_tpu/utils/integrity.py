@@ -665,7 +665,12 @@ def run_device_check(
     Unavailable via fault injection and the robust wrapper must recover
     bit-correct through the NEXT rung on-device, with a
     decision(source="degrade") record — CHECK_MODE=supervisor exercises
-    one real degrade transition on hardware for the next tunnel window).
+    one real degrade transition on hardware for the next tunnel window),
+    or "keygen" (ISSUE 13: per shape, a device-mode batched keygen —
+    pallas on Mosaic platforms, else the plane-space XLA mode — must
+    byte-match the scalar oracle on spot rows AND its keys must evaluate
+    bit-exact under the HOST engine at alpha and off-alpha points —
+    CHECK_MODE=keygen, the hardware gate for device-side dealers).
 
     `pipeline` (None = DPF_TPU_PIPELINE env / platform default) drives the
     chunk generators through the pipelined executor (ops/pipeline.py) —
@@ -705,6 +710,10 @@ def run_device_check(
         return failures + _run_router_check(
             shapes, rng, report, pipeline=pipeline
         )
+    if mode == "keygen":
+        return failures + _run_keygen_check(
+            shapes, rng, report, pipeline=pipeline
+        )
     for num_keys, lds in shapes:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
         alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
@@ -740,6 +749,95 @@ def run_device_check(
                 "corruption",
                 f"device check: {bad}/{num_keys} keys mismatch at "
                 f"log_domain={lds} mode={mode}",
+                _backend_name(),
+                num_keys=num_keys,
+                log_domain=lds,
+                mode=mode,
+            )
+        failures += bad
+    return failures
+
+
+def _run_keygen_check(shapes, rng, report, pipeline=None) -> int:
+    """CHECK_MODE=keygen body of `run_device_check` (ISSUE 13): the
+    device-side batched dealer on the live backend.
+
+    Per (num_keys, log_domain) shape, a batched keygen runs in the
+    platform's device mode ("pallas" on Mosaic platforms — compiled, not
+    interpreted — else the plane-space XLA "jax" mode) from pinned
+    seeds, then two independent verdicts:
+
+    1. **Byte-match spot rows** — the first and last key pairs are
+       regenerated through the scalar per-key oracle from the same seeds
+       and every serialized byte must agree (the wire form IS the
+       contract: a dealer whose keys differ anywhere is broken even if
+       they happen to evaluate correctly at the probed points).
+    2. **Host-engine evaluation** — every generated key pair is
+       evaluated under the HOST engine at its alpha and an off-alpha
+       point; the parties' shares must reconstruct beta and 0. This
+       catches the failure class byte-comparison can't see run on
+       hardware: a miscompiled device AES producing self-consistent but
+       wrong circuits would fail here against the independent host AES.
+
+    Returns the number of mismatched keys (0 = all verified).
+    """
+    del pipeline  # keygen's level loop has no chunk executor
+    from ..core.dpf import DistributedPointFunction
+    from ..core.params import DpfParameters
+    from ..core.value_types import Int
+    from ..ops import evaluator, keygen_batch
+    from ..protos import serialization
+
+    mode = "pallas" if evaluator._pallas_default() else "jax"
+    failures = 0
+    for num_keys, lds in shapes:
+        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+        # Byte-draw alphas: rng.integers caps at int64, and deep domains
+        # (the >88-bit range the serialization fix covers) must be
+        # checkable on hardware.
+        alphas = [
+            int.from_bytes(rng.bytes(16), "little") % (1 << lds)
+            for _ in range(num_keys)
+        ]
+        betas = [int(x) for x in rng.integers(1, 1000, size=num_keys)]
+        seeds = rng.integers(0, 2**32, size=(num_keys, 2, 4), dtype=np.uint32)
+        keys_0, keys_1 = keygen_batch.generate_keys_batch(
+            dpf, alphas, [betas], mode=mode, seeds=seeds
+        )
+        bad = 0
+        params = dpf.validator.parameters
+        for i in sorted({0, num_keys - 1}):
+            s = (
+                int.from_bytes(seeds[i, 0].tobytes(), "little"),
+                int.from_bytes(seeds[i, 1].tobytes(), "little"),
+            )
+            want_0, want_1 = dpf.generate_keys(alphas[i], betas[i], seeds=s)
+            for got, want in ((keys_0[i], want_0), (keys_1[i], want_1)):
+                if serialization.serialize_dpf_key(
+                    got, params
+                ) != serialization.serialize_dpf_key(want, params):
+                    bad += 1
+        byte_bad = bad
+        mask = (1 << 64) - 1
+        for i in range(num_keys):
+            off = (alphas[i] + 1) % (1 << lds)
+            e0 = dpf.evaluate_at(keys_0[i], 0, [alphas[i], off])
+            e1 = dpf.evaluate_at(keys_1[i], 0, [alphas[i], off])
+            if (e0[0] + e1[0]) & mask != betas[i] or (e0[1] + e1[1]) & mask:
+                bad += 1
+        status = (
+            "OK" if bad == 0
+            else f"MISMATCH ({bad} verdicts: {byte_bad} byte, "
+            f"{bad - byte_bad} eval)"
+        )
+        report(
+            f"keys={num_keys:4d} log_domain={lds:3d} keygen[{mode}]: {status}"
+        )
+        if bad:
+            emit_event(
+                "corruption",
+                f"keygen device check: {bad} failed verdicts at "
+                f"keys={num_keys} log_domain={lds} mode={mode}",
                 _backend_name(),
                 num_keys=num_keys,
                 log_domain=lds,

@@ -661,6 +661,71 @@ def test_serving_frontdoor_adds_zero_programs(program_counter):
     )
 
 
+def test_keygen_batch_program_budget(program_counter, monkeypatch):
+    """ISSUE 13 pin: jax-mode batched keygen launches EXACTLY
+    tree_levels_needed device programs per warm batch — one fused
+    expansion per level step plus the final value hash — independent of
+    the key count, with the pipeline env on AND off (keygen's level loop
+    has no chunk executor; the pin proves none sneaks in)."""
+    from distributed_point_functions_tpu.ops import keygen_batch
+
+    rng = np.random.default_rng(5)
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    expected = dpf.validator.tree_levels_needed
+    alphas = [3, 70, 201]
+    betas = [[5, 9, 40]]
+    seeds = rng.integers(0, 2**32, size=(3, 2, 4), dtype=np.uint32)
+
+    for pipeline_env in ("0", "1"):
+        monkeypatch.setenv("DPF_TPU_PIPELINE", pipeline_env)
+        run = lambda: keygen_batch.generate_keys_batch(
+            dpf, alphas, betas, mode="jax", seeds=seeds
+        )
+        run()  # warm: compiles allowed
+        program_counter["programs"] = 0
+        run()
+        got = program_counter["programs"]
+        assert got == expected, (
+            f"jax-mode keygen ran {got} device programs for a "
+            f"{expected}-tree-level batch with DPF_TPU_PIPELINE="
+            f"{pipeline_env} (pinned: one per level step + the final "
+            "value hash)"
+        )
+
+
+def test_serving_keygen_runs_zero_device_programs(program_counter):
+    """ISSUE 13 acceptance pin: the keygen-offload serving path routes
+    to the host batched dealer (device keygen modes are unverified,
+    router.UNVERIFIED_MODES), so a served keygen batch launches ZERO
+    device programs — the wire op costs nothing beyond the batched
+    path's own pinned budget, and the host batch's budget is zero."""
+    from distributed_point_functions_tpu import serving
+
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+
+    def door_pass():
+        # width_target == the merged alpha count: the flush fires on
+        # width, not the (deliberately huge) batch deadline.
+        door = serving.FrontDoor(max_wait_ms=1e6, width_target=3)
+        with door:
+            out = door.serve(
+                [
+                    serving.Request.keygen(dpf, [5, 9], [[1, 2]]),
+                    serving.Request.keygen(dpf, [44], [7]),
+                ],
+                timeout=120,
+            )
+        assert len(out[0]) == 4 and len(out[1]) == 2  # 2*K blobs each
+
+    door_pass()  # warm (object caches)
+    program_counter["programs"] = 0
+    door_pass()
+    assert program_counter["programs"] == 0, (
+        f"served keygen launched {program_counter['programs']} device "
+        "programs — the host dealer path must launch none"
+    )
+
+
 def test_serving_wire_adds_zero_programs(program_counter):
     """ISSUE 10 acceptance pin: the SOCKET boundary — framing, the
     server's request decode/reconstruct, deadline plumbing, response

@@ -1,0 +1,441 @@
+"""Batched two-party keygen on the batched AES kernels (ISSUE 13).
+
+Byte-identity is the contract: every mode of ops/keygen_batch.py
+("numpy" host batch, "jax" plane-space XLA, "pallas" row kernels) must
+produce SERIALIZED keys identical to the scalar
+`generate_keys_incremental` oracle from the same seeds — for DPF and
+DCF, both parties, u64/u128/IntModN and gate component keys.
+
+Compile budget: the jax-mode tests share one padded [32, 4] seed-row
+program family (every batch with 2K <= 32 seed rows pads to it), and
+the module's single interpret-pallas config runs the cheap-rows
+stand-in (the real row circuit is pinned by test_aes_pallas; real-
+circuit interpret of the batched row kernels is not CI-computable —
+the walkkernel lesson)."""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import (
+    Int,
+    IntModN,
+    TupleType,
+    XorWrapper,
+)
+from distributed_point_functions_tpu.dcf.dcf import (
+    DistributedComparisonFunction,
+)
+from distributed_point_functions_tpu.ops import keygen_batch, supervisor
+from distributed_point_functions_tpu.ops.degrade import DegradationPolicy
+from distributed_point_functions_tpu.protos import serialization
+from distributed_point_functions_tpu.utils import faultinject, integrity
+from distributed_point_functions_tpu.utils import telemetry
+from distributed_point_functions_tpu.utils.errors import (
+    DataCorruptionError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+RNG_SEED = 0xDEA13
+
+
+def _seeds(rng, k):
+    return rng.integers(0, 2**32, size=(k, 2, 4), dtype=np.uint32)
+
+
+def _scalar_pair(dpf, alpha, per_level_betas, seeds_row):
+    return dpf.generate_keys_incremental(
+        alpha, per_level_betas,
+        seeds=(
+            int.from_bytes(seeds_row[0].tobytes(), "little"),
+            int.from_bytes(seeds_row[1].tobytes(), "little"),
+        ),
+    )
+
+
+def _key_bytes(key, params):
+    return serialization.serialize_dpf_key(key, params)
+
+
+POLICY = DegradationPolicy(backoff_seconds=0.0)
+
+
+# ---------------------------------------------------------------------------
+# Byte-identity: batched modes vs the scalar oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "value_type,lds,betas",
+    [
+        (Int(64), 10, [5, 900, (1 << 60) + 3, 1]),
+        (Int(128), 9, [(1 << 100) + 7, 2, 3, (1 << 127) - 1]),
+        (XorWrapper(64), 10, [0xDEADBEEF, 1, 2, 3]),
+        (IntModN(64, 4294967291), 10, [5, 4294967290, 17, 0]),
+        (TupleType(Int(32), Int(64)), 8,
+         [(1, 2), (0, 5), ((1 << 32) - 1, 9), (7, 8)]),
+    ],
+)
+def test_numpy_batch_matches_scalar_bytes(value_type, lds, betas):
+    """The host batched path == the scalar per-key oracle, serialized,
+    both parties, every value-type class (incl. the vectorized <=64-bit
+    correction fast path and the exact-int wide/sampled paths)."""
+    rng = np.random.default_rng(RNG_SEED)
+    dpf = DistributedPointFunction.create(DpfParameters(lds, value_type))
+    k = len(betas)
+    alphas = [int(x) for x in rng.integers(0, 1 << lds, size=k)]
+    seeds = _seeds(rng, k)
+    keys_0, keys_1 = dpf.generate_keys_batch(alphas, [betas], seeds=seeds)
+    params = dpf.parameters
+    for i in range(k):
+        want_0, want_1 = _scalar_pair(dpf, alphas[i], [betas[i]], seeds[i])
+        assert _key_bytes(keys_0[i], params) == _key_bytes(want_0, params)
+        assert _key_bytes(keys_1[i], params) == _key_bytes(want_1, params)
+
+
+def test_jax_mode_byte_identical_to_numpy():
+    """mode="jax" (plane-space XLA expansion behind the KeygenPrg seam)
+    emits byte-identical keys for scalar, wide, and sampled value types.
+    All three DPFs use k=4 so the padded [32, 4] program family is
+    shared (one compile per (want_value,) variant for the module)."""
+    rng = np.random.default_rng(RNG_SEED + 1)
+    cases = [
+        (Int(64), 10, [5, 9, 40, 2]),
+        (Int(128), 9, [(1 << 90) + 1, 2, 3, 4]),
+        (IntModN(64, 101), 10, [5, 100, 17, 0]),
+    ]
+    for value_type, lds, betas in cases:
+        dpf = DistributedPointFunction.create(DpfParameters(lds, value_type))
+        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=4)]
+        seeds = _seeds(rng, 4)
+        base_0, base_1 = dpf.generate_keys_batch(alphas, [betas], seeds=seeds)
+        jax_0, jax_1 = keygen_batch.generate_keys_batch(
+            dpf, alphas, [betas], mode="jax", seeds=seeds
+        )
+        params = dpf.parameters
+        for got, want in zip(jax_0 + jax_1, base_0 + base_1):
+            assert _key_bytes(got, params) == _key_bytes(want, params)
+
+
+def test_dcf_jax_mode_byte_identical():
+    """DCF keygen through the mode seam (dcf.generate_keys_batch(mode=))
+    == the default host path, serialized, both parties — the gate
+    dealers' Int(128) payload family."""
+    rng = np.random.default_rng(RNG_SEED + 2)
+    dcf = DistributedComparisonFunction.create(5, Int(128))
+    alphas = [3, 17, 30]
+    seeds = _seeds(rng, 3)
+    base_0, base_1 = dcf.generate_keys_batch(alphas, 7, seeds=seeds)
+    jax_0, jax_1 = dcf.generate_keys_batch(alphas, 7, seeds=seeds, mode="jax")
+    params = dcf.dpf.parameters
+    for got, want in zip(jax_0 + jax_1, base_0 + base_1):
+        assert serialization.serialize_dcf_key(
+            got, params
+        ) == serialization.serialize_dcf_key(want, params)
+
+
+def test_gate_gen_and_bundle_ride_the_batch_path():
+    """MaskedGate.gen == gen(keygen_mode="jax") byte-for-byte (pinned
+    component seeds), and gen_bundle == sequential gens given the same
+    prng stream — the 4-component ReLU dealer in ONE batched pass."""
+    from distributed_point_functions_tpu.gates.prng import CounterRng
+    from distributed_point_functions_tpu.gates.relu import ReluGate
+
+    gate = ReluGate.create(8)
+    assert gate.num_components == 4  # two pieces x degree-1 coefficients
+    rng = np.random.default_rng(RNG_SEED + 3)
+    params = gate.dcf.dpf.parameters
+
+    def comp_seeds():
+        return [
+            (int(rng.integers(1, 1 << 62)), int(rng.integers(1, 1 << 62)))
+            for _ in range(gate.num_components)
+        ]
+
+    sd = comp_seeds()
+    k0_a, k1_a = gate.gen(
+        77, [5], prng=CounterRng(seed=b"kg-batch"), dcf_seeds=sd
+    )
+    k0_b, k1_b = gate.gen(
+        77, [5], prng=CounterRng(seed=b"kg-batch"), dcf_seeds=sd,
+        keygen_mode="jax",
+    )
+    for got, want in ((k0_b, k0_a), (k1_b, k1_a)):
+        assert serialization.serialize_gate_key(
+            got, params
+        ) == serialization.serialize_gate_key(want, params)
+
+    # Bundle of 2 inputs == two sequential gens, same prng draw order.
+    bundle_seeds = [comp_seeds(), comp_seeds()]
+    b0, b1 = gate.gen_bundle(
+        [11, 200], [[3], [9]], prng=CounterRng(seed=b"kg-bundle"),
+        dcf_seeds=bundle_seeds,
+    )
+    seq_prng = CounterRng(seed=b"kg-bundle")
+    for idx, (r_in, r_out) in enumerate([(11, [3]), (200, [9])]):
+        w0, w1 = gate.gen(
+            r_in, r_out, prng=seq_prng, dcf_seeds=bundle_seeds[idx]
+        )
+        assert serialization.serialize_gate_key(
+            b0[idx], params
+        ) == serialization.serialize_gate_key(w0, params)
+        assert serialization.serialize_gate_key(
+            b1[idx], params
+        ) == serialization.serialize_gate_key(w1, params)
+
+
+# ---------------------------------------------------------------------------
+# Pallas plumbing (cheap rows, ONE interpret config for the module)
+# ---------------------------------------------------------------------------
+
+
+class _CheapRows:
+    """The test_aes_pallas stand-in: shape/lane-preserving row rotation +
+    key-mask XOR so interpret mode can execute the kernel plumbing."""
+
+    def __call__(self, rows, rk_base, rk_diff, key_mask):
+        out = []
+        for p in range(128):
+            row = rows[(p + 1) % 128]
+            if rk_diff is not None and key_mask is not None:
+                row = row ^ key_mask
+            out.append(row)
+        return out
+
+    @staticmethod
+    def np_hash(planes, key_mask):
+        x = planes
+        sig = np.concatenate([x[64:], x[64:] ^ x[:64]], axis=0)
+        enc = np.roll(sig, -1, axis=0)
+        if key_mask is not None:
+            enc = enc ^ key_mask[None, :]
+        return enc ^ sig
+
+
+def test_pallas_expand_plumbing_interpret(monkeypatch):
+    """The keygen pallas wrappers (pack -> zero-correction expand kernel
+    -> bit-0 restore -> unpack -> trim, plus the value-hash path) against
+    a numpy model of the cheap circuit: validates everything the pallas
+    mode adds over "jax" — the real row circuit itself is pinned by
+    test_aes_pallas. ONE interpret-pallas config."""
+    import jax
+
+    from distributed_point_functions_tpu.ops import aes_pallas
+
+    jax.clear_caches()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    try:
+        rng = np.random.default_rng(RNG_SEED + 4)
+        flat = rng.integers(0, 2**32, size=(1024, 4), dtype=np.uint32)
+        prg = keygen_batch.DeviceKeygenPrg("pallas", interpret=True)
+        left, right, value = prg.expand(flat, want_value=True)
+        planes = np.asarray(keygen_batch._pack_planes_jit()(flat))
+        w = planes.shape[1]
+        full = np.full(w, 0xFFFFFFFF, np.uint32)
+        unpack = keygen_batch._unpack_planes_jit()
+        for got, mask in (
+            (left, np.zeros(w, np.uint32)),
+            (right, full),
+            (value, None),
+        ):
+            want = np.asarray(unpack(_CheapRows.np_hash(planes, mask)))
+            np.testing.assert_array_equal(got, want)
+        # value_hash wrapper (the blocks_needed > 1 / final-level path)
+        # shares the hash kernel config compiled above.
+        vh = prg.value_hash(flat[:100])
+        want = np.asarray(unpack(_CheapRows.np_hash(planes, None)))[:100]
+        np.testing.assert_array_equal(vh, want)
+        # Short batches pad to the [*, 128, 32] lane floor (a W=1
+        # interpret config ran ~100x slower — the _PALLAS_LANE_FLOOR
+        # rationale) and trim back.
+        l2, r2, _ = prg.expand(flat[:6], want_value=False)
+        np.testing.assert_array_equal(l2, left[:6] * 0 + l2)  # shape pin
+        assert l2.shape == (6, 4) and r2.shape == (6, 4)
+    finally:
+        jax.clear_caches()  # drop cheap-circuit traces
+
+
+# ---------------------------------------------------------------------------
+# Robust wrapper: rung walk, spot check, chunk halving
+# ---------------------------------------------------------------------------
+
+
+def _fixture(k=6, lds=10):
+    rng = np.random.default_rng(RNG_SEED + 5)
+    dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+    alphas = [int(x) for x in rng.integers(0, 1 << lds, size=k)]
+    betas = [int(x) for x in rng.integers(1, 99, size=k)]
+    seeds = _seeds(rng, k)
+    want_0, want_1 = dpf.generate_keys_batch(alphas, [betas], seeds=seeds)
+    return dpf, alphas, betas, seeds, want_0, want_1
+
+
+def _assert_same_keys(dpf, got, want):
+    params = dpf.parameters
+    for g, w in zip(got[0] + got[1], want[0] + want[1]):
+        assert _key_bytes(g, params) == _key_bytes(w, params)
+
+
+def test_robust_clean_and_unavailable_degrade():
+    """Clean jax-mode robust == host batch bytes; an injected
+    UnavailableError on the jax rung retries then degrades to
+    keygen/numpy with the SAME bytes (seeds drawn once, rungs
+    interchangeable), emitting retry/degrade/recovered events and a
+    decision(source="degrade") record."""
+    dpf, alphas, betas, seeds, want_0, want_1 = _fixture()
+    got = supervisor.generate_keys_robust(
+        dpf, alphas, [betas], mode="jax", seeds=seeds, policy=POLICY
+    )
+    _assert_same_keys(dpf, got, (want_0, want_1))
+
+    with telemetry.capture() as cap, integrity.capture_events() as events:
+        with faultinject.inject(faultinject.FaultPlan(
+            stage="device_call",
+            exception=UnavailableError("UNAVAILABLE: injected"),
+            backends=frozenset(["jax"]),
+        )):
+            got = supervisor.generate_keys_robust(
+                dpf, alphas, [betas], mode="jax", seeds=seeds, policy=POLICY
+            )
+    _assert_same_keys(dpf, got, (want_0, want_1))
+    kinds = [e.kind for e in events]
+    assert kinds.count("retry") == POLICY.max_retries
+    assert "degrade" in kinds and "recovered" in kinds
+    snap = cap.snapshot()
+    assert snap["decisions_by_source"].get("degrade", 0) == kinds.count(
+        "degrade"
+    )
+
+
+def test_robust_spot_check_catches_corruption():
+    """A corrupted device expansion (the keygen corrupt_output seam)
+    yields wrong correction words; the serialized spot check against the
+    scalar oracle must catch it and degrade — recovered bytes exact."""
+    dpf, alphas, betas, seeds, want_0, want_1 = _fixture()
+    with integrity.capture_events() as events:
+        with faultinject.inject(faultinject.FaultPlan(
+            stage="device_output", pattern="lane", key_row=-1,
+            backends=frozenset(["jax"]), max_fires=1,
+        )):
+            got = supervisor.generate_keys_robust(
+                dpf, alphas, [betas], mode="jax", seeds=seeds, policy=POLICY
+            )
+    _assert_same_keys(dpf, got, (want_0, want_1))
+    degrades = [e for e in events if e.kind == "degrade"]
+    assert degrades and degrades[0].data.get("error") == "DataCorruptionError"
+
+
+def test_robust_oom_halves_chunks_then_degrades():
+    dpf, alphas, betas, seeds, want_0, want_1 = _fixture()
+    with integrity.capture_events() as events:
+        with faultinject.inject(faultinject.FaultPlan(
+            stage="device_call",
+            exception=ResourceExhaustedError("RESOURCE_EXHAUSTED: injected"),
+            backends=frozenset(["jax"]),
+        )):
+            got = supervisor.generate_keys_robust(
+                dpf, alphas, [betas], mode="jax", seeds=seeds, policy=POLICY
+            )
+    _assert_same_keys(dpf, got, (want_0, want_1))
+    kinds = [e.kind for e in events]
+    assert "chunk-halved" in kinds and "degrade" in kinds
+
+
+def test_robust_corruption_detected_without_verify_disabled():
+    """policy.verify=False skips the spot check: the corruption flows
+    through undetected (documented tradeoff — the test pins that the
+    check is what catches it, not luck)."""
+    dpf, alphas, betas, seeds, want_0, want_1 = _fixture(k=3)
+    with faultinject.inject(faultinject.FaultPlan(
+        stage="device_output", pattern="lane", key_row=0,
+        backends=frozenset(["jax"]), max_fires=1,
+    )):
+        got = supervisor.generate_keys_robust(
+            dpf, alphas, [betas], mode="jax", seeds=seeds,
+            policy=DegradationPolicy(backoff_seconds=0.0, verify=False),
+        )
+    params = dpf.parameters
+    same = all(
+        _key_bytes(g, params) == _key_bytes(w, params)
+        for g, w in zip(got[0] + got[1], want_0 + want_1)
+    )
+    assert not same
+
+
+# ---------------------------------------------------------------------------
+# Mode resolution, env discipline, helpers, validation
+# ---------------------------------------------------------------------------
+
+
+def test_mode_resolution_and_decisions(monkeypatch):
+    dpf, alphas, betas, seeds, want_0, want_1 = _fixture(k=2, lds=6)
+    with telemetry.capture() as cap:
+        keygen_batch.generate_keys_batch(
+            dpf, alphas, [betas], mode="numpy", seeds=seeds
+        )
+    recs = cap.decision_records(op="keygen")
+    assert recs and recs[-1]["data"]["choice"] == "numpy"
+    assert recs[-1]["data"]["source"] == "explicit"
+
+    monkeypatch.setenv("DPF_TPU_KEYGEN", "numpy")
+    with telemetry.capture() as cap:
+        got = keygen_batch.generate_keys_batch(
+            dpf, alphas, [betas], seeds=seeds
+        )
+    _assert_same_keys(dpf, got, (want_0, want_1))
+    recs = cap.decision_records(op="keygen")
+    assert recs[-1]["data"]["source"] == "env-default"
+
+    monkeypatch.setenv("DPF_TPU_KEYGEN", "quantum")
+    with pytest.raises(InvalidArgumentError, match="DPF_TPU_KEYGEN"):
+        keygen_batch.generate_keys_batch(dpf, alphas, [betas], seeds=seeds)
+    with pytest.raises(InvalidArgumentError, match="keygen mode"):
+        keygen_batch.generate_keys_batch(
+            dpf, alphas, [betas], mode="fast", seeds=seeds
+        )
+
+
+def test_generate_key_batches_helper():
+    """The evaluator-facing helper packs both parties' keys into
+    KeyBatch form identical to KeyBatch.from_keys on the key lists."""
+    from distributed_point_functions_tpu.ops.evaluator import KeyBatch
+
+    dpf, alphas, betas, seeds, _, _ = _fixture(k=3, lds=8)
+    kb0, kb1, keys_0, keys_1 = keygen_batch.generate_key_batches(
+        dpf, alphas, [betas], seeds=seeds
+    )
+    want0 = KeyBatch.from_keys(dpf, keys_0)
+    assert kb0.party == 0 and kb1.party == 1
+    np.testing.assert_array_equal(kb0.seeds, want0.seeds)
+    np.testing.assert_array_equal(kb0.cw_seeds, want0.cw_seeds)
+    np.testing.assert_array_equal(
+        kb0.value_corrections, want0.value_corrections
+    )
+
+
+def test_keygen_chain_shapes():
+    assert supervisor.keygen_chain("pallas") == (
+        ("keygen", "pallas"), ("keygen", "jax"), ("keygen", "numpy"),
+        (None, "numpy"),
+    )
+    assert supervisor.keygen_chain("jax") == (
+        ("keygen", "jax"), ("keygen", "numpy"), (None, "numpy"),
+    )
+    assert supervisor.keygen_chain("numpy") == (
+        ("keygen", "numpy"), (None, "numpy"),
+    )
+    with pytest.raises(InvalidArgumentError):
+        supervisor.keygen_chain("walk")
+
+
+def test_validation_matches_scalar_contract():
+    dpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    with pytest.raises(InvalidArgumentError, match="same size"):
+        keygen_batch.generate_keys_batch(dpf, [1], [[1], [2]], mode="numpy")
+    with pytest.raises(InvalidArgumentError, match="per key"):
+        keygen_batch.generate_keys_batch(dpf, [1, 2], [[1]], mode="numpy")
+    with pytest.raises(InvalidArgumentError, match="alpha"):
+        keygen_batch.generate_keys_batch(dpf, [1 << 9], [[1]], mode="numpy")

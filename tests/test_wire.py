@@ -143,7 +143,7 @@ def test_request_body_round_trip():
 
 def test_request_body_rejects_unknown_op():
     with pytest.raises(InvalidArgumentError, match="not servable"):
-        wire.encode_request_body("keygen", b"")
+        wire.encode_request_body("transmogrify", b"")
     from distributed_point_functions_tpu.protos import wire as pb
 
     bogus = pb.uint64_field(1, 99) + pb.len_field(3, b"x")
@@ -277,6 +277,9 @@ def op_payloads():
         "hierarchical": wire.encode_hierarchical(
             hp, [hk0], [(0, [0, 1]), (2, [4, 5, 6])], group=4
         ),
+        # Incremental parameters + per-level beta columns: the dealer-
+        # offload request exercises multi-level value typing on the wire.
+        "keygen": wire.encode_keygen(hp, [2, 9], [[1, 2], [3, 4], 5]),
     }
 
 
@@ -301,6 +304,9 @@ def test_op_payload_reencode_is_byte_identical(op, op_payloads):
     elif op == "pir":
         params, keys, name = wire.decode_pir(payload)
         again = wire.encode_pir(params, keys, name)
+    elif op == "keygen":
+        params, alphas, betas = wire.decode_keygen(payload)
+        again = wire.encode_keygen(params, alphas, betas)
     else:
         params, keys, plan, group = wire.decode_hierarchical(payload)
         again = wire.encode_hierarchical(params, keys, plan, group)

@@ -209,6 +209,45 @@ def _build_fixtures(rng):
         # — corrupt the lone fold lane instead.
         "corrupt_pattern": "lane",
     }
+
+    # keygen (ISSUE 13): batched dealer starting at the keygen/jax rung
+    # (the first rung carrying both the device_call and the expansion
+    # corrupt_output seams); truth = the serialized bytes of the host
+    # batch from the SAME pinned seeds — the robust wrapper must recover
+    # the exact wire bytes through whatever rung serves. No pipeline
+    # stages in the level loop, so device_hang has nothing to wedge:
+    # "kinds" maps a drawn hang onto unavailable (same rng draw count —
+    # the seeded schedule of the other fixtures is unchanged).
+    from distributed_point_functions_tpu.protos import serialization
+
+    kdpf = DistributedPointFunction.create(DpfParameters(8, Int(64)))
+    kalphas = [3, 70, 201]
+    kbetas = [5, 9, 40]
+    kseeds = rng.integers(0, 2**32, size=(3, 2, 4), dtype=np.uint32)
+    kparams = kdpf.validator.parameters
+
+    def _key_bytes(pair):
+        keys_0, keys_1 = pair
+        out = np.empty(len(keys_0) + len(keys_1), dtype=object)
+        out[:] = [
+            serialization.serialize_dpf_key(k, kparams)
+            for k in list(keys_0) + list(keys_1)
+        ]
+        return out
+
+    want_kg = _key_bytes(kdpf.generate_keys_batch(kalphas, [kbetas], seeds=kseeds))
+    fixtures["keygen"] = {
+        "want": want_kg,
+        "run": lambda policy: _key_bytes(
+            supervisor.generate_keys_robust(
+                kdpf, kalphas, [kbetas], mode="jax", seeds=kseeds,
+                policy=policy,
+            )
+        ),
+        "chain": supervisor.keygen_chain("jax"),
+        "corrupt_pattern": "lane",
+        "kinds": ("corruption", "oom", "unavailable"),
+    }
     return fixtures
 
 
@@ -827,7 +866,8 @@ def main() -> int:
     ap.add_argument("--rounds", type=int, default=4)
     ap.add_argument(
         "--entries", default="",
-        help="comma-filter: full_domain,evaluate_at,dcf,mic,hierarchical,pir",
+        help="comma-filter: full_domain,evaluate_at,dcf,mic,hierarchical,"
+             "pir,keygen",
     )
     ap.add_argument("--wire", action="store_true",
                     help="two-subprocess socket soak (ISSUE 10)")
@@ -873,6 +913,11 @@ def main() -> int:
     for rnd in range(args.rounds):
         for name, fx in fixtures.items():
             kind = FAULT_KINDS[int(rng.integers(len(FAULT_KINDS)))]
+            if kind not in fx.get("kinds", FAULT_KINDS):
+                # Fixture can't express this fault (keygen has no pipeline
+                # stage for a hang to wedge): deterministic remap, same
+                # rng draw count so the seeded schedule stays stable.
+                kind = "unavailable"
             first_backend = fx["chain"][0][1]
             policy = degrade.DegradationPolicy(
                 backoff_seconds=0.0,

@@ -119,7 +119,12 @@ def _main() -> int:
     # requests, executed through the supervisor, sliced back, and
     # verified against the host oracle, with the decision(source=
     # "router") records checked for predicted costs; tpu_measure.sh's
-    # serving_router stage) — the program shapes fail independently on a broken
+    # serving_router stage) or "keygen" (the device-side batched dealer,
+    # ISSUE 13: a device-mode keygen — Mosaic row kernels on real TPUs,
+    # plane-space XLA elsewhere — must byte-match the scalar oracle on
+    # spot rows AND its keys must evaluate bit-exact under the HOST
+    # engine; tpu_measure.sh's keygen_device stage, the hardware gate
+    # for dealer offload) — the program shapes fail independently on a broken
     # backend (PERF.md). This tool measures the RAW platform:
     # auto-slabbing would hide exactly the over-threshold programs being
     # probed, so it is force-disabled regardless of the caller's

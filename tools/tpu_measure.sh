@@ -174,6 +174,20 @@ BENCH_GATES_MODE=walkkernel \
   stage gates_walkkernel 1500 python tools/run_bench_stage.py bench_gates.py \
   RECORD_SUFFIX=_walkkernel SUPERSEDES=gates_relu
 
+# 2b'''''. Device-side batched keygen (ISSUE 13): the dealer gate first
+# (CHECK_MODE=keygen: a device-mode batched keygen — Mosaic row kernels
+# on real TPUs — must byte-match the scalar oracle on spot rows AND its
+# keys must evaluate bit-exact under the HOST engine), then the
+# device-mode keygen record in its own results.json slot. SUPERSEDES the
+# HOST keygen record — a verified faster device record flips the
+# engine-table "keygen: host" row; the bench's serialized-bytes spot
+# verification gates the `verified` flag.
+CHECK_MODE=keygen CHECK_SHAPES=64x20 \
+  stage gate-keygen 900 python tools/check_device.py
+BENCH_KEYGEN_MODE=pallas \
+  stage keygen_device 1500 python tools/run_bench_stage.py bench_keygen.py \
+  RECORD_SUFFIX=_device SUPERSEDES=keygen
+
 # 2c. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
 # the pipelined chunk executor forced OFF land in their own results.json
 # slots, so the on/off pair is a first-class record pair (not just the
@@ -236,6 +250,7 @@ required="headline gate-megakernel headline_megakernel pir_megakernel \
 gate-walkkernel evaluate_at_walkkernel dcf_walkkernel \
 gate-hierkernel heavy_hitters_hierkernel \
 serving_router serving gates gates_walkkernel \
+gate-keygen keygen_device \
 headline-syncexec pir-syncexec evalat dcf hh-device \
 extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
