@@ -28,6 +28,19 @@ record also carries).
 Record: throughput speedup (the headline value), per-arm req/s, p50/p95
 request latency, the batch-width histogram, and the router's decision mix
 from a separate auto-routed pass.
+
+Fleet mode (ISSUE 14, ``BENCH_SERVING_MODE=fleet``): the same seeded
+mixed-op idea at the FLEET tier — one party's replica pool
+(serving/fleet.py) behind the frame-aware FleetProxy on loopback, driven
+by ``BENCH_SERVING_THREADS`` concurrent clients. Arms: 1 replica vs
+``BENCH_SERVING_REPLICAS`` (default 3) replicas serving the identical
+seeded schedule; the fleet arm SIGKILLs + restarts one replica mid-run
+(failover rides the client retry budget — the error count must stay 0).
+Each replica is its own process, so the single-replica arm is capped by
+one batcher worker + one GIL; the record's headline is the aggregate
+throughput ratio. A second, in-process measurement records the Orca
+fairness A/B: a 10:1 flood of per-key gate batches vs a minority op,
+minority p95 under ``fair=True`` vs the FIFO baseline vs uncontended.
 """
 
 import os
@@ -130,7 +143,293 @@ def _pcts(latencies):
     )
 
 
+def _fleet_workload(rng):
+    """One party's seeded mixed-op request set for the fleet arms, as
+    PRE-ENCODED (op, payload) pairs — encoding is client-side work that
+    would otherwise bound the (single-process) load generator before the
+    replicas saturate. Server-side-heavy mix (the mic gate's exact-int
+    host eval over a 16-bit group dominates) so replica scaling, not
+    wire overhead, is what the A/B measures."""
+    from distributed_point_functions_tpu.core.dpf import (
+        DistributedPointFunction,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.dcf.dcf import (
+        DistributedComparisonFunction,
+    )
+    from distributed_point_functions_tpu.gates.mic import (
+        MultipleIntervalContainmentGate,
+    )
+    from distributed_point_functions_tpu.serving import wire
+
+    params = [DpfParameters(10, Int(64))]
+    dpf = DistributedPointFunction.create(params[0])
+    alphas = [int(a) for a in rng.integers(0, 1 << 10, size=8)]
+    keys, _ = dpf.generate_keys_batch(alphas, [[7] * 8])
+    dcf = DistributedComparisonFunction.create(16, Int(64))
+    dkeys = [dcf.generate_keys(int(rng.integers(0, 1 << 16)), 99)[0]
+             for _ in range(4)]
+    intervals = [(2, 1000), (2000, 9000), (20000, 40000)]
+    gate = MultipleIntervalContainmentGate.create(16, intervals)
+    gkeys = [gate.gen(int(rng.integers(0, 1 << 16)), [3, 7, 11])[0]
+             for _ in range(6)]
+
+    def _eval_at(i):
+        pts = [int(x) for x in rng.integers(0, 1 << 10, size=8)]
+        return ("evaluate_at", wire.encode_evaluate_at(
+            params, [keys[i % len(keys)]], pts))
+
+    def _dcf(i):
+        xs = [int(x) for x in rng.integers(0, 1 << 16, size=24)]
+        return ("dcf", wire.encode_dcf(
+            16, Int(64), [dkeys[i % len(dkeys)]], xs))
+
+    def _mic(i):
+        xs = [int(x) for x in rng.integers(0, 1 << 16, size=32)]
+        return ("mic", wire.encode_mic(
+            16, intervals, gkeys[i % len(gkeys)], xs))
+
+    # 3:1:1 mic-dominated — ~2 ms of exact-int server work per average
+    # request, an order over the load generator's per-call cost.
+    kinds = (_mic, _mic, _mic, _dcf, _eval_at)
+    return [kinds[int(rng.integers(0, len(kinds)))](i) for i in range(2048)]
+
+
+def _drive_fleet(serving, port, calls, n, threads_n, on_progress=None):
+    """n pre-encoded calls spread over threads_n serial clients against
+    `port`; returns (wall, latencies, errors)."""
+    import threading
+    import time
+
+    per = n // threads_n
+    lock = threading.Lock()
+    latencies, errors, done = [], [], [0]
+
+    def _worker(t):
+        cli = serving.DpfClient("127.0.0.1", port)
+        try:
+            for i in range(per):
+                op, payload = calls[(t * per + i) % len(calls)]
+                t0 = time.perf_counter()
+                try:
+                    cli.call(op, payload, deadline=120.0)
+                except Exception as exc:  # noqa: BLE001 — counted, not fatal
+                    with lock:
+                        errors.append(f"{type(exc).__name__}: {exc}")
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+                    done[0] += 1
+                    if on_progress is not None:
+                        on_progress(done[0])
+        finally:
+            cli.close()
+
+    t0 = time.perf_counter()
+    workers = [threading.Thread(target=_worker, args=(t,), daemon=True)
+               for t in range(threads_n)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=900)
+    return time.perf_counter() - t0, latencies, errors
+
+
+def _bench_fairness(serving, rng):
+    """The Orca fairness A/B, in-process: a 10:1 flood of per-key gate
+    batches (12 distinct keys = 12 compatibility queues per scan) vs a
+    minority evaluate_at stream. Records the minority op's p95 under
+    fair round-robin ordering vs the FIFO baseline vs uncontended."""
+    import time
+
+    from distributed_point_functions_tpu.core.dpf import (
+        DistributedPointFunction,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.gates.mic import (
+        MultipleIntervalContainmentGate,
+    )
+
+    params = DpfParameters(8, Int(64))
+    dpf = DistributedPointFunction.create(params)
+    mkey, _ = dpf.generate_keys(3, 5)
+    intervals = [(2, 1000), (2000, 9000), (20000, 40000)]
+    gate = MultipleIntervalContainmentGate.create(16, intervals)
+    gkeys = [gate.gen(int(rng.integers(0, 1 << 16)), [3, 7, 11])[0]
+             for _ in range(16)]
+    rounds = int(os.environ.get("BENCH_SERVING_FAIR_ROUNDS", 25))
+
+    def _minority():
+        return serving.Request.evaluate_at(dpf, [mkey], [1, 2, 3, 4])
+
+    def _run(fair, flood):
+        minority_lat = []
+        with serving.FrontDoor(
+            engine="host", max_wait_ms=2.0, width_target=64, fair=fair,
+        ) as door:
+            futures = []
+            for r in range(rounds):
+                if flood:
+                    for j in range(10):
+                        xs = [int(x) for x in rng.integers(0, 1 << 16,
+                                                          size=8)]
+                        gk = gkeys[(r * 10 + j) % len(gkeys)]
+                        futures.append(door.submit(
+                            serving.Request.mic(gate, gk, xs)
+                        ))
+                fut = door.submit(_minority())
+                futures.append(fut)
+                minority_lat.append(fut)
+                time.sleep(0.002)
+            for f in futures:
+                f.result(timeout=300)
+        lats = sorted(f.latency_seconds for f in minority_lat)
+        return lats[min(len(lats) - 1, int(len(lats) * 0.95))] * 1e3
+
+    # Warm the per-process host caches (crypto objects, params
+    # signatures, the host oracle's value tables) OUT of the timed arms —
+    # the uncontended arm runs first and must not read as cold-start.
+    _run(fair=True, flood=False)
+    p95_u = _run(fair=True, flood=False)
+    p95_fair = _run(fair=True, flood=True)
+    p95_fifo = _run(fair=False, flood=True)
+    return {
+        "rounds": rounds,
+        "flood_ratio": 10,
+        "uncontended_p95_ms": round(p95_u, 2),
+        "fair_p95_ms": round(p95_fair, 2),
+        "fifo_p95_ms": round(p95_fifo, 2),
+        "fair_factor_vs_uncontended": round(p95_fair / max(p95_u, 1e-9), 2),
+        "fifo_factor_vs_uncontended": round(p95_fifo / max(p95_u, 1e-9), 2),
+    }
+
+
+def _bench_fleet(jax, smoke):
+    """BENCH_SERVING_MODE=fleet: 1-replica vs N-replica aggregate
+    throughput behind the FleetProxy, with a mid-run kill/restart on the
+    fleet arm, plus the in-process fairness A/B."""
+    import time
+
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.serving import fleet as fleet_mod
+
+    replicas = int(os.environ.get("BENCH_SERVING_REPLICAS", 3))
+    n = int(os.environ.get("BENCH_SERVING_REQUESTS", 480 if smoke else 2400))
+    threads_n = int(os.environ.get("BENCH_SERVING_THREADS", 16))
+    rng = np.random.default_rng(int(os.environ.get("BENCH_SEED", 17)))
+    calls = _fleet_workload(rng)
+    server_args = ["--engine", "host", "--max-wait-ms", "2"]
+
+    arms = {}
+    for label, count in (("single", 1), ("fleet", replicas)):
+        pool = fleet_mod.ReplicaPool(replicas=count, server_args=server_args)
+        proxy = None
+        try:
+            with Timer() as tup:
+                pool.start()
+                proxy = serving.FleetProxy(pool.endpoints).start()
+                probe = serving.DpfClient("127.0.0.1", proxy.port)
+                probe.wait_ready(timeout=180)
+                probe.close()
+            log(f"{label}: {count} replica(s) up in {tup.elapsed:.1f}s")
+            # warm: every op family once per client-thread count
+            _drive_fleet(serving, proxy.port, calls, threads_n * 4,
+                         threads_n)
+            killer = {}
+            if label == "fleet":
+                # mid-run chaos: SIGKILL the hottest replica at ~1/3 of
+                # the run, restart it (same port) — failover must ride
+                # the client retry budget with zero errors.
+                def _maybe_kill(done, _state={"fired": False}):
+                    if _state["fired"] or done < n // 3:
+                        return
+                    _state["fired"] = True
+
+                    def _chaos():
+                        st = proxy._stats()
+                        routed = {
+                            r["endpoint"]: r["routed"]
+                            for r in st["fleet"]["replicas"]
+                        }
+                        victim = max(
+                            range(count),
+                            key=lambda i: routed.get(
+                                f"127.0.0.1:{pool.ports[i]}", 0),
+                        )
+                        log(f"fleet: SIGKILL replica {victim} mid-run")
+                        pool.kill(victim)
+                        time.sleep(0.3)
+                        pool.restart(victim)
+                        log(f"fleet: replica {victim} restarted")
+
+                    import threading
+
+                    th = threading.Thread(target=_chaos, daemon=True)
+                    th.start()
+                    killer["thread"] = th
+
+                on_progress = _maybe_kill
+            else:
+                on_progress = None
+            wall, lats, errors = _drive_fleet(
+                serving, proxy.port, calls, n, threads_n,
+                on_progress=on_progress,
+            )
+            if killer.get("thread") is not None:
+                killer["thread"].join(timeout=120)
+            if not lats:
+                # Surface the recorded failures instead of dying on an
+                # empty-percentile IndexError (which would also discard
+                # the other arm's results).
+                raise RuntimeError(
+                    f"{label} arm served 0 of {n} requests; "
+                    f"errors: {errors[:3]}"
+                )
+            p50, p95 = _pcts(lats)
+            stats = proxy._stats()
+            arms[label] = {
+                "replicas": count,
+                "req_per_sec": round(len(lats) / wall, 1),
+                "served": len(lats),
+                "errors": len(errors),
+                "error_samples": errors[:3],
+                "latency_ms": {"p50": p50, "p95": p95},
+                "fleet_counters": stats["fleet"]["counters"],
+            }
+            log(f"{label}: {len(lats)}/{n} in {wall:.1f}s "
+                f"({len(lats) / wall:.0f} req/s), p95 {p95} ms, "
+                f"errors {len(errors)}")
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            pool.stop()
+
+    fairness = _bench_fairness(serving, rng)
+    log(f"fairness: {fairness}")
+    speedup = (
+        arms["fleet"]["req_per_sec"] / max(arms["single"]["req_per_sec"], 1e-9)
+    )
+    return {
+        "bench": "serving",
+        "metric": "fleet_aggregate_throughput_vs_single_replica",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "config": {
+            "mode": "fleet",
+            "requests": n,
+            "threads": threads_n,
+            "arms": arms,
+            "fairness": fairness,
+        },
+    }
+
+
 def bench(jax, smoke):
+    if os.environ.get("BENCH_SERVING_MODE", "ab") == "fleet":
+        return _bench_fleet(jax, smoke)
     from distributed_point_functions_tpu import serving
     from distributed_point_functions_tpu.core.dpf import (
         DistributedPointFunction,

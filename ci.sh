@@ -108,13 +108,22 @@ run_faults() {
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -x -m faults
   JAX_PLATFORMS=cpu python tools/chaos_soak.py --rounds 2 --seed 7
   # ISSUE 10: the socket chaos soak — two real server subprocesses on
-  # loopback, party 0 behind the frame-aware chaos proxy, a mixed
-  # two-server workload driven through serving/client.py with seeded
-  # wire faults (conn_reset / garbage_frame / slow_server / mid-batch
-  # server_kill + journal resume). Bounded rounds, loopback only,
-  # XLA:CPU, zero new pallas configs.
+  # loopback, party 0 behind the library fleet proxy (single-replica
+  # degenerate case since ISSUE 14), a mixed two-server workload driven
+  # through serving/client.py with seeded wire faults (conn_reset /
+  # garbage_frame / slow_server / mid-batch server_kill + journal
+  # resume). Bounded rounds, loopback only, XLA:CPU, zero new pallas
+  # configs.
   JAX_PLATFORMS=cpu python tools/chaos_soak.py --wire --seed 7 \
     --wire-requests 60 --wire-faults 6
+  # ISSUE 14: the fleet soak — 2 replicas per party behind FleetProxy,
+  # seeded mixed-op load, the hottest party-0 replica SIGKILLed and
+  # restarted mid-run. Asserts bit-exact shares, zero caller-visible
+  # failures (client retry budgets absorb the failover), and affinity
+  # resumption on the restarted replica. Bounded (<60 s), loopback,
+  # XLA:CPU, host engine — zero pallas configs.
+  JAX_PLATFORMS=cpu python tools/chaos_soak.py --fleet --replicas 2 \
+    --fleet-requests 120 --fleet-threads 4 --seed 7
 }
 
 case "$tier" in

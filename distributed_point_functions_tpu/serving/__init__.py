@@ -24,6 +24,7 @@ from .client import (  # noqa: F401
     RetryPolicy,
     TwoServerClient,
 )
+from .fleet import FleetProxy, ReplicaPool  # noqa: F401
 from .frontdoor import FrontDoor  # noqa: F401
 from .server import DpfServer  # noqa: F401
 from .router import (  # noqa: F401

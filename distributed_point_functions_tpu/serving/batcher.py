@@ -17,6 +17,25 @@ granularity):
 * **Batch-deadline timers** — a queue flushes when its width reaches
   ``width_target`` OR its oldest request has waited ``max_wait_ms``:
   wide batches when traffic is heavy, bounded latency when it is not.
+  With ``adaptive_wait`` the deadline is width-aware (the remaining Orca
+  depth, ISSUE 14): a queue whose traffic cannot fill the width target
+  within the full window is not going to — waiting the full
+  ``max_wait_ms`` buys no batching, only latency — so its effective
+  deadline scales with a per-signature ARRIVAL-RATE EWMA (the width a
+  full window would collect, projected from each flush's width over its
+  actual accumulation time; never below ``_ADAPT_FLOOR`` of
+  ``max_wait_ms``, never above it). Rate, not raw width: widths
+  measured under an already-shortened window would self-reinforce and
+  never let the window grow back when traffic returns.
+* **Fair scheduling + priorities** — when several queues are ripe at
+  once, flushes are ordered iteration-level fair across *op classes*
+  (the Orca scheduling idea at batch granularity): ops are served
+  round-robin by least-recently-served, so a flood of one op class —
+  e.g. hundreds of per-key gate queues — cannot starve another op's
+  lone ripe queue behind its whole backlog. An optional ``priorities``
+  map (op -> class, lower serves first) orders classes before fairness
+  applies *within* a class; ``fair=False`` restores the FIFO baseline
+  (ripeness-scan order), which is also the bench's starvation arm.
 * **Admission control** — total queued requests are bounded by
   ``max_queue_depth``; past it, ``submit`` raises
   ``ResourceExhaustedError`` immediately (fail fast beats queue collapse;
@@ -361,13 +380,35 @@ class Request:
 
 
 class _Queue:
-    __slots__ = ("sig", "requests", "width", "oldest")
+    __slots__ = ("sig", "requests", "width", "oldest", "taken_elapsed")
 
     def __init__(self, sig):
         self.sig = sig
         self.requests: List[Request] = []
         self.width = 0
         self.oldest = float("inf")
+        #: accumulation time at the moment _take_ripe POPPED the queue —
+        #: the adaptive-rate denominator. Measured at pop, not at flush:
+        #: time spent waiting in pump's pending list behind other
+        #: batches is service contention, not arrival-rate evidence, and
+        #: counting it would underestimate busy signatures' rates.
+        self.taken_elapsed = 0.0
+
+
+#: adaptive_wait never shrinks a queue's effective deadline below this
+#: fraction of ``max_wait_ms`` — light-traffic queues flush early, but a
+#: burst arriving just after its first request still gets a window to
+#: merge into.
+_ADAPT_FLOOR = 0.25
+
+#: adaptive_wait needs this many flush samples for a signature before it
+#: trusts the rate EWMA (a single quiet flush must not collapse the
+#: window for a queue that was merely unlucky once).
+_ADAPT_MIN_SAMPLES = 3
+
+#: bound on the per-signature rate-EWMA table (signatures are
+#: client-controlled for the per-key gate ops; LRU-evict past this).
+_ADAPT_MAX_SIGS = 512
 
 
 class ContinuousBatcher:
@@ -378,6 +419,12 @@ class ContinuousBatcher:
     raises rejects the whole batch (each future carries it). Use as a
     context manager, or call :meth:`start` / :meth:`stop` explicitly;
     :meth:`pump` flushes ripe queues inline for deterministic tests.
+
+    ``priorities`` maps op -> scheduling class (lower flushes first;
+    missing ops are class 0); within a class, ripe queues are served
+    round-robin across ops (``fair=True``) so no op class starves behind
+    a flood of another. ``adaptive_wait`` scales each queue's batch
+    deadline by its flushed-width history (see the module docstring).
     """
 
     def __init__(
@@ -386,6 +433,9 @@ class ContinuousBatcher:
         max_wait_ms: float = 5.0,
         width_target: int = 64,
         max_queue_depth: int = 1024,
+        priorities: Optional[Dict[str, int]] = None,
+        fair: bool = True,
+        adaptive_wait: bool = False,
     ):
         if width_target < 1 or max_queue_depth < 1:
             raise InvalidArgumentError(
@@ -395,10 +445,22 @@ class ContinuousBatcher:
         self.max_wait = max_wait_ms / 1e3
         self.width_target = width_target
         self.max_queue_depth = max_queue_depth
+        self.priorities = dict(priorities or {})
+        self.fair = fair
+        self.adaptive_wait = adaptive_wait
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: Dict[tuple, _Queue] = collections.OrderedDict()
         self._pending = 0
+        #: per-signature EWMA of request ARRIVAL rates (width / actual
+        #: accumulation time at flush — adaptive_wait's input),
+        #: LRU-bounded; values are (rate_per_second, samples).
+        self._rate_ewma: "collections.OrderedDict[tuple, Tuple[float, int]]" = (
+            collections.OrderedDict()
+        )
+        #: fairness clock: op -> sequence number of its last flush.
+        self._op_last_served: Dict[str, int] = {}
+        self._serve_seq = 0
         self._worker: Optional[threading.Thread] = None
         self._stop = False
         #: the exception that killed the worker thread, once dead. A dead
@@ -491,7 +553,36 @@ class ContinuousBatcher:
         with self._lock:
             return self._pending
 
+    def queue_depths(self) -> Dict[str, int]:
+        """Queued request count per op — the stats-frame field the fleet
+        proxy's least-loaded routing reads (ISSUE 14)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for q in self._queues.values():
+                if q.requests:
+                    op = q.requests[0].op
+                    out[op] = out.get(op, 0) + len(q.requests)
+            return out
+
     # -- flushing ----------------------------------------------------------
+    def _wait_for(self, sig: tuple) -> float:
+        """Effective batch deadline for `sig`, seconds. Caller holds
+        self._lock. Width-aware adaptation: a queue whose flushes have
+        been running at a fraction of the width target is not going to
+        fill — scale its window down proportionally (floored) so light
+        traffic stops paying latency for batching it never gets."""
+        if not self.adaptive_wait:
+            return self.max_wait
+        hit = self._rate_ewma.get(sig)
+        if hit is None or hit[1] < _ADAPT_MIN_SAMPLES:
+            return self.max_wait
+        # The width a FULL window would collect at the measured rate —
+        # window-invariant, so a shortened window can grow back the
+        # moment traffic does.
+        projected = hit[0] * self.max_wait
+        frac = projected / self.width_target
+        return self.max_wait * min(1.0, max(_ADAPT_FLOOR, frac))
+
     def _take_ripe(self, now: float, force: bool) -> List[_Queue]:
         """Pops every queue that is ripe (width target met, deadline
         passed, or force). Caller holds no lock."""
@@ -502,17 +593,72 @@ class ContinuousBatcher:
                 if not q.requests:
                     del self._queues[sig]
                     continue
-                expired = now - q.oldest >= self.max_wait
+                expired = now - q.oldest >= self._wait_for(sig)
                 if force or expired or q.width >= self.width_target:
                     del self._queues[sig]
                     self._pending -= len(q.requests)
+                    q.taken_elapsed = now - q.oldest
                     ripe.append(q)
             if _tm.enabled() and ripe:
                 _tm.gauge("serving.queue_depth", self._pending)
         return ripe
 
-    def _run_flush(self, q: _Queue) -> None:
+    def _order_ripe(self, ripe: List[_Queue]) -> List[_Queue]:
+        """Iteration-level fair flush order (the Orca scheduling idea at
+        batch granularity): priority class first, then round-robin
+        across op classes by least-recently-served, oldest queue first
+        within an op. ``fair=False`` keeps the ripeness-scan (FIFO)
+        order within a priority class — the baseline a flood of per-key
+        gate queues starves — but an explicit ``priorities`` map still
+        applies (an operator who set classes gets classes, whichever
+        fairness arm is running)."""
+        if len(ripe) <= 1:
+            return ripe
+        if not self.fair:
+            if not self.priorities:
+                return ripe
+            return sorted(  # stable: FIFO within each priority class
+                ripe,
+                key=lambda q: self.priorities.get(q.requests[0].op, 0),
+            )
+        by_op: Dict[str, List[_Queue]] = collections.OrderedDict()
+        for q in ripe:
+            by_op.setdefault(q.requests[0].op, []).append(q)
+        for queues in by_op.values():
+            queues.sort(key=lambda q: q.oldest)
+        out: List[_Queue] = []
+        with self._lock:
+            while by_op:
+                op = min(
+                    by_op,
+                    key=lambda o: (
+                        self.priorities.get(o, 0),
+                        self._op_last_served.get(o, -1),
+                    ),
+                )
+                out.append(by_op[op].pop(0))
+                self._serve_seq += 1
+                self._op_last_served[op] = self._serve_seq
+                if not by_op[op]:
+                    del by_op[op]
+        return out
+
+    def _observe_rate(self, sig: tuple, width: int, elapsed: float) -> None:
+        rate = width / max(elapsed, 1e-4)
+        with self._lock:
+            ewma, n = self._rate_ewma.get(sig, (rate, 0))
+            self._rate_ewma[sig] = (0.5 * rate + 0.5 * ewma, n + 1)
+            self._rate_ewma.move_to_end(sig)
+            while len(self._rate_ewma) > _ADAPT_MAX_SIGS:
+                self._rate_ewma.popitem(last=False)
+
+    def _run_flush(self, q: _Queue, forced: bool = False) -> None:
         op = q.requests[0].op
+        if not forced:
+            # Forced drains (shutdown, inline test pumps) are not
+            # traffic evidence — their near-zero accumulation time would
+            # read as an infinite arrival rate.
+            self._observe_rate(q.sig, q.width, q.taken_elapsed)
         if _tm.enabled():
             _tm.counter("serving.batches", op=op)
             _tm.observe("serving.batch_width", q.width, op=op)
@@ -546,11 +692,24 @@ class ContinuousBatcher:
         """Flushes ripe (or, with force, all) queues inline on the caller
         thread; returns the number of batches flushed. The deterministic
         test/shutdown path — the worker thread does exactly this on a
-        timer."""
+        timer.
+
+        With ``fair`` (and not ``force``), scheduling is ITERATION-level
+        (the Orca granularity): after every flushed batch the ripe set
+        is re-scanned and re-ordered, so a request that ripens while a
+        long pass of another op's backlog drains waits at most ONE batch
+        service — not the remainder of the pass. ``force`` keeps the
+        single-scan drain semantics (the shutdown path must terminate
+        against concurrent submitters)."""
         flushed = 0
-        for q in self._take_ripe(time.perf_counter(), force):
-            self._run_flush(q)
+        pending = self._order_ripe(self._take_ripe(time.perf_counter(), force))
+        while pending:
+            self._run_flush(pending.pop(0), forced=force)
             flushed += 1
+            if self.fair and not force and not self._stop:
+                fresh = self._take_ripe(time.perf_counter(), False)
+                if fresh:
+                    pending = self._order_ripe(pending + fresh)
         return flushed
 
     @property
@@ -599,13 +758,14 @@ class ContinuousBatcher:
                 for q in self._queues.values():
                     if not q.requests:
                         continue
+                    wait = self._wait_for(q.sig)
                     if (
                         q.width >= self.width_target
-                        or now - q.oldest >= self.max_wait
+                        or now - q.oldest >= wait
                     ):
                         ready = True
                         break
-                    d = q.oldest + self.max_wait
+                    d = q.oldest + wait
                     deadline = d if deadline is None else min(deadline, d)
                 if not ready:
                     timeout = (
@@ -667,6 +827,20 @@ class WarmCache:
         self._dbs = _LRU(db_capacity)
         self._plans = _LRU(plan_capacity)
         self._keys = _LRU(keys_capacity)
+
+    def inventory(self) -> Dict[str, List[str]]:
+        """Digest inventory of the warm tiers — the stats-frame field the
+        fleet proxy exposes so an operator can see WHICH replica holds a
+        prepared database / plan / key batch hot (ISSUE 14). Digests are
+        short hashes of the tier keys (stable within a process; the PIR
+        tier's key includes an object id, so cross-replica equality is
+        not meaningful there — presence and counts are)."""
+        with self._lock:
+            return {
+                "pir": [_digest(k) for k in self._dbs.data],
+                "plans": [_digest(k) for k in self._plans.data],
+                "keys": [_digest(k) for k in self._keys.data],
+            }
 
     def _get_or_make(self, lru: _LRU, key, make, op: str):
         with self._lock:

@@ -178,6 +178,11 @@ class FrontDoor:
       entry points' choice).
     * ``max_wait_ms`` / ``width_target`` / ``max_queue_depth`` — the
       batcher's deadline, width and admission knobs.
+    * ``priorities`` / ``fair`` / ``adaptive_wait`` — the batcher's Orca
+      scheduling knobs (ISSUE 14): per-op priority classes, round-robin
+      fairness across op classes (default on; ``False`` is the FIFO
+      baseline), and width-aware batch-deadline adaptation (default
+      off — a deployment choice, see README "Fleet deployment").
     * ``robust`` — execute through ops/supervisor.py (default) vs the raw
       entry points (enables the prepared-plan / prepared-keys warm tiers).
     * ``policy`` / ``pipeline`` — passed through to the execution layer.
@@ -200,6 +205,9 @@ class FrontDoor:
         max_wait_ms: float = 5.0,
         width_target: int = 64,
         max_queue_depth: int = 1024,
+        priorities: Optional[Dict[str, int]] = None,
+        fair: bool = True,
+        adaptive_wait: bool = False,
         robust: bool = True,
         policy=None,
         pipeline: Optional[bool] = None,
@@ -239,6 +247,9 @@ class FrontDoor:
             max_wait_ms=max_wait_ms,
             width_target=width_target,
             max_queue_depth=max_queue_depth,
+            priorities=priorities,
+            fair=fair,
+            adaptive_wait=adaptive_wait,
         )
 
     # -- lifecycle ---------------------------------------------------------

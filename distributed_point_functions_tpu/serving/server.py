@@ -100,6 +100,7 @@ class DpfServer:
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         self._inflight = 0
+        self._served = 0
         self._inflight_lock = threading.Lock()
         self._draining = False
         self._stopped = threading.Event()
@@ -324,10 +325,18 @@ class DpfServer:
     # -- endpoints ---------------------------------------------------------
     def _health(self) -> dict:
         dead = self.door.batcher.dead
+        with self._inflight_lock:
+            inflight, served = self._inflight, self._served
         return {
             "status": "draining" if self._draining else "serving",
             "ready": self.ready,
             "pending": self.door.batcher.pending(),
+            # ISSUE 14: the fleet proxy's least-loaded signal — requests
+            # being handled right now plus per-op queue depths. New keys
+            # in the existing body; pre-fleet clients never read them.
+            "inflight": inflight,
+            "served": served,
+            "queues": self.door.batcher.queue_depths(),
             "worker_dead": (
                 f"{type(dead).__name__}: {dead}" if dead else None
             ),
@@ -338,14 +347,23 @@ class DpfServer:
         if self._collector is None:
             return {}
         snap = self._collector.snapshot()
+        with self._inflight_lock:
+            inflight, served = self._inflight, self._served
         # The counter/aggregate view only: the event ring is an operator
-        # debugging surface, not a polling payload.
+        # debugging surface, not a polling payload. The ISSUE 14 keys
+        # (wire.STATS_FLEET_KEYS) are additive: per-op queue depth +
+        # in-flight count feed the fleet proxy's routing, the warm-cache
+        # digest inventory its affinity observability.
         return {
             "wall_seconds": snap["wall_seconds"],
             "counters": snap["counters"],
             "gauges": snap["gauges"],
             "decisions_by_source": snap["decisions_by_source"],
             "integrity_by_kind": snap["integrity_by_kind"],
+            "queues": self.door.batcher.queue_depths(),
+            "inflight": inflight,
+            "served": served,
+            "warm": self.door.cache.inventory(),
         }
 
     # -- request handling --------------------------------------------------
@@ -416,6 +434,7 @@ class DpfServer:
         finally:
             with self._inflight_lock:
                 self._inflight -= 1
+                self._served += 1
 
     #: bound on the crypto-object cache below. The keys are
     #: client-controlled (parameter bytes, interval lists), so an
@@ -543,6 +562,15 @@ def main(argv=None) -> int:
     ap.add_argument("--max-wait-ms", type=float, default=5.0)
     ap.add_argument("--width-target", type=int, default=64)
     ap.add_argument("--max-queue-depth", type=int, default=1024)
+    # Orca scheduling knobs (ISSUE 14): fair round-robin across op
+    # classes is the default; --fifo is the starvation baseline arm.
+    ap.add_argument("--fifo", action="store_true",
+                    help="disable fair cross-op flush ordering (baseline)")
+    ap.add_argument("--adaptive-wait", action="store_true",
+                    help="width-aware batch-deadline adaptation")
+    ap.add_argument("--priorities", default=None, metavar="OP=N[,OP=N]",
+                    help="op priority classes, lower flushes first "
+                    "(e.g. evaluate_at=0,full_domain=1)")
     ap.add_argument("--key-chunk", type=int, default=None)
     ap.add_argument("--journal-dir", default=None,
                     help="full-domain chunk-journal directory (crash resume)")
@@ -572,12 +600,32 @@ def main(argv=None) -> int:
     except Exception:
         pass
 
+    priorities = None
+    if args.priorities:
+        priorities = {}
+        for part in args.priorities.split(","):
+            if not part:
+                continue
+            op, sep, val = part.partition("=")
+            bad = not sep
+            if not bad:
+                try:
+                    priorities[op] = int(val)
+                except ValueError:
+                    bad = True
+            if bad:
+                ap.error(  # exits with the argparse usage message
+                    f"--priorities entry {part!r}: want OP=N (e.g. "
+                    "evaluate_at=0,full_domain=1)"
+                )
     server = DpfServer(
         host=args.host, port=args.port,
         engine=args.engine, mode=args.mode,
         max_wait_ms=args.max_wait_ms, width_target=args.width_target,
         max_queue_depth=args.max_queue_depth, key_chunk=args.key_chunk,
         journal_dir=args.journal_dir,
+        fair=not args.fifo, adaptive_wait=args.adaptive_wait,
+        priorities=priorities,
     )
     for name, db in args.pir_db:
         server.register_db(name, db)

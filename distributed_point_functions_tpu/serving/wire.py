@@ -41,9 +41,10 @@ Robustness contract (pinned by tests/test_wire.py):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import socket
 import struct
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -694,6 +695,103 @@ def decode_keygen(buf: bytes):
             )
         betas.append(col)
     return parameters, alphas, betas
+
+
+# ---------------------------------------------------------------------------
+# Fleet routing + stats aggregation (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+#: Health/stats body keys added for fleet routing (ISSUE 14), all
+#: BACKWARD-COMPATIBLE: new keys in the existing JSON bodies, which old
+#: clients simply never read (pinned by the re-encode test in
+#: tests/test_wire.py). ``queues`` = per-op queued request counts,
+#: ``inflight`` = requests currently being handled, ``served`` = total
+#: requests answered this process, ``warm`` = the warm-cache digest
+#: inventory per tier (pir/plans/keys).
+STATS_FLEET_KEYS = ("queues", "inflight", "served", "warm")
+
+#: Request-payload fields, per op, that determine the request's
+#: compatibility-queue key and warm-cache identity on the replica — the
+#: affinity-routing digest hashes EXACTLY these. Key material is
+#: deliberately EXCLUDED for the key-merged ops (full_domain /
+#: evaluate_at / dcf / keygen): two clients' different keys must still
+#: land on ONE replica and merge into one batch there — routing on
+#: (op, parameters, level) keeps every mergeable request together, which
+#: also keeps a repeated key set's PreparedKeyBatch tier hot. The gate
+#: ops (mic) INCLUDE the key blob: their queues are per-key anyway, so
+#: per-key spreading buys load balance without losing any merge. pir
+#: adds the database name (the PreparedPirDatabase tier), hierarchical
+#: the plan entries + group (the PreparedLevelsPlan tier).
+_ROUTING_FIELDS: Dict[str, Tuple[int, ...]] = {
+    "full_domain": (1, 3),      # params, hierarchy_level
+    "evaluate_at": (1, 3),      # params, hierarchy_level
+    "dcf": (1,),                # dcf parameters
+    "mic": (1, 2),              # mic parameters, key blob (per-key queues)
+    "pir": (1, 3),              # params, db name
+    "hierarchical": (1, 3, 4),  # params, plan entries, group
+    "keygen": (1,),             # params (any same-parameter batch merges)
+}
+
+
+def routing_digest(op: str, payload: bytes) -> str:
+    """Affinity-routing digest of a request payload (ISSUE 14): the
+    fleet proxy rendezvous-hashes this against the replica set so
+    requests that share a compatibility queue — and therefore a
+    warm-cache tier — always meet on the same replica. Computed from the
+    raw payload fields (no key parsing, no crypto-object construction):
+    the proxy must stay cheap per frame."""
+    fields = _ROUTING_FIELDS.get(op)
+    if fields is None:
+        raise InvalidArgumentError(
+            f"op {op!r} has no routing rule (one of {sorted(_ROUTING_FIELDS)})"
+        )
+    h = hashlib.sha256(op.encode())
+    for field, _, value in pb.iter_fields(payload):
+        if field not in fields:
+            continue
+        h.update(struct.pack("<I", field))
+        if isinstance(value, int):  # varint/fixed field (hierarchy level…)
+            h.update(struct.pack("<Q", value & ((1 << 64) - 1)))
+        else:  # length-delimited (params / key / name / plan blobs)
+            h.update(struct.pack("<I", len(value)))
+            h.update(value)
+    return h.hexdigest()[:16]
+
+
+def merge_stats(bodies: Sequence[dict]) -> dict:
+    """Aggregates replica stats bodies (T_STATS_OK JSON) into one fleet
+    view: counters / gauges / queue depths / inflight / served SUM
+    across replicas, ``wall_seconds`` takes the max (replicas started
+    together; the eldest bounds the window), warm inventories
+    concatenate. Bodies missing the ISSUE 14 keys (an older server)
+    aggregate fine — the keys are additive, both directions."""
+    out: dict = {
+        "wall_seconds": 0.0, "counters": {}, "gauges": {},
+        "decisions_by_source": {}, "integrity_by_kind": {},
+        "queues": {}, "inflight": 0, "served": 0,
+        "warm": {"pir": [], "plans": [], "keys": []},
+    }
+    for body in bodies:
+        out["wall_seconds"] = max(
+            out["wall_seconds"], float(body.get("wall_seconds", 0.0))
+        )
+        for section in ("counters", "decisions_by_source",
+                        "integrity_by_kind", "queues"):
+            for k, v in (body.get(section) or {}).items():
+                out[section][k] = out[section].get(k, 0) + v
+        # Gauges are {"last", "max"} dicts; summing across replicas is
+        # the fleet reading (aggregate queue depth etc.).
+        for k, v in (body.get("gauges") or {}).items():
+            prev = out["gauges"].get(k, {"last": 0, "max": 0})
+            out["gauges"][k] = {
+                "last": prev["last"] + v.get("last", 0),
+                "max": prev["max"] + v.get("max", 0),
+            }
+        out["inflight"] += int(body.get("inflight", 0))
+        out["served"] += int(body.get("served", 0))
+        for tier, digests in (body.get("warm") or {}).items():
+            out["warm"].setdefault(tier, []).extend(digests)
+    return out
 
 
 def keygen_result_arrays(

@@ -785,3 +785,81 @@ def test_serving_wire_adds_zero_programs(program_counter):
             f"device programs vs {direct_count} for the direct merged "
             "call — framing and the server loop must add zero dispatches"
         )
+
+
+def test_fleet_adds_zero_programs(program_counter):
+    """ISSUE 14 acceptance pin — the front door's zero-overhead pin
+    extended to the FLEET tier: a proxy + N replicas launch EXACTLY the
+    device programs N direct servers do. Affinity routing is what makes
+    this hold: the four single-key requests share a routing digest, so
+    they all land on ONE replica and merge into the same 4-key batch a
+    single server would run — the proxy never splits a mergeable batch
+    across replicas (which would multiply programs), and relay/routing
+    are pure host work."""
+    import threading
+
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.ops import supervisor
+
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9, 44, 77], [[1, 2, 3, 4]])
+    params = [DpfParameters(10, Int(64))]
+
+    def direct():
+        supervisor.full_domain_evaluate_robust(
+            dpf, list(keys), key_chunk=2, pipeline=False
+        )
+
+    direct()  # warm: compiles + probe caches
+    program_counter["programs"] = 0
+    direct()
+    direct_count = program_counter["programs"]
+    assert direct_count >= 1
+
+    replicas = [
+        serving.DpfServer(
+            engine="device", max_wait_ms=10_000.0, width_target=4,
+            key_chunk=2, pipeline=False,
+        ).start()
+        for _ in range(2)
+    ]
+    proxy = serving.FleetProxy(
+        [("127.0.0.1", s.port) for s in replicas]
+    ).start()
+    try:
+        ready = serving.DpfClient("127.0.0.1", proxy.port)
+        ready.wait_ready(timeout=60)
+        ready.close()
+
+        def fleet_pass():
+            # One key per client connection; the width target of 4 and
+            # the shared routing digest flush them as ONE merged batch
+            # on ONE replica.
+            def one(k):
+                cli = serving.DpfClient("127.0.0.1", proxy.port)
+                try:
+                    cli.full_domain(params, [k], deadline=300)
+                finally:
+                    cli.close()
+
+            threads = [
+                threading.Thread(target=one, args=(k,)) for k in keys
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        fleet_pass()  # warm (server object caches on the serving replica)
+        program_counter["programs"] = 0
+        fleet_pass()
+        assert program_counter["programs"] == direct_count, (
+            f"the fleet tier launched {program_counter['programs']} "
+            f"device programs vs {direct_count} for the direct merged "
+            "call — affinity must keep a mergeable batch on one replica "
+            "and the proxy must add zero dispatches"
+        )
+    finally:
+        proxy.stop()
+        for s in replicas:
+            s.stop()

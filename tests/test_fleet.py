@@ -1,0 +1,371 @@
+"""Fleet-tier pins over real loopback sockets (ISSUE 14).
+
+All service tests run in-process ``DpfServer`` replicas with
+``engine="host"`` behind the REAL :class:`FleetProxy` — the full
+frame-relay / affinity-routing / failover path with zero XLA programs and
+zero new compiles (the wire-suite budget discipline; the
+zero-added-device-programs pin lives in tests/test_dispatch_audit.py).
+The routing-digest and stats-merge units are pure wire-format tests.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import serving
+from distributed_point_functions_tpu.core import host_eval
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int
+from distributed_point_functions_tpu.serving import wire
+from distributed_point_functions_tpu.serving.fleet import _rendezvous_score
+from distributed_point_functions_tpu.utils import telemetry
+from distributed_point_functions_tpu.utils.errors import UnavailableError
+
+PARAMS = [DpfParameters(8, Int(64))]
+FAST = serving.RetryPolicy(
+    attempts=4, base_backoff=0.01, max_backoff=0.05, connect_attempts=3,
+    connect_backoff=0.05, attempt_timeout=10.0, seed=0,
+)
+
+
+@pytest.fixture(scope="module")
+def dpf():
+    return DistributedPointFunction.create(PARAMS[0])
+
+
+@pytest.fixture(scope="module")
+def keys(dpf):
+    return dpf.generate_keys_batch([3, 70, 201], [[5, 9, 40]])
+
+
+@pytest.fixture()
+def fleet():
+    """Two in-process host-engine replicas behind a FleetProxy. The
+    probe interval is long: tests that want request-path death detection
+    must not race the probe loop; tests that want the probe call
+    proxy._probe themselves."""
+    servers = [
+        serving.DpfServer(engine="host", max_wait_ms=1.0).start()
+        for _ in range(2)
+    ]
+    proxy = serving.FleetProxy(
+        [("127.0.0.1", s.port) for s in servers], probe_interval=60.0,
+    ).start()
+    yield servers, proxy
+    proxy.stop()
+    for s in servers:
+        s.stop()
+
+
+@pytest.fixture()
+def client(fleet):
+    _, proxy = fleet
+    c = serving.DpfClient("127.0.0.1", proxy.port, policy=FAST)
+    c.wait_ready(timeout=30)
+    yield c
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# Routing digest (pure wire-format)
+# ---------------------------------------------------------------------------
+
+
+def test_routing_digest_key_independent_for_merged_ops(dpf, keys):
+    """Two clients' DIFFERENT keys for the same parameters must share a
+    digest — they can merge into one replica batch, and splitting them
+    across replicas would forfeit exactly the batching the front door
+    exists for."""
+    k0s, k1s = keys
+    a = wire.routing_digest(
+        "evaluate_at", wire.encode_evaluate_at(PARAMS, [k0s[0]], [1, 2])
+    )
+    b = wire.routing_digest(
+        "evaluate_at", wire.encode_evaluate_at(PARAMS, [k1s[2]], [7])
+    )
+    assert a == b
+    # ... but a different hierarchy level is a different program family.
+    c = wire.routing_digest(
+        "evaluate_at", wire.encode_evaluate_at(PARAMS, [k0s[0]], [1], 0)
+    )
+    assert c != a
+    # ... and a different op never collides by construction.
+    d = wire.routing_digest(
+        "full_domain", wire.encode_full_domain(PARAMS, [k0s[0]])
+    )
+    assert d != a
+
+
+def test_routing_digest_pir_keys_on_database(dpf, keys):
+    """PIR requests route on the database name (the PreparedPirDatabase
+    warm tier), not on key material."""
+    k0s, _ = keys
+    a = wire.routing_digest("pir", wire.encode_pir(PARAMS, [k0s[0]], "db-a"))
+    b = wire.routing_digest("pir", wire.encode_pir(PARAMS, [k0s[1]], "db-a"))
+    c = wire.routing_digest("pir", wire.encode_pir(PARAMS, [k0s[0]], "db-b"))
+    assert a == b and a != c
+
+
+def test_routing_digest_mic_keys_per_key(dpf):
+    """Gate requests route per key (their compatibility queues are
+    per-key anyway, so spreading keys buys load balance for free)."""
+    from distributed_point_functions_tpu.gates.mic import (
+        MultipleIntervalContainmentGate,
+    )
+
+    gate = MultipleIntervalContainmentGate.create(6, [(2, 10), (20, 40)])
+    ka, _ = gate.gen(5, [3, 7])
+    kb, _ = gate.gen(9, [1, 2])
+    a = wire.routing_digest("mic", wire.encode_mic(6, gate.intervals, ka, [1]))
+    b = wire.routing_digest("mic", wire.encode_mic(6, gate.intervals, kb, [1]))
+    assert a != b
+
+
+def test_rendezvous_rehash_is_minimal():
+    """The rendezvous property the failover design leans on: removing
+    one replica re-homes ONLY the digests it owned — every other
+    digest's winner is unchanged (no global reshuffle on death)."""
+    replicas = [f"127.0.0.1:{9000 + i}" for i in range(4)]
+    digests = [f"digest-{i:03d}" for i in range(200)]
+
+    def winner(pool, d):
+        return max(pool, key=lambda r: _rendezvous_score(d, r))
+
+    before = {d: winner(replicas, d) for d in digests}
+    dead = replicas[1]
+    survivors = [r for r in replicas if r != dead]
+    for d in digests:
+        after = winner(survivors, d)
+        if before[d] == dead:
+            assert after != dead
+        else:
+            assert after == before[d], "unrelated digest re-homed"
+
+
+# ---------------------------------------------------------------------------
+# Stats merge (the backward-compat satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_merge_stats_sums_and_tolerates_old_bodies():
+    """A pre-fleet stats body (no ISSUE 14 keys) merges with a new one:
+    the new keys are additive in both directions — old clients ignore
+    them, old servers simply don't contribute."""
+    old_body = {
+        "wall_seconds": 10.0,
+        "counters": {"rpc.server.requests[dcf]": 3},
+        "gauges": {"serving.queue_depth": {"last": 2, "max": 5}},
+        "decisions_by_source": {"router": 1},
+        "integrity_by_kind": {},
+    }
+    new_body = {
+        "wall_seconds": 12.0,
+        "counters": {"rpc.server.requests[dcf]": 4},
+        "gauges": {"serving.queue_depth": {"last": 1, "max": 2}},
+        "decisions_by_source": {"router": 2},
+        "integrity_by_kind": {},
+        "queues": {"dcf": 6},
+        "inflight": 2,
+        "served": 40,
+        "warm": {"pir": ["abc"], "plans": [], "keys": ["def"]},
+    }
+    merged = wire.merge_stats([old_body, new_body])
+    assert merged["wall_seconds"] == 12.0
+    assert merged["counters"]["rpc.server.requests[dcf]"] == 7
+    assert merged["gauges"]["serving.queue_depth"] == {"last": 3, "max": 7}
+    assert merged["queues"] == {"dcf": 6}
+    assert merged["inflight"] == 2 and merged["served"] == 40
+    assert merged["warm"]["pir"] == ["abc"]
+
+
+def test_stats_body_new_keys_are_additive():
+    """The ISSUE 14 stats keys ride the EXISTING JSON body — re-encoding
+    a body without them is byte-stable, and a consumer reading only the
+    pre-fleet keys sees identical values with or without them."""
+    import json
+
+    base = {"wall_seconds": 1.0, "counters": {"x": 1}, "gauges": {}}
+    extended = dict(
+        base, queues={"dcf": 1}, inflight=0, served=9,
+        warm={"pir": [], "plans": [], "keys": []},
+    )
+    assert set(wire.STATS_FLEET_KEYS) == set(extended) - set(base)
+    # An old consumer's view of the extended body == the base body.
+    old_view = {k: extended[k] for k in base}
+    assert old_view == base
+    # And re-encode stability: the base body round-trips byte-identical.
+    blob = json.dumps(base, sort_keys=True).encode()
+    assert json.dumps(json.loads(blob), sort_keys=True).encode() == blob
+
+
+# ---------------------------------------------------------------------------
+# End-to-end over loopback
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_bit_exact_and_aggregated_probes(fleet, client, dpf, keys):
+    k0s, _ = keys
+    pts = [0, 3, 70, 201, 255]
+    got = client.evaluate_at(PARAMS, list(k0s), pts, deadline=30)
+    want = host_eval.values_to_limbs(
+        host_eval.evaluate_at_host(dpf, list(k0s), pts, 0), 64
+    )
+    assert np.array_equal(got, want)
+    h = client.health()
+    assert h["ready"] and h["fleet"]["size"] == 2
+    st = client.stats()
+    # The merged replica counters + the fleet routing section.
+    assert st["fleet"]["counters"]["requests"] >= 1
+    assert sum(
+        v for k, v in st["counters"].items()
+        if k.startswith("rpc.server.requests")
+    ) >= 1
+    # The ISSUE 14 stats fields arrive through the proxy too.
+    for key in wire.STATS_FLEET_KEYS:
+        assert key in st, key
+
+
+def test_affinity_keeps_a_family_on_one_replica(fleet, client, dpf, keys):
+    """Same-parameter requests share a routing digest, so they all land
+    on ONE replica — where they can merge into one batch and share its
+    warm tiers. The other replica serves nothing."""
+    k0s, _ = keys
+    for _ in range(6):
+        client.evaluate_at(PARAMS, [k0s[0]], [1, 2], deadline=30)
+    st = client.stats()
+    routed = sorted(r["routed"] for r in st["fleet"]["replicas"])
+    assert routed == [0, 6], routed
+    assert st["fleet"]["counters"]["affinity_hits"] >= 6
+
+
+def test_failover_rides_the_client_retry_budget(fleet, client, dpf, keys):
+    """The pinned client-failover contract: a replica killed under a
+    warm digest range costs the caller ZERO visible errors — the proxy
+    answers UNAVAILABLE (retryable), the client's existing retry budget
+    carries the call, and the retry lands on the surviving replica
+    because the dead one left the candidate set synchronously."""
+    servers, proxy = fleet
+    k0s, _ = keys
+    pts = [0, 3, 70]
+    want = host_eval.values_to_limbs(
+        host_eval.evaluate_at_host(dpf, [k0s[0]], pts, 0), 64
+    )
+    got = client.evaluate_at(PARAMS, [k0s[0]], pts, deadline=30)
+    assert np.array_equal(got, want)
+    st = client.stats()
+    owner_key = [
+        r["endpoint"] for r in st["fleet"]["replicas"] if r["routed"] > 0
+    ][0]
+    owner = next(s for s in servers if owner_key.endswith(f":{s.port}"))
+    owner.stop()
+    with telemetry.capture() as cap:
+        t0 = time.perf_counter()
+        got = client.evaluate_at(PARAMS, [k0s[0]], pts, deadline=30)
+        dt = time.perf_counter() - t0
+    assert np.array_equal(got, want)  # zero caller-visible errors
+    assert dt < 5, "failover took a reconnect-budget walk, not a retry"
+    snap = cap.snapshot()
+    retries = sum(
+        v for k, v in snap["counters"].items()
+        if k.startswith("rpc.client.retries")
+    )
+    assert retries >= 1
+    st = client.stats()
+    assert st["fleet"]["counters"]["failovers"] >= 1
+    dead = [r for r in st["fleet"]["replicas"] if r["endpoint"] == owner_key]
+    assert dead[0]["alive"] is False
+
+
+def test_probe_revives_a_restarted_replica_and_affinity_rehomes(
+    fleet, client, dpf, keys
+):
+    """Drain + re-hash, both directions: a dead replica's digest range
+    re-homes to the survivor; a replica revived ON THE SAME PORT wins
+    its range back (rendezvous keys on host:port), so warm-tier reuse
+    resumes — the counter the fleet soak also asserts."""
+    servers, proxy = fleet
+    k0s, _ = keys
+    client.evaluate_at(PARAMS, [k0s[0]], [1], deadline=30)
+    st = client.stats()
+    owner_key = [
+        r["endpoint"] for r in st["fleet"]["replicas"] if r["routed"] > 0
+    ][0]
+    owner_i = next(
+        i for i, s in enumerate(servers) if owner_key.endswith(f":{s.port}")
+    )
+    port = servers[owner_i].port
+    servers[owner_i].stop()
+    for r in proxy._replicas:
+        proxy._probe(r)
+    # Re-hash: the survivor owns the digest now.
+    client.evaluate_at(PARAMS, [k0s[0]], [1], deadline=30)
+    st = client.stats()
+    by_key = {r["endpoint"]: r for r in st["fleet"]["replicas"]}
+    assert by_key[owner_key]["alive"] is False
+    survivor_routed = sum(
+        r["routed"] for r in st["fleet"]["replicas"]
+        if r["endpoint"] != owner_key
+    )
+    assert survivor_routed >= 1
+    # Revive on the SAME port: the range re-homes back.
+    servers[owner_i] = serving.DpfServer(
+        engine="host", max_wait_ms=1.0, port=port,
+    ).start()
+    for r in proxy._replicas:
+        proxy._probe(r)
+    base = {r.key: r.routed for r in proxy._replicas}[owner_key]
+    for _ in range(3):
+        client.evaluate_at(PARAMS, [k0s[0]], [1], deadline=30)
+    st = client.stats()
+    assert {
+        r["endpoint"]: r["routed"] for r in st["fleet"]["replicas"]
+    }[owner_key] == base + 3
+
+
+def test_whole_fleet_down_is_unavailable_not_a_hang(dpf, keys):
+    k0s, _ = keys
+    srv = serving.DpfServer(engine="host", max_wait_ms=1.0).start()
+    proxy = serving.FleetProxy(
+        [("127.0.0.1", srv.port)], probe_interval=60.0,
+    ).start()
+    cli = serving.DpfClient("127.0.0.1", proxy.port, policy=FAST)
+    cli.wait_ready(timeout=30)
+    srv.stop()
+    t0 = time.perf_counter()
+    with pytest.raises(UnavailableError):
+        cli.evaluate_at(PARAMS, [k0s[0]], [1], deadline=10)
+    assert time.perf_counter() - t0 < 8  # bounded by the retry budget
+    st = cli.stats()
+    assert st["fleet"]["counters"]["no_replica"] >= 1
+    cli.close()
+    proxy.stop()
+    srv.stop()
+
+
+def test_spill_overrides_a_hot_affinity_winner(fleet, dpf, keys):
+    """A hot digest must not melt one replica while the other idles:
+    when the winner's load runs spill_margin past the least-loaded, the
+    request spills (counted)."""
+    _, proxy = fleet
+    k0s, _ = keys
+    for r in proxy._replicas:  # deterministic: don't race the probe loop
+        proxy._probe(r)
+    # Make the rendezvous winner for this digest look overloaded.
+    digest = wire.routing_digest(
+        "evaluate_at", wire.encode_evaluate_at(PARAMS, [k0s[0]], [1])
+    )
+    winner = max(
+        proxy._replicas, key=lambda r: _rendezvous_score(digest, r.key)
+    )
+    with proxy._lock:
+        winner.pending = proxy.spill_margin + 5
+    picked = proxy._pick(digest)
+    try:
+        assert picked is not winner
+        assert proxy.counters["spills"] == 1
+    finally:
+        proxy._release(picked)
+        with proxy._lock:
+            winner.pending = 0

@@ -285,6 +285,119 @@ class TestBatcher:
         with pytest.raises(InvalidArgumentError):
             b.submit(serving.Request.full_domain(dpf, []))
 
+    def test_fair_ordering_interleaves_op_classes(self):
+        """The Orca fairness pin (ISSUE 14): a flood of one op's ripe
+        queues cannot starve another op's lone queue to the back of the
+        pass — round-robin across op classes serves the minority op by
+        the SECOND flush. fair=False is the FIFO baseline where it waits
+        behind the whole flood."""
+        dpf, keys = _dpf6(2)
+        for fair, want_pos in ((True, 1), (False, 6)):
+            batches, flush = self._collector()
+            b = serving.ContinuousBatcher(
+                flush, max_wait_ms=1e6, width_target=100, fair=fair,
+            )
+            # 6 distinct full_domain queues (per-hierarchy-level
+            # signatures — the per-key gate-queue flood shape) ...
+            for hl in range(6):
+                b.submit(serving.Request.full_domain(dpf, keys[:1], hl))
+            # ... then one minority evaluate_at queue, submitted LAST.
+            b.submit(serving.Request.evaluate_at(dpf, keys[:1], [1]))
+            assert b.pump(force=True) == 7
+            order = [reqs[0].op for _, reqs in batches]
+            assert order.index("evaluate_at") == want_pos, (fair, order)
+
+    def test_priorities_order_before_fairness(self):
+        """A priority class flushes before lower classes regardless of
+        round-robin — the explicit-priority half of the Orca knobs."""
+        dpf, keys = _dpf6(2)
+        batches, flush = self._collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100,
+            priorities={"evaluate_at": 0, "full_domain": 1},
+        )
+        for hl in range(3):
+            b.submit(serving.Request.full_domain(dpf, keys[:1], hl))
+        b.submit(serving.Request.evaluate_at(dpf, keys[:1], [1]))
+        assert b.pump(force=True) == 4
+        order = [reqs[0].op for _, reqs in batches]
+        assert order[0] == "evaluate_at", order
+
+    def test_adaptive_wait_shrinks_for_light_queues(self):
+        """Width-aware max_wait adaptation (ISSUE 14): a signature whose
+        measured ARRIVAL RATE projects far under the width target over a
+        full window gets a shorter batch deadline (floored at 25%) —
+        waiting buys no batching there, only latency. The signal is a
+        rate (width / accumulation time at flush), not the raw width:
+        widths measured under an already-shortened window would
+        self-reinforce and never let the window grow back. A fresh
+        signature (no history) keeps the full window. Forced pumps
+        (shutdown/test drains) are excluded from the history — their
+        near-zero accumulation time is not traffic evidence."""
+        dpf, keys = _dpf6(2)
+
+        def _seed_history(b, rate_per_window):
+            # Inject the rate history directly (deterministic: timing a
+            # real deadline-ripened flush on a shared vCPU is not) —
+            # rate in requests/second such that a full window collects
+            # `rate_per_window` of the width target.
+            rate = rate_per_window / b.max_wait
+            with b._lock:
+                b._rate_ewma[
+                    serving.Request.full_domain(dpf, keys[:1]).signature()
+                ] = (rate, 3)
+
+        for adaptive, want in ((True, 1), (False, 0)):
+            batches, flush = self._collector()
+            b = serving.ContinuousBatcher(
+                flush, max_wait_ms=200.0, width_target=8,
+                adaptive_wait=adaptive,
+            )
+            _seed_history(b, rate_per_window=2)  # 2 of 8: light traffic
+            # Effective wait is 200ms * max(0.25, 2/8) = 50ms when
+            # adaptive; still 200ms otherwise.
+            b.submit(serving.Request.full_domain(dpf, keys[:1]))
+            time.sleep(0.1)
+            assert b.pump() == want, adaptive
+            b.pump(force=True)  # drain
+        # Recovery (the hysteresis pin): with heavy-traffic history the
+        # projected width reaches the target and the window is FULL
+        # again — a rate signal cannot get stuck at the floor.
+        b = serving.ContinuousBatcher(
+            (lambda s, r: [req.future._resolve("ok") for req in r]),
+            max_wait_ms=200.0, width_target=8, adaptive_wait=True,
+        )
+        _seed_history(b, rate_per_window=16)  # 2x the target per window
+        b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        time.sleep(0.1)
+        assert b.pump() == 0  # full 200ms window again
+        b.pump(force=True)
+        # And forced flushes never feed the history.
+        assert all(n >= 3 for _, n in b._rate_ewma.values())
+        assert len(b._rate_ewma) == 1
+
+    def test_adaptive_wait_fresh_signature_keeps_full_window(self):
+        dpf, keys = _dpf6(1)
+        _, flush = self._collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=200.0, width_target=8, adaptive_wait=True,
+        )
+        b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        time.sleep(0.1)  # over the adapted floor, under the full window
+        assert b.pump() == 0
+        b.pump(force=True)
+
+    def test_queue_depths_by_op(self):
+        dpf, keys = _dpf6(3)
+        _, flush = self._collector()
+        b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=100)
+        b.submit(serving.Request.full_domain(dpf, keys[:1]))
+        b.submit(serving.Request.full_domain(dpf, keys[1:2]))
+        b.submit(serving.Request.evaluate_at(dpf, keys[:1], [1]))
+        assert b.queue_depths() == {"full_domain": 2, "evaluate_at": 1}
+        b.pump(force=True)
+        assert b.queue_depths() == {}
+
     def test_submit_after_stop_rejected(self):
         # A request landing after stop()'s final drain has no worker and
         # no future pump: it must fail fast, not hang its caller.

@@ -261,6 +261,44 @@ def test_health_stats_and_drain(server, client, dpf, keys):
             s.close()
 
 
+def test_stats_and_health_carry_fleet_routing_fields(server, client, dpf,
+                                                     keys):
+    """ISSUE 14 satellite: the stats/health bodies gain the fields the
+    fleet proxy routes on — per-op queue depth, in-flight count, served
+    total, warm-cache digest inventory — as ADDITIVE keys in the
+    existing JSON bodies (wire.STATS_FLEET_KEYS); every pre-fleet key is
+    still present with its old meaning."""
+    k0s, _ = keys
+    client.evaluate_at(PARAMS, k0s, [1, 2], deadline=30)
+    stats = client.stats()
+    for key in ("wall_seconds", "counters", "gauges",
+                "decisions_by_source", "integrity_by_kind"):
+        assert key in stats, key  # the pre-fleet body, unchanged
+    for key in wire.STATS_FLEET_KEYS:
+        assert key in stats, key
+    assert stats["served"] >= 1
+    assert isinstance(stats["queues"], dict)
+    assert set(stats["warm"]) == {"pir", "plans", "keys"}
+    health = client.health()
+    assert health["inflight"] == 0 and health["served"] >= 1
+    assert isinstance(health["queues"], dict)
+    # Queued-but-unflushed requests are visible per op (no worker pump:
+    # submit directly so the request sits queued).
+    server.door.submit(serving.Request.evaluate_at(dpf, list(k0s), [7]))
+    # the server's worker may flush it on the deadline — poll the window
+    deadline = time.perf_counter() + 5
+    seen = False
+    while time.perf_counter() < deadline:
+        depths = client.health()["queues"]
+        if depths.get("evaluate_at", 0) >= 1:
+            seen = True
+            break
+        if server.door.batcher.pending() == 0:
+            seen = True  # flushed before we looked: depth went through 0
+            break
+    assert seen
+
+
 def test_slow_mid_frame_request_is_served_not_torn(server, dpf, keys):
     """A request that stalls >0.5 s BETWEEN header and body must be
     served: the 0.5 s idle poll may not tear an in-progress frame (the

@@ -38,9 +38,10 @@ Wire mode (ISSUE 10)::
     JAX_PLATFORMS=cpu python tools/chaos_soak.py --wire --seed 7
 
 spawns TWO real server subprocesses (serving/server.py) on loopback —
-party 0 behind a frame-aware chaos proxy — and drives a mixed
-multi-op two-server workload through serving/client.py with seeded
-wire faults:
+party 0 behind the LIBRARY fleet proxy (serving/fleet.py's FleetProxy in
+its single-replica degenerate case; its chaos seam IS this soak's fault
+injector since ISSUE 14) — and drives a mixed multi-op two-server
+workload through serving/client.py with seeded wire faults:
 
   ``conn_reset``     the proxy RSTs the connection instead of forwarding
                      a response;
@@ -58,12 +59,22 @@ retry counters == injected faults, the deadline-shed counter visible on
 the server, and journal resume on the restarted party. Loopback only,
 XLA:CPU, zero Pallas configs — the same compile-budget discipline as the
 in-process soak.
+
+Fleet mode (ISSUE 14)::
+
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --fleet --replicas 3
+
+spawns N replica servers PER PARTY (serving/fleet.py ReplicaPool) behind
+one FleetProxy each, drives a seeded mixed-op load from concurrent
+client threads, SIGKILLs the hottest party-0 replica mid-run and
+restarts it on the same port. Asserts every share bit-exact, ZERO
+caller-visible failures (the client retry budget absorbs the failover),
+and that affinity routing resumes on the restarted replica (rendezvous
+re-homes its digest range).
 """
 
 import argparse
 import os
-import socket
-import struct
 import sys
 import threading
 import time
@@ -310,7 +321,7 @@ def _assert_equal(name, got, want):
 
 
 # ---------------------------------------------------------------------------
-# Wire mode (ISSUE 10): two server subprocesses + chaos proxy
+# Wire mode (ISSUE 10): two server subprocesses + the library fleet proxy
 # ---------------------------------------------------------------------------
 
 WIRE_FAULT_KINDS = ("conn_reset", "garbage_frame", "slow_server")
@@ -322,164 +333,36 @@ SLOW_SECONDS = 3.0
 WIRE_ATTEMPT_TIMEOUT = 1.0
 
 
-class ChaosProxy:
-    """A frame-aware TCP proxy in front of one server. Client->server
-    bytes pump verbatim; server->client frames are parsed so a fault can
-    be injected at exactly one RESPONSE boundary: ``arm(kind)`` makes the
-    next T_RESPONSE/T_ERROR frame (never handshake or probe answers)
-    reset, garble, or stall — one fault per arm, counted in ``fired``."""
+def _chaos_proxy(upstream_port: int):
+    """Party 0's front proxy: the LIBRARY FleetProxy in its
+    single-replica degenerate case (ISSUE 14 — the soak used to carry a
+    private frame-relay copy; the chaos seam `arm`/`fired` and the
+    upstream-socket-timeout fix now live in serving/fleet.py). The fault
+    vocabulary (`WIRE_FAULT_KINDS` == fleet.CHAOS_KINDS) is unchanged."""
+    from distributed_point_functions_tpu.serving import fleet
 
-    def __init__(self, upstream_host: str, upstream_port: int):
-        self.upstream = (upstream_host, upstream_port)
-        self._lock = threading.Lock()
-        self._armed = None
-        self.fired = {k: 0 for k in WIRE_FAULT_KINDS}
-        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._listener.bind(("127.0.0.1", 0))
-        self._listener.listen(16)
-        self._listener.settimeout(0.25)
-        self.port = self._listener.getsockname()[1]
-        self._stop = False
-        self._thread = threading.Thread(
-            target=self._accept_loop, name="chaos-proxy", daemon=True
-        )
-        self._thread.start()
-
-    def arm(self, kind: str) -> None:
-        assert kind in WIRE_FAULT_KINDS, kind
-        with self._lock:
-            self._armed = kind
-
-    def _take_armed(self):
-        with self._lock:
-            kind, self._armed = self._armed, None
-            return kind
-
-    def stop(self) -> None:
-        self._stop = True
-        try:
-            self._listener.close()
-        except OSError:
-            pass
-        self._thread.join(timeout=5)
-
-    def _accept_loop(self) -> None:
-        while not self._stop:
-            try:
-                client, _ = self._listener.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                return
-            try:
-                server = socket.create_connection(self.upstream, timeout=5)
-                # connect timeout only: a cold response can take a
-                # compile's worth of seconds, and the pump must wait, not
-                # inject a spurious disconnect at 5 s.
-                server.settimeout(None)
-            except OSError:
-                client.close()  # upstream down (restart window): drop
-                continue
-            threading.Thread(
-                target=self._pump_c2s, args=(client, server), daemon=True
-            ).start()
-            threading.Thread(
-                target=self._pump_s2c, args=(server, client), daemon=True
-            ).start()
-
-    @staticmethod
-    def _pump_c2s(client, server) -> None:
-        try:
-            while True:
-                data = client.recv(1 << 16)
-                if not data:
-                    break
-                server.sendall(data)
-        except OSError:
-            pass
-        finally:
-            for s in (client, server):
-                try:
-                    s.close()
-                except OSError:
-                    pass
-
-    def _pump_s2c(self, server, client) -> None:
-        from distributed_point_functions_tpu.serving import wire
-
-        try:
-            while True:
-                frame = wire.read_frame(server, check_version=False)
-                if frame is None:
-                    break
-                kind = (
-                    self._take_armed()
-                    if frame.ftype in (wire.T_RESPONSE, wire.T_ERROR)
-                    else None
-                )
-                if kind == "conn_reset":
-                    self.fired[kind] += 1
-                    # SO_LINGER(on, 0): close sends RST, not FIN — the
-                    # client sees a hard reset mid-conversation.
-                    client.setsockopt(
-                        socket.SOL_SOCKET, socket.SO_LINGER,
-                        struct.pack("ii", 1, 0),
-                    )
-                    break
-                if kind == "garbage_frame":
-                    self.fired[kind] += 1
-                    client.sendall(b"\xde\xad\xbe\xef" * 8)  # not a frame
-                    break
-                if kind == "slow_server":
-                    self.fired[kind] += 1
-                    time.sleep(SLOW_SECONDS)
-                client.sendall(wire.encode_frame(
-                    frame.ftype, frame.request_id, frame.body,
-                    version=frame.version,
-                ))
-        except Exception:  # noqa: BLE001 — pump dies with its connection
-            pass
-        finally:
-            for s in (server, client):
-                try:
-                    s.close()
-                except OSError:
-                    pass
+    assert WIRE_FAULT_KINDS == fleet.CHAOS_KINDS, "fault vocabulary drifted"
+    proxy = fleet.FleetProxy([("127.0.0.1", upstream_port)]).start()
+    proxy.slow_seconds = SLOW_SECONDS
+    return proxy
 
 
-def _spawn_server(repo_root, port, journal_dir, ready_file, log_path):
-    """One party's server subprocess: XLA:CPU, device engine (so the
-    robust chains + journal run), key_chunk=2 (many journal chunks =
-    a wide mid-batch kill window), the shared seeded PIR replica."""
-    import subprocess
+def _party_pool(base_dir, journal_dir):
+    """One party's server as a single-replica library ReplicaPool
+    (ISSUE 14 dedupe — the soak used to carry a private spawn/ready-file
+    copy): XLA:CPU, device engine (so the robust chains + journal run),
+    key_chunk=2 (many journal chunks = a wide mid-batch kill window),
+    the shared seeded PIR replica. ``pool.restart(0)`` respawns on the
+    SAME port + journal dir — the server_kill case's contract."""
+    from distributed_point_functions_tpu.serving import ReplicaPool
 
-    if os.path.exists(ready_file):
-        os.unlink(ready_file)
-    cmd = [
-        sys.executable, "-m",
-        "distributed_point_functions_tpu.serving.server",
-        "--port", str(port), "--platform", "cpu",
-        "--engine", "device", "--key-chunk", "2", "--max-wait-ms", "2",
-        "--journal-dir", journal_dir, "--ready-file", ready_file,
-        "--pir-db", "soak:8:1234",
-    ]
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
-    log = open(log_path, "ab")
-    return subprocess.Popen(
-        cmd, cwd=repo_root, env=env, stdout=log, stderr=log
+    return ReplicaPool(
+        replicas=1,
+        server_args=["--engine", "device", "--key-chunk", "2",
+                     "--max-wait-ms", "2", "--pir-db", "soak:8:1234"],
+        base_dir=base_dir,
+        journal_base=journal_dir,
     )
-
-
-def _wait_port(ready_file: str, timeout: float = 120.0) -> int:
-    t_end = time.perf_counter() + timeout
-    while time.perf_counter() < t_end:
-        try:
-            with open(ready_file) as f:
-                return int(f.read().strip())
-        except (OSError, ValueError):
-            time.sleep(0.1)
-    raise RuntimeError(f"server never wrote {ready_file}")
 
 
 def _wire_fixtures(rng):
@@ -635,7 +518,6 @@ def wire_main(args) -> int:
     import tempfile
     import threading
 
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     import jax
 
     jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
@@ -649,20 +531,31 @@ def wire_main(args) -> int:
 
     rng = np.random.default_rng(args.seed)
     tmp = tempfile.mkdtemp(prefix="dpf-wire-soak-")
-    procs = [None, None]
+    pools = [None, None]
     proxy = None
     failures = []
     t_start = time.perf_counter()
     try:
         # ---- two real server subprocesses, party 0 behind the proxy ----
-        ready = [os.path.join(tmp, f"ready{i}") for i in range(2)]
-        jdirs = [os.path.join(tmp, f"journal{i}") for i in range(2)]
-        logs = [os.path.join(tmp, f"server{i}.log") for i in range(2)]
         for i in range(2):
-            procs[i] = _spawn_server(repo_root, 0, jdirs[i], ready[i], logs[i])
-        ports = [_wait_port(r) for r in ready]
-        proxy = ChaosProxy("127.0.0.1", ports[0])
-        print(f"wire soak: servers pid={procs[0].pid},{procs[1].pid} "
+            pools[i] = _party_pool(
+                os.path.join(tmp, f"party{i}"),
+                os.path.join(tmp, f"journal{i}"),
+            )
+        spawners = [
+            threading.Thread(target=pools[i].start, daemon=True)
+            for i in range(2)
+        ]
+        for th in spawners:
+            th.start()
+        for th in spawners:
+            th.join(timeout=240)
+        ports = [pool.ports[0] for pool in pools]
+        if 0 in ports:
+            raise RuntimeError(f"a party never spawned (ports {ports})")
+        proxy = _chaos_proxy(ports[0])
+        print(f"wire soak: servers "
+              f"pid={pools[0].procs[0].pid},{pools[1].procs[0].pid} "
               f"ports={ports} proxy={proxy.port} tmp={tmp}")
 
         policy = RetryPolicy(
@@ -723,28 +616,31 @@ def wire_main(args) -> int:
                         f"req {i} {name} ({kind=}): "
                         f"{type(exc).__name__}: {exc}"
                     )
-            # one deliberately unmeetable deadline: the server must SHED
-            # (serving.shed_deadline) and the client must fail fast. A
-            # 1 ms budget can also die CLIENT-side before the request is
-            # ever sent (the deadline-spent-reconnecting fail-fast), in
-            # which case the server never saw it — repeat (bounded) until
-            # an attempt actually reaches the server and sheds. Pre-send
-            # expiries add no client retries, so the retries==injected
-            # accounting below stays exact.
-            for _ in range(10):
-                try:
-                    fixtures["evaluate_at"]["call"](client,
-                                                    {"deadline": 0.001})
-                    failures.append("shed: doomed-deadline call succeeded")
-                    break
-                except UnavailableError as exc:
-                    if "DEADLINE_EXCEEDED" not in str(exc):
-                        failures.append(f"shed: wrong error {exc}")
-                        break
-                if _counter_sum(client.clients[0].stats(),
-                                "serving.shed_deadline") >= 1:
-                    break
             snap = cap.snapshot()
+        # one deliberately unmeetable deadline: the server must SHED
+        # (serving.shed_deadline) and the client must fail fast. A 1 ms
+        # budget can also die CLIENT-side before the request is ever
+        # sent (the deadline-spent-reconnecting fail-fast), in which
+        # case the server never saw it — repeat (bounded) until an
+        # attempt actually reaches the server and sheds. Runs OUTSIDE
+        # the workload's capture window: when the shed answer loses the
+        # ~1 ms socket race the client counts ONE socket-timeout retry
+        # before the deadline check kills the call, which would
+        # misread as an extra injected fault in the retries==injected
+        # accounting below (observed ~1-in-3 runs on the shared vCPU).
+        for _ in range(10):
+            try:
+                fixtures["evaluate_at"]["call"](client,
+                                                {"deadline": 0.001})
+                failures.append("shed: doomed-deadline call succeeded")
+                break
+            except UnavailableError as exc:
+                if "DEADLINE_EXCEEDED" not in str(exc):
+                    failures.append(f"shed: wrong error {exc}")
+                    break
+            if _counter_sum(client.clients[0].stats(),
+                            "serving.shed_deadline") >= 1:
+                break
         retries = _counter_sum(snap, "rpc.client.retries")
         injected = sum(proxy.fired.values())
         print(f"wire soak: {n} requests, faults fired={proxy.fired}, "
@@ -791,21 +687,18 @@ def wire_main(args) -> int:
                 # after completion would never be retried, and the
                 # resume assertion below would test nothing.
                 if rec >= base + 2 and not box:
-                    os.kill(procs[1].pid, _signal.SIGKILL)
-                    procs[1].wait()
+                    pid = pools[1].procs[0].pid
+                    pools[1].kill(0, _signal.SIGKILL)
                     killed = True
                 time.sleep(0.005)
             if not killed:
                 failures.append("server_kill: never saw 2 journaled chunks "
                                 "(job too fast or stats unreachable)")
             else:
-                print(f"wire soak: SIGKILLed party 1 (pid {procs[1].pid}) "
+                print(f"wire soak: SIGKILLed party 1 (pid {pid}) "
                       "mid-batch; restarting on the same port + journal dir")
                 probe1.close()
-                procs[1] = _spawn_server(
-                    repo_root, ports[1], jdirs[1], ready[1], logs[1]
-                )
-                _wait_port(ready[1])
+                pools[1].restart(0)  # same port + journal dir
             th.join(timeout=300)
             if th.is_alive():
                 failures.append("server_kill: call never completed")
@@ -840,13 +733,9 @@ def wire_main(args) -> int:
     finally:
         if proxy is not None:
             proxy.stop()
-        for p in procs:
-            if p is not None and p.poll() is None:
-                p.terminate()
-                try:
-                    p.wait(timeout=20)
-                except Exception:  # noqa: BLE001
-                    p.kill()
+        for pool in pools:
+            if pool is not None:
+                pool.stop()
         if not failures:
             shutil.rmtree(tmp, ignore_errors=True)
 
@@ -857,6 +746,228 @@ def wire_main(args) -> int:
             print(f"  - {f}")
         return 1
     print(f"wire soak: PASS in {total:.1f}s")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet mode (ISSUE 14): replica pools behind FleetProxy, kill + rehash
+# ---------------------------------------------------------------------------
+
+
+def fleet_main(args) -> int:
+    """The fleet soak: N replicas per party behind one FleetProxy each,
+    a seeded mixed-op load from concurrent client threads, one party-0
+    replica SIGKILLed and restarted mid-run. Asserts:
+
+      1. every reconstructed share bit-exact vs the in-process host
+         oracle, ZERO caller-visible failures — the client retry budget
+         absorbs the failover;
+      2. the affinity-hit counter shows warm-tier reuse RESUMES after
+         the re-hash: the restarted replica (same port = same rendezvous
+         range) serves routed requests again before the run ends;
+      3. aggregate throughput is reported (the bench records the A/B).
+
+    engine=host on every replica: the full wire/fleet/batching path with
+    zero XLA programs and zero pallas configs (the wire-soak budget
+    discipline)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from distributed_point_functions_tpu.serving import (
+        FleetProxy,
+        ReplicaPool,
+        RetryPolicy,
+        TwoServerClient,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    tmp = tempfile.mkdtemp(prefix="dpf-fleet-soak-")
+    pools = [None, None]
+    proxies = [None, None]
+    failures = []
+    t_start = time.perf_counter()
+    try:
+        # ---- two replica pools (one per party) + proxies ---------------
+        t0 = time.perf_counter()
+        for party in range(2):
+            pools[party] = ReplicaPool(
+                replicas=args.replicas,
+                server_args=["--engine", "host", "--max-wait-ms", "2",
+                             "--pir-db", "soak:8:1234"],
+                base_dir=os.path.join(tmp, f"party{party}"),
+            )
+            pools[party].start()
+            proxies[party] = FleetProxy(pools[party].endpoints).start()
+        print(f"fleet soak: 2 parties x {args.replicas} replicas up in "
+              f"{time.perf_counter() - t0:.1f}s, proxy ports "
+              f"{[p.port for p in proxies]} tmp={tmp}")
+
+        policy = RetryPolicy(
+            attempts=5, base_backoff=0.05, max_backoff=1.0,
+            attempt_timeout=30.0, connect_attempts=240,
+            connect_backoff=0.25, seed=args.seed,
+        )
+        endpoints = [("127.0.0.1", proxies[0].port),
+                     ("127.0.0.1", proxies[1].port)]
+        warm_client = TwoServerClient(endpoints, policy=policy)
+        warm_client.wait_ready(timeout=180)
+
+        fixtures, _kill = _wire_fixtures(rng)
+        names = sorted(fixtures)
+        t0 = time.perf_counter()
+        for name in names:
+            fixtures[name]["call"](warm_client, {"deadline": 120.0})
+        warm_client.close()
+        print(f"fleet soak: warm pass ({len(names)} op families) in "
+              f"{time.perf_counter() - t0:.1f}s")
+
+        # ---- seeded mixed-op load from T concurrent clients ------------
+        n, threads_n = args.fleet_requests, args.fleet_threads
+        per_thread = n // threads_n
+        kill_at = per_thread // 3  # the kill lands ~1/3 into the run
+        lock = threading.Lock()
+        served = [0]
+
+        def _worker(t_index):
+            client = TwoServerClient(endpoints, policy=policy)
+            try:
+                for i in range(per_thread):
+                    name = names[(t_index + i) % len(names)]
+                    try:
+                        got = fixtures[name]["call"](client,
+                                                     {"deadline": 120.0})
+                        _assert_shares(f"t{t_index} req {i} {name}", got,
+                                       fixtures[name])
+                        with lock:
+                            served[0] += 1
+                    except Exception as exc:  # noqa: BLE001 — soak reports
+                        with lock:
+                            failures.append(
+                                f"t{t_index} req {i} {name}: "
+                                f"{type(exc).__name__}: {exc}"
+                            )
+            finally:
+                client.close()
+
+        t0 = time.perf_counter()
+        workers = [
+            threading.Thread(target=_worker, args=(t,), daemon=True)
+            for t in range(threads_n)
+        ]
+        for w in workers:
+            w.start()
+
+        # ---- mid-run: SIGKILL one party-0 replica, restart, re-hash ----
+        # Wait until the load has demonstrably started, then kill the
+        # replica affinity has been favoring (the hottest one).
+        while served[0] < kill_at * threads_n // 2 and any(
+            w.is_alive() for w in workers
+        ):
+            time.sleep(0.01)
+        st = proxies[0]._stats()
+        routed = {r["endpoint"]: r["routed"] for r in st["fleet"]["replicas"]}
+        victim = max(range(args.replicas),
+                     key=lambda i: routed.get(
+                         f"127.0.0.1:{pools[0].ports[i]}", 0))
+        victim_key = f"127.0.0.1:{pools[0].ports[victim]}"
+        routed_before = routed.get(victim_key, 0)
+        print(f"fleet soak: SIGKILLing party-0 replica {victim} "
+              f"({victim_key}, routed={routed_before}) mid-run")
+        pools[0].kill(victim)
+        time.sleep(0.5)  # let in-flight failovers land
+        pools[0].restart(victim)
+        print(f"fleet soak: replica {victim} restarted on the same port")
+        # Routed count at restart: affinity resumption is measured from
+        # here — rendezvous must send its digest range back.
+        st = proxies[0]._stats()
+        routed_at_restart = {
+            r["endpoint"]: r["routed"] for r in st["fleet"]["replicas"]
+        }[victim_key]
+
+        for w in workers:
+            w.join(timeout=600)
+        wall = time.perf_counter() - t0
+        alive = [w for w in workers if w.is_alive()]
+        if alive:
+            failures.append(f"{len(alive)} worker threads never finished")
+
+        st = proxies[0]._stats()
+        counters = st["fleet"]["counters"]
+        print(f"fleet soak: {served[0]}/{n} served in {wall:.1f}s "
+              f"({served[0] / wall:.0f} q/s aggregate incl. the restart "
+              f"window), fleet counters {counters}")
+        if counters["failovers"] + counters["replica_down"] < 1:
+            failures.append("kill was never observed by the proxy "
+                            "(no failover/replica_down counted)")
+        if counters["affinity_hits"] < served[0] // 2:
+            failures.append(
+                f"affinity hits {counters['affinity_hits']} < half the "
+                f"{served[0]} served requests — rendezvous routing broken?"
+            )
+
+        # ---- affinity re-homing: the restarted replica serves again ----
+        # The load may drain before the probe revives the restart, so the
+        # resumption assertion gets its own deterministic phase: wait for
+        # the revive, then drive every op family once — the victim was
+        # the HOTTEST replica, so rendezvous hands at least one family's
+        # digest range back to it (same port = same range).
+        t_rev = time.perf_counter() + 30
+        revived = False
+        while time.perf_counter() < t_rev:
+            st = proxies[0]._stats()
+            rep = {r["endpoint"]: r
+                   for r in st["fleet"]["replicas"]}[victim_key]
+            if rep["alive"]:
+                revived = True
+                break
+            time.sleep(0.1)
+        if not revived:
+            failures.append("restarted replica never probed back ready")
+        else:
+            client = TwoServerClient(endpoints, policy=policy)
+            try:
+                for name in names:
+                    got = fixtures[name]["call"](client, {"deadline": 120.0})
+                    _assert_shares(f"resume {name}", got, fixtures[name])
+            except Exception as exc:  # noqa: BLE001 — soak reports all
+                failures.append(
+                    f"post-restart batch failed: "
+                    f"{type(exc).__name__}: {exc}"
+                )
+            finally:
+                client.close()
+            st = proxies[0]._stats()
+            routed_end = {
+                r["endpoint"]: r["routed"] for r in st["fleet"]["replicas"]
+            }[victim_key]
+            if routed_end <= routed_at_restart:
+                failures.append(
+                    f"affinity did not resume on the restarted replica "
+                    f"(routed {routed_at_restart} -> {routed_end})"
+                )
+            else:
+                print(f"fleet soak: affinity resumed on {victim_key} "
+                      f"(routed {routed_at_restart} -> {routed_end})")
+    finally:
+        for proxy in proxies:
+            if proxy is not None:
+                proxy.stop()
+        for pool in pools:
+            if pool is not None:
+                pool.stop()
+        if not failures:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    total = time.perf_counter() - t_start
+    if failures:
+        print(f"fleet soak: FAIL in {total:.1f}s (logs kept in {tmp}):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"fleet soak: PASS in {total:.1f}s")
     return 0
 
 
@@ -873,7 +984,15 @@ def main() -> int:
                     help="two-subprocess socket soak (ISSUE 10)")
     ap.add_argument("--wire-requests", type=int, default=200)
     ap.add_argument("--wire-faults", type=int, default=9)
+    ap.add_argument("--fleet", action="store_true",
+                    help="replica-pool soak behind FleetProxy (ISSUE 14)")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="replicas per party in --fleet mode")
+    ap.add_argument("--fleet-requests", type=int, default=480)
+    ap.add_argument("--fleet-threads", type=int, default=6)
     args = ap.parse_args()
+    if args.fleet:
+        return fleet_main(args)
     if args.wire:
         return wire_main(args)
 

@@ -44,6 +44,7 @@ THREADED_MODULES = (
     f"{PACKAGE}/ops/pipeline.py",
     f"{PACKAGE}/serving/batcher.py",
     f"{PACKAGE}/serving/server.py",
+    f"{PACKAGE}/serving/fleet.py",
 )
 
 _LOCK_CTORS = {
