@@ -1,0 +1,183 @@
+"""Streaming heavy-hitters ingestion rate (ISSUE 15).
+
+The write-heavy tier's throughput question: how many client keys per
+second can the two-server pair ACCEPT — journal-fsync'd, deduped,
+windowed — and how fast do closed windows publish behind the ingest
+front? By design the system is **keygen-bound**: every uploaded key is a
+client-side incremental DPF keygen (PR 13's batched dealer measured
+8.5 K keys/s at depth 20 — the feed-rate ceiling for any client fleet),
+so the serving-side interesting numbers are the ingest ack rate (the
+fsync + dedup + window accounting path) and the publish lag.
+
+Arms, one seeded run on loopback (two in-process servers; the leader
+drives the advance against the follower over the real wire):
+
+* ``ingest`` — keys/s acknowledged across ``BENCH_STREAM_THREADS``
+  concurrent uploading clients (keys pre-generated: the client keygen
+  cost is PR 13's record, not re-measured here);
+* ``publish`` — wall from the final flush to every window published
+  (the level-by-level advance + peer exchange for the whole backlog).
+
+CPU-only (the host-engine advance is the production default; the
+hierkernel arm stays staged-for-tunnel behind the stream's mode knob).
+"""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+from common import Timer, log, run_bench
+
+
+def smoke_shrink(smoke: bool) -> bool:
+    """CPU smoke runs shrink the batch count; the record is tagged by
+    run_bench either way."""
+    return smoke
+
+
+def bench_streaming(jax, smoke):
+    del jax
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.core.dpf import (
+        DistributedPointFunction,
+    )
+
+    n_threads = int(os.environ.get("BENCH_STREAM_THREADS", 4))
+    n_batches = int(os.environ.get(
+        "BENCH_STREAM_BATCHES", 10 if smoke_shrink(smoke) else 40
+    ))
+    keys_per_batch = int(os.environ.get("BENCH_STREAM_BATCH_KEYS", 4))
+    bits, bpl = 16, 2
+    window_keys = int(os.environ.get("BENCH_STREAM_WINDOW", 64))
+
+    cfg = serving.StreamConfig.bitwise(
+        "bench", bits, bpl, threshold=8, window_keys=window_keys,
+        max_pending_windows=1 << 30,  # measure raw rates, not the shed
+    )
+    dpf = DistributedPointFunction.create_incremental(list(cfg.parameters))
+    n_levels = len(cfg.parameters)
+
+    tmp = tempfile.mkdtemp(prefix="dpf-bench-stream-")
+    follower = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    follower.register_stream(
+        serving.HeavyHitterStream(cfg, os.path.join(tmp, "p1"))
+    )
+    follower.start()
+    leader = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    leader.register_stream(serving.HeavyHitterStream(
+        cfg, os.path.join(tmp, "p0"), peer=("127.0.0.1", follower.port),
+    ))
+    leader.start()
+    policy = serving.RetryPolicy(
+        attempts=8, base_backoff=0.05, max_backoff=0.5, seed=0,
+    )
+
+    rng = np.random.default_rng(20260804)
+    hot = [int(v) for v in rng.integers(0, 1 << bits, size=4)]
+    log(f"pre-generating {n_threads * n_batches} batches x "
+        f"{keys_per_batch} keys (client keygen, the PR 13-bound side)")
+    t0 = time.perf_counter()
+    schedule = {}
+    for t in range(n_threads):
+        for i in range(n_batches):
+            pool = hot * 4 + [
+                int(v) for v in rng.integers(0, 1 << bits, size=4)
+            ]
+            vals = [
+                pool[j]
+                for j in rng.integers(0, len(pool), size=keys_per_batch)
+            ]
+            k0s, k1s = [], []
+            for v in vals:
+                k0, k1 = dpf.generate_keys_incremental(v, [1] * n_levels)
+                k0s.append(k0)
+                k1s.append(k1)
+            schedule[f"t{t}-b{i}"] = (k0s, k1s)
+    keygen_wall = time.perf_counter() - t0
+    total_keys = n_threads * n_batches * keys_per_batch
+    log(f"client keygen: {total_keys} keys in {keygen_wall:.2f}s "
+        f"({total_keys / keygen_wall:.0f} keys/s scalar loop)")
+
+    endpoints = [("127.0.0.1", leader.port), ("127.0.0.1", follower.port)]
+    warm = serving.TwoServerClient(endpoints, policy=policy)
+    warm.wait_ready(timeout=60)
+    warm.close()
+
+    def _worker(t_index):
+        client = serving.TwoServerClient(endpoints, policy=policy)
+        try:
+            for i in range(n_batches):
+                bid = f"t{t_index}-b{i}"
+                client.hh_ingest(
+                    "bench", cfg.parameters, schedule[bid], bid,
+                    deadline=60.0,
+                )
+        finally:
+            client.close()
+
+    with Timer() as t_ingest:
+        workers = [
+            threading.Thread(target=_worker, args=(t,), daemon=True)
+            for t in range(n_threads)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+
+    fin = serving.TwoServerClient(endpoints, policy=policy)
+    with Timer() as t_publish:
+        fin.hh_ingest("bench", cfg.parameters, ([], []), "", flush=True,
+                      deadline=60.0)
+        deadline = time.perf_counter() + 300
+        snap = None
+        while time.perf_counter() < deadline:
+            snap = fin.clients[0].hh_snapshot("bench", deadline=10.0)
+            done = {b for w in snap["published"] for b in w["batch_ids"]}
+            if len(done) == len(schedule) and snap["pending_windows"] == 0:
+                break
+            time.sleep(0.05)
+    stats = snap["stats"]
+    fin.close()
+    leader.stop()
+    follower.stop()
+    assert stats["accepted_keys"] == total_keys, "lost keys"
+
+    ingest_rate = total_keys / t_ingest.elapsed
+    log(f"ingest: {total_keys} keys ({len(schedule)} batches, "
+        f"{n_threads} clients) acked in {t_ingest.elapsed:.2f}s = "
+        f"{ingest_rate:.0f} keys/s; publish drain {t_publish.elapsed:.2f}s "
+        f"for {stats['windows_published']} windows")
+    return {
+        "bench": "streaming_ingest",
+        "value": round(ingest_rate, 1),
+        "bits": bits,
+        "bits_per_level": bpl,
+        "levels": n_levels,
+        "window_keys": window_keys,
+        "threads": n_threads,
+        "total_keys": total_keys,
+        "batches": len(schedule),
+        "client_keygen_keys_per_sec": total_keys / keygen_wall,
+        "ingest_keys_per_sec": ingest_rate,
+        "ingest_wall_s": t_ingest.elapsed,
+        "publish_drain_s": t_publish.elapsed,
+        "windows_published": stats["windows_published"],
+        "journals_rotated": stats["journals_rotated"],
+        "engine": "host",
+        "notes": (
+            "write path is journal-fsync-per-batch by contract; the "
+            "system feed rate is keygen-bound by design (PR 13 batched "
+            "dealer: 8504 keys/s at depth 20)"
+        ),
+    }
+
+
+if __name__ == "__main__":
+    run_bench("streaming_ingest", bench_streaming)

@@ -85,6 +85,10 @@ run_smoke() {
   # (serving/server.py + serving/client.py) on loopback.
   python examples/pir_demo.py --log_domain 12 --platform cpu --serve
   python examples/heavy_hitters_demo.py
+  # ISSUE 15: the streaming deployment shape — a real two-server pair
+  # on loopback, batched hh_ingest uploads into rolling windows,
+  # continuous publishes checked per window against the batch oracle.
+  HH_CLIENTS=48 python examples/heavy_hitters_demo.py --serve
 }
 
 run_device() {
@@ -124,6 +128,18 @@ run_faults() {
   # XLA:CPU, host engine — zero pallas configs.
   JAX_PLATFORMS=cpu python tools/chaos_soak.py --fleet --replicas 2 \
     --fleet-requests 120 --fleet-threads 4 --seed 7
+  # ISSUE 15: the streaming heavy-hitters soak — two server
+  # subprocesses (party 0 the aggregation leader via --stream-peer), a
+  # seeded client fleet uploading key batches into rolling window
+  # generations, the FOLLOWER SIGKILLed mid-window and restarted on the
+  # same port + journal dir. Asserts per-window published prefixes +
+  # counts EXACTLY equal the batch oracle (exactly-once membership: no
+  # lost, no double-counted keys), journal reload across the kill,
+  # >= 1 retry carried by the client budget, and the backpressure path
+  # (RESOURCE_EXHAUSTED refused, retried to success). Bounded, loopback,
+  # XLA:CPU, host-engine advance — zero pallas configs.
+  JAX_PLATFORMS=cpu python tools/chaos_soak.py --stream --seed 7 \
+    --stream-batches 12 --stream-threads 3
 }
 
 case "$tier" in

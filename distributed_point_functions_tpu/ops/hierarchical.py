@@ -695,6 +695,38 @@ def bitwise_hierarchy_plan(levels: int, finals) -> list:
     return plan
 
 
+def candidate_children(
+    prefixes, prev_log_domain: int, log_domain: int
+) -> np.ndarray:
+    """Domain indices of every child candidate an advance from
+    `prev_log_domain` to `log_domain` expands, in the exact column order
+    `evaluate_until_batch` emits its outputs (sorted prefix, then leaf) —
+    candidate i of the advance's [K, n] output array is domain value
+    ``candidate_children(...)[i]``. An empty prefix set (the first
+    advance) covers the whole level-`log_domain` domain. This is the one
+    shared candidate↔output mapping for the heavy-hitters pruning loop
+    (the batch demo and the streaming window manager, ISSUE 15); uint64
+    bookkeeping only, so domains stay below the 63-bit prefix boundary.
+    """
+    if log_domain > 62:
+        raise InvalidArgumentError(
+            "candidate_children covers uint64 bookkeeping domains only "
+            f"(log_domain {log_domain} > 62)"
+        )
+    if prev_log_domain >= log_domain:
+        raise InvalidArgumentError(
+            "`log_domain` must exceed `prev_log_domain` (an advance "
+            "always descends)"
+        )
+    prefixes = np.asarray(sorted(int(p) for p in prefixes), dtype=np.uint64)
+    if prefixes.size == 0:
+        return np.arange(1 << log_domain, dtype=np.uint64)
+    d = log_domain - prev_log_domain
+    base = np.repeat(prefixes, 1 << d)
+    child = np.tile(np.arange(1 << d, dtype=np.uint64), prefixes.size)
+    return (base << np.uint64(d)) + child
+
+
 def draw_random_finals(levels: int, n: int, rng) -> list:
     """`n` uniform `levels`-bit leaf indices (python ints) for a
     heavy-hitters workload — composed from 32-bit words above the int64

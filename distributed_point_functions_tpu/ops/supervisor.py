@@ -515,15 +515,43 @@ class ChunkJournal:
         _tm.counter("journal.chunks_recorded", op=self.op)
 
     def finalize(self) -> None:
+        if self._finalized:
+            return
         self._writer()
         self._append({"kind": "done", "chunks": len(self._chunks)})
         self._finalized = True
         self.close()
 
+    @property
+    def finalized(self) -> bool:
+        """True once the ``done`` marker is durable — the journal's
+        atomic completion bit (the streaming tier reads it as a window's
+        durable *closed* marker, ISSUE 15)."""
+        return self._finalized
+
+    def completed_indices(self) -> list:
+        """Sorted indices of every verified chunk on record (no
+        counters; the resume loaders iterate this before `completed`)."""
+        return sorted(self._chunks)
+
     def close(self) -> None:
         if self._f is not None:
             self._f.close()
             self._f = None
+
+    def unlink(self) -> None:
+        """Closes and removes the journal file — the rotation hook for
+        long-lived servers (ISSUE 15): a finalized window journal has
+        done its job once the window's result is durable elsewhere, and
+        keeping one result-sized file per window grows disk without
+        bound (the PR 10 fingerprint-derived journal lesson)."""
+        self.close()
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+        if _tm.enabled():
+            _tm.counter("journal.rotated", op=self.op)
 
 
 def _payload_sha(payload: dict) -> str:
@@ -953,6 +981,38 @@ def _ctx_apply(ctx, rec: dict) -> None:
     else:
         ctx.seeds = None
         ctx.control = None
+
+
+#: Public journal hooks (ISSUE 15): the streaming window manager
+#: checkpoints a window's resumable BatchedContext state per advanced
+#: level through exactly the encoding the hierarchical journal already
+#: uses — one state format, one loader.
+ctx_record = _ctx_record
+ctx_apply = _ctx_apply
+
+
+def advance_level_robust(
+    ctx,
+    hierarchy_level: int,
+    prefixes,
+    group: int = 16,
+    policy: DegradationPolicy = DEFAULT_POLICY,
+    mode: Optional[str] = None,
+    key_chunk: Optional[int] = None,
+    pipeline: Optional[bool] = None,
+) -> np.ndarray:
+    """ONE incremental window advance behind the supervisor (ISSUE 15):
+    the single-entry plan form of :func:`evaluate_levels_fused_robust` —
+    the streaming heavy-hitters tier advances each rolling window level
+    by level as survivor prefixes arrive, so the one-entry shape IS its
+    natural call. Inherits the full hierkernel → fused/pallas →
+    fused/jax → numpy chain, host-oracle spot checks, and the resumable
+    BatchedContext commit discipline (a failed rung never leaves `ctx`
+    advanced). Returns uint32[K, n_outputs, lpe] limbs."""
+    return evaluate_levels_fused_robust(
+        ctx, [(int(hierarchy_level), list(prefixes))], group=group,
+        policy=policy, mode=mode, key_chunk=key_chunk, pipeline=pipeline,
+    )[0]
 
 
 def evaluate_levels_fused_robust(

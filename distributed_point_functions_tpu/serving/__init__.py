@@ -27,6 +27,11 @@ from .client import (  # noqa: F401
 from .fleet import FleetProxy, ReplicaPool  # noqa: F401
 from .frontdoor import FrontDoor  # noqa: F401
 from .server import DpfServer  # noqa: F401
+from .streaming import (  # noqa: F401
+    HeavyHitterStream,
+    StreamConfig,
+    parse_stream_spec,
+)
 from .router import (  # noqa: F401
     ANCHORS,
     DISPATCH_SECONDS_PRIOR,

@@ -462,6 +462,45 @@ class DpfClient:
             deadline=deadline, **kw,
         )
 
+    def hh_ingest(
+        self, stream: str, parameters, keys, batch_id: str,
+        flush: bool = False, deadline: Optional[float] = None, **kw,
+    ) -> Tuple[int, bool]:
+        """One key batch into a heavy-hitter stream's open window
+        (ISSUE 15). The server journals the batch BEFORE acknowledging,
+        and `batch_id` is the exactly-once identity: a retry of an
+        already-accepted batch (this client's retry budget fires on a
+        lost ack, a server restart, or backpressure) is acknowledged
+        with its original window generation, never double-counted.
+        Returns (window generation, deduped)."""
+        arrays = self.call(
+            "hh_ingest",
+            wire.encode_hh_ingest(
+                stream, parameters, keys, batch_id, flush=flush
+            ),
+            deadline=deadline, **kw,
+        )
+        out = np.asarray(arrays[0], dtype=np.uint64)
+        return int(out[0]), bool(out[1])
+
+    def hh_snapshot(
+        self, stream: str, since_generation: int = 0,
+        deadline: Optional[float] = None, **kw,
+    ) -> dict:
+        """The stream's published heavy-hitter view: per published
+        window its generation, batch membership, surviving prefixes and
+        exact counts (decimal strings), plus the open-window and stats
+        fields. `since_generation` is the poller's cursor — only
+        windows at or past it return (`published_total` still counts
+        them all), so a long-poll loop stays O(new windows) instead of
+        re-shipping the stream's whole history every probe."""
+        arrays = self.call(
+            "hh_snapshot",
+            wire.encode_hh_snapshot(stream, since_generation),
+            deadline=deadline, **kw,
+        )
+        return wire.json_from_arrays(arrays)
+
     def keygen(
         self, parameters, alphas: Sequence[int], betas,
         deadline: Optional[float] = None, **kw,
@@ -603,6 +642,28 @@ class TwoServerClient:
         return self._pair(
             "hierarchical", key_pair, parameters, None, plan, group, **kw
         )
+
+    def hh_ingest(
+        self, stream: str, parameters, key_pair, batch_id: str,
+        flush: bool = False, **kw,
+    ) -> tuple:
+        """The streaming upload shape (ISSUE 15): one client's key batch
+        to BOTH parties concurrently — party 0's share keys to server 0,
+        party 1's to server 1, the SAME batch id on both (each party
+        journals and dedups independently; window membership converges
+        on the ids). Returns the ((gen, deduped), (gen, deduped)) pair.
+        A party that stays down past its budget raises
+        PartyUnavailableError naming it; re-calling with the same
+        batch_id is always safe — the surviving party deduped."""
+        k0, k1 = key_pair
+        return tuple(self._both([
+            lambda: self.clients[0].hh_ingest(
+                stream, parameters, k0, batch_id, flush=flush, **kw
+            ),
+            lambda: self.clients[1].hh_ingest(
+                stream, parameters, k1, batch_id, flush=flush, **kw
+            ),
+        ]))
 
     def generate_keys_batch(
         self, parameters, alphas: Sequence[int], betas, **kw

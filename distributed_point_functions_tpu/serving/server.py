@@ -91,6 +91,10 @@ class DpfServer:
         #: A peer stalled mid-frame past this is dead: drop it.
         self.frame_timeout = frame_timeout
         self._dbs: Dict[str, np.ndarray] = {}
+        #: heavy-hitter streams by name (ISSUE 15) — registered before
+        #: start(); the server owns their lifecycle (the leader's advance
+        #: worker starts/stops with the socket loop).
+        self._streams: Dict[str, object] = {}
         self._objs: "collections.OrderedDict[tuple, object]" = (
             collections.OrderedDict()
         )
@@ -112,6 +116,25 @@ class DpfServer:
         per name for the server's lifetime — request merging and the warm
         cache both key on the object's identity."""
         self._dbs[name] = np.asarray(db)
+
+    def register_stream(self, stream) -> None:
+        """Registers a heavy-hitter stream (ISSUE 15: a
+        :class:`~.streaming.HeavyHitterStream`) — its ``hh_ingest`` /
+        ``hh_snapshot`` / ``hh_aggregate`` ops become servable, its
+        stats ride the stats/health frames, and its lifecycle (journal
+        reload, the leader's advance worker) follows the server's."""
+        self._streams[stream.config.name] = stream
+        if self._listener is not None:
+            stream.start()
+
+    def _stream_for(self, name: str):
+        stream = self._streams.get(name)
+        if stream is None:
+            raise InvalidArgumentError(
+                f"stream {name!r} is not registered on this server "
+                f"(registered: {sorted(self._streams)})"
+            )
+        return stream
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -141,6 +164,8 @@ class DpfServer:
         self._listener = listener
         self._port = listener.getsockname()[1]
         self.door.start()
+        for stream in self._streams.values():
+            stream.start()
         self._collector = _tm.attach_collector()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="dpf-rpc-accept", daemon=True
@@ -171,6 +196,8 @@ class DpfServer:
 
     def stop(self, drain_timeout: float = 5.0) -> None:
         self.drain(drain_timeout)
+        for stream in self._streams.values():
+            stream.stop()
         self._stopped.set()
         with self._conns_lock:
             conns = list(self._conns)
@@ -340,6 +367,12 @@ class DpfServer:
             "worker_dead": (
                 f"{type(dead).__name__}: {dead}" if dead else None
             ),
+            # ISSUE 15: per-stream window/ingest state
+            # (wire.STATS_STREAM_KEYS) — additive keys, old clients
+            # never read them.
+            "streams": {
+                name: s.stats_fields() for name, s in self._streams.items()
+            },
             "pid": os.getpid(),
         }
 
@@ -364,6 +397,9 @@ class DpfServer:
             "inflight": inflight,
             "served": served,
             "warm": self.door.cache.inventory(),
+            "streams": {
+                name: s.stats_fields() for name, s in self._streams.items()
+            },
         }
 
     # -- request handling --------------------------------------------------
@@ -387,6 +423,24 @@ class DpfServer:
                         "UNAVAILABLE: server is draining — retry another "
                         "replica"
                     )
+                if op in ("hh_snapshot", "hh_aggregate"):
+                    # Streaming reads/exchanges (ISSUE 15) are served by
+                    # the window manager directly — no engine choice, no
+                    # batch merging; the manager's own lock serializes
+                    # window state. They answer on the handler thread
+                    # like health/stats, inside the shared error
+                    # taxonomy (an incomplete window's UNAVAILABLE is a
+                    # client retry signal).
+                    arrays = self._serve_stream_op(op, payload)
+                    wire.write_frame(
+                        sock, wire.T_RESPONSE, frame.request_id,
+                        wire.encode_result_arrays(arrays),
+                    )
+                    _tm.observe(
+                        "rpc.server.request_ms",
+                        (time.perf_counter() - t0) * 1e3, op=op,
+                    )
+                    return
                 request = self._build_request(op, payload)
             except (DpfError, ConnectionError, OSError):
                 raise
@@ -473,6 +527,21 @@ class DpfServer:
             make = lambda: DistributedPointFunction.create(parameters[0])
         return self._cached(key, make)
 
+    def _serve_stream_op(self, op: str, payload: bytes):
+        """The streaming read/exchange ops (ISSUE 15), answered inline."""
+        if op == "hh_snapshot":
+            name, since = wire.decode_hh_snapshot(payload)
+            stream = self._stream_for(name)
+            return wire.json_result_arrays(
+                stream.snapshot(since_generation=since)
+            )
+        stream_name, generation, batch_ids, plan = wire.decode_hh_aggregate(
+            payload
+        )
+        stream = self._stream_for(stream_name)
+        agg = stream.aggregate(generation, batch_ids, plan)
+        return [np.asarray(agg, dtype=np.uint64)]
+
     def _build_request(self, op: str, payload: bytes) -> Request:
         if op == "full_domain":
             parameters, keys, hl = wire.decode_full_domain(payload)
@@ -518,6 +587,20 @@ class DpfServer:
             parameters, keys, plan, group = wire.decode_hierarchical(payload)
             return Request.hierarchical(
                 self._dpf(parameters), keys, plan, group
+            )
+        if op == "hh_ingest":
+            # Streaming ingestion (ISSUE 15): rides the batcher as its
+            # own op class (the fair-flush ordering — an ingest flood
+            # cannot starve the query ops), journaled-then-acknowledged
+            # inside the flush. Backpressure is checked at submit
+            # (FrontDoor -> stream.check_admission): past the pending-
+            # window bound the client sees RESOURCE_EXHAUSTED.
+            parameters, blobs, stream_name, batch_id, flush = (
+                wire.decode_hh_ingest(payload)
+            )
+            return Request.hh_ingest(
+                self._stream_for(stream_name), parameters, blobs, batch_id,
+                flush=flush,
             )
         if op == "keygen":
             # Dealer offload (ISSUE 13): this server generates BOTH
@@ -576,6 +659,19 @@ def main(argv=None) -> int:
                     help="full-domain chunk-journal directory (crash resume)")
     ap.add_argument("--pir-db", type=_parse_pir_db, action="append",
                     default=[], metavar="NAME:LOG_DOMAIN:SEED[:WIDTH]")
+    # Streaming heavy hitters (ISSUE 15). --stream registers a bitwise
+    # Int(64) stream; --stream-peer names the OTHER party's endpoint and
+    # makes this server the aggregation leader (it drives window
+    # advances + publishes); without it the server is the follower
+    # (serves hh_aggregate). Streams require --journal-dir: journaled
+    # exactly-once window accounting is the tier's contract.
+    ap.add_argument("--stream", action="append", default=[],
+                    metavar="NAME:BITS:BPL:THRESHOLD:WINDOW[:PENDING]",
+                    help="register a heavy-hitter stream (requires "
+                    "--journal-dir)")
+    ap.add_argument("--stream-peer", default=None, metavar="HOST:PORT",
+                    help="peer party endpoint: this server becomes the "
+                    "stream aggregation leader")
     ap.add_argument("--ready-file", default=None,
                     help="write '<port>\\n' here once listening (the "
                     "subprocess-orchestration handshake)")
@@ -629,6 +725,19 @@ def main(argv=None) -> int:
     )
     for name, db in args.pir_db:
         server.register_db(name, db)
+    if args.stream:
+        from .streaming import HeavyHitterStream, parse_stream_spec
+
+        if not args.journal_dir:
+            ap.error("--stream requires --journal-dir (durable windows)")
+        peer = None
+        if args.stream_peer:
+            host_part, _, port_part = args.stream_peer.rpartition(":")
+            peer = (host_part or "127.0.0.1", int(port_part))
+        for spec in args.stream:
+            server.register_stream(HeavyHitterStream(
+                parse_stream_spec(spec), args.journal_dir, peer=peer,
+            ))
     server.start()
     print(
         f"dpf-server: pid={os.getpid()} listening on "

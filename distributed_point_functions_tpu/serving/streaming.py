@@ -1,0 +1,1116 @@
+"""Streaming heavy hitters: crash-safe windowed ingestion as a live
+two-server service (ISSUE 15).
+
+Poplar's deployment shape (PAPERS.md: Boneh et al.) is millions of
+clients *streaming* key uploads while two non-colluding servers
+aggregate. This module is that tier's window manager: arriving key
+batches accumulate into rolling **window generations**, each closed
+window runs the level-by-level prefix-tree advance (the resumable
+``BatchedContext`` the hierarchical journal already checkpoints), counts
+reconstruct through a leader→peer aggregate-share exchange (the only
+server-to-server communication — two vectors per level, exactly the
+batch demo's), survivors prune by threshold, and popular prefixes
+publish continuously.
+
+**The durability contract is the point** (the robustness headline — a
+write-heavy ingestion service that loses a window of client keys on a
+crash, or double-counts them on resume, is worse than no service):
+
+* every accepted ingest batch is journaled — fsync'd into the open
+  window generation's :class:`~..ops.supervisor.ChunkJournal` — *before*
+  it is acknowledged; a torn tail from a mid-append kill reads as
+  "never accepted", which is exactly what the client believes (its ack
+  never arrived; the retry re-ingests);
+* batches carry a client-chosen **batch id**: a retry of an
+  already-journaled batch (the ack lost to a crash) is acknowledged
+  with its original generation and never double-counted;
+* window advances commit per level through the same verified-chunk
+  journal (``ctx_record`` state + reconstructed counts), fingerprinted
+  by (stream, generation, membership digest): a resumed window replays
+  verified levels, and a generation whose membership no longer matches
+  its fingerprint **starts clean instead of merging stale counts**;
+* backpressure is explicit: past ``max_pending_windows`` closed-but-
+  unpublished windows, ingests are refused with
+  ``RESOURCE_EXHAUSTED`` — the PR 10 client retry budget already treats
+  that as "later, not never";
+* published windows **rotate** their journals (compacted into one
+  ``retired.jsonl`` line, then unlinked) so a long-lived server's disk
+  does not grow one window-sized file per generation (the PR 10
+  fingerprint-journal lesson, applied from day one, with a counter).
+
+Roles: the party whose stream is constructed with a ``peer`` endpoint
+is the **aggregation leader** — it drives each window's advance,
+fetching the peer party's aggregate share vector per level over the
+existing RPC client (``hh_aggregate``), reconstructing counts (the
+published output; nothing beyond the protocol's output is revealed),
+and publishing. The peer (the **follower**) serves ``hh_aggregate``
+from its own journaled window state, fast-forwarding a freshly
+restarted window through the request's level trail deterministically.
+Window *membership* is the leader's declaration (batch ids); a follower
+still missing a batch answers ``UNAVAILABLE`` and the leader retries —
+clients upload each batch to both parties, so delivery converges.
+
+Host engine everywhere by default (``engine="host"``: the native AES
+advance, zero device programs — pinned); ``engine="device"`` routes each
+advance through :func:`~..ops.supervisor.advance_level_robust`, so the
+hierkernel window advance stays staged-for-tunnel behind the same mode
+plumbing as every kernel since round 5.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.params import DpfParameters
+from ..core.value_types import Int
+from ..protos import serialization
+from ..utils import telemetry as _tm
+from ..utils.errors import (
+    DataLossError,
+    FailedPreconditionError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+
+@dataclasses.dataclass
+class StreamConfig:
+    """One heavy-hitter stream's public configuration (shared by both
+    parties and by clients — the ingest op validates parameters against
+    it, so a misconfigured client fails loudly, not with garbage
+    counts)."""
+
+    name: str
+    parameters: List[DpfParameters]  # the incremental hierarchy
+    threshold: int
+    #: accepted keys that close the open window (the generation size).
+    window_keys: int = 64
+    #: closed-but-unpublished windows admitted before ingests are refused
+    #: with RESOURCE_EXHAUSTED (the backpressure bound).
+    max_pending_windows: int = 2
+    group: int = 16
+    #: "host" (native AES advance, zero device programs) or "device"
+    #: (the robust hierarchical chain; mode= below picks the kernel).
+    engine: str = "host"
+    #: device advance mode (None = env default; "hierkernel" is the
+    #: staged-for-tunnel single-program window advance).
+    mode: Optional[str] = None
+
+    def __post_init__(self):
+        if not self.name or not re.fullmatch(r"[\w.-]+", self.name):
+            raise InvalidArgumentError(
+                f"stream name {self.name!r} must be a non-empty "
+                "filesystem-safe token"
+            )
+        if not self.parameters:
+            raise InvalidArgumentError("a stream needs >= 1 hierarchy level")
+        bits = None
+        for p in self.parameters:
+            if not isinstance(p.value_type, Int) or p.value_type.bitsize > 64:
+                raise InvalidArgumentError(
+                    "stream levels must use additive Int(<=64) value "
+                    "types (counts are share sums mod 2^bits)"
+                )
+            if bits is not None and p.value_type.bitsize != bits:
+                raise InvalidArgumentError(
+                    "stream levels must share one value type"
+                )
+            bits = p.value_type.bitsize
+        if self.parameters[-1].log_domain_size > 62:
+            raise InvalidArgumentError(
+                "stream domains are bounded at 62 bits (uint64 candidate "
+                "bookkeeping)"
+            )
+        if self.threshold < 1 or self.window_keys < 1:
+            raise InvalidArgumentError(
+                "threshold and window_keys must be >= 1"
+            )
+        if self.max_pending_windows < 1:
+            raise InvalidArgumentError("max_pending_windows must be >= 1")
+        if self.engine not in ("host", "device"):
+            raise InvalidArgumentError(
+                f"engine must be 'host' or 'device', got {self.engine!r}"
+            )
+
+    @property
+    def value_bits(self) -> int:
+        return self.parameters[-1].value_type.bitsize
+
+    @classmethod
+    def bitwise(
+        cls, name: str, bits: int, bits_per_level: int, threshold: int, **kw
+    ) -> "StreamConfig":
+        """The heavy-hitters demo shape: `bits`-bit values, one hierarchy
+        level per `bits_per_level` bits, Int(64) counts."""
+        params = [
+            DpfParameters(lds, Int(64))
+            for lds in range(bits_per_level, bits + 1, bits_per_level)
+        ]
+        return cls(name=name, parameters=params, threshold=threshold, **kw)
+
+
+def parse_stream_spec(spec: str) -> StreamConfig:
+    """CLI form NAME:BITS:BITS_PER_LEVEL:THRESHOLD:WINDOW_KEYS[:PENDING]
+    — the deterministic two-terminal quickstart shape (production
+    deployments construct StreamConfig directly)."""
+    parts = spec.split(":")
+    if len(parts) not in (5, 6):
+        raise InvalidArgumentError(
+            f"--stream {spec!r}: want "
+            "NAME:BITS:BITS_PER_LEVEL:THRESHOLD:WINDOW_KEYS[:PENDING]"
+        )
+    kw = {}
+    if len(parts) == 6:
+        kw["max_pending_windows"] = int(parts[5])
+    return StreamConfig.bitwise(
+        parts[0], int(parts[1]), int(parts[2]), int(parts[3]),
+        window_keys=int(parts[4]), **kw,
+    )
+
+
+class _Window:
+    """One ingest generation: the durable unit of window accounting. On
+    the leader, generations ARE the advance windows; on the follower they
+    are arrival buckets (the leader's membership declaration is what
+    defines its windows there)."""
+
+    __slots__ = (
+        "generation", "journal", "batch_ids", "keys", "shas", "keys_total",
+        "closed",
+    )
+
+    def __init__(self, generation: int, journal):
+        self.generation = generation
+        self.journal = journal
+        self.batch_ids: List[str] = []
+        self.keys: Dict[str, list] = {}
+        self.shas: Dict[str, str] = {}
+        self.keys_total = 0
+        self.closed = False
+
+
+class _PeerWindow:
+    """Follower-side state of one leader-declared window: the resumable
+    advance context plus the journaled per-level trail."""
+
+    __slots__ = (
+        "generation", "batch_ids", "ctx", "journal", "levels",
+        "consumed_logged",
+    )
+
+    def __init__(self, generation: int, batch_ids: List[str], ctx, journal):
+        self.generation = generation
+        self.batch_ids = list(batch_ids)
+        self.ctx = ctx
+        self.journal = journal
+        self.levels: Dict[int, dict] = {}
+        #: True once this window's "consumed" retired.jsonl line is
+        #: durable — written the moment the FINAL hierarchy level is
+        #: served, so a follower restart between serving a window and
+        #: the leader's next-generation request cannot orphan its batch
+        #: ids (the segment-rotation input).
+        self.consumed_logged = False
+
+    @property
+    def next_level(self) -> int:
+        return self.ctx.previous_hierarchy_level + 1
+
+
+class HeavyHitterStream:
+    """One stream's crash-safe window manager (ISSUE 15).
+
+    ``peer=(host, port)`` makes this party the aggregation **leader**
+    (its advance worker drives window publishes against that peer's
+    ``hh_aggregate`` endpoint); ``peer=None`` is the **follower**.
+    ``journal_dir`` is mandatory — durability is this tier's contract,
+    not an option. The manager is thread-safe; the RPC server calls
+    :meth:`ingest` from the batcher flush, :meth:`aggregate` /
+    :meth:`snapshot` from connection threads."""
+
+    #: seconds the leader's advance worker backs off after a failed
+    #: window attempt (peer down mid-restart, etc.) before retrying —
+    #: journaled levels replay, so retries are cheap.
+    RETRY_SECONDS = 0.5
+
+    def __init__(
+        self,
+        config: StreamConfig,
+        journal_dir: str,
+        peer: Optional[Tuple[str, int]] = None,
+        peer_policy=None,
+        policy=None,
+        peer_deadline: float = 30.0,
+    ):
+        if not journal_dir:
+            raise InvalidArgumentError(
+                "a heavy-hitter stream needs a journal_dir — exactly-once "
+                "window accounting is the streaming tier's contract"
+            )
+        self.config = config
+        self.dir = os.path.join(journal_dir, f"stream-{config.name}")
+        self.peer = tuple(peer) if peer is not None else None
+        self.role = "leader" if self.peer is not None else "follower"
+        self._peer_policy = peer_policy
+        self._peer_deadline = float(peer_deadline)
+        self._policy = policy
+        self._lock = threading.RLock()
+        self._wake = threading.Condition(self._lock)
+        self._stop_evt = threading.Event()
+        self._loaded = False
+        self._dpf_obj = None
+        self._party: Optional[int] = None
+        self._windows: Dict[int, _Window] = {}
+        self._open: Optional[_Window] = None
+        self._accepted: Dict[str, int] = {}  # batch id -> ingest generation
+        self._consumed: set = set()
+        self._peer_windows: Dict[int, _PeerWindow] = {}
+        self._published: List[dict] = []
+        self._retired_keys = 0
+        self._deduped = 0
+        self._backpressure = 0
+        self._rotated = 0
+        self._client = None
+        #: byte offset of retired.jsonl's good prefix when the file ends
+        #: in a torn tail (None = clean); the next append truncates to
+        #: it first so records never weld onto garbage.
+        self._retired_good_bytes: Optional[int] = None
+        #: highest generation the orphaned-window disk sweep already
+        #: covered (one listdir per generation, not per level request).
+        self._swept_below = 0
+        self._advance_thread: Optional[threading.Thread] = None
+        bits = config.value_bits
+        self._count_mask = np.uint64((1 << bits) - 1 if bits < 64
+                                     else 0xFFFFFFFFFFFFFFFF)
+        #: the configured hierarchy's canonical encoding, computed ONCE —
+        #: ingest validation and every journal fingerprint compare
+        #: against it on the hot ack path.
+        self._config_blobs = [
+            serialization.encode_dpf_parameters(p) for p in config.parameters
+        ]
+
+    # -- construction helpers ---------------------------------------------
+    @property
+    def _dpf(self):
+        with self._lock:  # reentrant: callers may already hold it
+            if self._dpf_obj is None:
+                from ..core.dpf import DistributedPointFunction
+
+                params = self.config.parameters
+                self._dpf_obj = (
+                    DistributedPointFunction.create_incremental(list(params))
+                    if len(params) > 1
+                    else DistributedPointFunction.create(params[0])
+                )
+            return self._dpf_obj
+
+    @property
+    def validator(self):
+        return self._dpf.validator
+
+    def _params_blob(self) -> bytes:
+        return b"".join(self._config_blobs)
+
+    def _ingest_fingerprint(self, generation: int) -> str:
+        h = hashlib.sha256(b"hh-ingest|")
+        h.update(self.config.name.encode())
+        h.update(self._params_blob())
+        h.update(str(generation).encode())
+        return h.hexdigest()
+
+    def _member_digest(self, batch_ids: Sequence[str],
+                       shas: Dict[str, str]) -> str:
+        h = hashlib.sha256()
+        for bid in batch_ids:
+            h.update(bid.encode())
+            h.update(shas[bid].encode())
+        return h.hexdigest()
+
+    def _window_fingerprint(self, generation: int, member_digest: str) -> str:
+        h = hashlib.sha256(b"hh-window|")
+        h.update(self.config.name.encode())
+        h.update(self._params_blob())
+        h.update(str(generation).encode())
+        h.update(member_digest.encode())
+        return h.hexdigest()
+
+    def _ingest_path(self, generation: int) -> str:
+        return os.path.join(self.dir, f"ingest-g{generation:08d}.journal")
+
+    def _window_path(self, generation: int) -> str:
+        return os.path.join(self.dir, f"window-g{generation:08d}.journal")
+
+    # -- durable load ------------------------------------------------------
+    def _ensure_loaded(self) -> None:
+        """Reload every live journal under the stream directory (caller
+        holds the lock). Torn ingest tails are discarded by ChunkJournal
+        — those batches were never acknowledged, so the client still owns
+        them; retired.jsonl lines keep dedup identity for generations
+        whose journals already rotated away."""
+        with self._lock:  # reentrant: public callers already hold it
+            if self._loaded:
+                return
+            self._loaded = True
+            os.makedirs(self.dir, exist_ok=True)
+            from ..ops import supervisor as _sv
+
+            retired_gens: set = set()
+            for line in self._read_retired():
+                kind = line.get("kind")
+                gen = int(line.get("generation", -1))
+                for bid in line.get("batch_ids", ()):
+                    self._accepted.setdefault(bid, gen)
+                self._retired_keys += int(line.get("keys", 0))
+                if kind == "published":
+                    self._published.append(line)
+                    retired_gens.add(gen)
+                elif kind == "retired":
+                    retired_gens.add(gen)
+                elif kind == "consumed":
+                    self._consumed.update(line.get("batch_ids", ()))
+            self._published.sort(key=lambda r: int(r["generation"]))
+
+            gens = []
+            for fname in os.listdir(self.dir):
+                m = re.fullmatch(r"ingest-g(\d+)\.journal", fname)
+                if m:
+                    gens.append(int(m.group(1)))
+            for gen in sorted(gens):
+                if gen in retired_gens:
+                    # Rotation crashed between the retired line and the
+                    # unlink: finish it now.
+                    for path in (
+                        self._ingest_path(gen), self._window_path(gen)
+                    ):
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                    continue
+                jr = _sv.ChunkJournal(
+                    self._ingest_path(gen), self._ingest_fingerprint(gen),
+                    op="hh_ingest",
+                )
+                w = _Window(gen, jr)
+                for index in jr.completed_indices():
+                    payload = jr.completed(index)
+                    self._apply_batch(w, payload["batch_id"], [
+                        base64.b64decode(b) for b in payload["blobs"]
+                    ])
+                w.closed = jr.finalized
+                self._windows[gen] = w
+            live = sorted(self._windows)
+            if live:
+                # Every generation below the newest is closed (the close
+                # decision happened before the next generation opened,
+                # even if the crash tore the finalize marker off with
+                # the tail).
+                for gen in live[:-1]:
+                    self._windows[gen].closed = True
+                newest = self._windows[live[-1]]
+                if not newest.closed:
+                    self._open = newest
+            next_gen = (live[-1] + 1) if live else (
+                (max(retired_gens) + 1) if retired_gens else 0
+            )
+            if self._open is None:
+                self._open = self._new_window(next_gen)
+
+    def _new_window(self, generation: int) -> _Window:
+        from ..ops import supervisor as _sv
+
+        jr = _sv.ChunkJournal(
+            self._ingest_path(generation),
+            self._ingest_fingerprint(generation), op="hh_ingest",
+        )
+        w = _Window(generation, jr)
+        with self._lock:
+            self._windows[generation] = w
+        return w
+
+    def _apply_batch(self, w: _Window, batch_id: str,
+                     blobs: List[bytes]) -> None:
+        keys = [serialization.parse_dpf_key(b) for b in blobs]
+        party = keys[0].party
+        for k in keys:
+            if k.party != party:
+                raise InvalidArgumentError(
+                    "an ingest batch must carry one party's keys"
+                )
+        with self._lock:
+            if self._party is None:
+                self._party = party
+            elif party != self._party:
+                raise InvalidArgumentError(
+                    f"stream {self.config.name!r} holds party "
+                    f"{self._party} keys; batch {batch_id!r} carries "
+                    f"party {party}"
+                )
+            w.batch_ids.append(batch_id)
+            w.keys[batch_id] = keys
+            w.shas[batch_id] = hashlib.sha256(b"".join(blobs)).hexdigest()
+            w.keys_total += len(keys)
+            self._accepted[batch_id] = w.generation
+
+    def _retired_path(self) -> str:
+        return os.path.join(self.dir, "retired.jsonl")
+
+    def _read_retired(self) -> List[dict]:
+        """Loads the good prefix of retired.jsonl and remembers where it
+        ends: a crash mid-append leaves a torn tail line, and appending
+        after it would WELD the next record onto garbage — one joined
+        unparsable line that silently drops every later record (and the
+        rotated-generation dedup identity with it) on the following
+        reload. The first append after a torn load truncates back to
+        the good prefix instead (the ChunkJournal rewrite discipline)."""
+        with self._lock:  # reentrant: load/append callers hold it
+            out: List[dict] = []
+            good_bytes = 0
+            try:
+                with open(self._retired_path(), "rb") as f:
+                    raw = f.read()
+            except OSError:
+                self._retired_good_bytes = None
+                return out
+            pos = 0
+            while pos < len(raw):
+                nl = raw.find(b"\n", pos)
+                if nl < 0:
+                    break  # unterminated tail: a mid-append kill
+                line = raw[pos:nl].strip()
+                if line:
+                    try:
+                        out.append(json.loads(line.decode("utf-8")))
+                    except ValueError:
+                        break  # torn/corrupt: trust nothing at or after
+                pos = nl + 1
+                good_bytes = pos
+            self._retired_good_bytes = (
+                good_bytes if good_bytes < len(raw) else None
+            )
+            return out
+
+    def _append_retired(self, line: dict) -> None:
+        with self._lock:
+            self._ensure_loaded()  # the torn-tail offset comes from load
+            if self._retired_good_bytes is not None:
+                with open(self._retired_path(), "r+b") as f:
+                    f.truncate(self._retired_good_bytes)
+                self._retired_good_bytes = None
+            with open(self._retired_path(), "a") as f:
+                f.write(json.dumps(line, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "HeavyHitterStream":
+        # Pay the heavy imports (jax via ops/hierarchical) at start, not
+        # inside the first window advance — a cold first advance
+        # otherwise stalls ~10 s with ingests backing up against the
+        # pending-window bound, which reads as spurious backpressure.
+        from ..ops import hierarchical  # noqa: F401
+        from ..ops import supervisor  # noqa: F401
+
+        with self._lock:
+            self._ensure_loaded()
+            if (
+                self.role == "leader"
+                and self._advance_thread is None
+                and not self._stop_evt.is_set()
+            ):
+                t = threading.Thread(
+                    target=self._advance_loop,
+                    name=f"dpf-hh-advance-{self.config.name}", daemon=True,
+                )
+                self._advance_thread = t
+                t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        with self._lock:
+            self._wake.notify_all()
+            t = self._advance_thread
+            self._advance_thread = None
+        if t is not None:
+            t.join(timeout=15)
+        with self._lock:
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+            for w in self._windows.values():
+                w.journal.close()
+            for pw in self._peer_windows.values():
+                pw.journal.close()
+
+    def __enter__(self) -> "HeavyHitterStream":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- ingestion ---------------------------------------------------------
+    def _pending_locked(self) -> List[_Window]:
+        return [
+            w for g, w in sorted(self._windows.items()) if w.closed
+        ]
+
+    def check_admission(self, batch_id: Optional[str] = None) -> None:
+        """Backpressure gate (called by FrontDoor.submit before an
+        ingest queues, and again inside :meth:`ingest`): past the
+        pending-window bound the server says "later" —
+        ``RESOURCE_EXHAUSTED``, the client's retry-with-backoff signal —
+        instead of queueing work the advance cannot keep up with.
+        A `batch_id` this stream has ALREADY ACCEPTED passes regardless:
+        the retry of a lost ack must be acknowledged (the exactly-once
+        contract), not refused for work that was already admitted.
+
+        LEADER ONLY. The follower's closed segments retire with the
+        LEADER's window progress, and that progress needs every
+        membership batch delivered to the follower — a follower that
+        refused ingests at its own segment bound would reject exactly
+        the deliveries that unblock the pipeline (a real deadlock, found
+        by the --stream soak: the leader's pending window stalled
+        UNAVAILABLE-incomplete while the follower shed the missing
+        batches RESOURCE_EXHAUSTED forever). The follower's backlog is
+        bounded transitively: clients upload to both parties in
+        lockstep, so the leader's bound throttles them both."""
+        if self.role != "leader":
+            return
+        with self._lock:
+            self._ensure_loaded()
+            if batch_id and batch_id in self._accepted:
+                return  # a dedup ack is always answered
+            pending = len(self._pending_locked())
+            if pending >= self.config.max_pending_windows:
+                self._backpressure += 1
+                _tm.counter("streaming.backpressure", op=self.config.name)
+                raise ResourceExhaustedError(
+                    f"RESOURCE_EXHAUSTED: stream {self.config.name!r} has "
+                    f"{pending} pending windows (max_pending_windows="
+                    f"{self.config.max_pending_windows}) — ingestion is "
+                    "outpacing the window advance; retry with backoff"
+                )
+
+    def _check_params(self, parameters: Sequence[DpfParameters]) -> None:
+        got = [serialization.encode_dpf_parameters(p) for p in parameters]
+        if got != self._config_blobs:
+            raise InvalidArgumentError(
+                f"ingest parameters do not match stream "
+                f"{self.config.name!r}'s configured hierarchy"
+            )
+
+    def ingest(
+        self,
+        parameters: Sequence[DpfParameters],
+        key_blobs: Sequence[bytes],
+        batch_id: str,
+        flush: bool = False,
+    ) -> Tuple[int, bool]:
+        """One client key batch into the open window. Returns
+        (generation, deduped). The batch is journaled — one fsync'd
+        ChunkJournal line — BEFORE this returns, so an acknowledged batch
+        survives SIGKILL; a batch id seen before is acknowledged with its
+        original generation and never re-counted (the client retry after
+        a lost ack). ``flush=True`` closes the open window after
+        accepting (empty `key_blobs` = a pure window-close control
+        message)."""
+        self._check_params(parameters)
+        if key_blobs and not batch_id:
+            raise InvalidArgumentError(
+                "a non-empty ingest batch needs a batch_id (the "
+                "exactly-once dedup identity)"
+            )
+        blobs = [bytes(b) for b in key_blobs]
+        with self._lock:
+            self._ensure_loaded()
+            if batch_id and batch_id in self._accepted:
+                self._deduped += 1
+                _tm.counter("streaming.deduped", op=self.config.name)
+                if flush:
+                    self._maybe_close_locked()
+                return self._accepted[batch_id], True
+            if blobs or (flush and self._open.batch_ids):
+                self.check_admission()
+            gen = self._open.generation
+            if blobs:
+                w = self._open
+                w.journal.record(
+                    len(w.batch_ids),
+                    {
+                        "batch_id": batch_id,
+                        "blobs": [
+                            base64.b64encode(b).decode("ascii")
+                            for b in blobs
+                        ],
+                    },
+                )
+                self._apply_batch(w, batch_id, blobs)
+                _tm.counter("streaming.accepted", op=self.config.name)
+                if w.keys_total >= self.config.window_keys:
+                    self._maybe_close_locked()
+            if flush:
+                self._maybe_close_locked()
+            return gen, False
+
+    def _maybe_close_locked(self) -> None:
+        """Closes the open window (finalize = the durable closed marker)
+        and opens the next generation. A window with no batches stays
+        open — there is nothing to advance."""
+        with self._lock:
+            w = self._open
+            if not w.batch_ids:
+                return
+            w.journal.finalize()
+            w.closed = True
+            _tm.counter("streaming.windows_closed", op=self.config.name)
+            self._open = self._new_window(w.generation + 1)
+            self._wake.notify_all()
+
+    # -- the advance (leader) ---------------------------------------------
+    def _advance_loop(self) -> None:
+        while not self._stop_evt.is_set():
+            with self._lock:
+                w = next(iter(self._pending_locked()), None)
+                if w is None:
+                    self._wake.wait(timeout=0.25)
+                    continue
+            try:
+                self._advance_window(w)
+            except Exception as exc:  # noqa: BLE001 — the worker survives
+                _tm.counter("streaming.advance_errors", op=self.config.name)
+                from ..utils import integrity
+
+                integrity.emit_event(
+                    "stream-advance-retry",
+                    f"stream {self.config.name!r} window {w.generation} "
+                    f"advance failed ({type(exc).__name__}: {exc}) — "
+                    "retrying; journaled levels replay",
+                    "",
+                    op=self.config.name,
+                    generation=w.generation,
+                )
+                self._stop_evt.wait(self.RETRY_SECONDS)
+
+    def _advance_window(self, w: _Window) -> None:
+        """One closed window end to end: level-by-level advance, peer
+        exchange, threshold prune, publish, rotate. Every committed level
+        is journaled (counts + resumable context state) so a SIGKILL at
+        any point resumes without re-walking verified levels — and
+        without double-counting: the ingest journal is the membership of
+        record, and the window fingerprint binds the state journal to
+        exactly that membership."""
+        from ..ops import hierarchical
+        from ..ops import supervisor as _sv
+
+        cfg = self.config
+        v = self._dpf.validator
+        if not w.journal.finalized:
+            w.journal.finalize()  # durably close a crash-recovered window
+        keys = [k for bid in w.batch_ids for k in w.keys[bid]]
+        ctx = hierarchical.BatchedContext.create(self._dpf, keys)
+        jr = _sv.ChunkJournal(
+            self._window_path(w.generation),
+            self._window_fingerprint(
+                w.generation, self._member_digest(w.batch_ids, w.shas)
+            ),
+            op="hh_window",
+        )
+        survivors: List[int] = []
+        counts_of: Dict[int, int] = {}
+        trail: List[Tuple[int, list]] = []
+        prefixes: List[int] = []
+        try:
+            for level in range(v.num_hierarchy_levels):
+                prev_lds = (
+                    0 if level == 0
+                    else v.parameters[level - 1].log_domain_size
+                )
+                lds = v.parameters[level].log_domain_size
+                trail.append((level, list(prefixes)))
+                want = [str(p) for p in prefixes]
+                stored = jr.completed(level)
+                if stored is not None and stored["prefixes"] == want:
+                    counts = np.array(
+                        [int(c) for c in stored["counts"]], dtype=np.uint64
+                    )
+                    _sv.ctx_apply(ctx, stored["state"])
+                else:
+                    own = self._level_shares(ctx, level, prefixes)
+                    peer = self._peer_level(w, trail)
+                    if peer.shape != own.shape:
+                        raise DataLossError(
+                            f"peer aggregate for window {w.generation} "
+                            f"level {level} has {peer.shape[0]} candidates"
+                            f", expected {own.shape[0]}"
+                        )
+                    counts = (own + peer) & self._count_mask
+                    jr.record(level, {
+                        "prefixes": want,
+                        "counts": [str(int(c)) for c in counts],
+                        "state": _sv.ctx_record(ctx),
+                    })
+                cand = hierarchical.candidate_children(
+                    prefixes, prev_lds, lds
+                )
+                keep = np.nonzero(counts >= np.uint64(cfg.threshold))[0]
+                survivors = [int(cand[i]) for i in keep]
+                counts_of = {int(cand[i]): int(counts[i]) for i in keep}
+                prefixes = survivors
+                if not prefixes:
+                    break
+            self._publish(w, jr, survivors, counts_of)
+        finally:
+            jr.close()
+
+    def _publish(self, w: _Window, jr, prefixes: List[int],
+                 counts_of: Dict[int, int]) -> None:
+        line = {
+            "kind": "published",
+            "generation": w.generation,
+            "batch_ids": list(w.batch_ids),
+            "keys": w.keys_total,
+            "prefixes": [str(p) for p in prefixes],
+            "counts": [str(counts_of[p]) for p in prefixes],
+        }
+        # Durability order: the published line lands (fsync) BEFORE the
+        # window's journals rotate away — a crash in between re-runs
+        # rotation at reload, never the window.
+        self._append_retired(line)
+        jr.finalize()
+        with self._lock:
+            self._published.append(line)
+            self._windows.pop(w.generation, None)
+            self._retired_keys += w.keys_total
+            self._wake.notify_all()
+        jr.unlink()
+        w.journal.unlink()
+        with self._lock:
+            self._rotated += 2
+        _tm.counter("streaming.windows_published", op=self.config.name)
+
+    def _peer_client(self):
+        with self._lock:
+            if self._client is None:
+                from .client import DpfClient, RetryPolicy
+
+                policy = self._peer_policy or RetryPolicy(
+                    attempts=5, base_backoff=0.1, max_backoff=1.0,
+                    attempt_timeout=self._peer_deadline,
+                    connect_attempts=40, connect_backoff=0.25, seed=0,
+                )
+                self._client = DpfClient(
+                    self.peer[0], self.peer[1], policy=policy
+                )
+            return self._client
+
+    def _peer_level(self, w: _Window, trail) -> np.ndarray:
+        """The peer party's aggregate share vector for the trail's last
+        level — the only server-to-server communication (two vectors per
+        level, like the batch demo). The client's retry budget carries
+        the call across a peer restart; a still-incomplete peer window
+        answers UNAVAILABLE, which lands here as a retry too."""
+        from . import wire
+
+        payload = wire.encode_hh_aggregate(
+            self.config.name, w.generation, list(w.batch_ids), trail
+        )
+        arrays = self._peer_client().call(
+            "hh_aggregate", payload, deadline=self._peer_deadline
+        )
+        return np.asarray(arrays[0], dtype=np.uint64)
+
+    def _level_shares(self, ctx, level: int, prefixes) -> np.ndarray:
+        """This party's aggregate share vector for one advance: the
+        per-key per-candidate shares summed over keys mod 2^bits. Host
+        engine = the native AES advance (zero device programs, pinned);
+        device = the robust hierarchical chain with the hierkernel mode
+        staged-for-tunnel behind the same plumbing."""
+        cfg = self.config
+        bits = cfg.value_bits
+        if cfg.engine == "host":
+            from ..ops import hierarchical
+
+            out = hierarchical.evaluate_until_batch(
+                ctx, level, list(prefixes), engine="host"
+            )
+            vals = np.asarray(out).astype(np.uint64)
+        else:
+            from ..ops import evaluator
+            from ..ops import supervisor as _sv
+
+            kw = {} if self._policy is None else {"policy": self._policy}
+            limbs = _sv.advance_level_robust(
+                ctx, level, list(prefixes), group=cfg.group, mode=cfg.mode,
+                **kw,
+            )
+            vals = np.asarray(
+                evaluator.values_to_numpy(limbs, bits)
+            ).astype(np.uint64)
+        return vals.sum(axis=0, dtype=np.uint64) & self._count_mask
+
+    # -- the peer exchange (follower) --------------------------------------
+    def aggregate(self, generation: int, batch_ids: Sequence[str],
+                  plan) -> np.ndarray:
+        """Serves the leader's per-level aggregate request: assemble this
+        party's window from the declared batch-id membership, fast-
+        forward through the request's level trail (journaling each
+        advanced level), and return the LAST entry's share vector. A
+        batch this party has not yet ingested answers UNAVAILABLE (the
+        leader retries — the client upload will land); a journaled trail
+        that no longer matches starts the window clean."""
+        if self.role != "follower":
+            raise InvalidArgumentError(
+                "hh_aggregate is served by the peer (follower) party"
+            )
+        if not plan:
+            raise InvalidArgumentError("hh_aggregate needs a level trail")
+        with self._lock:
+            self._ensure_loaded()
+            missing = [b for b in batch_ids if b not in self._accepted]
+            if missing:
+                raise UnavailableError(
+                    f"UNAVAILABLE: stream {self.config.name!r} window "
+                    f"{generation} is missing {len(missing)} ingest "
+                    "batches on this party — retry once the client "
+                    "uploads land"
+                )
+            pw = self._peer_windows.get(generation)
+            if pw is None:
+                pw = self._make_peer_window_locked(generation, batch_ids)
+                self._peer_windows[generation] = pw
+            elif list(pw.batch_ids) != list(batch_ids):
+                raise FailedPreconditionError(
+                    f"window {generation} membership drifted between "
+                    "aggregate requests (leader bug or stale journal)"
+                )
+            result = self._serve_trail_locked(pw, plan)
+            # The window that just served is re-fetched: a trail
+            # divergence inside _serve_trail_locked replaces the object.
+            pw = self._peer_windows[generation]
+            if plan[-1][0] == self.validator.num_hierarchy_levels - 1:
+                # The FINAL level served: this window's batches are
+                # consumed — make that durable NOW, not at the leader's
+                # next-generation request, or a follower restart in
+                # between orphans the ids (segments would never retire;
+                # review catch). The window journal itself stays until
+                # retire-below so a leader crash-resume can re-request
+                # the final level.
+                self._mark_consumed_locked(pw)
+                self._sweep_segments_locked()
+            self._retire_before_locked(generation)
+            return result
+
+    def _make_peer_window_locked(self, generation: int,
+                                 batch_ids: Sequence[str]) -> _PeerWindow:
+        from ..ops import hierarchical
+        from ..ops import supervisor as _sv
+
+        keys, shas = [], {}
+        for bid in batch_ids:
+            w = self._windows.get(self._accepted[bid])
+            if w is None or bid not in w.keys:
+                raise FailedPreconditionError(
+                    f"batch {bid!r} was already consumed by a retired "
+                    "window — the leader is replaying a published "
+                    "generation"
+                )
+            keys.extend(w.keys[bid])
+            shas[bid] = w.shas[bid]
+        ctx = hierarchical.BatchedContext.create(self._dpf, keys)
+        jr = _sv.ChunkJournal(
+            self._window_path(generation),
+            self._window_fingerprint(
+                generation, self._member_digest(list(batch_ids), shas)
+            ),
+            op="hh_peer",
+        )
+        pw = _PeerWindow(generation, list(batch_ids), ctx, jr)
+        # Replay the journaled trail: contiguous levels from 0, context
+        # fast-forwarded to the highest replayed level's state.
+        for level in jr.completed_indices():
+            if level != pw.next_level:
+                break
+            stored = jr.completed(level)
+            pw.levels[level] = {
+                "prefixes": stored["prefixes"],
+                "agg": np.array(
+                    [int(x) for x in stored["agg"]], dtype=np.uint64
+                ),
+            }
+            _sv.ctx_apply(pw.ctx, stored["state"])
+        return pw
+
+    def _serve_trail_locked(self, pw: _PeerWindow, plan) -> np.ndarray:
+        from ..ops import supervisor as _sv
+
+        for attempt in range(2):
+            diverged = False
+            for level, prefixes in plan:
+                want = [str(int(p)) for p in prefixes]
+                have = pw.levels.get(level)
+                if have is not None:
+                    if have["prefixes"] == want:
+                        continue
+                    # Stale counts must never merge: start clean.
+                    _tm.counter(
+                        "streaming.window_reset", op=self.config.name
+                    )
+                    pw = self._reset_peer_window_locked(pw)
+                    diverged = True
+                    break
+                if level != pw.next_level:
+                    raise FailedPreconditionError(
+                        f"aggregate trail skips to level {level} but this "
+                        f"party's window is at level {pw.next_level}"
+                    )
+                agg = self._level_shares(pw.ctx, level, prefixes)
+                pw.journal.record(level, {
+                    "prefixes": want,
+                    "agg": [str(int(x)) for x in agg],
+                    "state": _sv.ctx_record(pw.ctx),
+                })
+                pw.levels[level] = {"prefixes": want, "agg": agg}
+            if not diverged:
+                break
+        last_level = plan[-1][0]
+        return np.asarray(pw.levels[last_level]["agg"], dtype=np.uint64)
+
+    def _reset_peer_window_locked(self, pw: _PeerWindow) -> _PeerWindow:
+        pw.journal.unlink()
+        fresh = self._make_peer_window_locked(pw.generation, pw.batch_ids)
+        with self._lock:
+            self._rotated += 1
+            self._peer_windows[pw.generation] = fresh
+        return fresh
+
+    def _mark_consumed_locked(self, pw: _PeerWindow) -> None:
+        """Durably records a peer window's batch ids as consumed (one
+        retired.jsonl line; idempotent across restarts — the loader
+        setdefaults)."""
+        with self._lock:
+            if pw.consumed_logged:
+                return
+            self._append_retired({
+                "kind": "consumed", "generation": pw.generation,
+                "batch_ids": list(pw.batch_ids),
+            })
+            self._consumed.update(pw.batch_ids)
+            pw.consumed_logged = True
+
+    def _sweep_segments_locked(self) -> None:
+        """Unlinks any closed ingest segment whose batches are all
+        consumed, compacting it into a retired line first."""
+        with self._lock:
+            for seg_gen, w in sorted(self._windows.items()):
+                if not w.closed or not w.batch_ids:
+                    continue
+                if all(bid in self._consumed for bid in w.batch_ids):
+                    self._append_retired({
+                        "kind": "retired", "generation": seg_gen,
+                        "batch_ids": list(w.batch_ids),
+                        "keys": w.keys_total,
+                    })
+                    self._retired_keys += w.keys_total
+                    w.journal.unlink()
+                    self._rotated += 1
+                    self._windows.pop(seg_gen)
+
+    def _retire_before_locked(self, generation: int) -> None:
+        """Rotation, follower side: the leader advances generations in
+        order and publishes g before requesting g+1, so a request for
+        `generation` retires every earlier peer window — its state
+        journal unlinks (including journals ORPHANED on disk by a
+        restart: the in-memory map is rebuilt lazily, so files below
+        the requested generation are swept by path) — and any closed
+        ingest segment whose batches are all consumed compacts into a
+        retired line and unlinks too."""
+        with self._lock:
+            for gen in sorted(
+                g for g in self._peer_windows if g < generation
+            ):
+                pw = self._peer_windows.pop(gen)
+                self._mark_consumed_locked(pw)
+                pw.journal.unlink()
+                self._rotated += 1
+            # Orphaned window journals (served before a restart, retired
+            # after it): the leader never revisits generations below
+            # `generation`, so their files are dead weight — sweep them
+            # (once per generation, not per level request).
+            if generation <= self._swept_below:
+                return
+            self._swept_below = generation
+            try:
+                names = os.listdir(self.dir)
+            except OSError:
+                names = []
+            for fname in names:
+                m = re.fullmatch(r"window-g(\d+)\.journal", fname)
+                if m and int(m.group(1)) < generation:
+                    try:
+                        os.unlink(os.path.join(self.dir, fname))
+                        self._rotated += 1
+                    except OSError:
+                        pass
+            self._sweep_segments_locked()
+
+    # -- observability ------------------------------------------------------
+    def snapshot(self, since_generation: int = 0) -> dict:
+        """The hh_snapshot read body: published windows (generation,
+        membership, heavy-hitter prefixes + exact counts — the
+        continuously-published output), the open window, and the stats
+        fields. Counts/prefixes travel as decimal strings (JSON keeps
+        them exact at any width). `since_generation` bounds the
+        published list to generations >= it (the poller's cursor —
+        ``published_total`` always counts the whole history), so a
+        long-lived stream's snapshot cost tracks NEW windows, not its
+        lifetime."""
+        with self._lock:
+            self._ensure_loaded()
+            return {
+                "stream": self.config.name,
+                "role": self.role,
+                "threshold": self.config.threshold,
+                "window_keys": self.config.window_keys,
+                "published_total": len(self._published),
+                "published": [
+                    w for w in self._published
+                    if int(w["generation"]) >= since_generation
+                ],
+                "open": {
+                    "generation": self._open.generation,
+                    "batches": len(self._open.batch_ids),
+                    "keys": self._open.keys_total,
+                },
+                "pending_windows": len(self._pending_locked()),
+                "stats": self.stats_fields(),
+            }
+
+    def stats_fields(self) -> dict:
+        """The per-stream block of the server's stats/health frames
+        (wire.STATS_STREAM_KEYS)."""
+        with self._lock:
+            self._ensure_loaded()
+            pending = self._pending_locked()
+            live_keys = sum(w.keys_total for w in self._windows.values())
+            return {
+                "role": self.role,
+                "open_generation": self._open.generation,
+                "pending_windows": len(pending),
+                "pending_keys": sum(w.keys_total for w in pending),
+                "accepted_batches": len(self._accepted),
+                "accepted_keys": live_keys + self._retired_keys,
+                "deduped_batches": self._deduped,
+                "backpressure_rejections": self._backpressure,
+                "windows_published": len(self._published),
+                "journals_rotated": self._rotated,
+            }

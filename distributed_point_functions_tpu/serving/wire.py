@@ -244,11 +244,17 @@ def read_frame(
 #: dealer-offload op (ISSUE 13): the client ships parameters + points +
 #: per-level values, the server runs the batched level-major keygen and
 #: answers with both parties' serialized key blobs — dealers scale
-#: horizontally behind the existing retry/deadline machinery. Appended
-#: LAST: op ids are positional and wire-stable.
+#: horizontally behind the existing retry/deadline machinery. The
+#: streaming heavy-hitters tier (ISSUE 15) adds three ops: "hh_ingest"
+#: (one client key batch into a named stream's open window — journaled
+#: before it is acknowledged), "hh_snapshot" (the published
+#: heavy-hitter view, a JSON read op) and "hh_aggregate" (the
+#: leader-to-peer per-level share exchange that drives a window's
+#: prefix-tree advance). Appended LAST: op ids are positional and
+#: wire-stable.
 WIRE_OPS = (
     "full_domain", "evaluate_at", "dcf", "mic", "pir", "hierarchical",
-    "keygen",
+    "keygen", "hh_ingest", "hh_snapshot", "hh_aggregate",
 )
 
 _OP_TO_ID = {name: i + 1 for i, name in enumerate(WIRE_OPS)}
@@ -698,6 +704,157 @@ def decode_keygen(buf: bytes):
 
 
 # ---------------------------------------------------------------------------
+# Streaming heavy hitters (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def encode_hh_ingest(
+    stream: str,
+    parameters: Sequence[DpfParameters],
+    keys: Sequence,
+    batch_id: str,
+    flush: bool = False,
+) -> bytes:
+    """Key-ingestion request (ISSUE 15): the full DpfParameters list (1,
+    the stream's hierarchy — validated against the server's stream
+    config), one serialized DpfKey blob per uploaded key (2, the PR 13
+    key-batch wire shape — `keys` may be DpfKey objects or pre-serialized
+    bytes), the stream name (3), the client-chosen batch id (4, the
+    exactly-once dedup identity: a retried batch with the same id is
+    acknowledged, never double-counted) and a flush flag (5: close the
+    open window after accepting — an EMPTY batch with flush=True is a
+    pure window-close control message)."""
+    parameters = list(parameters)
+    out = _encode_params(parameters)
+    for k in keys:
+        blob = (
+            bytes(k) if isinstance(k, (bytes, bytearray, memoryview))
+            else serialization.serialize_dpf_key(k, parameters)
+        )
+        out += pb.len_field(2, blob)
+    out += pb.len_field(3, stream.encode("utf-8"))
+    out += pb.len_field(4, batch_id.encode("utf-8"))
+    out += pb.uint64_field(5, 1 if flush else 0)
+    return out
+
+
+def decode_hh_ingest(buf: bytes):
+    """-> (parameters, key_blobs, stream, batch_id, flush). Key blobs
+    stay RAW bytes: the server journals exactly what it acknowledged and
+    parses once — re-serialization at the ingest boundary would be a
+    byte-identity hazard on the durability path."""
+    parameters: List[DpfParameters] = []
+    blobs: List[bytes] = []
+    stream = ""
+    batch_id = ""
+    flush = False
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            parameters.append(serialization.decode_dpf_parameters(value))
+        elif field == 2:
+            blobs.append(value)
+        elif field == 3:
+            stream = value.decode("utf-8")
+        elif field == 4:
+            batch_id = value.decode("utf-8")
+        elif field == 5:
+            flush = bool(value)
+    if not parameters or not stream:
+        raise InvalidArgumentError(
+            "hh_ingest payload needs params + stream name"
+        )
+    return parameters, blobs, stream, batch_id, flush
+
+
+def encode_hh_snapshot(stream: str, since_generation: int = 0) -> bytes:
+    """Snapshot read request: the stream name (1) and an optional
+    published-window cursor (2): only windows with generation >=
+    `since_generation` are returned. A long-lived stream publishes
+    windows forever — pollers pass their last seen generation + 1 so
+    the response stays O(new windows), not O(stream lifetime)."""
+    return pb.len_field(1, stream.encode("utf-8")) + pb.uint64_field(
+        2, int(since_generation)
+    )
+
+
+def decode_hh_snapshot(buf: bytes) -> Tuple[str, int]:
+    stream = ""
+    since = 0
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            stream = value.decode("utf-8")
+        elif field == 2:
+            since = int(value)
+    if not stream:
+        raise InvalidArgumentError("hh_snapshot payload needs a stream name")
+    return stream, since
+
+
+def encode_hh_aggregate(
+    stream: str, generation: int, batch_ids: Sequence[str], plan,
+) -> bytes:
+    """Leader-to-peer aggregate request: stream (1), window generation
+    (2), the window's batch-id membership in leader order (3 — the peer
+    assembles ITS OWN share keys for exactly these acknowledged batches;
+    sums are order-independent) and the full level trail so far (4, the
+    hierarchical plan-entry message: the peer fast-forwards a freshly
+    restarted window through every earlier level deterministically). The
+    response is the LAST entry's aggregate share vector."""
+    out = pb.len_field(1, stream.encode("utf-8"))
+    out += pb.uint64_field(2, int(generation))
+    for bid in batch_ids:
+        out += pb.len_field(3, bid.encode("utf-8"))
+    for level, prefixes in plan:
+        out += pb.len_field(4, _encode_plan_entry(level, prefixes))
+    return out
+
+
+def decode_hh_aggregate(buf: bytes):
+    stream = ""
+    generation = 0
+    batch_ids: List[str] = []
+    plan = []
+    for field, _, value in pb.iter_fields(buf):
+        if field == 1:
+            stream = value.decode("utf-8")
+        elif field == 2:
+            generation = int(value)
+        elif field == 3:
+            batch_ids.append(value.decode("utf-8"))
+        elif field == 4:
+            plan.append(_decode_plan_entry(value))
+    if not stream or not plan:
+        raise InvalidArgumentError(
+            "hh_aggregate payload needs stream name + level trail"
+        )
+    return stream, generation, batch_ids, plan
+
+
+def json_result_arrays(body: dict) -> List[np.ndarray]:
+    """A JSON body as the generic result-array stream (one uint8 array) —
+    the hh_snapshot response form (python ints of any width serialize
+    exactly; the client json-parses the bytes back)."""
+    import json as _json
+
+    return [
+        np.frombuffer(
+            _json.dumps(body, sort_keys=True).encode("utf-8"), np.uint8
+        ).copy()
+    ]
+
+
+def json_from_arrays(arrays: Sequence[np.ndarray]) -> dict:
+    """Inverse of :func:`json_result_arrays`."""
+    import json as _json
+
+    if not arrays:
+        raise DataLossError("JSON response carries no array")
+    return _json.loads(
+        np.asarray(arrays[0], dtype=np.uint8).tobytes().decode("utf-8")
+    )
+
+
+# ---------------------------------------------------------------------------
 # Fleet routing + stats aggregation (ISSUE 14)
 # ---------------------------------------------------------------------------
 
@@ -709,6 +866,20 @@ def decode_keygen(buf: bytes):
 #: requests answered this process, ``warm`` = the warm-cache digest
 #: inventory per tier (pir/plans/keys).
 STATS_FLEET_KEYS = ("queues", "inflight", "served", "warm")
+
+#: Health/stats body keys added for the streaming heavy-hitters tier
+#: (ISSUE 15), following the STATS_FLEET_KEYS pattern — new keys in the
+#: existing JSON bodies, BACKWARD-COMPATIBLE both directions (old bodies
+#: merge fine, old clients never read the new key). ``streams`` maps
+#: stream name -> its counters: open window generation, pending window
+#: depth (the backpressure bound), keys/batches accepted + deduped,
+#: windows published, journals rotated.
+STATS_STREAM_KEYS = ("streams",)
+
+#: Per-stream stats fields that aggregate by MAX across replicas (the
+#: open generation is a high-water mark, not a rate); every other
+#: numeric field sums, non-numeric fields (role) keep the first body's.
+_STREAM_MAX_FIELDS = frozenset({"open_generation"})
 
 #: Request-payload fields, per op, that determine the request's
 #: compatibility-queue key and warm-cache identity on the replica — the
@@ -730,6 +901,11 @@ _ROUTING_FIELDS: Dict[str, Tuple[int, ...]] = {
     "pir": (1, 3),              # params, db name
     "hierarchical": (1, 3, 4),  # params, plan entries, group
     "keygen": (1,),             # params (any same-parameter batch merges)
+    # Streaming ops route on the stream identity: one replica owns a
+    # stream's window state (journals + contexts are process-local).
+    "hh_ingest": (3,),          # stream name
+    "hh_snapshot": (1,),        # stream name
+    "hh_aggregate": (1,),       # stream name
 }
 
 
@@ -770,6 +946,7 @@ def merge_stats(bodies: Sequence[dict]) -> dict:
         "decisions_by_source": {}, "integrity_by_kind": {},
         "queues": {}, "inflight": 0, "served": 0,
         "warm": {"pir": [], "plans": [], "keys": []},
+        "streams": {},
     }
     for body in bodies:
         out["wall_seconds"] = max(
@@ -791,6 +968,19 @@ def merge_stats(bodies: Sequence[dict]) -> dict:
         out["served"] += int(body.get("served", 0))
         for tier, digests in (body.get("warm") or {}).items():
             out["warm"].setdefault(tier, []).extend(digests)
+        # Streaming fields (ISSUE 15): per-stream numeric fields sum,
+        # except the generation high-water marks which take the max —
+        # like the gauges above, a snapshot field is not a rate. Old
+        # bodies simply lack the key.
+        for name, fields in (body.get("streams") or {}).items():
+            agg = out.setdefault("streams", {}).setdefault(name, {})
+            for k, v in fields.items():
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    agg.setdefault(k, v)
+                elif k in _STREAM_MAX_FIELDS:
+                    agg[k] = max(agg.get(k, v), v)
+                else:
+                    agg[k] = agg.get(k, 0) + v
     return out
 
 

@@ -27,6 +27,15 @@ Protocol (semi-honest, additive shares mod 2^64):
 
 Run: python examples/heavy_hitters_demo.py  (CPU; a few seconds)
 
+``--serve`` runs the STREAMING deployment shape instead (ISSUE 15): two
+real in-process RPC servers on loopback — party 1 the follower, party 0
+the aggregation leader driving the window advance against it — with
+clients uploading key batches through the ``hh_ingest`` wire op into
+rolling window generations (journaled before acknowledgement), windows
+closing at ``HH_WINDOW`` keys, popular prefixes publishing continuously,
+and the final ``hh_snapshot`` compared per window against the exact
+batch oracle.
+
 ``HH_MODE`` selects the server-side execution strategy:
 
 * ``fused`` (default) — the grouped fused advance through the robust
@@ -52,6 +61,116 @@ BITS_PER_LEVEL = 2
 NUM_CLIENTS = int(os.environ.get("HH_CLIENTS", 120))
 THRESHOLD = int(os.environ.get("HH_THRESHOLD", 8))
 HH_MODE = os.environ.get("HH_MODE", "fused")
+
+
+def serve_main() -> int:
+    """The streaming tier (ISSUE 15): the same protocol as `main`, but
+    as a LIVE two-server service — batched client uploads over the real
+    wire, rolling crash-safe window generations, continuous publishes."""
+    import collections
+    import tempfile
+
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+
+    window_keys = int(os.environ.get("HH_WINDOW", 48))
+    cfg = serving.StreamConfig.bitwise(
+        "demo", BITS, BITS_PER_LEVEL, THRESHOLD, window_keys=window_keys,
+    )
+    dpf = DistributedPointFunction.create_incremental(list(cfg.parameters))
+    n_levels = len(cfg.parameters)
+
+    rng = np.random.default_rng(2026)
+    heavy = [0xBEEF, 0x1234, 0xC0DE]
+    values = []
+    for h in heavy:
+        values += [h] * (THRESHOLD + int(rng.integers(0, 5)))
+    while len(values) < NUM_CLIENTS:
+        values.append(int(rng.integers(0, 1 << BITS)))
+    rng.shuffle(values)
+    values = values[:NUM_CLIENTS]
+
+    tmp = tempfile.mkdtemp(prefix="dpf-hh-serve-")
+    follower = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    follower.register_stream(
+        serving.HeavyHitterStream(cfg, os.path.join(tmp, "party1"))
+    )
+    follower.start()
+    leader = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    leader.register_stream(serving.HeavyHitterStream(
+        cfg, os.path.join(tmp, "party0"),
+        peer=("127.0.0.1", follower.port),
+    ))
+    leader.start()
+    print(f"# two-server streaming pair up: leader :{leader.port} "
+          f"(party 0), follower :{follower.port} (party 1); "
+          f"window_keys={window_keys}, journals under {tmp}")
+
+    client = serving.TwoServerClient(
+        [("127.0.0.1", leader.port), ("127.0.0.1", follower.port)],
+        policy=serving.RetryPolicy(
+            attempts=8, base_backoff=0.05, max_backoff=0.5, seed=0,
+        ),
+    )
+    batch_size = 4
+    batch_values = {}
+    t0 = time.time()
+    try:
+        for start in range(0, len(values), batch_size):
+            vals = values[start:start + batch_size]
+            bid = f"client-{start // batch_size}"
+            batch_values[bid] = vals
+            keys0, keys1 = [], []
+            for v in vals:
+                k0, k1 = dpf.generate_keys_incremental(v, [1] * n_levels)
+                keys0.append(k0)
+                keys1.append(k1)
+            client.hh_ingest("demo", cfg.parameters, (keys0, keys1), bid,
+                             deadline=60)
+        client.hh_ingest("demo", cfg.parameters, ([], []), "", flush=True,
+                         deadline=30)
+        print(f"# {len(batch_values)} client batches x {batch_size} keys "
+              f"ingested + flushed in {time.time() - t0:.2f}s "
+              "(journaled before every ack)")
+
+        deadline = time.time() + 60
+        snap = None
+        while time.time() < deadline:
+            snap = client.clients[0].hh_snapshot("demo", deadline=10)
+            done = {b for w in snap["published"] for b in w["batch_ids"]}
+            if (
+                len(done) == len(batch_values)
+                and snap["pending_windows"] == 0
+            ):
+                break
+            time.sleep(0.2)
+
+        ok = True
+        for w in snap["published"]:
+            vals = [v for b in w["batch_ids"] for v in batch_values[b]]
+            cnt = collections.Counter(vals)
+            want = {v: c for v, c in cnt.items() if c >= THRESHOLD}
+            got = {int(p): int(c) for p, c in zip(w["prefixes"], w["counts"])}
+            hot = {hex(k): v for k, v in sorted(got.items())}
+            print(f"# window {w['generation']}: {len(w['batch_ids'])} "
+                  f"batches, {w['keys']} keys -> {hot}")
+            if got != want:
+                ok = False
+                print(f"MISMATCH vs batch oracle: want {want}")
+        seen = sorted(b for w in snap["published"] for b in w["batch_ids"])
+        if seen != sorted(batch_values):
+            ok = False
+            print("MISMATCH: published membership is not exactly-once")
+        print(f"# stream stats: {snap['stats']}")
+        if not ok:
+            return 1
+        print("OK: every window's published counts equal its batch oracle "
+              "(no lost, no double-counted keys)")
+        return 0
+    finally:
+        client.close()
+        leader.stop()
+        follower.stop()
 
 
 def main() -> int:
@@ -137,19 +256,12 @@ def main() -> int:
         counts = (agg_a + agg_b).astype(np.uint64)  # mod 2^64
         n_candidates = counts.shape[0]
         survivors = np.nonzero(counts >= THRESHOLD)[0]
-        # Candidate i is (prefix index << bits_per_level) + child — in the
-        # batched path outputs are ordered by sorted prefix then leaf.
-        if prefixes:
-            base = np.repeat(
-                np.asarray(prefixes, dtype=np.uint64), 1 << BITS_PER_LEVEL
-            )
-            child = np.tile(
-                np.arange(1 << BITS_PER_LEVEL, dtype=np.uint64),
-                len(prefixes),
-            )
-            cand = (base << np.uint64(BITS_PER_LEVEL)) + child
-        else:
-            cand = np.arange(n_candidates, dtype=np.uint64)
+        # Candidate i is (prefix index << bits_per_level) + child — the
+        # shared candidate<->output-column mapping (sorted prefix, then
+        # leaf) the streaming window manager uses too (ISSUE 15).
+        cand = hierarchical.candidate_children(
+            prefixes, level * BITS_PER_LEVEL, (level + 1) * BITS_PER_LEVEL,
+        )
         prefixes = sorted(int(cand[i]) for i in survivors)
         print(
             f"# level {level}: {n_candidates} candidates -> "
@@ -173,4 +285,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--serve" in sys.argv[1:]:
+        sys.exit(serve_main())
     sys.exit(main())

@@ -863,3 +863,50 @@ def test_fleet_adds_zero_programs(program_counter):
         proxy.stop()
         for s in replicas:
             s.stop()
+
+
+def test_streaming_adds_zero_programs(program_counter, tmp_path):
+    """ISSUE 15 acceptance pin: the streaming heavy-hitters tier on the
+    host route — ingest journaling, window close, the leader's full
+    level-by-level advance with the peer exchange, threshold prune,
+    publish, rotation — launches ZERO device programs. The host-engine
+    advance is the native AES path end to end; hierkernel stays
+    staged-for-tunnel behind the stream's mode plumbing."""
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.protos import serialization as ser
+
+    cfg = serving.StreamConfig.bitwise(
+        "audit", 6, 2, threshold=2, window_keys=4
+    )
+    dpf = DistributedPointFunction.create_incremental(list(cfg.parameters))
+    n = len(cfg.parameters)
+
+    follower = serving.HeavyHitterStream(cfg, str(tmp_path / "f"))
+    leader = serving.HeavyHitterStream(
+        cfg, str(tmp_path / "l"), peer=("127.0.0.1", 1),
+    )
+    leader._peer_level = lambda w, trail: follower.aggregate(
+        w.generation, list(w.batch_ids), trail
+    )
+    program_counter["programs"] = 0
+    for i, vals in enumerate([[9, 9], [40, 9]]):
+        b0, b1 = [], []
+        for v in vals:
+            k0, k1 = dpf.generate_keys_incremental(v, [1] * n)
+            b0.append(ser.serialize_dpf_key(k0, cfg.parameters))
+            b1.append(ser.serialize_dpf_key(k1, cfg.parameters))
+        leader.ingest(cfg.parameters, b0, f"b-{i}")
+        follower.ingest(cfg.parameters, b1, f"b-{i}")
+    leader.ingest(cfg.parameters, [], "", flush=True)
+    with leader._lock:
+        pending = list(leader._pending_locked())
+    for w in pending:
+        leader._advance_window(w)
+    snap = leader.snapshot()
+    assert snap["published"], "the window must publish"
+    assert program_counter["programs"] == 0, (
+        f"the streaming host route launched {program_counter['programs']} "
+        "device programs — ingest/advance/publish must be pure host work"
+    )
+    leader.stop()
+    follower.stop()

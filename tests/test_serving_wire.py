@@ -173,11 +173,16 @@ def test_two_server_partial_failure_names_dead_party(dpf, keys):
             assert time.perf_counter() - t0 < 10
 
 
+@pytest.mark.slow
 def test_dead_party_reported_before_survivor_finishes(dpf, keys):
     """The partial-failure contract is fail-FAST: a dead party surfaces
     the moment ITS budget exhausts, not after the surviving party's
     (possibly long) call returns (review catch — _both was
-    join-both-then-check)."""
+    join-both-then-check).
+
+    Slow tier (ISSUE 15 budget satellite): the timing-variant sibling of
+    test_two_server_partial_failure_names_dead_party, which keeps the
+    PartyUnavailableError attribution + bounded-budget pins fast."""
     k0s, k1s = keys
     # Party 0: accepts and handshakes, then sits on the request far
     # longer than party 1's whole failure budget.
@@ -326,10 +331,17 @@ def test_slow_mid_frame_request_is_served_not_torn(server, dpf, keys):
         sock.close()
 
 
+@pytest.mark.slow
 def test_derived_journal_cleaned_up_after_success(dpf, keys, tmp_path):
     """The journal_dir (fingerprint-derived) form unlinks its journal on
     success — a long-lived server must not grow one result-sized file
-    per distinct client batch forever (review catch)."""
+    per distinct client batch forever (review catch).
+
+    Slow tier (ISSUE 15 budget satellite): at ~5.5 s this was the whole
+    wire suite's dominant cost (a full robust full-domain run through
+    XLA), and the journal-lifecycle class it guards is fast-covered by
+    test_supervisor's journal pins plus the streaming rotation pins in
+    test_streaming.py."""
     from distributed_point_functions_tpu.ops import supervisor
 
     k0s, _ = keys

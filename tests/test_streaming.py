@@ -1,0 +1,593 @@
+"""Streaming heavy-hitters pins (ISSUE 15).
+
+The wire-suite budget discipline: every service test runs in-process
+``DpfServer`` pairs (or the window manager directly) with
+``engine="host"`` — the full ingest/journal/advance/publish path with
+zero XLA programs and zero new compiles. The zero-added-device-programs
+pin lives with the other audits in tests/test_dispatch_audit.py; the
+subprocess SIGKILL soak is ``tools/chaos_soak.py --stream`` (faults
+tier).
+"""
+
+import collections
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import serving
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int, XorWrapper
+from distributed_point_functions_tpu.ops import hierarchical
+from distributed_point_functions_tpu.protos import serialization as ser
+from distributed_point_functions_tpu.serving import wire
+from distributed_point_functions_tpu.serving.streaming import (
+    HeavyHitterStream,
+    StreamConfig,
+    parse_stream_spec,
+)
+from distributed_point_functions_tpu.utils import integrity
+from distributed_point_functions_tpu.utils.errors import (
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+
+FAST = serving.RetryPolicy(
+    attempts=6, base_backoff=0.02, max_backoff=0.2, connect_attempts=3,
+    connect_backoff=0.05, attempt_timeout=10.0, seed=0,
+)
+
+#: 6-bit values, 2 bits/level = 3 hierarchy levels — advances are
+#: microseconds on the host engine.
+CFG_KW = dict(bits=6, bits_per_level=2, threshold=2)
+
+
+def _cfg(name, **kw):
+    merged = dict(CFG_KW)
+    merged.update(kw)
+    return StreamConfig.bitwise(name, **merged)
+
+
+@pytest.fixture(scope="module")
+def dpf():
+    cfg = _cfg("shape-probe")
+    return DistributedPointFunction.create_incremental(list(cfg.parameters))
+
+
+def _blob_pair(dpf, cfg, values):
+    """([party0 blobs], [party1 blobs]) for a value list."""
+    n = len(cfg.parameters)
+    out0, out1 = [], []
+    for v in values:
+        k0, k1 = dpf.generate_keys_incremental(int(v), [1] * n)
+        out0.append(ser.serialize_dpf_key(k0, cfg.parameters))
+        out1.append(ser.serialize_dpf_key(k1, cfg.parameters))
+    return out0, out1
+
+
+def _key_pair(dpf, cfg, values):
+    n = len(cfg.parameters)
+    out0, out1 = [], []
+    for v in values:
+        k0, k1 = dpf.generate_keys_incremental(int(v), [1] * n)
+        out0.append(k0)
+        out1.append(k1)
+    return out0, out1
+
+
+def _wired_pair(dpf, cfg, leader_stream, follower_stream):
+    """Connects a leader stream's peer exchange straight to a follower
+    stream object — the in-process harness for journal/crash pins (the
+    socket path is covered by the service test + the --stream soak)."""
+    leader_stream._peer_level = lambda w, trail: follower_stream.aggregate(
+        w.generation, list(w.batch_ids), trail
+    )
+    return leader_stream
+
+
+def _drain_leader(leader_stream):
+    """Advances every pending window inline (no worker thread)."""
+    leader_stream.stats_fields()  # journal reload (start() without the worker)
+    while True:
+        with leader_stream._lock:
+            pending = leader_stream._pending_locked()
+            w = pending[0] if pending else None
+        if w is None:
+            return
+        leader_stream._advance_window(w)
+
+
+# ---------------------------------------------------------------------------
+# Candidate mapping + config units
+# ---------------------------------------------------------------------------
+
+
+def test_candidate_children_matches_advance_output_order():
+    """candidate_children is the candidate<->output-column contract:
+    sorted prefix, then leaf — and the first advance covers the whole
+    level domain."""
+    got = hierarchical.candidate_children([], 0, 2)
+    assert got.tolist() == [0, 1, 2, 3]
+    got = hierarchical.candidate_children([3, 1], 2, 4)  # unsorted input
+    assert got.tolist() == [4, 5, 6, 7, 12, 13, 14, 15]
+    with pytest.raises(InvalidArgumentError):
+        hierarchical.candidate_children([0], 4, 4)
+    with pytest.raises(InvalidArgumentError):
+        hierarchical.candidate_children([0], 0, 63)
+
+
+def test_stream_config_validation():
+    with pytest.raises(InvalidArgumentError, match="Int"):
+        StreamConfig("s", [DpfParameters(4, XorWrapper(64))], 2)
+    with pytest.raises(InvalidArgumentError, match="one value type"):
+        StreamConfig(
+            "s", [DpfParameters(2, Int(32)), DpfParameters(4, Int(64))], 2
+        )
+    with pytest.raises(InvalidArgumentError, match="name"):
+        StreamConfig("bad/name", [DpfParameters(4, Int(64))], 2)
+    cfg = parse_stream_spec("hh:12:2:5:24:3")
+    assert cfg.name == "hh" and cfg.threshold == 5
+    assert cfg.window_keys == 24 and cfg.max_pending_windows == 3
+    assert [p.log_domain_size for p in cfg.parameters] == [2, 4, 6, 8, 10, 12]
+    with pytest.raises(InvalidArgumentError):
+        parse_stream_spec("hh:12:2")
+
+
+def test_ingest_is_its_own_batcher_op_class(dpf, tmp_path):
+    """hh_ingest rides the batcher as its OWN op class (the fair-flush
+    rotation): signature keys on the stream, width counts keys, and the
+    op is in the OPS vocabulary the scheduler rotates over."""
+    from distributed_point_functions_tpu.serving import batcher
+
+    assert "hh_ingest" in batcher.OPS
+    cfg = _cfg("opclass")
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    blobs, _ = _blob_pair(dpf, cfg, [1, 2])
+    r = serving.Request.hh_ingest(stream, cfg.parameters, blobs, "b-0")
+    assert r.signature() == ("hh_ingest", "opclass")
+    assert r.width == 2
+    flush = serving.Request.hh_ingest(stream, cfg.parameters, [], "",
+                                      flush=True)
+    assert flush.width == 1  # a pure window-close control message
+
+
+# ---------------------------------------------------------------------------
+# The live service (real loopback sockets)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def pair(tmp_path):
+    """Leader + follower DpfServer pair sharing one stream config."""
+    cfg = _cfg("hh", window_keys=6, max_pending_windows=4)
+    follower = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    follower.register_stream(
+        HeavyHitterStream(cfg, str(tmp_path / "party1"))
+    )
+    follower.start()
+    leader = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    leader.register_stream(HeavyHitterStream(
+        cfg, str(tmp_path / "party0"), peer=("127.0.0.1", follower.port),
+    ))
+    leader.start()
+    client = serving.TwoServerClient(
+        [("127.0.0.1", leader.port), ("127.0.0.1", follower.port)],
+        policy=FAST,
+    )
+    yield cfg, leader, follower, client
+    client.close()
+    leader.stop()
+    follower.stop()
+
+
+def test_stream_publishes_exact_counts_over_wire(pair, dpf):
+    """The acceptance shape in-process: batched uploads over the real
+    wire into rolling windows, published prefixes + counts EXACTLY equal
+    the per-window batch oracle, membership exactly-once, retried
+    batch ids deduped."""
+    cfg, leader, follower, client = pair
+    rng = np.random.default_rng(3)
+    batch_values = {}
+    for b in range(5):
+        vals = [int(v) for v in rng.choice([9, 9, 9, 40, 3], size=3)]
+        batch_values[f"b-{b}"] = vals
+        gen_pair = client.hh_ingest(
+            "hh", cfg.parameters, _key_pair(dpf, cfg, vals), f"b-{b}",
+            deadline=30,
+        )
+        assert gen_pair[0][1] is False and gen_pair[1][1] is False
+    client.hh_ingest("hh", cfg.parameters, ([], []), "", flush=True,
+                     deadline=30)
+    # A retried batch id (the lost-ack path) is acknowledged, deduped.
+    (g0, d0), (g1, d1) = client.hh_ingest(
+        "hh", cfg.parameters, _key_pair(dpf, cfg, batch_values["b-0"]),
+        "b-0", deadline=30,
+    )
+    assert d0 is True and d1 is True
+
+    deadline = time.perf_counter() + 30
+    snap = None
+    while time.perf_counter() < deadline:
+        snap = client.clients[0].hh_snapshot("hh", deadline=10)
+        done = {b for w in snap["published"] for b in w["batch_ids"]}
+        if done == set(batch_values) and snap["pending_windows"] == 0:
+            break
+        time.sleep(0.05)
+    seen = [b for w in snap["published"] for b in w["batch_ids"]]
+    assert sorted(seen) == sorted(batch_values)  # exactly-once
+    for w in snap["published"]:
+        vals = [v for b in w["batch_ids"] for v in batch_values[b]]
+        cnt = collections.Counter(vals)
+        want = {v: c for v, c in cnt.items() if c >= cfg.threshold}
+        got = {int(p): int(c) for p, c in zip(w["prefixes"], w["counts"])}
+        assert got == want, f"window {w['generation']}"
+    # The dedup ack never double-counted: b-0's window was published
+    # before the retry and its counts above already matched the oracle.
+    stats = snap["stats"]
+    assert stats["deduped_batches"] >= 1
+    assert stats["windows_published"] == len(snap["published"])
+    assert stats["journals_rotated"] >= 2  # ingest + window per publish
+    # The poller's cursor (review catch — a long-lived stream must not
+    # re-ship its whole history per probe): since_generation filters
+    # the published list, published_total still counts everything.
+    last_gen = max(int(w["generation"]) for w in snap["published"])
+    cut = client.clients[0].hh_snapshot(
+        "hh", since_generation=last_gen, deadline=10
+    )
+    assert [int(w["generation"]) for w in cut["published"]] == [last_gen]
+    assert cut["published_total"] == len(snap["published"])
+
+
+def test_stats_and_health_frames_carry_stream_fields(pair):
+    """ISSUE 15 satellite: stats/health bodies gain the per-stream block
+    (wire.STATS_STREAM_KEYS) as ADDITIVE keys — every pre-stream key
+    still present."""
+    cfg, leader, follower, client = pair
+    stats = client.clients[0].stats()
+    for key in ("wall_seconds", "counters", "gauges") + wire.STATS_FLEET_KEYS:
+        assert key in stats, key
+    for key in wire.STATS_STREAM_KEYS:
+        assert key in stats, key
+    fields = stats["streams"]["hh"]
+    for key in (
+        "role", "open_generation", "pending_windows", "pending_keys",
+        "accepted_batches", "accepted_keys", "deduped_batches",
+        "backpressure_rejections", "windows_published", "journals_rotated",
+    ):
+        assert key in fields, key
+    assert fields["role"] == "leader"
+    health = client.clients[1].health()
+    assert health["streams"]["hh"]["role"] == "follower"
+
+
+def test_merge_stats_streams_sum_and_old_bodies(dpf):
+    """merge_stats aggregates the stream block: counters sum, the open
+    generation takes the max, and an OLD body (no "streams" key, gauges
+    as {"last","max"} dicts) still merges — backward compatible both
+    directions."""
+    new_a = {
+        "counters": {"x": 1}, "gauges": {"g": {"last": 1, "max": 2}},
+        "streams": {"hh": {"role": "leader", "open_generation": 3,
+                           "accepted_keys": 10, "windows_published": 2}},
+    }
+    new_b = {
+        "counters": {"x": 2}, "gauges": {"g": {"last": 3, "max": 5}},
+        "streams": {"hh": {"role": "leader", "open_generation": 5,
+                           "accepted_keys": 7, "windows_published": 1}},
+    }
+    old = {"counters": {"x": 4}, "gauges": {"g": {"last": 1, "max": 1}}}
+    merged = wire.merge_stats([new_a, new_b, old])
+    assert merged["counters"]["x"] == 7
+    assert merged["gauges"]["g"] == {"last": 5, "max": 8}
+    hh = merged["streams"]["hh"]
+    assert hh["open_generation"] == 5  # max, not sum
+    assert hh["accepted_keys"] == 17 and hh["windows_published"] == 3
+    assert hh["role"] == "leader"
+    # Old-only merge: the streams key exists and is empty.
+    assert wire.merge_stats([old])["streams"] == {}
+
+
+# ---------------------------------------------------------------------------
+# Durability: torn tails, fingerprints, resume (the window manager
+# directly — the subprocess SIGKILL arm is the --stream soak)
+# ---------------------------------------------------------------------------
+
+
+def test_torn_ingest_tail_discarded_and_not_acked(dpf, tmp_path):
+    """ISSUE 15 satellite: a torn last ingest append (the mid-fsync
+    kill) is DISCARDED on reload — the batch was never acknowledged, so
+    the client's retry re-ingests it fresh (not deduped), and nothing
+    is double-counted."""
+    cfg = _cfg("torn")
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    b1, _ = _blob_pair(dpf, cfg, [1, 2])
+    b2, _ = _blob_pair(dpf, cfg, [3])
+    assert stream.ingest(cfg.parameters, b1, "batch-1") == (0, False)
+    assert stream.ingest(cfg.parameters, b2, "batch-2") == (0, False)
+    stream.stop()
+    path = stream._ingest_path(0)
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[:-9])  # tear the last append mid-line
+
+    resumed = HeavyHitterStream(cfg, str(tmp_path))
+    fields = resumed.stats_fields()
+    assert fields["accepted_batches"] == 1  # batch-2's ack never landed
+    assert fields["accepted_keys"] == 2
+    # The retry is accepted FRESH (not deduped), exactly once.
+    assert resumed.ingest(cfg.parameters, b2, "batch-2") == (0, False)
+    assert resumed.stats_fields()["accepted_batches"] == 2
+    assert resumed.ingest(cfg.parameters, b2, "batch-2") == (0, True)
+    resumed.stop()
+
+
+def test_follower_resumes_window_from_journal(dpf, tmp_path):
+    """A follower restarted mid-window serves the SAME aggregate vectors
+    from its journaled trail — the context fast-forwards from the stored
+    state instead of recomputing (pinned via the advance-call spy)."""
+    cfg = _cfg("resume")
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    _, blobs1 = _blob_pair(dpf, cfg, [9, 9, 40])
+    stream.ingest(cfg.parameters, blobs1, "b-0", flush=True)
+    plan0 = [(0, [])]
+    plan1 = [(0, []), (1, [2])]  # 9 >> 4 bits... level-0 survivor 9>>4=0b10
+    first0 = stream.aggregate(0, ["b-0"], plan0)
+    first1 = stream.aggregate(0, ["b-0"], plan1)
+    stream.stop()
+
+    resumed = HeavyHitterStream(cfg, str(tmp_path))
+    calls = []
+    orig = resumed._level_shares
+
+    def spy(ctx, level, prefixes):
+        calls.append(level)
+        return orig(ctx, level, prefixes)
+
+    resumed._level_shares = spy
+    again1 = resumed.aggregate(0, ["b-0"], plan1)
+    assert np.array_equal(again1, first1)
+    assert calls == []  # served entirely from the journaled trail
+    again0 = resumed.aggregate(0, ["b-0"], plan0)
+    assert np.array_equal(again0, first0)
+    resumed.stop()
+
+
+def test_window_fingerprint_mismatch_starts_clean(dpf, tmp_path):
+    """ISSUE 15 satellite: a window state journal whose generation
+    fingerprint no longer matches (membership changed under it — e.g. a
+    torn ingest tail removed a batch) is DISCARDED and the window starts
+    clean instead of merging stale counts."""
+    cfg = _cfg("fpmm")
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    _, b0 = _blob_pair(dpf, cfg, [9, 9])
+    _, b1 = _blob_pair(dpf, cfg, [40])
+    stream.ingest(cfg.parameters, b0, "b-0", flush=True)
+    agg_b0 = stream.aggregate(0, ["b-0"], [(0, [])])
+    stream.stop()
+
+    resumed = HeavyHitterStream(cfg, str(tmp_path))
+    resumed.ingest(cfg.parameters, b1, "b-1")
+    with integrity.capture_events() as events:
+        # The same generation now declares DIFFERENT membership: the
+        # stored state journal must not feed it.
+        agg_both = resumed.aggregate(0, ["b-0", "b-1"], [(0, [])])
+    assert any(e.kind == "journal-discarded" for e in events)
+    assert not np.array_equal(agg_both, agg_b0)
+    # The clean recompute is the exact share sum over BOTH batches.
+    want = resumed.aggregate(0, ["b-0", "b-1"], [(0, [])])
+    assert np.array_equal(agg_both, want)
+    resumed.stop()
+
+
+def test_missing_batch_answers_unavailable_retry(dpf, tmp_path):
+    """A leader declaring a batch this party has not ingested yet gets
+    UNAVAILABLE (retryable — the client upload will land), never a
+    wrong-membership aggregate."""
+    cfg = _cfg("missing")
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    _, b0 = _blob_pair(dpf, cfg, [9])
+    stream.ingest(cfg.parameters, b0, "b-0")
+    with pytest.raises(UnavailableError, match="missing 1 ingest"):
+        stream.aggregate(0, ["b-0", "b-late"], [(0, [])])
+    stream.stop()
+
+
+def test_backpressure_bounded_pending_windows(dpf, tmp_path):
+    """ISSUE 15: past max_pending_windows closed-unpublished windows
+    (an unstarted leader = a stalled advance), ingests shed
+    RESOURCE_EXHAUSTED and the counter records it."""
+    cfg = _cfg("bp", window_keys=1, max_pending_windows=2)
+    stream = HeavyHitterStream(
+        cfg, str(tmp_path), peer=("127.0.0.1", 1),  # leader, peer dead
+    )
+    for i in range(2):
+        blobs, _ = _blob_pair(dpf, cfg, [i])
+        stream.ingest(cfg.parameters, blobs, f"b-{i}")  # closes at 1 key
+    blobs, _ = _blob_pair(dpf, cfg, [5])
+    with pytest.raises(ResourceExhaustedError, match="pending windows"):
+        stream.ingest(cfg.parameters, blobs, "b-over")
+    assert stream.stats_fields()["backpressure_rejections"] == 1
+    # Dedup acks still answer (no new work admitted, none refused) —
+    # including at the ADMISSION gate, so a lost-ack retry arriving
+    # through FrontDoor.submit during backpressure is acknowledged,
+    # never RESOURCE_EXHAUSTED for work the server already accepted
+    # (review catch).
+    stream.check_admission(batch_id="b-0")  # must not raise
+    blobs0, _ = _blob_pair(dpf, cfg, [0])
+    assert stream.ingest(cfg.parameters, blobs0, "b-0")[1] is True
+    stream.stop()
+
+
+def test_leader_crash_mid_window_resumes_exact(dpf, tmp_path):
+    """The leader's window advance killed mid-window (peer exchange dies
+    after level 0) resumes on a FRESH manager over the same journals:
+    verified levels replay (no re-walk — pinned by the advance spy), the
+    remaining levels run, and the published counts equal the batch
+    oracle exactly."""
+    cfg = _cfg("crash", window_keys=4)
+    follower = HeavyHitterStream(cfg, str(tmp_path / "f"))
+    leader = HeavyHitterStream(
+        cfg, str(tmp_path / "l"), peer=("127.0.0.1", 1),
+    )
+    values = [9, 9, 40, 9]
+    blobs0, blobs1 = _blob_pair(dpf, cfg, values)
+    leader.ingest(cfg.parameters, blobs0, "b-0", flush=True)
+    follower.ingest(cfg.parameters, blobs1, "b-0", flush=True)
+
+    calls = {"n": 0}
+    real_peer = lambda w, trail: follower.aggregate(
+        w.generation, list(w.batch_ids), trail
+    )
+
+    def dying_peer(w, trail):
+        if calls["n"] >= 1:
+            raise UnavailableError("UNAVAILABLE: chaos — peer died")
+        calls["n"] += 1
+        return real_peer(w, trail)
+
+    leader._peer_level = dying_peer
+    with pytest.raises(UnavailableError):
+        _drain_leader(leader)
+    assert leader.stats_fields()["windows_published"] == 0
+    leader.stop()
+
+    resumed = HeavyHitterStream(
+        cfg, str(tmp_path / "l"), peer=("127.0.0.1", 1),
+    )
+    _wired_pair(dpf, cfg, resumed, follower)
+    level_calls = []
+    orig = resumed._level_shares
+
+    def spy(ctx, level, prefixes):
+        level_calls.append(level)
+        return orig(ctx, level, prefixes)
+
+    resumed._level_shares = spy
+    _drain_leader(resumed)
+    snap = resumed.snapshot()
+    assert len(snap["published"]) == 1
+    w = snap["published"][0]
+    cnt = collections.Counter(values)
+    want = {v: c for v, c in cnt.items() if c >= cfg.threshold}
+    got = {int(p): int(c) for p, c in zip(w["prefixes"], w["counts"])}
+    assert got == want  # exact: nothing lost, nothing double-counted
+    assert 0 not in level_calls  # the journaled level 0 was NOT re-walked
+    # Rotation: the published window's journals are gone, the counter
+    # moved (the long-lived-server growth satellite).
+    assert resumed.stats_fields()["journals_rotated"] >= 2
+    import os
+
+    assert not os.path.exists(resumed._window_path(0))
+    assert not os.path.exists(resumed._ingest_path(0))
+    resumed.stop()
+    follower.stop()
+
+
+def test_follower_rotation_retires_consumed_generations(dpf, tmp_path):
+    """Follower-side rotation: serving generation g retires every peer
+    window below it (journals unlinked, membership compacted into
+    retired.jsonl) and fully-consumed ingest segments unlink too — while
+    dedup of retired batch ids SURVIVES a restart."""
+    import os
+
+    cfg = _cfg("rot", window_keys=2)
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    _, b0 = _blob_pair(dpf, cfg, [9, 9])
+    _, b1 = _blob_pair(dpf, cfg, [40, 9])
+    stream.ingest(cfg.parameters, b0, "b-0")  # closes segment 0
+    stream.ingest(cfg.parameters, b1, "b-1")  # closes segment 1
+    stream.aggregate(0, ["b-0"], [(0, [])])
+    assert os.path.exists(stream._window_path(0))
+    before = stream.stats_fields()["journals_rotated"]
+    stream.aggregate(1, ["b-1"], [(0, [])])  # retires window 0
+    assert not os.path.exists(stream._window_path(0))
+    assert not os.path.exists(stream._ingest_path(0))
+    assert stream.stats_fields()["journals_rotated"] > before
+    stream.stop()
+
+    resumed = HeavyHitterStream(cfg, str(tmp_path))
+    # b-0 lives only in retired.jsonl now — still deduped.
+    assert resumed.ingest(cfg.parameters, b0, "b-0")[1] is True
+    resumed.stop()
+
+
+def test_torn_retired_tail_never_welds_later_records(dpf, tmp_path):
+    """A crash mid-append leaves retired.jsonl with a torn tail; the
+    NEXT append must truncate back to the good prefix first — welding a
+    record onto the torn line would make one unparsable joined line
+    whose reload drops every later record, and with them the rotated
+    generations' dedup identity (review catch)."""
+    import os
+
+    cfg = _cfg("weld", window_keys=2)
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    _, b0 = _blob_pair(dpf, cfg, [9, 9])
+    _, b1 = _blob_pair(dpf, cfg, [40, 9])
+    stream.ingest(cfg.parameters, b0, "b-0")
+    stream.ingest(cfg.parameters, b1, "b-1")
+    stream.aggregate(0, ["b-0"], [(0, [])])
+    stream.aggregate(1, ["b-1"], [(0, [])])  # retires gen 0 -> lines
+    stream.stop()
+    path = os.path.join(stream.dir, "retired.jsonl")
+    with open(path, "ab") as f:
+        f.write(b'{"kind": "consumed", "generation')  # the torn tail
+
+    resumed = HeavyHitterStream(cfg, str(tmp_path))
+    # The next retirement append must truncate the torn tail first.
+    resumed._append_retired({"kind": "consumed", "generation": 9,
+                             "batch_ids": ["b-probe"]})
+    resumed.stop()
+    # ...and a second reload must still see EVERY record: the old
+    # rotated ids stay deduped and the new line parses.
+    final = HeavyHitterStream(cfg, str(tmp_path))
+    assert final.ingest(cfg.parameters, b0, "b-0")[1] is True
+    assert final.ingest(cfg.parameters, b1, "b-1")[1] is True
+    records = final._read_retired()
+    assert any(r.get("generation") == 9 for r in records)
+    assert all(r.get("kind") in ("consumed", "retired", "published")
+               for r in records)
+    final.stop()
+
+
+def test_follower_restart_does_not_orphan_served_windows(dpf, tmp_path):
+    """A follower restarted AFTER serving a window's final level but
+    BEFORE the leader's next-generation request must not orphan it: the
+    consumed line is durable at final-level serve (segments still
+    retire), and the next retire sweeps the orphaned window journal off
+    disk (review catch — the in-memory peer-window map is rebuilt
+    lazily, so the old retire loop never saw the served window)."""
+    import os
+
+    cfg = _cfg("orphan", window_keys=2)
+    n_levels = len(cfg.parameters)
+    stream = HeavyHitterStream(cfg, str(tmp_path))
+    _, b0 = _blob_pair(dpf, cfg, [9, 9])
+    _, b1 = _blob_pair(dpf, cfg, [40, 9])
+    stream.ingest(cfg.parameters, b0, "b-0")  # closes segment 0
+    stream.ingest(cfg.parameters, b1, "b-1")  # closes segment 1
+    # The full trail through the FINAL level: window 0 is complete.
+    trail = []
+    prefixes = []
+    for level in range(n_levels):
+        trail.append((level, list(prefixes)))
+        agg = stream.aggregate(0, ["b-0"], trail)
+        lds = cfg.parameters[level].log_domain_size
+        prev = 0 if level == 0 else cfg.parameters[level - 1].log_domain_size
+        cand = hierarchical.candidate_children(prefixes, prev, lds)
+        prefixes = [int(cand[i]) for i in np.nonzero(agg >= 1)[0]][:4]
+    # Serving the final level made b-0's consumption durable: segment 0
+    # already retired even though the leader never asked for gen 1.
+    assert not os.path.exists(stream._ingest_path(0))
+    stream.stop()
+
+    # Restart (the in-memory peer-window map is gone), then the leader
+    # moves on to generation 1: the orphaned window-0 journal sweeps.
+    resumed = HeavyHitterStream(cfg, str(tmp_path))
+    assert os.path.exists(resumed._window_path(0))
+    resumed.aggregate(1, ["b-1"], [(0, [])])
+    assert not os.path.exists(resumed._window_path(0))
+    # ...and b-0 stays deduped (consumed line reloaded).
+    assert resumed.ingest(cfg.parameters, b0, "b-0")[1] is True
+    resumed.stop()

@@ -280,6 +280,17 @@ def op_payloads():
         # Incremental parameters + per-level beta columns: the dealer-
         # offload request exercises multi-level value typing on the wire.
         "keygen": wire.encode_keygen(hp, [2, 9], [[1, 2], [3, 4], 5]),
+        # Streaming ops (ISSUE 15): ingest with DpfKey objects (the
+        # encoder serializes once), snapshot by name, aggregate with a
+        # two-entry level trail incl. a level-0 entry (explicit-0
+        # varint semantics).
+        "hh_ingest": wire.encode_hh_ingest(
+            "hh", hp, [hk0], "batch-7", flush=True
+        ),
+        "hh_snapshot": wire.encode_hh_snapshot("hh", since_generation=2),
+        "hh_aggregate": wire.encode_hh_aggregate(
+            "hh", 3, ["batch-7", "batch-9"], [(0, []), (1, [1, 3])]
+        ),
     }
 
 
@@ -307,6 +318,19 @@ def test_op_payload_reencode_is_byte_identical(op, op_payloads):
     elif op == "keygen":
         params, alphas, betas = wire.decode_keygen(payload)
         again = wire.encode_keygen(params, alphas, betas)
+    elif op == "hh_ingest":
+        params, blobs, stream, batch_id, flush = wire.decode_hh_ingest(
+            payload
+        )
+        again = wire.encode_hh_ingest(
+            stream, params, blobs, batch_id, flush=flush
+        )
+    elif op == "hh_snapshot":
+        stream, since = wire.decode_hh_snapshot(payload)
+        again = wire.encode_hh_snapshot(stream, since)
+    elif op == "hh_aggregate":
+        stream, gen, batch_ids, plan = wire.decode_hh_aggregate(payload)
+        again = wire.encode_hh_aggregate(stream, gen, batch_ids, plan)
     else:
         params, keys, plan, group = wire.decode_hierarchical(payload)
         again = wire.encode_hierarchical(params, keys, plan, group)
@@ -345,3 +369,20 @@ def test_payloads_reject_missing_fields():
         wire.decode_pir(b"")
     with pytest.raises(InvalidArgumentError):
         wire.decode_hierarchical(b"")
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_hh_ingest(b"")
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_hh_snapshot(b"")
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_hh_aggregate(b"")
+
+
+def test_json_result_arrays_round_trip():
+    """The hh_snapshot response form: a JSON body as one uint8 result
+    array, exact at any integer width (counts are decimal strings)."""
+    body = {"published": [{"prefixes": [str((1 << 80) + 3)], "counts":
+                           ["12"]}], "pending_windows": 0}
+    back = wire.json_from_arrays(wire.json_result_arrays(body))
+    assert back == body
+    with pytest.raises(DataLossError):
+        wire.json_from_arrays([])

@@ -750,6 +750,441 @@ def wire_main(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Stream mode (ISSUE 15): windowed ingestion, follower kill mid-window
+# ---------------------------------------------------------------------------
+
+#: the soak stream: 12-bit values, 2 bits/level (6 hierarchy levels),
+#: windows of 16 keys, at most 2 closed-unpublished windows before
+#: ingests shed RESOURCE_EXHAUSTED.
+STREAM_SPEC = "hh:12:2:4:16:2"
+STREAM_THRESHOLD = 4
+STREAM_WINDOW_KEYS = 16
+STREAM_PENDING = 2
+STREAM_KEYS_PER_BATCH = 3
+
+
+def stream_main(args) -> int:
+    """The streaming heavy-hitters soak (ISSUE 15): two real server
+    subprocesses — party 1 the follower, party 0 the aggregation leader
+    (``--stream-peer``) — a seeded client fleet uploading key batches
+    over loopback, and the PARTY-1 SERVER SIGKILLED MID-WINDOW and
+    restarted on the same port + journal dir. Asserts:
+
+      1. **exact counts**: every published window's heavy-hitter
+         prefixes and counts EQUAL the batch oracle over exactly that
+         window's accepted batches, and the union of published window
+         memberships is every uploaded batch EXACTLY ONCE — no lost and
+         no double-counted keys through the kill/restart;
+      2. **durable ingestion**: the follower's journal reload carries
+         its accepted batches across the SIGKILL (accepted count never
+         moves backward), with the kill landing while its open window
+         held keys;
+      3. **retry budget across the restart**: >= 1 client
+         reconnect/retry is recorded during the kill phase while zero
+         uploads are lost (the PR 10 budget carries ingest calls over
+         the dead server);
+      4. **backpressure**: with the follower down the leader's advance
+         stalls, pending windows hit the bound, an ingest is refused
+         RESOURCE_EXHAUSTED, and the SAME batch retried after the
+         restart is accepted (retried to success).
+
+    engine=host everywhere: the full wire/journal/window path with zero
+    XLA programs and zero pallas configs (the wire-soak discipline)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from distributed_point_functions_tpu.core.dpf import (
+        DistributedPointFunction,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.protos import serialization as ser
+    from distributed_point_functions_tpu.serving import (
+        DpfClient,
+        ReplicaPool,
+        RetryPolicy,
+        TwoServerClient,
+    )
+    from distributed_point_functions_tpu.utils import telemetry
+    from distributed_point_functions_tpu.utils.errors import (
+        ResourceExhaustedError,
+    )
+
+    bits, bpl = 12, 2
+    params = [
+        DpfParameters(lds, Int(64)) for lds in range(bpl, bits + 1, bpl)
+    ]
+    dpf = DistributedPointFunction.create_incremental(params)
+    n_levels = len(params)
+    rng = np.random.default_rng(args.seed)
+    hot = [int(v) for v in rng.integers(0, 1 << bits, size=3)]
+
+    def draw_batch():
+        # Skewed draw: hot values cross the per-window threshold, noise
+        # stays under it.
+        pool = hot * 3 + [int(v) for v in rng.integers(0, 1 << bits, size=4)]
+        idx = rng.integers(0, len(pool), size=STREAM_KEYS_PER_BATCH)
+        return [pool[i] for i in idx]
+
+    def key_pair_for(vals):
+        keys0, keys1 = [], []
+        for v in vals:
+            k0, k1 = dpf.generate_keys_incremental(v, [1] * n_levels)
+            keys0.append(k0)
+            keys1.append(k1)
+        return keys0, keys1
+
+    tmp = tempfile.mkdtemp(prefix="dpf-stream-soak-")
+    pools = [None, None]
+    failures = []
+    batch_values = {}
+    values_lock = threading.Lock()
+    t_start = time.perf_counter()
+    policy = RetryPolicy(
+        attempts=6, base_backoff=0.1, max_backoff=1.0,
+        attempt_timeout=20.0, connect_attempts=160, connect_backoff=0.25,
+        seed=args.seed,
+    )
+    try:
+        # ---- follower first (the leader's --stream-peer needs its port)
+        pools[1] = ReplicaPool(
+            replicas=1,
+            server_args=["--engine", "host", "--max-wait-ms", "2",
+                         "--stream", STREAM_SPEC],
+            base_dir=os.path.join(tmp, "party1"),
+            journal_base=os.path.join(tmp, "journal1"),
+        )
+        pools[1].start()
+        follower_port = pools[1].ports[0]
+        pools[0] = ReplicaPool(
+            replicas=1,
+            server_args=["--engine", "host", "--max-wait-ms", "2",
+                         "--stream", STREAM_SPEC,
+                         "--stream-peer", f"127.0.0.1:{follower_port}"],
+            base_dir=os.path.join(tmp, "party0"),
+            journal_base=os.path.join(tmp, "journal0"),
+        )
+        pools[0].start()
+        endpoints = [("127.0.0.1", pools[0].ports[0]),
+                     ("127.0.0.1", follower_port)]
+        print(f"stream soak: leader pid={pools[0].procs[0].pid} "
+              f"port={endpoints[0][1]}, follower pid={pools[1].procs[0].pid} "
+              f"port={follower_port}, tmp={tmp}")
+
+        warm = TwoServerClient(endpoints, policy=policy)
+        warm.wait_ready(timeout=180)
+
+        # ---- warm window: one batch + flush, wait for the publish ----
+        vals = draw_batch()
+        batch_values["warm-0"] = vals
+        warm.hh_ingest("hh", params, key_pair_for(vals), "warm-0",
+                       flush=True, deadline=120.0)
+        t_end = time.perf_counter() + 120
+        while time.perf_counter() < t_end:
+            snap = warm.clients[0].hh_snapshot("hh", deadline=10.0)
+            if snap["published"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("warm window never published")
+        print(f"stream soak: warm window published in "
+              f"{time.perf_counter() - t_start:.1f}s from start")
+        warm.close()
+
+        # ---- seeded client fleet + mid-window follower kill ----------
+        n_threads = args.stream_threads
+        per_thread = args.stream_batches
+        # Pre-draw every batch AND its key pair on the main thread: the
+        # schedule is a pure function of --seed regardless of thread
+        # interleaving, and — the exactly-once contract's client half —
+        # a RETRIED batch must resend the SAME key material (a re-keygen
+        # under a deduping batch id would leave the two parties holding
+        # non-complementary shares: the leader keeps the first attempt's
+        # party-0 keys while the follower accepts the retry's party-1
+        # keys, and every reconstructed count turns to noise — found by
+        # this soak's first run).
+        schedule = {}
+        batch_pairs = {}
+        for t in range(n_threads):
+            for i in range(per_thread):
+                bid = f"t{t}-b{i}"
+                v = draw_batch()
+                schedule[bid] = v
+                batch_values[bid] = v
+                batch_pairs[bid] = key_pair_for(v)
+        ingested = [0]
+        phase_deadline = time.perf_counter() + 420
+
+        def _worker(t_index):
+            client = TwoServerClient(endpoints, policy=policy)
+            try:
+                for i in range(per_thread):
+                    bid = f"t{t_index}-b{i}"
+                    pair = batch_pairs[bid]
+                    while time.perf_counter() < phase_deadline:
+                        try:
+                            client.hh_ingest("hh", params, pair, bid,
+                                             deadline=30.0)
+                            with values_lock:
+                                ingested[0] += 1
+                            break
+                        except Exception:  # noqa: BLE001 — keep trying
+                            time.sleep(0.2)
+                    else:
+                        with values_lock:
+                            failures.append(f"{bid}: never accepted")
+                        return
+            finally:
+                client.close()
+
+        kill_stats = {}
+        with telemetry.capture(ring=16384) as cap:
+            workers = [
+                threading.Thread(target=_worker, args=(t,), daemon=True)
+                for t in range(n_threads)
+            ]
+            for w in workers:
+                w.start()
+
+            # Kill the follower MID-WINDOW: wait for real load, then for
+            # a snapshot showing keys accepted into its open window.
+            probe1 = DpfClient("127.0.0.1", follower_port, policy=policy)
+            total = n_threads * per_thread
+            killed = False
+            t_end = time.perf_counter() + 180
+            while time.perf_counter() < t_end and not killed:
+                with values_lock:
+                    done = ingested[0]
+                if done < max(2, total // 3):
+                    time.sleep(0.02)
+                    continue
+                try:
+                    snap1 = probe1.hh_snapshot("hh", deadline=5.0)
+                except Exception:  # noqa: BLE001 — busy: keep polling
+                    time.sleep(0.05)
+                    continue
+                if snap1["open"]["keys"] > 0:
+                    kill_stats["before"] = snap1["stats"]
+                    pools[1].kill(0)
+                    killed = True
+            probe1.close()
+            if not killed:
+                failures.append("follower kill window never found "
+                                "(no mid-window snapshot)")
+            else:
+                print(f"stream soak: SIGKILLed follower mid-window "
+                      f"(open window held {snap1['open']['keys']} keys, "
+                      f"{kill_stats['before']['accepted_batches']} batches "
+                      "accepted)")
+
+                # -- with the follower down, the leader's advance stalls:
+                # closed windows accumulate to the pending bound and an
+                # ingest is refused RESOURCE_EXHAUSTED (the backpressure
+                # contract). attempts=1: observe the raw refusal.
+                shed_probe = DpfClient(
+                    "127.0.0.1", endpoints[0][1],
+                    policy=RetryPolicy(attempts=1, connect_attempts=10,
+                                       connect_backoff=0.1, seed=args.seed),
+                )
+                backpressured = None
+                for i in range(STREAM_PENDING + 4):
+                    bid = f"probe-{i}"
+                    vals = draw_batch()
+                    pair = key_pair_for(vals)
+                    batch_pairs[bid] = pair
+                    try:
+                        shed_probe.hh_ingest(
+                            "hh", params, pair[0], bid, flush=True,
+                            deadline=20.0,
+                        )
+                        batch_values[bid] = vals
+                        schedule[bid] = vals
+                    except ResourceExhaustedError:
+                        backpressured = (bid, vals, pair)
+                        break
+                    except Exception as exc:  # noqa: BLE001
+                        failures.append(
+                            f"shed probe {bid}: unexpected "
+                            f"{type(exc).__name__}: {exc}"
+                        )
+                        break
+                shed_probe.close()
+                if backpressured is None:
+                    failures.append(
+                        "backpressure never observed: leader accepted "
+                        f"{STREAM_PENDING + 4} flush batches with its "
+                        "peer down"
+                    )
+                else:
+                    print(f"stream soak: {backpressured[0]} refused "
+                          "RESOURCE_EXHAUSTED at the pending-window bound")
+
+                pools[1].restart(0)  # same port + journal dir
+                print("stream soak: follower restarted")
+
+                # Probe batches were leader-only: deliver them (and the
+                # refused one) to BOTH parties now — the leader dedups,
+                # the follower ingests fresh; the refused batch is the
+                # "RESOURCE_EXHAUSTED retried to success" arm.
+                repair = TwoServerClient(endpoints, policy=policy)
+                try:
+                    todo = [
+                        (bid, v) for bid, v in schedule.items()
+                        if bid.startswith("probe-")
+                    ]
+                    if backpressured is not None:
+                        bid, vals, _ = backpressured
+                        batch_values[bid] = vals
+                        schedule[bid] = vals
+                        todo.append((bid, vals))
+                    for bid, vals in todo:
+                        t_retry = time.perf_counter() + 120
+                        while True:
+                            try:
+                                # The SAME key pair as the first attempt
+                                # (the client half of exactly-once).
+                                repair.hh_ingest(
+                                    "hh", params, batch_pairs[bid], bid,
+                                    deadline=30.0,
+                                )
+                                break
+                            except Exception:  # noqa: BLE001
+                                if time.perf_counter() > t_retry:
+                                    failures.append(
+                                        f"{bid}: never accepted after "
+                                        "restart"
+                                    )
+                                    break
+                                time.sleep(0.25)
+                finally:
+                    repair.close()
+
+            for w in workers:
+                w.join(timeout=480)
+            if any(w.is_alive() for w in workers):
+                failures.append("worker threads never finished")
+            snap_kill = cap.snapshot()
+
+        retries = _counter_sum(snap_kill, "rpc.client.retries")
+        reconnects = _counter_sum(snap_kill, "rpc.client.reconnects")
+        print(f"stream soak: kill phase client retries={retries:.0f} "
+              f"reconnects={reconnects:.0f}")
+        if killed and retries + reconnects < 1:
+            failures.append(
+                "no client retry/reconnect recorded across the follower "
+                "restart — the retry budget carried nothing"
+            )
+
+        # ---- drain: flush, wait until EVERY batch publishes ----------
+        fin = TwoServerClient(endpoints, policy=policy)
+        try:
+            fin.wait_ready(timeout=120)
+            all_ids = set(batch_values)
+            t_end = time.perf_counter() + 300
+            snap = None
+            while time.perf_counter() < t_end:
+                try:
+                    fin.hh_ingest("hh", params, ([], []), "", flush=True,
+                                  deadline=30.0)
+                    snap = fin.clients[0].hh_snapshot("hh", deadline=10.0)
+                except Exception:  # noqa: BLE001 — drain keeps trying
+                    time.sleep(0.25)
+                    continue
+                done = {
+                    b for w in snap["published"] for b in w["batch_ids"]
+                }
+                if done == all_ids and snap["pending_windows"] == 0:
+                    break
+                time.sleep(0.25)
+            else:
+                missing = all_ids - {
+                    b for w in (snap or {"published": []})["published"]
+                    for b in w["batch_ids"]
+                }
+                failures.append(
+                    f"drain timeout: {len(missing)} batches never "
+                    f"published (e.g. {sorted(missing)[:4]})"
+                )
+
+            if snap is not None:
+                # -- the acceptance assertion: per-window EXACT equality
+                # with the batch oracle + exactly-once membership.
+                seen = []
+                for w in snap["published"]:
+                    seen.extend(w["batch_ids"])
+                    vals = [
+                        v for b in w["batch_ids"] for v in batch_values[b]
+                    ]
+                    import collections as _c
+
+                    cnt = _c.Counter(vals)
+                    want = {
+                        v: c for v, c in cnt.items()
+                        if c >= STREAM_THRESHOLD
+                    }
+                    got = {
+                        int(p): int(c)
+                        for p, c in zip(w["prefixes"], w["counts"])
+                    }
+                    if got != want:
+                        failures.append(
+                            f"window {w['generation']}: published "
+                            f"{got} != oracle {want}"
+                        )
+                if sorted(seen) != sorted(batch_values):
+                    dup = len(seen) - len(set(seen))
+                    failures.append(
+                        f"membership not exactly-once: {dup} duplicates, "
+                        f"{len(set(batch_values) - set(seen))} missing"
+                    )
+                stats0 = fin.clients[0].stats()["streams"]["hh"]
+                if killed and stats0["backpressure_rejections"] < 1:
+                    failures.append(
+                        "leader never counted a backpressure rejection"
+                    )
+                if killed:
+                    stats1 = fin.clients[1].hh_snapshot(
+                        "hh", deadline=10.0
+                    )["stats"]
+                    if (
+                        stats1["accepted_batches"]
+                        < kill_stats["before"]["accepted_batches"]
+                    ):
+                        failures.append(
+                            "follower journal reload lost batches: "
+                            f"{stats1['accepted_batches']} accepted after "
+                            "restart < "
+                            f"{kill_stats['before']['accepted_batches']} "
+                            "before the kill"
+                        )
+                print(
+                    f"stream soak: {len(snap['published'])} windows "
+                    f"published, {len(batch_values)} batches x "
+                    f"{STREAM_KEYS_PER_BATCH} keys, leader stats {stats0}"
+                )
+        finally:
+            fin.close()
+    finally:
+        for pool in pools:
+            if pool is not None:
+                pool.stop()
+        if not failures:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    total = time.perf_counter() - t_start
+    if failures:
+        print(f"stream soak: FAIL in {total:.1f}s (logs kept in {tmp}):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"stream soak: PASS in {total:.1f}s")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # Fleet mode (ISSUE 14): replica pools behind FleetProxy, kill + rehash
 # ---------------------------------------------------------------------------
 
@@ -990,7 +1425,15 @@ def main() -> int:
                     help="replicas per party in --fleet mode")
     ap.add_argument("--fleet-requests", type=int, default=480)
     ap.add_argument("--fleet-threads", type=int, default=6)
+    ap.add_argument("--stream", action="store_true",
+                    help="streaming heavy-hitters soak: windowed "
+                    "ingestion + follower kill mid-window (ISSUE 15)")
+    ap.add_argument("--stream-batches", type=int, default=12,
+                    help="ingest batches per client thread in --stream")
+    ap.add_argument("--stream-threads", type=int, default=3)
     args = ap.parse_args()
+    if args.stream:
+        return stream_main(args)
     if args.fleet:
         return fleet_main(args)
     if args.wire:

@@ -45,6 +45,7 @@ THREADED_MODULES = (
     f"{PACKAGE}/serving/batcher.py",
     f"{PACKAGE}/serving/server.py",
     f"{PACKAGE}/serving/fleet.py",
+    f"{PACKAGE}/serving/streaming.py",
 )
 
 _LOCK_CTORS = {
