@@ -16,7 +16,12 @@ drives the advance against the follower over the real wire):
   concurrent uploading clients (keys pre-generated: the client keygen
   cost is PR 13's record, not re-measured here);
 * ``publish`` — wall from the final flush to every window published
-  (the level-by-level advance + peer exchange for the whole backlog).
+  (the level-by-level advance + peer exchange for the whole backlog);
+* ``failover`` (ISSUE 16) — the leader is stopped WITHOUT releasing its
+  lease (the crash shape), the ex-leader restarts as a demoted
+  follower, and the wall from the kill to (a) the follower's lease
+  promotion and (b) the first post-flip publish of the backlog window
+  is measured against the lease TTL.
 
 CPU-only (the host-engine advance is the production default; the
 hierkernel arm stays staged-for-tunnel behind the stream's mode knob).
@@ -39,6 +44,129 @@ def smoke_shrink(smoke: bool) -> bool:
     """CPU smoke runs shrink the batch count; the record is tagged by
     run_bench either way."""
     return smoke
+
+
+def _bench_failover(serving, dpf, bits, bpl, n_levels, lease_ttl):
+    """Measures the ISSUE 16 failover path: leader crash (lease NOT
+    released), ex-leader restarted as a demoted follower on the same
+    port + journals, the follower promoted by lease expiry, and the
+    backlog window published under the new epoch. Returns
+    (promote_wall_s, first_post_flip_publish_wall_s), both from the
+    kill."""
+    cfg = serving.StreamConfig.bitwise(
+        "flip", bits, bpl, threshold=8, window_keys=16,
+        max_pending_windows=1 << 30,
+    )
+    tmp = tempfile.mkdtemp(prefix="dpf-bench-failover-")
+    lease_dir = os.path.join(tmp, "lease")
+    policy = serving.RetryPolicy(
+        attempts=8, base_backoff=0.05, max_backoff=0.5,
+        connect_attempts=80, connect_backoff=0.1, seed=0,
+    )
+
+    f_stream = serving.HeavyHitterStream(
+        cfg, os.path.join(tmp, "p1"), role="follower",
+        lease_dir=lease_dir, lease_ttl=lease_ttl, owner="bench-p1",
+    )
+    f_srv = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    f_srv.register_stream(f_stream)
+    f_srv.start()
+    l_srv = serving.DpfServer(engine="host", max_wait_ms=1.0)
+    l_stream = serving.HeavyHitterStream(
+        cfg, os.path.join(tmp, "p0"), peer=("127.0.0.1", f_srv.port),
+        lease_dir=lease_dir, lease_ttl=lease_ttl, owner="bench-p0",
+    )
+    l_srv.register_stream(l_stream)
+    l_srv.start()
+    # The follower's promotion legs need the leader's endpoint, which
+    # only exists now (both sides of an in-process pair cannot name
+    # each other before either binds). start() is re-entrant: with the
+    # peer known it starts the advance worker a promoted follower
+    # drives.
+    f_stream.peer = ("127.0.0.1", l_srv.port)
+    f_stream.start()
+
+    def _keys(vals):
+        k0s, k1s = [], []
+        for v in vals:
+            k0, k1 = dpf.generate_keys_incremental(int(v), [1] * n_levels)
+            k0s.append(k0)
+            k1s.append(k1)
+        return k0s, k1s
+
+    endpoints = [("127.0.0.1", l_srv.port), ("127.0.0.1", f_srv.port)]
+    client = serving.TwoServerClient(endpoints, policy=policy)
+    client.wait_ready(timeout=60)
+    rng = np.random.default_rng(16)
+    # Warm window: the full publish path is live before the kill.
+    client.hh_ingest("flip", cfg.parameters, _keys([1] * 9), "warm",
+                     flush=True, deadline=60.0)
+    deadline = time.perf_counter() + 60
+    while time.perf_counter() < deadline:
+        if client.clients[1].hh_snapshot("flip", deadline=10.0)["published"]:
+            break
+        time.sleep(0.02)
+    else:
+        raise RuntimeError("failover arm: warm window never published")
+    # The backlog: 12 of 16 window keys — the window stays OPEN, so the
+    # dying leader cannot publish it early; the post-flip flush closes
+    # it under the new leader.
+    for i in range(3):
+        vals = [int(v) for v in rng.integers(0, 1 << bits, size=4)]
+        client.hh_ingest("flip", cfg.parameters, _keys(vals), f"flip-{i}",
+                         deadline=60.0)
+
+    t_kill = time.perf_counter()
+    l_stream.release_on_stop = False  # the crash shape: lease left held
+    l_srv.stop()
+
+    promote_s = None
+    deadline = time.perf_counter() + 60
+    while time.perf_counter() < deadline:
+        if f_stream.role == "leader":
+            promote_s = time.perf_counter() - t_kill
+            break
+        time.sleep(0.005)
+    if promote_s is None:
+        raise RuntimeError("failover arm: follower never promoted")
+
+    # The ex-leader returns on the same port + journals once the flip
+    # is decided (restarting inside the expiry window would race the
+    # follower for the lease); boot arbitration finds the promoted
+    # leader's live lease and demotes it to follower.
+    l_srv2 = serving.DpfServer(engine="host", max_wait_ms=1.0,
+                               port=endpoints[0][1])
+    l_srv2.register_stream(serving.HeavyHitterStream(
+        cfg, os.path.join(tmp, "p0"), peer=("127.0.0.1", f_srv.port),
+        lease_dir=lease_dir, lease_ttl=lease_ttl, owner="bench-p0r",
+    ))
+    l_srv2.start()
+
+    flip_publish_s = None
+    fin = serving.TwoServerClient(endpoints, policy=policy)
+    deadline = time.perf_counter() + 120
+    while time.perf_counter() < deadline:
+        try:
+            fin.hh_ingest("flip", cfg.parameters, ([], []), "",
+                          flush=True, deadline=30.0)
+            snap = fin.clients[1].hh_snapshot("flip", deadline=10.0)
+        except Exception:  # noqa: BLE001 — restart settling
+            time.sleep(0.02)
+            continue
+        done = {b for w in snap["published"] for b in w["batch_ids"]}
+        if "flip-0" in done:
+            flip_publish_s = time.perf_counter() - t_kill
+            break
+        time.sleep(0.005)
+    fin.close()
+    client.close()
+    f_srv.stop()
+    l_srv2.stop()
+    if flip_publish_s is None:
+        raise RuntimeError(
+            "failover arm: backlog window never published post-flip"
+        )
+    return promote_s, flip_publish_s
 
 
 def bench_streaming(jax, smoke):
@@ -154,6 +282,16 @@ def bench_streaming(jax, smoke):
         f"{n_threads} clients) acked in {t_ingest.elapsed:.2f}s = "
         f"{ingest_rate:.0f} keys/s; publish drain {t_publish.elapsed:.2f}s "
         f"for {stats['windows_published']} windows")
+
+    # ---- failover arm (ISSUE 16): leader kill -> lease flip ----------
+    lease_ttl = float(os.environ.get("BENCH_STREAM_LEASE_TTL", 0.5))
+    promote_s, flip_publish_s = _bench_failover(
+        serving, dpf, bits, bpl, n_levels, lease_ttl
+    )
+    log(f"failover: lease ttl={lease_ttl:.2f}s, follower promoted "
+        f"{promote_s:.2f}s after the kill, first post-flip publish at "
+        f"{flip_publish_s:.2f}s (full backlog window: reconcile + "
+        "restart + advance)")
     return {
         "bench": "streaming_ingest",
         "value": round(ingest_rate, 1),
@@ -170,6 +308,9 @@ def bench_streaming(jax, smoke):
         "publish_drain_s": t_publish.elapsed,
         "windows_published": stats["windows_published"],
         "journals_rotated": stats["journals_rotated"],
+        "failover_lease_ttl_s": lease_ttl,
+        "failover_promote_s": round(promote_s, 3),
+        "failover_first_publish_s": round(flip_publish_s, 3),
         "engine": "host",
         "notes": (
             "write path is journal-fsync-per-batch by contract; the "

@@ -136,8 +136,14 @@ run_faults() {
   # counts EXACTLY equal the batch oracle (exactly-once membership: no
   # lost, no double-counted keys), journal reload across the kill,
   # >= 1 retry carried by the client budget, and the backpressure path
-  # (RESOURCE_EXHAUSTED refused, retried to success). Bounded, loopback,
-  # XLA:CPU, host-engine advance — zero pallas configs.
+  # (RESOURCE_EXHAUSTED refused, retried to success). ISSUE 16 rides the
+  # same flag with two more arms: the LEADER SIGKILLed (the follower
+  # promotes itself by lease within ~TTL, a superseded-epoch zombie leg
+  # is refused FAILED_PRECONDITION, seeded beta!=1 poison batches are
+  # quarantined on both parties) and a fleet-sheltered stream (the
+  # owning replica over a shared --stream-journal-root SIGKILLed, the
+  # survivor re-homes by ownership lease, exactly-once intact). Bounded,
+  # loopback, XLA:CPU, host-engine advance — zero pallas configs.
   JAX_PLATFORMS=cpu python tools/chaos_soak.py --stream --seed 7 \
     --stream-batches 12 --stream-threads 3
 }

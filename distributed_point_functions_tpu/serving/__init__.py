@@ -26,6 +26,7 @@ from .client import (  # noqa: F401
 )
 from .fleet import FleetProxy, ReplicaPool  # noqa: F401
 from .frontdoor import FrontDoor  # noqa: F401
+from .lease import LeaseState, StreamLease  # noqa: F401
 from .server import DpfServer  # noqa: F401
 from .streaming import (  # noqa: F401
     HeavyHitterStream,

@@ -501,6 +501,28 @@ class DpfClient:
         )
         return wire.json_from_arrays(arrays)
 
+    def hh_aggregate(
+        self, stream: str, generation: int, batch_ids: Sequence[str],
+        plan, epoch: int = 0, publish: Optional[dict] = None,
+        audit: bool = False, quarantine: Sequence[str] = (),
+        deadline: Optional[float] = None, **kw,
+    ) -> np.ndarray:
+        """One hh_aggregate leg (normally server-to-server — the leader's
+        advance worker drives this; exposed here for tooling and the
+        chaos soak's zombie-fence probe). `epoch` is the sender's lease
+        epoch: in a lease-failover deployment a stale epoch is rejected
+        with FAILED_PRECONDITION, which this client never retries."""
+        arrays = self.call(
+            "hh_aggregate",
+            wire.encode_hh_aggregate(
+                stream, generation, list(batch_ids), plan,
+                epoch=epoch, publish=publish, audit=audit,
+                quarantine=quarantine,
+            ),
+            deadline=deadline, **kw,
+        )
+        return np.asarray(arrays[0], dtype=np.uint64)
+
     def keygen(
         self, parameters, alphas: Sequence[int], betas,
         deadline: Optional[float] = None, **kw,

@@ -804,6 +804,7 @@ class ReplicaPool:
         base_dir: Optional[str] = None,
         platform: str = "cpu",
         journal_base: Optional[str] = None,
+        stream_journal_root: Optional[str] = None,
     ):
         if replicas is None:
             replicas = envflags.env_int("DPF_TPU_FLEET_REPLICAS", 3)
@@ -813,6 +814,11 @@ class ReplicaPool:
         self.server_args = list(server_args)
         self.platform = platform
         self.journal_base = journal_base
+        #: ONE directory shared by every replica (ISSUE 16, deliberately
+        #: NOT per-replica suffixed like journal_base): fleet-sheltered
+        #: streams re-home to a survivor by re-acquiring the per-stream
+        #: ownership lease inside this volume and resuming its journals.
+        self.stream_journal_root = stream_journal_root
         if base_dir is None:
             import tempfile
 
@@ -850,6 +856,8 @@ class ReplicaPool:
         if self.journal_base is not None:
             cmd += ["--journal-dir",
                     os.path.join(self.journal_base, f"replica{i}")]
+        if self.stream_journal_root is not None:
+            cmd += ["--stream-journal-root", self.stream_journal_root]
         env = dict(os.environ, JAX_PLATFORMS=self.platform)
         with open(self._logs[i], "ab") as log:
             self.procs[i] = subprocess.Popen(
@@ -967,6 +975,12 @@ def main(argv=None) -> int:
                     help="ready-file/log directory (default: a tmp dir)")
     ap.add_argument("--journal-base", default=None,
                     help="per-replica journal dirs under this path")
+    ap.add_argument("--stream-journal-root", default=None,
+                    help="SHARED stream journal volume for fleet-"
+                    "sheltered heavy-hitter streams (ISSUE 16): one "
+                    "directory for ALL replicas; per-stream ownership "
+                    "leases re-home a killed replica's streams to a "
+                    "survivor")
     ap.add_argument("--ready-file", default=None,
                     help="write '<port>\\n' here once the proxy listens")
     args, server_args = ap.parse_known_args(argv)
@@ -977,6 +991,7 @@ def main(argv=None) -> int:
         replicas=args.replicas, server_args=server_args,
         base_dir=args.base_dir, platform=args.platform,
         journal_base=args.journal_base,
+        stream_journal_root=args.stream_journal_root,
     )
     proxy = None
     try:

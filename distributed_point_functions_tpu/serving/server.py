@@ -535,11 +535,15 @@ class DpfServer:
             return wire.json_result_arrays(
                 stream.snapshot(since_generation=since)
             )
-        stream_name, generation, batch_ids, plan = wire.decode_hh_aggregate(
-            payload
+        stream_name, generation, batch_ids, plan, extras = (
+            wire.decode_hh_aggregate(payload)
         )
         stream = self._stream_for(stream_name)
-        agg = stream.aggregate(generation, batch_ids, plan)
+        agg = stream.aggregate(
+            generation, batch_ids, plan,
+            epoch=extras["epoch"], publish=extras["publish"],
+            audit=extras["audit"], quarantine=extras["quarantine"],
+        )
         return [np.asarray(agg, dtype=np.uint64)]
 
     def _build_request(self, op: str, payload: bytes) -> Request:
@@ -666,12 +670,33 @@ def main(argv=None) -> int:
     # (serves hh_aggregate). Streams require --journal-dir: journaled
     # exactly-once window accounting is the tier's contract.
     ap.add_argument("--stream", action="append", default=[],
-                    metavar="NAME:BITS:BPL:THRESHOLD:WINDOW[:PENDING]",
+                    metavar="NAME:BITS:BPL:THRESHOLD:WINDOW"
+                    "[:PENDING[:audit]]",
                     help="register a heavy-hitter stream (requires "
-                    "--journal-dir)")
+                    "--journal-dir or --stream-journal-root)")
     ap.add_argument("--stream-peer", default=None, metavar="HOST:PORT",
                     help="peer party endpoint: this server becomes the "
                     "stream aggregation leader")
+    # ISSUE 16: leader failover + fleet-sheltered streams.
+    ap.add_argument("--stream-follower-of", default=None,
+                    metavar="HOST:PORT",
+                    help="peer party endpoint, but boot as the FOLLOWER: "
+                    "the failover shape — this server promotes itself by "
+                    "lease when the leader's lease expires (requires "
+                    "--stream-lease-root)")
+    ap.add_argument("--stream-lease-root", default=None, metavar="DIR",
+                    help="role-lease directory shared by both parties: "
+                    "epoch-numbered TTL-renewed leader lease (failover + "
+                    "zombie fencing)")
+    ap.add_argument("--stream-lease-ttl", type=float, default=2.0,
+                    help="lease TTL seconds (renewed at ttl/3; a dead "
+                    "holder is superseded within ~ttl)")
+    ap.add_argument("--stream-journal-root", default=None, metavar="DIR",
+                    help="SHARED stream journal volume (fleet-sheltered "
+                    "streams): replicas arbitrate per-stream ownership "
+                    "by lease inside the stream directory, so a replica "
+                    "kill re-homes the stream to a survivor resuming "
+                    "from the same journals")
     ap.add_argument("--ready-file", default=None,
                     help="write '<port>\\n' here once listening (the "
                     "subprocess-orchestration handshake)")
@@ -728,15 +753,39 @@ def main(argv=None) -> int:
     if args.stream:
         from .streaming import HeavyHitterStream, parse_stream_spec
 
-        if not args.journal_dir:
-            ap.error("--stream requires --journal-dir (durable windows)")
+        if args.stream_peer and args.stream_follower_of:
+            ap.error("--stream-peer and --stream-follower-of are "
+                     "mutually exclusive (leader vs failover-follower)")
+        if args.stream_follower_of and not args.stream_lease_root:
+            ap.error("--stream-follower-of requires --stream-lease-root "
+                     "(the role is arbitrated by lease)")
+        if args.stream_journal_root and (
+            args.stream_peer or args.stream_follower_of
+            or args.stream_lease_root
+        ):
+            ap.error("--stream-journal-root (fleet-sheltered follower "
+                     "replica) excludes --stream-peer/"
+                     "--stream-follower-of/--stream-lease-root")
+        if not args.journal_dir and not args.stream_journal_root:
+            ap.error("--stream requires --journal-dir (durable windows) "
+                     "or --stream-journal-root (shared volume)")
+        peer_spec = args.stream_peer or args.stream_follower_of
         peer = None
-        if args.stream_peer:
-            host_part, _, port_part = args.stream_peer.rpartition(":")
+        if peer_spec:
+            host_part, _, port_part = peer_spec.rpartition(":")
             peer = (host_part or "127.0.0.1", int(port_part))
+        role = "follower" if args.stream_follower_of else None
+        owner = f"pid{os.getpid()}:{args.port or 0}"
         for spec in args.stream:
             server.register_stream(HeavyHitterStream(
-                parse_stream_spec(spec), args.journal_dir, peer=peer,
+                parse_stream_spec(spec),
+                args.stream_journal_root or args.journal_dir,
+                peer=peer,
+                role=role,
+                lease_dir=args.stream_lease_root,
+                lease_ttl=args.stream_lease_ttl,
+                owner=owner,
+                shared=args.stream_journal_root is not None,
             ))
     server.start()
     print(

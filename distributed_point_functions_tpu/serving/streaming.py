@@ -55,6 +55,41 @@ advance, zero device programs — pinned); ``engine="device"`` routes each
 advance through :func:`~..ops.supervisor.advance_level_robust`, so the
 hierkernel window advance stays staged-for-tunnel behind the same mode
 plumbing as every kernel since round 5.
+
+**Failover & robustness (ISSUE 16)** — three coupled layers on top:
+
+* **leader failover by lease** (``lease_dir=``): the role is no longer
+  fixed at construction — an epoch-numbered TTL-renewed
+  :class:`~.lease.StreamLease` file arbitrates it. The leader renews
+  from its lease watcher; the follower watches the same file and, when
+  the lease expires, bumps the epoch, flips role and drives the advance
+  itself. Every ``hh_aggregate`` leg carries the sender's epoch, so a
+  *zombie* ex-leader's stale requests are rejected with
+  ``FAILED_PRECONDITION`` — fenced, never merged. The one state a
+  follower lacks (the published log) is closed two ways: each publish
+  record replicates to the follower as a final per-window
+  ``hh_aggregate`` leg BEFORE the window's journals rotate, and a
+  freshly promoted leader *reconciles* (pulls the peer's published log)
+  before its first advance, so a crash between publish and replication
+  neither loses nor double-publishes a window — membership is filtered
+  against the union of published batch ids at advance time;
+* **fleet-sheltered streams** (``shared=True`` / server
+  ``--stream-journal-root``): replicas behind the PR 14 FleetProxy share
+  one journal volume, and a per-stream *ownership* lease inside the
+  stream directory guarantees exactly one replica loads/advances it.
+  A replica SIGKILL re-homes the stream to a survivor that acquires the
+  lease, reloads the same journals through the existing
+  fingerprint/resume machinery, and picks up mid-window — stream
+  handoff is journal-directory handoff;
+* **malicious-client audit** (``audit=True`` in the config / spec): a
+  per-batch share-consistency check before a batch enters window
+  membership — both parties reconstruct the batch's level-0 aggregate,
+  which for an honest batch of n one-hot keys sums to exactly n with no
+  cell above n. A failing batch is quarantined by batch id on BOTH
+  parties (durable ``retired.jsonl`` line, ``hh.quarantined`` counter,
+  IntegrityEvent), bounding a poisoning client's damage to its own
+  rejected batch. (This bounds per-batch mass; full malicious security
+  à la Poplar would add the sketching layer on top.)
 """
 
 from __future__ import annotations
@@ -66,6 +101,7 @@ import json
 import os
 import re
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -81,6 +117,7 @@ from ..utils.errors import (
     ResourceExhaustedError,
     UnavailableError,
 )
+from .lease import StreamLease
 
 
 @dataclasses.dataclass
@@ -105,6 +142,10 @@ class StreamConfig:
     #: device advance mode (None = env default; "hierkernel" is the
     #: staged-for-tunnel single-program window advance).
     mode: Optional[str] = None
+    #: per-batch share-consistency audit before window membership
+    #: (ISSUE 16): a batch whose level-0 aggregate does not reconstruct
+    #: to one-hot mass on BOTH parties is quarantined, not counted.
+    audit: bool = False
 
     def __post_init__(self):
         if not self.name or not re.fullmatch(r"[\w.-]+", self.name):
@@ -160,18 +201,28 @@ class StreamConfig:
 
 
 def parse_stream_spec(spec: str) -> StreamConfig:
-    """CLI form NAME:BITS:BITS_PER_LEVEL:THRESHOLD:WINDOW_KEYS[:PENDING]
+    """CLI form
+    NAME:BITS:BITS_PER_LEVEL:THRESHOLD:WINDOW_KEYS[:PENDING[:audit]]
     — the deterministic two-terminal quickstart shape (production
-    deployments construct StreamConfig directly)."""
+    deployments construct StreamConfig directly). The trailing literal
+    ``audit`` token switches the per-batch share-consistency audit on."""
     parts = spec.split(":")
-    if len(parts) not in (5, 6):
+    if len(parts) not in (5, 6, 7):
         raise InvalidArgumentError(
             f"--stream {spec!r}: want "
-            "NAME:BITS:BITS_PER_LEVEL:THRESHOLD:WINDOW_KEYS[:PENDING]"
+            "NAME:BITS:BITS_PER_LEVEL:THRESHOLD:WINDOW_KEYS"
+            "[:PENDING[:audit]]"
         )
     kw = {}
-    if len(parts) == 6:
+    if len(parts) >= 6:
         kw["max_pending_windows"] = int(parts[5])
+    if len(parts) == 7:
+        if parts[6] != "audit":
+            raise InvalidArgumentError(
+                f"--stream {spec!r}: the 7th field must be the literal "
+                f"'audit', got {parts[6]!r}"
+            )
+        kw["audit"] = True
     return StreamConfig.bitwise(
         parts[0], int(parts[1]), int(parts[2]), int(parts[3]),
         window_keys=int(parts[4]), **kw,
@@ -186,7 +237,7 @@ class _Window:
 
     __slots__ = (
         "generation", "journal", "batch_ids", "keys", "shas", "keys_total",
-        "closed",
+        "closed", "next_index",
     )
 
     def __init__(self, generation: int, journal):
@@ -197,6 +248,10 @@ class _Window:
         self.shas: Dict[str, str] = {}
         self.keys_total = 0
         self.closed = False
+        #: the next ChunkJournal record index — counts every journaled
+        #: entry, including quarantined batches the reload skips, so a
+        #: live append never collides with a skipped index.
+        self.next_index = 0
 
 
 class _PeerWindow:
@@ -250,6 +305,11 @@ class HeavyHitterStream:
         peer_policy=None,
         policy=None,
         peer_deadline: float = 30.0,
+        lease_dir: Optional[str] = None,
+        lease_ttl: float = 2.0,
+        role: Optional[str] = None,
+        owner: Optional[str] = None,
+        shared: bool = False,
     ):
         if not journal_dir:
             raise InvalidArgumentError(
@@ -259,7 +319,56 @@ class HeavyHitterStream:
         self.config = config
         self.dir = os.path.join(journal_dir, f"stream-{config.name}")
         self.peer = tuple(peer) if peer is not None else None
-        self.role = "leader" if self.peer is not None else "follower"
+        if role is not None and role not in ("leader", "follower"):
+            raise InvalidArgumentError(
+                f"stream role must be 'leader' or 'follower', got {role!r}"
+            )
+        self.role = role if role is not None else (
+            "leader" if self.peer is not None else "follower"
+        )
+        if self.role == "leader" and self.peer is None:
+            raise InvalidArgumentError(
+                "the aggregation leader needs a peer endpoint"
+            )
+        if (self.role == "follower" and self.peer is not None
+                and not lease_dir):
+            raise InvalidArgumentError(
+                "a follower with a peer endpoint is the failover shape — "
+                "it needs lease_dir to arbitrate the role by lease"
+            )
+        if shared:
+            if self.peer is not None:
+                raise InvalidArgumentError(
+                    "a fleet-sheltered (shared-journal) stream is a "
+                    "follower replica — it cannot also be an aggregation "
+                    "leader or failover party (peer=...)"
+                )
+            if lease_dir:
+                raise InvalidArgumentError(
+                    "shared-journal streams arbitrate by the per-stream "
+                    "ownership lease inside the stream directory; a role "
+                    "lease_dir does not apply"
+                )
+        self._owner_name = owner or f"pid{os.getpid()}-{id(self):x}"
+        #: the role lease (leader failover, ISSUE 16); None = the static
+        #: PR 15 single-pair shape.
+        self._lease = (
+            StreamLease(
+                os.path.join(lease_dir, f"stream-{config.name}.lease"),
+                self._owner_name, ttl=lease_ttl,
+            ) if lease_dir else None
+        )
+        #: the ownership lease (fleet-sheltered shared journals); lives
+        #: INSIDE the stream dir so it travels with the journal volume.
+        self._owner_lease = (
+            StreamLease(
+                os.path.join(self.dir, "owner.lease"),
+                self._owner_name, ttl=lease_ttl,
+            ) if shared else None
+        )
+        #: False simulates SIGKILL in tests/benchmarks: stop() keeps the
+        #: lease so the peer must wait out the TTL like a real crash.
+        self.release_on_stop = True
         self._peer_policy = peer_policy
         self._peer_deadline = float(peer_deadline)
         self._policy = policy
@@ -275,6 +384,37 @@ class HeavyHitterStream:
         self._consumed: set = set()
         self._peer_windows: Dict[int, _PeerWindow] = {}
         self._published: List[dict] = []
+        #: union of batch ids across every published record (own,
+        #: replicated, or adopted at reconcile) — the exactly-once spine
+        #: the failover advance filters membership against.
+        self._published_bids: set = set()
+        #: publish records not yet acknowledged by the peer — drained by
+        #: the advance loop; a window's journals only matter locally, so
+        #: losing this list to a crash is covered by the new leader's
+        #: reconcile pull (and by the boot-time rebroadcast from load).
+        self._publish_unacked: List[dict] = []
+        #: batch ids rejected by the share-consistency audit (durable
+        #: via "quarantined" retired.jsonl lines).
+        self._quarantined_ids: set = set()
+        self._quarantined = 0
+        #: quarantine decisions not yet notified to the peer — ride the
+        #: next outgoing hh_aggregate leg (idempotent re-sends).
+        self._quarantine_unacked: set = set()
+        #: batch ids that already passed the audit (in-memory only — a
+        #: restart re-audits, which is cheap and deterministic).
+        self._audited: set = set()
+        self._lease_epoch = 0
+        #: True once this leader pulled the peer's published log after
+        #: taking the lease — required before the first post-flip
+        #: advance (closes the publish-vs-replication crash gap).
+        self._reconciled = True
+        self._lease_booted = False
+        self._lease_thread: Optional[threading.Thread] = None
+        #: ownership-lease bookkeeping (shared-journal mode): the held
+        #: epoch and a wall-clock horizon below which requests skip the
+        #: lease-file read entirely.
+        self._owner_epoch = 0
+        self._owner_ok_until = 0.0
         self._retired_keys = 0
         self._deduped = 0
         self._backpressure = 0
@@ -335,8 +475,16 @@ class HeavyHitterStream:
             h.update(shas[bid].encode())
         return h.hexdigest()
 
-    def _window_fingerprint(self, generation: int, member_digest: str) -> str:
+    def _window_fingerprint(self, generation: int, member_digest: str,
+                            kind: str = "window") -> str:
+        """`kind` separates the leader's advance journal ("window") from
+        the follower's serve journal ("peer"): with lease failover both
+        roles can run in ONE process lifetime over ONE directory, and a
+        role flip must discard the other role's leftover journal (via
+        fingerprint mismatch → clean recompute) instead of replaying a
+        trail recorded under different semantics."""
         h = hashlib.sha256(b"hh-window|")
+        h.update(kind.encode())
         h.update(self.config.name.encode())
         h.update(self._params_blob())
         h.update(str(generation).encode())
@@ -364,20 +512,43 @@ class HeavyHitterStream:
             from ..ops import supervisor as _sv
 
             retired_gens: set = set()
+            lease_pub_gens: set = set()
             for line in self._read_retired():
                 kind = line.get("kind")
                 gen = int(line.get("generation", -1))
                 for bid in line.get("batch_ids", ()):
                     self._accepted.setdefault(bid, gen)
+                if kind == "published" and line.get("lease"):
+                    # A lease-mode publish does NOT retire its ingest
+                    # segments (its generation numbering is the
+                    # PUBLISHER's, which after a role flip is not this
+                    # party's segment numbering): the keys stay live
+                    # until the segment sweep writes "retired" lines —
+                    # which also carry the key accounting.
+                    self._published.append(line)
+                    self._published_bids.update(line.get("batch_ids", ()))
+                    self._consumed.update(line.get("batch_ids", ()))
+                    lease_pub_gens.add(gen)
+                    continue
                 self._retired_keys += int(line.get("keys", 0))
                 if kind == "published":
                     self._published.append(line)
+                    self._published_bids.update(line.get("batch_ids", ()))
                     retired_gens.add(gen)
                 elif kind == "retired":
                     retired_gens.add(gen)
                 elif kind == "consumed":
                     self._consumed.update(line.get("batch_ids", ()))
+                elif kind == "quarantined":
+                    self._quarantined_ids.update(line.get("batch_ids", ()))
             self._published.sort(key=lambda r: int(r["generation"]))
+            for gen in lease_pub_gens:
+                # Finish the publish-side rotation (the advance/serve
+                # journal of a published window is dead weight).
+                try:
+                    os.unlink(self._window_path(gen))
+                except OSError:
+                    pass
 
             gens = []
             for fname in os.listdir(self.dir):
@@ -403,6 +574,11 @@ class HeavyHitterStream:
                 w = _Window(gen, jr)
                 for index in jr.completed_indices():
                     payload = jr.completed(index)
+                    w.next_index = max(w.next_index, index + 1)
+                    if payload["batch_id"] in self._quarantined_ids:
+                        # Audited-out before the crash: the durable
+                        # quarantine line outranks the ingest record.
+                        continue
                     self._apply_batch(w, payload["batch_id"], [
                         base64.b64decode(b) for b in payload["blobs"]
                     ])
@@ -424,6 +600,19 @@ class HeavyHitterStream:
             )
             if self._open is None:
                 self._open = self._new_window(next_gen)
+            # Peer acks don't survive a crash and re-sends are
+            # idempotent: rebroadcast quarantine ids (and, in lease
+            # mode, the published log) once per boot.
+            self._quarantine_unacked = set(self._quarantined_ids)
+            if self._lease is not None:
+                if self.peer is not None:
+                    self._publish_unacked = [
+                        line for line in self._published
+                        if line.get("lease")
+                    ]
+                # Crash between a lease publish and its segment sweep:
+                # finish the sweep now.
+                self._sweep_segments_locked()
 
     def _new_window(self, generation: int) -> _Window:
         from ..ops import supervisor as _sv
@@ -521,9 +710,23 @@ class HeavyHitterStream:
         from ..ops import supervisor  # noqa: F401
 
         with self._lock:
-            self._ensure_loaded()
+            if self._owner_lease is None:
+                self._ensure_loaded()
+            # else: fleet-sheltered — journals load lazily on the first
+            # request that ACQUIRES the ownership lease; eagerly loading
+            # another replica's live journals would race its appends.
             if (
-                self.role == "leader"
+                self._lease is not None
+                and not self._lease_booted
+                and not self._stop_evt.is_set()
+            ):
+                self._lease_booted = True
+                self._boot_lease_locked()
+            drives = self.role == "leader" or (
+                self._lease is not None and self.peer is not None
+            )
+            if (
+                drives
                 and self._advance_thread is None
                 and not self._stop_evt.is_set()
             ):
@@ -533,6 +736,17 @@ class HeavyHitterStream:
                 )
                 self._advance_thread = t
                 t.start()
+            if (
+                self._lease is not None
+                and self._lease_thread is None
+                and not self._stop_evt.is_set()
+            ):
+                lt = threading.Thread(
+                    target=self._lease_loop,
+                    name=f"dpf-hh-lease-{self.config.name}", daemon=True,
+                )
+                self._lease_thread = lt
+                lt.start()
         return self
 
     def stop(self) -> None:
@@ -541,8 +755,23 @@ class HeavyHitterStream:
             self._wake.notify_all()
             t = self._advance_thread
             self._advance_thread = None
-        if t is not None:
-            t.join(timeout=15)
+            lt = self._lease_thread
+            self._lease_thread = None
+        for th in (t, lt):
+            if th is not None:
+                th.join(timeout=15)
+        with self._lock:
+            release = (
+                self._lease is not None
+                and self.release_on_stop
+                and self.role == "leader"
+            )
+            epoch = self._lease_epoch
+        if release:
+            try:
+                self._lease.release(epoch)
+            except (OSError, UnavailableError):
+                pass  # the TTL expires it anyway
         with self._lock:
             if self._client is not None:
                 self._client.close()
@@ -557,6 +786,231 @@ class HeavyHitterStream:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    # -- leader failover by lease (ISSUE 16) -------------------------------
+    def _boot_lease_locked(self) -> None:
+        """Role arbitration at start. The configured leader CLAIMS the
+        lease; a rival's unexpired claim demotes it to follower on the
+        spot — so a crashed ex-leader restarted with its original flags
+        self-arbitrates into the follower role instead of fighting the
+        promoted party. The configured follower just learns the current
+        epoch. Claiming always bumps the epoch (even re-claiming our own
+        expired lease): a restart must fence its own pre-crash requests
+        exactly like a rival's."""
+        if self.role == "leader":
+            got = None
+            try:
+                got = self._lease.try_acquire()
+            except (OSError, UnavailableError):
+                got = None
+            if got is not None:
+                self._lease_epoch = got
+                self._reconciled = False
+                return
+            st = self._lease.read()
+            self.role = "follower"
+            self._lease_epoch = max(
+                self._lease_epoch, 0 if st is None else st.epoch
+            )
+            self._reconciled = False
+            _tm.counter("streaming.boot_demoted", op=self.config.name)
+            from ..utils import integrity
+
+            integrity.emit_event(
+                "stream-role-flip",
+                f"stream {self.config.name!r} booted as configured "
+                f"leader but the lease is held (epoch "
+                f"{self._lease_epoch}) — joining as follower",
+                "", op=self.config.name,
+            )
+        else:
+            try:
+                self._lease_epoch = max(
+                    self._lease_epoch, self._lease.epoch()
+                )
+            except OSError:
+                pass
+
+    def _lease_loop(self) -> None:
+        """The lease watcher thread (both roles, lease mode only): the
+        leader renews at ttl/3 cadence; the follower polls for expiry
+        and promotes itself when the leader is dead or wedged."""
+        tick = max(0.05, self._lease.ttl / 3.0)
+        while not self._stop_evt.is_set():
+            try:
+                self._lease_tick()
+            except Exception:  # noqa: BLE001 — the watcher survives
+                _tm.counter("streaming.lease_errors", op=self.config.name)
+            self._stop_evt.wait(tick)
+
+    def _lease_tick(self) -> None:
+        with self._lock:
+            role = self.role
+            epoch = self._lease_epoch
+        if role == "leader":
+            if not self._lease.renew(epoch):
+                st = self._lease.read()
+                with self._lock:
+                    self._demote_locked(
+                        epoch if st is None else st.epoch
+                    )
+            return
+        st = self._lease.read()
+        if st is None:
+            return  # no lease ever granted: wait for the leader's boot
+        if st.epoch > epoch:
+            with self._lock:
+                self._demote_locked(st.epoch)  # learn the newer epoch
+        if self.peer is not None and st.expired():
+            got = None
+            try:
+                got = self._lease.try_acquire()
+            except (OSError, UnavailableError):
+                return
+            if got is not None:
+                with self._lock:
+                    self._promote_locked(got)
+
+    def _promote_locked(self, epoch: int) -> None:
+        self._lease_epoch = max(self._lease_epoch, int(epoch))
+        if self.role == "leader":
+            return
+        self.role = "leader"
+        self._reconciled = False
+        # Follower-side windows belong to the PREVIOUS reign's
+        # declarations; a later demotion must rebuild them against the
+        # then-leader's membership, never replay these.
+        for pw in self._peer_windows.values():
+            pw.journal.close()
+        self._peer_windows.clear()
+        _tm.counter("streaming.promoted", op=self.config.name)
+        from ..utils import integrity
+
+        integrity.emit_event(
+            "stream-role-flip",
+            f"stream {self.config.name!r} follower took the lease at "
+            f"epoch {self._lease_epoch} — now the aggregation leader",
+            "", op=self.config.name,
+        )
+        self._wake.notify_all()
+
+    def _demote_locked(self, epoch: int) -> None:
+        self._lease_epoch = max(self._lease_epoch, int(epoch))
+        if self.role != "leader":
+            return
+        self.role = "follower"
+        self._reconciled = False
+        for pw in self._peer_windows.values():
+            pw.journal.close()
+        self._peer_windows.clear()
+        _tm.counter("streaming.demoted", op=self.config.name)
+        from ..utils import integrity
+
+        integrity.emit_event(
+            "stream-role-flip",
+            f"stream {self.config.name!r} leader lost the lease (now "
+            f"epoch {self._lease_epoch}) — demoted to follower; "
+            "in-flight publishes are fenced by epoch",
+            "", op=self.config.name,
+        )
+
+    def _relearn_and_demote(self) -> None:
+        st = self._lease.read() if self._lease is not None else None
+        with self._lock:
+            self._demote_locked(
+                self._lease_epoch if st is None else st.epoch
+            )
+
+    def _reconcile_with_peer(self) -> None:
+        """New-leader catch-up, run before the first post-takeover
+        advance: pull the peer's published log and adopt every window
+        this party missed — the crash gap between the old leader's
+        publish and its replication ack. Adoption is idempotent by
+        batch-id set, so re-runs (and crossed replication legs) are
+        harmless. Raises on an unreachable peer: the advance loop
+        retries, which costs nothing — the advance needs the peer for
+        level shares anyway."""
+        from . import wire
+
+        arrays = self._peer_client().call(
+            "hh_snapshot",
+            wire.encode_hh_snapshot(self.config.name, 0),
+            deadline=self._peer_deadline,
+        )
+        snap = wire.json_from_arrays(arrays)
+        with self._lock:
+            for rec in snap.get("published", ()):
+                self._apply_replicated_publish_locked(rec)
+            self._reconciled = True
+
+    def _apply_replicated_publish_locked(self, record: dict) -> None:
+        """Adopts one publish record from the peer (the replication leg
+        or the reconcile pull): durable retired.jsonl line, published
+        view, exactly-once membership — all idempotent."""
+        bids = [str(b) for b in record.get("batch_ids", ())]
+        if not bids or all(b in self._published_bids for b in bids):
+            return
+        line = {
+            "kind": "published",
+            "generation": int(record.get("generation", -1)),
+            "batch_ids": bids,
+            "keys": int(record.get("keys", 0)),
+            "prefixes": [str(p) for p in record.get("prefixes", ())],
+            "counts": [str(c) for c in record.get("counts", ())],
+            "lease": True,
+        }
+        self._append_retired(line)
+        self._published.append(line)
+        self._published.sort(key=lambda r: int(r["generation"]))
+        self._published_bids.update(bids)
+        self._consumed.update(bids)
+        for bid in bids:
+            self._accepted.setdefault(bid, line["generation"])
+        pw = self._peer_windows.pop(line["generation"], None)
+        if pw is not None:
+            pw.journal.unlink()
+            self._rotated += 1
+        _tm.counter("streaming.publish_replicated", op=self.config.name)
+        self._sweep_segments_locked()
+
+    def _peer_notify(self, quarantine: Sequence[str] = (),
+                     publish: Optional[dict] = None) -> None:
+        """One notification-only hh_aggregate leg (no level trail):
+        quarantine ids and/or a publish record for the peer to adopt."""
+        from . import wire
+
+        with self._lock:
+            epoch = self._lease_epoch
+        payload = wire.encode_hh_aggregate(
+            self.config.name,
+            int(publish["generation"]) if publish else 0,
+            [], [],
+            epoch=epoch, publish=publish, quarantine=list(quarantine),
+        )
+        self._peer_client().call(
+            "hh_aggregate", payload, deadline=self._peer_deadline
+        )
+
+    def _flush_peer_state(self) -> None:
+        """Drains un-acked quarantine ids and publish records to the
+        peer (ordered, idempotent). Called from the advance loop and at
+        publish time; raising is fine — the caller retries."""
+        if self.peer is None:
+            return
+        with self._lock:
+            quarantine = sorted(self._quarantine_unacked)
+            publishes = list(self._publish_unacked)
+        if not quarantine and not publishes:
+            return
+        if quarantine:
+            self._peer_notify(quarantine=quarantine)
+            with self._lock:
+                self._quarantine_unacked.difference_update(quarantine)
+        for line in publishes:
+            self._peer_notify(publish=line)
+            with self._lock:
+                if line in self._publish_unacked:
+                    self._publish_unacked.remove(line)
 
     # -- ingestion ---------------------------------------------------------
     def _pending_locked(self) -> List[_Window]:
@@ -632,7 +1086,14 @@ class HeavyHitterStream:
             )
         blobs = [bytes(b) for b in key_blobs]
         with self._lock:
+            self._ensure_owner_locked()
             self._ensure_loaded()
+            if batch_id and batch_id in self._quarantined_ids:
+                # The audit's verdict outranks a retry: acknowledge (the
+                # client's delivery duty is done) without re-admitting.
+                self._deduped += 1
+                _tm.counter("streaming.deduped", op=self.config.name)
+                return self._accepted.get(batch_id, 0), True
             if batch_id and batch_id in self._accepted:
                 self._deduped += 1
                 _tm.counter("streaming.deduped", op=self.config.name)
@@ -645,7 +1106,7 @@ class HeavyHitterStream:
             if blobs:
                 w = self._open
                 w.journal.record(
-                    len(w.batch_ids),
+                    w.next_index,
                     {
                         "batch_id": batch_id,
                         "blobs": [
@@ -654,6 +1115,7 @@ class HeavyHitterStream:
                         ],
                     },
                 )
+                w.next_index += 1
                 self._apply_batch(w, batch_id, blobs)
                 _tm.counter("streaming.accepted", op=self.config.name)
                 if w.keys_total >= self.config.window_keys:
@@ -678,27 +1140,51 @@ class HeavyHitterStream:
 
     # -- the advance (leader) ---------------------------------------------
     def _advance_loop(self) -> None:
+        """The advance worker. In lease mode it lives for the PROCESS
+        (not the role): while follower it idles on the condition, and a
+        promotion wakes it — one thread, so two reigns in one process
+        can never double-advance."""
         while not self._stop_evt.is_set():
+            w = None
             with self._lock:
-                w = next(iter(self._pending_locked()), None)
-                if w is None:
+                if self.role != "leader":
+                    if self._lease is None:
+                        return  # static follower: nothing to drive, ever
                     self._wake.wait(timeout=0.25)
                     continue
+                reconciled = self._reconciled
+                w = next(iter(self._pending_locked()), None)
             try:
+                if not reconciled:
+                    self._reconcile_with_peer()
+                self._flush_peer_state()
+                if w is None:
+                    with self._lock:
+                        if self.role == "leader":
+                            self._wake.wait(timeout=0.25)
+                    continue
                 self._advance_window(w)
             except Exception as exc:  # noqa: BLE001 — the worker survives
                 _tm.counter("streaming.advance_errors", op=self.config.name)
                 from ..utils import integrity
 
+                gen = -1 if w is None else w.generation
                 integrity.emit_event(
                     "stream-advance-retry",
-                    f"stream {self.config.name!r} window {w.generation} "
+                    f"stream {self.config.name!r} window {gen} "
                     f"advance failed ({type(exc).__name__}: {exc}) — "
                     "retrying; journaled levels replay",
                     "",
                     op=self.config.name,
-                    generation=w.generation,
+                    generation=gen,
                 )
+                if (
+                    isinstance(exc, FailedPreconditionError)
+                    and self._lease is not None
+                ):
+                    # The peer fenced us: a newer epoch exists. Re-read
+                    # the lease and fall in line as follower.
+                    self._relearn_and_demote()
                 self._stop_evt.wait(self.RETRY_SECONDS)
 
     def _advance_window(self, w: _Window) -> None:
@@ -716,12 +1202,46 @@ class HeavyHitterStream:
         v = self._dpf.validator
         if not w.journal.finalized:
             w.journal.finalize()  # durably close a crash-recovered window
-        keys = [k for bid in w.batch_ids for k in w.keys[bid]]
+        # Membership of record: the segment's batches MINUS anything the
+        # published log already covers (a window the old leader
+        # published and we adopted at reconcile) MINUS quarantined ids.
+        # In the static PR 15 shape both sets are empty and member ==
+        # w.batch_ids, byte for byte.
+        with self._lock:
+            member = [
+                bid for bid in w.batch_ids
+                if bid not in self._published_bids
+                and bid not in self._quarantined_ids
+            ]
+        if cfg.audit and member:
+            member = self._audit_window(w, member)
+        if not member:
+            # Nothing left to count: retire the segment (and any stale
+            # advance journal) without a publish.
+            with self._lock:
+                try:
+                    os.unlink(self._window_path(w.generation))
+                except OSError:
+                    pass
+                self._sweep_segments_locked()
+            return
+        if self._lease is not None and not self._lease.renew(
+            self._lease_epoch
+        ):
+            # Zombie self-fence: the lease moved on mid-window — this
+            # party must not publish under a superseded epoch.
+            self._relearn_and_demote()
+            raise FailedPreconditionError(
+                f"FAILED_PRECONDITION: stream {self.config.name!r} lease "
+                f"epoch {self._lease_epoch} was superseded mid-advance — "
+                "this party is no longer the leader"
+            )
+        keys = [k for bid in member for k in w.keys[bid]]
         ctx = hierarchical.BatchedContext.create(self._dpf, keys)
         jr = _sv.ChunkJournal(
             self._window_path(w.generation),
             self._window_fingerprint(
-                w.generation, self._member_digest(w.batch_ids, w.shas)
+                w.generation, self._member_digest(member, w.shas)
             ),
             op="hh_window",
         )
@@ -746,7 +1266,7 @@ class HeavyHitterStream:
                     _sv.ctx_apply(ctx, stored["state"])
                 else:
                     own = self._level_shares(ctx, level, prefixes)
-                    peer = self._peer_level(w, trail)
+                    peer = self._peer_level(w, member, trail)
                     if peer.shape != own.shape:
                         raise DataLossError(
                             f"peer aggregate for window {w.generation} "
@@ -768,34 +1288,73 @@ class HeavyHitterStream:
                 prefixes = survivors
                 if not prefixes:
                     break
-            self._publish(w, jr, survivors, counts_of)
+            self._publish(w, jr, member, survivors, counts_of)
         finally:
             jr.close()
 
-    def _publish(self, w: _Window, jr, prefixes: List[int],
-                 counts_of: Dict[int, int]) -> None:
+    def _publish(self, w: _Window, jr, member: List[str],
+                 prefixes: List[int], counts_of: Dict[int, int]) -> None:
         line = {
             "kind": "published",
             "generation": w.generation,
-            "batch_ids": list(w.batch_ids),
-            "keys": w.keys_total,
+            "batch_ids": list(member),
+            "keys": sum(len(w.keys[b]) for b in member),
             "prefixes": [str(p) for p in prefixes],
             "counts": [str(counts_of[p]) for p in prefixes],
         }
+        if self._lease is not None:
+            line["lease"] = True
         # Durability order: the published line lands (fsync) BEFORE the
         # window's journals rotate away — a crash in between re-runs
         # rotation at reload, never the window.
-        self._append_retired(line)
+        with self._lock:
+            fresh = any(b not in self._published_bids for b in member)
+            if fresh:
+                if self._lease is not None and not self._lease.renew(
+                    self._lease_epoch
+                ):
+                    # The last fence before the log: a lease stolen
+                    # between the window's levels and its publish must
+                    # not produce a record the exactly-once spine then
+                    # has to fight.
+                    st = self._lease.read()
+                    self._demote_locked(
+                        self._lease_epoch if st is None else st.epoch
+                    )
+                    raise FailedPreconditionError(
+                        f"FAILED_PRECONDITION: stream "
+                        f"{self.config.name!r} lease epoch "
+                        f"{self._lease_epoch} was superseded at publish "
+                        "— record withheld"
+                    )
+                self._append_retired(line)
+                self._published.append(line)
+                self._published_bids.update(member)
+                self._consumed.update(member)
+                if self._lease is not None and self.peer is not None:
+                    self._publish_unacked.append(line)
+            self._wake.notify_all()
+        # Replication is part of the window's ack: the follower holds
+        # the publish record BEFORE this leader rotates the journals
+        # away (a failure here raises; the advance loop retries and the
+        # record rides _publish_unacked).
+        self._flush_peer_state()
         jr.finalize()
         with self._lock:
-            self._published.append(line)
-            self._windows.pop(w.generation, None)
-            self._retired_keys += w.keys_total
-            self._wake.notify_all()
+            if self._lease is None:
+                self._windows.pop(w.generation, None)
+                self._retired_keys += w.keys_total
         jr.unlink()
-        w.journal.unlink()
         with self._lock:
-            self._rotated += 2
+            if self._lease is None:
+                w.journal.unlink()
+                self._rotated += 2
+            else:
+                # Lease mode keeps segment accounting in the sweep (a
+                # published batch's segment may still hold OTHER live
+                # batches after a failover re-partition).
+                self._rotated += 1
+                self._sweep_segments_locked()
         _tm.counter("streaming.windows_published", op=self.config.name)
 
     def _peer_client(self):
@@ -813,20 +1372,31 @@ class HeavyHitterStream:
                 )
             return self._client
 
-    def _peer_level(self, w: _Window, trail) -> np.ndarray:
+    def _peer_level(self, w: _Window, member: List[str],
+                    trail) -> np.ndarray:
         """The peer party's aggregate share vector for the trail's last
         level — the only server-to-server communication (two vectors per
         level, like the batch demo). The client's retry budget carries
         the call across a peer restart; a still-incomplete peer window
-        answers UNAVAILABLE, which lands here as a retry too."""
+        answers UNAVAILABLE, which lands here as a retry too. The leg
+        carries the lease epoch (the zombie fence) and piggybacks any
+        un-acked quarantine ids, so a quarantined batch is excluded on
+        BOTH parties no later than the window's first level."""
         from . import wire
 
+        with self._lock:
+            epoch = self._lease_epoch
+            quarantine = sorted(self._quarantine_unacked)
         payload = wire.encode_hh_aggregate(
-            self.config.name, w.generation, list(w.batch_ids), trail
+            self.config.name, w.generation, list(member), trail,
+            epoch=epoch, quarantine=quarantine,
         )
         arrays = self._peer_client().call(
             "hh_aggregate", payload, deadline=self._peer_deadline
         )
+        if quarantine:
+            with self._lock:
+                self._quarantine_unacked.difference_update(quarantine)
         return np.asarray(arrays[0], dtype=np.uint64)
 
     def _level_shares(self, ctx, level: int, prefixes) -> np.ndarray:
@@ -860,22 +1430,63 @@ class HeavyHitterStream:
 
     # -- the peer exchange (follower) --------------------------------------
     def aggregate(self, generation: int, batch_ids: Sequence[str],
-                  plan) -> np.ndarray:
+                  plan, *, epoch: int = 0, publish: Optional[dict] = None,
+                  quarantine: Sequence[str] = (),
+                  audit: bool = False) -> np.ndarray:
         """Serves the leader's per-level aggregate request: assemble this
         party's window from the declared batch-id membership, fast-
         forward through the request's level trail (journaling each
         advanced level), and return the LAST entry's share vector. A
         batch this party has not yet ingested answers UNAVAILABLE (the
         leader retries — the client upload will land); a journaled trail
-        that no longer matches starts the window clean."""
-        if self.role != "follower":
-            raise InvalidArgumentError(
-                "hh_aggregate is served by the peer (follower) party"
-            )
-        if not plan:
-            raise InvalidArgumentError("hh_aggregate needs a level trail")
+        that no longer matches starts the window clean.
+
+        ISSUE 16 extensions (all keyword-only — the PR 15 wire shape is
+        the default): ``epoch`` is the sender's lease epoch and the
+        zombie fence — in lease mode a stale epoch answers
+        ``FAILED_PRECONDITION`` before ANY state is touched, and a newer
+        one demotes a current leader on the spot. ``quarantine`` applies
+        peer quarantine decisions; ``publish`` adopts a replicated
+        publish record; ``audit=True`` serves the named batches' level-0
+        aggregate from a throwaway context (the share-consistency
+        check's follower leg — no window state involved). A leg with no
+        level trail is a pure notification and returns an empty
+        vector."""
         with self._lock:
+            self._ensure_owner_locked()
             self._ensure_loaded()
+            if self._lease is not None:
+                if epoch > self._lease_epoch:
+                    # A newer leader exists: learn its epoch (dropping
+                    # leadership if this party still thought it led).
+                    self._demote_locked(epoch)
+                elif epoch < self._lease_epoch or self.role == "leader":
+                    _tm.counter("streaming.fenced", op=self.config.name)
+                    raise FailedPreconditionError(
+                        f"FAILED_PRECONDITION: stream "
+                        f"{self.config.name!r} hh_aggregate carries "
+                        f"lease epoch {epoch} but this party is at "
+                        f"epoch {self._lease_epoch} — a superseded "
+                        "(zombie) leader is fenced, never merged"
+                    )
+            elif self.role != "follower":
+                raise InvalidArgumentError(
+                    "hh_aggregate is served by the peer (follower) party"
+                )
+            for bid in quarantine:
+                self._apply_quarantine_locked(
+                    str(bid), note=" (peer notification)"
+                )
+            if publish is not None:
+                self._apply_replicated_publish_locked(publish)
+            if audit:
+                return self._serve_audit_locked(batch_ids)
+            if not plan:
+                if publish is not None or quarantine:
+                    return np.zeros(0, dtype=np.uint64)
+                raise InvalidArgumentError(
+                    "hh_aggregate needs a level trail"
+                )
             missing = [b for b in batch_ids if b not in self._accepted]
             if missing:
                 raise UnavailableError(
@@ -885,14 +1496,26 @@ class HeavyHitterStream:
                     "uploads land"
                 )
             pw = self._peer_windows.get(generation)
+            if pw is not None and list(pw.batch_ids) != list(batch_ids):
+                if self._lease is None:
+                    raise FailedPreconditionError(
+                        f"window {generation} membership drifted between "
+                        "aggregate requests (leader bug or stale journal)"
+                    )
+                # Failover redeclaration: a promoted leader legitimately
+                # re-partitions membership (adopted publishes and
+                # quarantines excluded) — rebuild clean; the fingerprint
+                # binds counts to the new membership.
+                _tm.counter(
+                    "streaming.window_redeclared", op=self.config.name
+                )
+                pw.journal.unlink()
+                self._rotated += 1
+                self._peer_windows.pop(generation, None)
+                pw = None
             if pw is None:
                 pw = self._make_peer_window_locked(generation, batch_ids)
                 self._peer_windows[generation] = pw
-            elif list(pw.batch_ids) != list(batch_ids):
-                raise FailedPreconditionError(
-                    f"window {generation} membership drifted between "
-                    "aggregate requests (leader bug or stale journal)"
-                )
             result = self._serve_trail_locked(pw, plan)
             # The window that just served is re-fetched: a trail
             # divergence inside _serve_trail_locked replaces the object.
@@ -930,7 +1553,8 @@ class HeavyHitterStream:
         jr = _sv.ChunkJournal(
             self._window_path(generation),
             self._window_fingerprint(
-                generation, self._member_digest(list(batch_ids), shas)
+                generation, self._member_digest(list(batch_ids), shas),
+                kind="peer",
             ),
             op="hh_peer",
         )
@@ -1008,13 +1632,29 @@ class HeavyHitterStream:
             pw.consumed_logged = True
 
     def _sweep_segments_locked(self) -> None:
-        """Unlinks any closed ingest segment whose batches are all
-        consumed, compacting it into a retired line first."""
+        """Unlinks any closed ingest segment whose batches are all done,
+        compacting it into a retired line first. "Done" is role-shape
+        dependent: the static follower retires on *consumed* (the final
+        level served — the leader publishes right after); in lease mode
+        consumption is NOT enough — a leader crash between the final
+        level and the publish must leave the keys recoverable for the
+        new leader's own advance, so only *published or quarantined*
+        batches release a segment."""
         with self._lock:
             for seg_gen, w in sorted(self._windows.items()):
                 if not w.closed or not w.batch_ids:
                     continue
-                if all(bid in self._consumed for bid in w.batch_ids):
+                if self._lease is not None:
+                    done = all(
+                        bid in self._published_bids
+                        or bid in self._quarantined_ids
+                        for bid in w.batch_ids
+                    )
+                else:
+                    done = all(
+                        bid in self._consumed for bid in w.batch_ids
+                    )
+                if done:
                     self._append_retired({
                         "kind": "retired", "generation": seg_gen,
                         "batch_ids": list(w.batch_ids),
@@ -1063,6 +1703,240 @@ class HeavyHitterStream:
                         pass
             self._sweep_segments_locked()
 
+    # -- malicious-client share audit (ISSUE 16) ----------------------------
+    def _audit_window(self, w: _Window, member: List[str]) -> List[str]:
+        """The leader leg of the per-batch share-consistency audit, run
+        BEFORE a batch enters window membership. Both parties aggregate
+        ONE batch's keys at level 0 with no prefix restriction; for an
+        honest batch of n one-hot (beta=1) keys the reconstructed vector
+        sums to exactly n with no cell above n. Anything else — a beta≠1
+        key, a zero key, a wrapped-negative beta — quarantines the batch
+        on both parties (the quarantine id rides the next peer leg; the
+        level-0 prefix mass is all this check reveals beyond the
+        protocol's output). Returns the surviving member list."""
+        from ..ops import hierarchical
+
+        ok: List[str] = []
+        for bid in member:
+            with self._lock:
+                if bid in self._audited:
+                    ok.append(bid)
+                    continue
+                batch_keys = list(w.keys.get(bid, ()))
+            if not batch_keys:
+                continue
+            ctx = hierarchical.BatchedContext.create(self._dpf, batch_keys)
+            own = self._level_shares(ctx, 0, [])
+            try:
+                peer = self._peer_audit(w.generation, bid)
+            except FailedPreconditionError:
+                # The peer already quarantined this batch and its
+                # notification died with a crash (reconcile filtered
+                # published/consumed bids out of `member` first, so a
+                # failed-precondition here IS the quarantine verdict):
+                # adopt it instead of looping a demote cycle.
+                with self._lock:
+                    self._apply_quarantine_locked(
+                        bid, note=" (peer verdict adopted)"
+                    )
+                continue
+            if peer.shape != own.shape:
+                raise DataLossError(
+                    f"audit share for batch {bid!r} has {peer.shape[0]} "
+                    f"candidates, expected {own.shape[0]}"
+                )
+            counts = (own + peer) & self._count_mask
+            n = len(batch_keys)
+            total = int(counts.sum(dtype=np.uint64) & self._count_mask)
+            if total == n and all(int(c) <= n for c in counts):
+                with self._lock:
+                    self._audited.add(bid)
+                ok.append(bid)
+            else:
+                with self._lock:
+                    self._apply_quarantine_locked(bid, note=(
+                        f" (level-0 mass {total} across "
+                        f"{int(counts.shape[0])} candidates from {n} "
+                        "keys)"
+                    ))
+        return ok
+
+    def _peer_audit(self, generation: int, bid: str) -> np.ndarray:
+        from . import wire
+
+        with self._lock:
+            epoch = self._lease_epoch
+        payload = wire.encode_hh_aggregate(
+            self.config.name, generation, [bid], [],
+            epoch=epoch, audit=True,
+        )
+        arrays = self._peer_client().call(
+            "hh_aggregate", payload, deadline=self._peer_deadline
+        )
+        return np.asarray(arrays[0], dtype=np.uint64)
+
+    def _serve_audit_locked(self, batch_ids: Sequence[str]) -> np.ndarray:
+        """The follower leg: the level-0 aggregate share over JUST the
+        named batches' keys, from a throwaway context — the audit runs
+        before window membership, so no window state is touched."""
+        from ..ops import hierarchical
+
+        missing = [b for b in batch_ids if b not in self._accepted]
+        if missing:
+            raise UnavailableError(
+                f"UNAVAILABLE: stream {self.config.name!r} audit is "
+                f"missing {len(missing)} ingest batches on this party — "
+                "retry once the client uploads land"
+            )
+        keys: List = []
+        for bid in batch_ids:
+            w = self._windows.get(self._accepted[bid])
+            if w is None or bid not in w.keys:
+                raise FailedPreconditionError(
+                    f"audit batch {bid!r} was already consumed or "
+                    "retired on this party"
+                )
+            keys.extend(w.keys[bid])
+        ctx = hierarchical.BatchedContext.create(self._dpf, keys)
+        return self._level_shares(ctx, 0, [])
+
+    def _apply_quarantine_locked(self, bid: str, note: str = "") -> None:
+        """Quarantines one batch id: removed from its live segment,
+        recorded durably ("quarantined" retired.jsonl line — the reload
+        skips the batch's ingest records), counted, and announced. A
+        retry of the batch is acknowledged-as-deduped, never
+        re-admitted. Idempotent."""
+        if bid in self._quarantined_ids:
+            return
+        gen = self._accepted.get(bid, -1)
+        w = self._windows.get(gen)
+        n = 0
+        if w is not None and bid in w.keys:
+            n = len(w.keys.pop(bid))
+            w.shas.pop(bid, None)
+            if bid in w.batch_ids:
+                w.batch_ids.remove(bid)
+            w.keys_total -= n
+        self._append_retired({
+            "kind": "quarantined", "generation": gen,
+            "batch_ids": [bid], "keys": n,
+        })
+        self._accepted.setdefault(bid, gen)
+        self._retired_keys += n
+        self._quarantined_ids.add(bid)
+        self._quarantined += 1
+        self._quarantine_unacked.add(bid)
+        self._audited.discard(bid)
+        _tm.counter("hh.quarantined", op=self.config.name)
+        from ..utils import integrity
+
+        integrity.emit_event(
+            "stream-batch-quarantined",
+            f"stream {self.config.name!r} batch {bid!r} failed the "
+            f"share-consistency audit ({n} keys){note} — quarantined "
+            "before window membership; honest batches are unaffected",
+            "", op=self.config.name,
+        )
+
+    # -- fleet-sheltered ownership (ISSUE 16) -------------------------------
+    def _owns_now_locked(self) -> bool:
+        if self._owner_lease is None:
+            return True
+        if not self._owner_epoch:
+            return False
+        if time.time() < self._owner_ok_until:
+            return True
+        st = self._owner_lease.read()
+        return (
+            st is not None
+            and st.owner == self._owner_name
+            and st.epoch == self._owner_epoch
+        )
+
+    def _ensure_owner_locked(self) -> None:
+        """The shared-journal gate, called before any request touches
+        stream state. Holding the ownership lease admits the request
+        (renewed at ttl/3 cadence, cached in `_owner_ok_until` so the
+        hot path skips the file). Another replica's unexpired lease
+        answers UNAVAILABLE — the fleet proxy's routing (and the
+        leader's advance retry loop) converge on whichever replica can
+        acquire. Acquiring after ANY foreign/newer epoch drops every
+        journal-derived structure and reloads the shared volume: stream
+        handoff is journal-directory handoff."""
+        if self._owner_lease is None:
+            return
+        now = time.time()
+        if self._owner_epoch and now < self._owner_ok_until:
+            return
+        st = self._owner_lease.read()
+        if (
+            st is not None
+            and self._owner_epoch
+            and st.owner == self._owner_name
+            and st.epoch == self._owner_epoch
+        ):
+            # Still my epoch — even if the TTL lapsed, no rival claimed
+            # it in between (a claim bumps the epoch), so the in-memory
+            # state is valid; just renew.
+            if self._owner_lease.renew(self._owner_epoch):
+                self._owner_ok_until = now + self._owner_lease.ttl / 3.0
+                return
+            st = self._owner_lease.read()  # a rival raced the renew
+        if (
+            st is not None
+            and st.owner != self._owner_name
+            and not st.expired(now)
+        ):
+            raise UnavailableError(
+                f"UNAVAILABLE: stream {self.config.name!r} is owned by "
+                f"replica {st.owner!r} (epoch {st.epoch}) — retry"
+            )
+        got = self._owner_lease.try_acquire()
+        if got is None:
+            raise UnavailableError(
+                f"UNAVAILABLE: stream {self.config.name!r} ownership is "
+                "contended — retry"
+            )
+        self._owner_epoch = got
+        self._owner_ok_until = now + self._owner_lease.ttl / 3.0
+        self._reset_state_locked()
+        self._ensure_loaded()
+        _tm.counter("streaming.rehomed", op=self.config.name)
+        from ..utils import integrity
+
+        integrity.emit_event(
+            "stream-rehomed",
+            f"stream {self.config.name!r} ownership acquired by "
+            f"{self._owner_name!r} at epoch {got} — journals reloaded "
+            "from the shared volume",
+            "", op=self.config.name,
+        )
+
+    def _reset_state_locked(self) -> None:
+        """Drops every journal-derived structure (process-lifetime
+        counters survive) so the next _ensure_loaded() re-reads the
+        shared volume — the ownership-handoff reload."""
+        for w in self._windows.values():
+            w.journal.close()
+        for pw in self._peer_windows.values():
+            pw.journal.close()
+        self._windows = {}
+        self._peer_windows = {}
+        self._open = None
+        self._accepted = {}
+        self._consumed = set()
+        self._published = []
+        self._published_bids = set()
+        self._publish_unacked = []
+        self._quarantined_ids = set()
+        self._quarantine_unacked = set()
+        self._audited = set()
+        self._party = None
+        self._retired_keys = 0
+        self._retired_good_bytes = None
+        self._swept_below = 0
+        self._loaded = False
+
     # -- observability ------------------------------------------------------
     def snapshot(self, since_generation: int = 0) -> dict:
         """The hh_snapshot read body: published windows (generation,
@@ -1075,10 +1949,12 @@ class HeavyHitterStream:
         long-lived stream's snapshot cost tracks NEW windows, not its
         lifetime."""
         with self._lock:
+            self._ensure_owner_locked()
             self._ensure_loaded()
             return {
                 "stream": self.config.name,
                 "role": self.role,
+                "lease_epoch": self._epoch_locked(),
                 "threshold": self.config.threshold,
                 "window_keys": self.config.window_keys,
                 "published_total": len(self._published),
@@ -1095,15 +1971,44 @@ class HeavyHitterStream:
                 "stats": self.stats_fields(),
             }
 
+    def _epoch_locked(self) -> int:
+        """The epoch the stats/snapshot frames report: the role lease's
+        in lease mode, the ownership lease's in shared mode, else 0."""
+        if self._lease is not None:
+            return self._lease_epoch
+        return self._owner_epoch
+
     def stats_fields(self) -> dict:
         """The per-stream block of the server's stats/health frames
-        (wire.STATS_STREAM_KEYS)."""
+        (wire.STATS_STREAM_KEYS). `role`/`lease_epoch`/`quarantined`
+        are the ISSUE 16 additions: a poller can tell which party is
+        authoritative after a flip, and how many batches the audit
+        rejected. A shared-journal replica that does NOT hold the
+        ownership lease reports its process counters with zeroed stream
+        state — health frames must never load (or fight over) another
+        replica's live journals."""
         with self._lock:
+            if not self._owns_now_locked():
+                return {
+                    "role": self.role,
+                    "lease_epoch": self._epoch_locked(),
+                    "open_generation": 0,
+                    "pending_windows": 0,
+                    "pending_keys": 0,
+                    "accepted_batches": 0,
+                    "accepted_keys": 0,
+                    "deduped_batches": self._deduped,
+                    "backpressure_rejections": self._backpressure,
+                    "windows_published": 0,
+                    "journals_rotated": self._rotated,
+                    "quarantined": self._quarantined,
+                }
             self._ensure_loaded()
             pending = self._pending_locked()
             live_keys = sum(w.keys_total for w in self._windows.values())
             return {
                 "role": self.role,
+                "lease_epoch": self._epoch_locked(),
                 "open_generation": self._open.generation,
                 "pending_windows": len(pending),
                 "pending_keys": sum(w.keys_total for w in pending),
@@ -1113,4 +2018,9 @@ class HeavyHitterStream:
                 "backpressure_rejections": self._backpressure,
                 "windows_published": len(self._published),
                 "journals_rotated": self._rotated,
+                # The durable count, not the process counter: a restart
+                # reloads its quarantine verdicts and must keep
+                # reporting them (the failover soak's both-parties
+                # assertion reads this through a crash).
+                "quarantined": len(self._quarantined_ids),
             }

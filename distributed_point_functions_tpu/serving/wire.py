@@ -791,7 +791,9 @@ def decode_hh_snapshot(buf: bytes) -> Tuple[str, int]:
 
 
 def encode_hh_aggregate(
-    stream: str, generation: int, batch_ids: Sequence[str], plan,
+    stream: str, generation: int, batch_ids: Sequence[str], plan, *,
+    epoch: int = 0, publish: Optional[dict] = None, audit: bool = False,
+    quarantine: Sequence[str] = (),
 ) -> bytes:
     """Leader-to-peer aggregate request: stream (1), window generation
     (2), the window's batch-id membership in leader order (3 — the peer
@@ -799,21 +801,49 @@ def encode_hh_aggregate(
     sums are order-independent) and the full level trail so far (4, the
     hierarchical plan-entry message: the peer fast-forwards a freshly
     restarted window through every earlier level deterministically). The
-    response is the LAST entry's aggregate share vector."""
+    response is the LAST entry's aggregate share vector.
+
+    ISSUE 16 appended fields, all ABSENT in the PR 15 encoding (old
+    payloads decode to the old meaning, old decoders skip unknown
+    fields): lease epoch (5 — the zombie fence; 0 = no lease), a publish
+    record to replicate as JSON (6), the audit flag (7 — serve the
+    named batches' level-0 aggregate from a throwaway context), and
+    quarantined batch ids to apply (8). A leg with no level trail is a
+    pure notification (publish / quarantine / audit only)."""
+    import json as _json
+
     out = pb.len_field(1, stream.encode("utf-8"))
     out += pb.uint64_field(2, int(generation))
     for bid in batch_ids:
         out += pb.len_field(3, bid.encode("utf-8"))
     for level, prefixes in plan:
         out += pb.len_field(4, _encode_plan_entry(level, prefixes))
+    if epoch:
+        out += pb.uint64_field(5, int(epoch))
+    if publish is not None:
+        out += pb.len_field(
+            6, _json.dumps(publish, sort_keys=True).encode("utf-8")
+        )
+    if audit:
+        out += pb.uint64_field(7, 1)
+    for bid in quarantine:
+        out += pb.len_field(8, bid.encode("utf-8"))
     return out
 
 
 def decode_hh_aggregate(buf: bytes):
+    """-> (stream, generation, batch_ids, plan, extras) with extras =
+    {"epoch", "publish", "audit", "quarantine"} (ISSUE 16 — defaults
+    reproduce the PR 15 meaning for old payloads)."""
+    import json as _json
+
     stream = ""
     generation = 0
     batch_ids: List[str] = []
     plan = []
+    extras = {
+        "epoch": 0, "publish": None, "audit": False, "quarantine": [],
+    }
     for field, _, value in pb.iter_fields(buf):
         if field == 1:
             stream = value.decode("utf-8")
@@ -823,11 +853,28 @@ def decode_hh_aggregate(buf: bytes):
             batch_ids.append(value.decode("utf-8"))
         elif field == 4:
             plan.append(_decode_plan_entry(value))
-    if not stream or not plan:
+        elif field == 5:
+            extras["epoch"] = int(value)
+        elif field == 6:
+            try:
+                extras["publish"] = _json.loads(value.decode("utf-8"))
+            except ValueError as exc:
+                raise InvalidArgumentError(
+                    f"hh_aggregate publish record is not JSON: {exc}"
+                ) from exc
+        elif field == 7:
+            extras["audit"] = bool(int(value))
+        elif field == 8:
+            extras["quarantine"].append(value.decode("utf-8"))
+    if not stream or not (
+        plan or extras["publish"] is not None or extras["audit"]
+        or extras["quarantine"]
+    ):
         raise InvalidArgumentError(
-            "hh_aggregate payload needs stream name + level trail"
+            "hh_aggregate payload needs stream name + level trail "
+            "(or an ISSUE 16 notification: publish/audit/quarantine)"
         )
-    return stream, generation, batch_ids, plan
+    return stream, generation, batch_ids, plan, extras
 
 
 def json_result_arrays(body: dict) -> List[np.ndarray]:
@@ -873,13 +920,18 @@ STATS_FLEET_KEYS = ("queues", "inflight", "served", "warm")
 #: merge fine, old clients never read the new key). ``streams`` maps
 #: stream name -> its counters: open window generation, pending window
 #: depth (the backpressure bound), keys/batches accepted + deduped,
-#: windows published, journals rotated.
+#: windows published, journals rotated — plus, since ISSUE 16, ``role``
+#: / ``lease_epoch`` (which party is authoritative after a failover
+#: flip, and under which lease epoch) and ``quarantined`` (batches the
+#: share-consistency audit rejected). Old PR 15 bodies simply lack the
+#: new fields and merge fine.
 STATS_STREAM_KEYS = ("streams",)
 
 #: Per-stream stats fields that aggregate by MAX across replicas (the
-#: open generation is a high-water mark, not a rate); every other
-#: numeric field sums, non-numeric fields (role) keep the first body's.
-_STREAM_MAX_FIELDS = frozenset({"open_generation"})
+#: open generation and the lease epoch are high-water marks, not
+#: rates); every other numeric field sums, non-numeric fields (role)
+#: keep the first body's.
+_STREAM_MAX_FIELDS = frozenset({"open_generation", "lease_epoch"})
 
 #: Request-payload fields, per op, that determine the request's
 #: compatibility-queue key and warm-cache identity on the replica — the

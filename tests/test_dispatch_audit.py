@@ -885,8 +885,8 @@ def test_streaming_adds_zero_programs(program_counter, tmp_path):
     leader = serving.HeavyHitterStream(
         cfg, str(tmp_path / "l"), peer=("127.0.0.1", 1),
     )
-    leader._peer_level = lambda w, trail: follower.aggregate(
-        w.generation, list(w.batch_ids), trail
+    leader._peer_level = lambda w, member, trail: follower.aggregate(
+        w.generation, list(member), trail
     )
     program_counter["programs"] = 0
     for i, vals in enumerate([[9, 9], [40, 9]]):

@@ -30,6 +30,7 @@ from distributed_point_functions_tpu.serving.streaming import (
 )
 from distributed_point_functions_tpu.utils import integrity
 from distributed_point_functions_tpu.utils.errors import (
+    FailedPreconditionError,
     InvalidArgumentError,
     ResourceExhaustedError,
     UnavailableError,
@@ -82,8 +83,10 @@ def _wired_pair(dpf, cfg, leader_stream, follower_stream):
     """Connects a leader stream's peer exchange straight to a follower
     stream object — the in-process harness for journal/crash pins (the
     socket path is covered by the service test + the --stream soak)."""
-    leader_stream._peer_level = lambda w, trail: follower_stream.aggregate(
-        w.generation, list(w.batch_ids), trail
+    leader_stream._peer_level = (
+        lambda w, member, trail: follower_stream.aggregate(
+            w.generation, list(member), trail
+        )
     )
     return leader_stream
 
@@ -256,9 +259,11 @@ def test_stats_and_health_frames_carry_stream_fields(pair):
         "role", "open_generation", "pending_windows", "pending_keys",
         "accepted_batches", "accepted_keys", "deduped_batches",
         "backpressure_rejections", "windows_published", "journals_rotated",
+        "lease_epoch", "quarantined",  # ISSUE 16: additive again
     ):
         assert key in fields, key
     assert fields["role"] == "leader"
+    assert fields["quarantined"] == 0  # no audit configured -> nothing cut
     health = client.clients[1].health()
     assert health["streams"]["hh"]["role"] == "follower"
 
@@ -271,12 +276,14 @@ def test_merge_stats_streams_sum_and_old_bodies(dpf):
     new_a = {
         "counters": {"x": 1}, "gauges": {"g": {"last": 1, "max": 2}},
         "streams": {"hh": {"role": "leader", "open_generation": 3,
-                           "accepted_keys": 10, "windows_published": 2}},
+                           "accepted_keys": 10, "windows_published": 2,
+                           "lease_epoch": 4, "quarantined": 1}},
     }
     new_b = {
         "counters": {"x": 2}, "gauges": {"g": {"last": 3, "max": 5}},
         "streams": {"hh": {"role": "leader", "open_generation": 5,
-                           "accepted_keys": 7, "windows_published": 1}},
+                           "accepted_keys": 7, "windows_published": 1,
+                           "lease_epoch": 2, "quarantined": 2}},
     }
     old = {"counters": {"x": 4}, "gauges": {"g": {"last": 1, "max": 1}}}
     merged = wire.merge_stats([new_a, new_b, old])
@@ -284,7 +291,9 @@ def test_merge_stats_streams_sum_and_old_bodies(dpf):
     assert merged["gauges"]["g"] == {"last": 5, "max": 8}
     hh = merged["streams"]["hh"]
     assert hh["open_generation"] == 5  # max, not sum
+    assert hh["lease_epoch"] == 4  # ISSUE 16: epochs max-merge too
     assert hh["accepted_keys"] == 17 and hh["windows_published"] == 3
+    assert hh["quarantined"] == 3  # plain counter: sums
     assert hh["role"] == "leader"
     # Old-only merge: the streams key exists and is empty.
     assert wire.merge_stats([old])["streams"] == {}
@@ -437,15 +446,15 @@ def test_leader_crash_mid_window_resumes_exact(dpf, tmp_path):
     follower.ingest(cfg.parameters, blobs1, "b-0", flush=True)
 
     calls = {"n": 0}
-    real_peer = lambda w, trail: follower.aggregate(
-        w.generation, list(w.batch_ids), trail
+    real_peer = lambda w, member, trail: follower.aggregate(
+        w.generation, list(member), trail
     )
 
-    def dying_peer(w, trail):
+    def dying_peer(w, member, trail):
         if calls["n"] >= 1:
             raise UnavailableError("UNAVAILABLE: chaos — peer died")
         calls["n"] += 1
-        return real_peer(w, trail)
+        return real_peer(w, member, trail)
 
     leader._peer_level = dying_peer
     with pytest.raises(UnavailableError):
@@ -591,3 +600,384 @@ def test_follower_restart_does_not_orphan_served_windows(dpf, tmp_path):
     # ...and b-0 stays deduped (consumed line reloaded).
     assert resumed.ingest(cfg.parameters, b0, "b-0")[1] is True
     resumed.stop()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: leader failover by lease, malicious-client audits, and
+# fleet-sheltered ownership — in-process managers on the host engine
+# (the subprocess/socket arms are tools/chaos_soak.py --stream)
+# ---------------------------------------------------------------------------
+
+
+def _wire_lease(leader_stream, follower_stream):
+    """In-process peer exchange for a LEASE-mode pair: every leg carries
+    the leader's current epoch, piggybacked quarantine ids drain through
+    aggregate() exactly like the socket path, and _peer_notify delivers
+    the replication/quarantine notifications."""
+
+    def peer_level(w, member, trail):
+        with leader_stream._lock:
+            epoch = leader_stream._lease_epoch
+            q = sorted(leader_stream._quarantine_unacked)
+        out = follower_stream.aggregate(
+            w.generation, list(member), trail, epoch=epoch, quarantine=q
+        )
+        with leader_stream._lock:
+            leader_stream._quarantine_unacked.difference_update(q)
+        return out
+
+    def peer_notify(quarantine=(), publish=None):
+        with leader_stream._lock:
+            epoch = leader_stream._lease_epoch
+        follower_stream.aggregate(
+            int(publish["generation"]) if publish else 0, [], [],
+            epoch=epoch, publish=publish, quarantine=list(quarantine),
+        )
+
+    def peer_audit(generation, bid):
+        with leader_stream._lock:
+            epoch = leader_stream._lease_epoch
+        return follower_stream.aggregate(
+            generation, [bid], [], epoch=epoch, audit=True
+        )
+
+    def reconcile():
+        snap = follower_stream.snapshot()
+        with leader_stream._lock:
+            for rec in snap["published"]:
+                leader_stream._apply_replicated_publish_locked(rec)
+            leader_stream._reconciled = True
+
+    leader_stream._peer_level = peer_level
+    leader_stream._peer_notify = peer_notify
+    leader_stream._peer_audit = peer_audit
+    leader_stream._reconcile_with_peer = reconcile
+    return leader_stream
+
+
+def _boot(stream):
+    with stream._lock:
+        stream._boot_lease_locked()
+    return stream
+
+
+def _published_kinds(stream):
+    import json as _json
+    import os as _os
+
+    path = stream._retired_path()
+    if not _os.path.exists(path):
+        return []
+    with open(path, "rb") as f:
+        return [
+            _json.loads(ln) for ln in f.read().splitlines() if ln
+        ]
+
+
+def test_publish_survives_flip_exactly_once(dpf, tmp_path):
+    """The satellite-(c) pin, journal level: the leader crashes AFTER
+    its publish record lands durably but BEFORE the replication ack
+    reaches the follower. The promoted follower reconciles by pulling
+    the ex-leader's published log — the window is neither re-published
+    (no double-count) nor lost, and both parties' published logs
+    converge batch-for-batch."""
+    cfg = _cfg("flip", window_keys=2)
+    ld = str(tmp_path / "lease")
+    a = HeavyHitterStream(
+        cfg, str(tmp_path / "a"), peer=("127.0.0.1", 1), role="leader",
+        lease_dir=ld, lease_ttl=0.3, owner="party-a",
+    )
+    b = HeavyHitterStream(
+        cfg, str(tmp_path / "b"), peer=("127.0.0.1", 1), role="follower",
+        lease_dir=ld, lease_ttl=0.3, owner="party-b",
+    )
+    _boot(a)
+    _boot(b)
+    assert a.role == "leader" and a._lease_epoch == 1
+    assert b.role == "follower" and b._lease_epoch == 1
+
+    batch_values = {"b-0": [9, 9], "b-1": [40, 40]}
+    for bid, vals in batch_values.items():
+        blobs0, blobs1 = _blob_pair(dpf, cfg, vals)
+        a.ingest(cfg.parameters, blobs0, bid)
+        b.ingest(cfg.parameters, blobs1, bid)
+
+    _wire_lease(a, b)
+    # Replication "crashes": the publish line lands in a's retired log,
+    # the follower never hears about it.
+    a._flush_peer_state = _raise_unavailable
+    with a._lock:
+        w0 = a._pending_locked()[0]
+    with pytest.raises(UnavailableError):
+        a._advance_window(w0)
+    assert [r["batch_ids"] for r in a._published] == [["b-0"]]
+    assert b._published == []  # the gap the reconcile must close
+
+    a.release_on_stop = False  # SIGKILL: the lease must expire, not hand over
+    a.stop()
+
+    # The follower waits out the TTL, then takes the lease.
+    deadline = time.time() + 5.0
+    while b.role != "leader" and time.time() < deadline:
+        time.sleep(0.05)
+        b._lease_tick()
+    assert b.role == "leader" and b._lease_epoch == 2
+    assert b._reconciled is False  # must pull before the first advance
+    b._lease.ttl = 30.0  # pin the reign: no spurious re-flip below
+    assert b._lease.renew(2)
+
+    # The ex-leader restarts with its ORIGINAL flags and self-arbitrates
+    # into the follower role (the lease is held at a newer epoch).
+    a2 = HeavyHitterStream(
+        cfg, str(tmp_path / "a"), peer=("127.0.0.1", 1), role="leader",
+        lease_dir=ld, lease_ttl=0.3, owner="party-a",
+    )
+    _boot(a2)
+    assert a2.role == "follower" and a2._lease_epoch == 2
+    a2.stats_fields()  # journal reload (start() without the workers)
+    # Its own durable publish line survived the crash.
+    assert [r["batch_ids"] for r in a2._published] == [["b-0"]]
+
+    _wire_lease(b, a2)
+    b._reconcile_with_peer()
+    # Adopted exactly once — and a second pull stays idempotent.
+    assert [r["batch_ids"] for r in b._published] == [["b-0"]]
+    b._reconcile_with_peer()
+    assert len(b._published) == 1
+
+    _drain_leader(b)
+    snap = b.snapshot()
+    seen = [bid for r in snap["published"] for bid in r["batch_ids"]]
+    assert sorted(seen) == ["b-0", "b-1"]  # exactly-once across the flip
+    for rec in snap["published"]:
+        vals = [v for bid in rec["batch_ids"] for v in batch_values[bid]]
+        cnt = collections.Counter(vals)
+        want = {v: c for v, c in cnt.items() if c >= cfg.threshold}
+        got = {
+            int(p): int(c) for p, c in zip(rec["prefixes"], rec["counts"])
+        }
+        assert got == want
+    # Replication-before-rotation: the OTHER party holds both records
+    # too (b-0 from its own pre-crash journal, b-1 replicated in-line
+    # with b's publish) — the logs converge.
+    seen_a2 = [
+        bid for r in a2._published for bid in r["batch_ids"]
+    ]
+    assert sorted(seen_a2) == ["b-0", "b-1"]
+    # Journal level: exactly one published line per window on each side.
+    for stream in (b, a2):
+        pub = [
+            ln for ln in _published_kinds(stream)
+            if ln.get("kind") == "published"
+        ]
+        assert sorted(tuple(ln["batch_ids"]) for ln in pub) == [
+            ("b-0",), ("b-1",)
+        ]
+    b.stop()
+    a2.stop()
+
+
+def _raise_unavailable(*a, **kw):
+    raise UnavailableError("UNAVAILABLE: chaos — crashed before the ack")
+
+
+def test_zombie_leader_is_fenced_never_merged(dpf, tmp_path):
+    """The epoch fence: a lease stolen mid-window demotes the ex-leader
+    at its next renew fence (the publish record is WITHHELD, not
+    merged), and any request it still has in flight answers
+    FAILED_PRECONDITION at the peer."""
+    cfg = _cfg("fence", window_keys=2)
+    ld = str(tmp_path / "lease")
+    a = HeavyHitterStream(
+        cfg, str(tmp_path / "a"), peer=("127.0.0.1", 1), role="leader",
+        lease_dir=ld, lease_ttl=0.25, owner="party-a",
+    )
+    b = HeavyHitterStream(
+        cfg, str(tmp_path / "b"), peer=("127.0.0.1", 1), role="follower",
+        lease_dir=ld, lease_ttl=0.25, owner="party-b",
+    )
+    _boot(a)
+    _boot(b)
+    blobs0, blobs1 = _blob_pair(dpf, cfg, [9, 9])
+    a.ingest(cfg.parameters, blobs0, "b-0")
+    b.ingest(cfg.parameters, blobs1, "b-0")
+
+    _wire_lease(a, b)
+    real_peer = a._peer_level
+    stolen = {"done": False}
+
+    def stealing_peer(w, member, trail):
+        out = real_peer(w, member, trail)
+        if not stolen["done"]:
+            # The rival waits out the TTL mid-window and takes over.
+            stolen["done"] = True
+            deadline = time.time() + 5.0
+            got = None
+            while got is None and time.time() < deadline:
+                time.sleep(0.05)
+                got = b._lease.try_acquire()
+            assert got == 2
+        return out
+
+    a._peer_level = stealing_peer
+    with a._lock:
+        w0 = a._pending_locked()[0]
+    with pytest.raises(FailedPreconditionError, match="superseded"):
+        a._advance_window(w0)
+    # Demoted on the spot; the record was withheld, never logged.
+    assert a.role == "follower" and a._lease_epoch == 2
+    assert a._published == [] and not any(
+        ln.get("kind") == "published" for ln in _published_kinds(a)
+    )
+
+    # The receiving-side fence: b (promoted) rejects a stale-epoch leg
+    # outright — nothing it carries is merged.
+    with b._lock:
+        b._promote_locked(2)
+    with pytest.raises(FailedPreconditionError, match="zombie"):
+        b.aggregate(0, [], [], epoch=1, quarantine=["poison-id"])
+    assert "poison-id" not in b._quarantined_ids
+    # An equal-epoch leg at a party that IS the leader is fenced too
+    # (two leaders at one epoch cannot happen; refuse loudly).
+    with pytest.raises(FailedPreconditionError):
+        b.aggregate(0, [], [], epoch=2, quarantine=["poison-id"])
+    a.stop()
+    b.stop()
+
+
+def _poison_blob_pair(dpf, cfg, values, beta):
+    """Malicious client: beta != 1 keys — each key adds `beta` to its
+    value's count cell instead of 1."""
+    n = len(cfg.parameters)
+    out0, out1 = [], []
+    for v in values:
+        k0, k1 = dpf.generate_keys_incremental(int(v), [beta] * n)
+        out0.append(ser.serialize_dpf_key(k0, cfg.parameters))
+        out1.append(ser.serialize_dpf_key(k1, cfg.parameters))
+    return out0, out1
+
+
+def test_audit_quarantines_poisoned_batch_on_both_parties(dpf, tmp_path):
+    """The malicious-client audit (audit=True streams): a batch whose
+    level-0 aggregate does not reconstruct to one-hot mass (here beta=3
+    keys) is quarantined on BOTH parties before window membership —
+    honest batches publish exact counts, the poisoned batch never
+    contributes, and its retry is acknowledged-as-deduped forever
+    (durably, across a restart)."""
+    cfg = _cfg("aud", window_keys=4, audit=True)
+    assert cfg.audit is True
+    follower = HeavyHitterStream(cfg, str(tmp_path / "f"))
+    leader = HeavyHitterStream(
+        cfg, str(tmp_path / "l"), peer=("127.0.0.1", 1),
+    )
+
+    def peer_audit(generation, bid):
+        return follower.aggregate(generation, [bid], [], audit=True)
+
+    def peer_level(w, member, trail):
+        with leader._lock:
+            q = sorted(leader._quarantine_unacked)
+        out = follower.aggregate(
+            w.generation, list(member), trail, quarantine=q
+        )
+        with leader._lock:
+            leader._quarantine_unacked.difference_update(q)
+        return out
+
+    leader._peer_audit = peer_audit
+    leader._peer_level = peer_level
+
+    honest0, honest1 = _blob_pair(dpf, cfg, [9, 9])
+    poison0, poison1 = _poison_blob_pair(dpf, cfg, [40, 40], beta=3)
+    leader.ingest(cfg.parameters, honest0, "b-h")
+    follower.ingest(cfg.parameters, honest1, "b-h")
+    leader.ingest(cfg.parameters, poison0, "b-p")
+    follower.ingest(cfg.parameters, poison1, "b-p")
+
+    _drain_leader(leader)
+    snap = leader.snapshot()
+    assert len(snap["published"]) == 1
+    rec = snap["published"][0]
+    assert rec["batch_ids"] == ["b-h"]  # membership: honest only
+    got = {int(p): int(c) for p, c in zip(rec["prefixes"], rec["counts"])}
+    assert got == {9: 2}  # the oracle over honest batches, exact
+    # Quarantined on BOTH parties (the id rode the first peer leg).
+    assert "b-p" in leader._quarantined_ids
+    assert "b-p" in follower._quarantined_ids
+    assert leader.stats_fields()["quarantined"] == 1
+    assert follower.stats_fields()["quarantined"] == 1
+    # The retry of a quarantined batch is acknowledged-as-deduped.
+    assert leader.ingest(cfg.parameters, poison0, "b-p")[1] is True
+    assert leader.snapshot()["published"] == snap["published"]
+    leader.stop()
+    follower.stop()
+
+    # Durability: the quarantine line outranks the ingest records after
+    # a restart — the batch stays out, the retry stays deduped.
+    resumed = HeavyHitterStream(
+        cfg, str(tmp_path / "l"), peer=("127.0.0.1", 1),
+    )
+    resumed.stats_fields()  # journal reload
+    assert "b-p" in resumed._quarantined_ids
+    assert resumed.ingest(cfg.parameters, poison0, "b-p")[1] is True
+    assert [r["batch_ids"] for r in resumed._published] == [["b-h"]]
+    resumed.stop()
+
+
+def test_parse_stream_spec_audit_token():
+    cfg = parse_stream_spec("hh:12:2:5:24:3:audit")
+    assert cfg.audit is True and cfg.max_pending_windows == 3
+    assert parse_stream_spec("hh:12:2:5:24:3").audit is False
+    with pytest.raises(InvalidArgumentError, match="audit"):
+        parse_stream_spec("hh:12:2:5:24:3:bogus")
+
+
+def test_shared_journal_ownership_rehomes_stream(dpf, tmp_path):
+    """Fleet-sheltered streams (ISSUE 16): two replicas over ONE shared
+    journal volume never advance a stream concurrently — the per-stream
+    ownership lease admits exactly one; the other answers UNAVAILABLE
+    (the proxy's retry signal). Killing the owner re-homes the stream to
+    the survivor within the TTL, with dedup identity intact."""
+    cfg = _cfg("shr", window_keys=8)
+    r1 = HeavyHitterStream(
+        cfg, str(tmp_path), shared=True, owner="replica-1", lease_ttl=0.5,
+    )
+    r2 = HeavyHitterStream(
+        cfg, str(tmp_path), shared=True, owner="replica-2", lease_ttl=0.5,
+    )
+    blobs0, _ = _blob_pair(dpf, cfg, [9, 9])
+    more0, _ = _blob_pair(dpf, cfg, [40])
+
+    gen, deduped = r1.ingest(cfg.parameters, blobs0, "b-0")
+    assert deduped is False
+    assert r1.stats_fields()["accepted_batches"] == 1
+    assert r1.stats_fields()["lease_epoch"] == 1
+    # The rival replica is refused while the owner's lease is live...
+    with pytest.raises(UnavailableError, match="owned by replica"):
+        r2.ingest(cfg.parameters, more0, "b-1")
+    # ...and its health frame reports zeroed stream state (it must not
+    # load the other replica's live journals).
+    assert r2.stats_fields()["accepted_batches"] == 0
+
+    # SIGKILL the owner: no stop(), no release — the TTL is the word.
+    deadline = time.time() + 5.0
+    taken = False
+    while not taken and time.time() < deadline:
+        time.sleep(0.1)
+        try:
+            # The retry of b-0 after re-homing: the shared volume's
+            # journals carry the dedup identity to the survivor.
+            gen2, deduped2 = r2.ingest(cfg.parameters, blobs0, "b-0")
+            taken = True
+        except UnavailableError:
+            continue
+    assert taken and deduped2 is True and gen2 == gen
+    assert r2.ingest(cfg.parameters, more0, "b-1")[1] is False
+    fields = r2.stats_fields()
+    assert fields["accepted_batches"] == 2
+    assert fields["lease_epoch"] == 2  # the handoff bumped the epoch
+    # The ex-owner is now the one refused.
+    with pytest.raises(UnavailableError, match="owned by replica"):
+        r1.ingest(cfg.parameters, more0, "b-2")
+    r2.stop()
+    r1.stop()

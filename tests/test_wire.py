@@ -329,8 +329,12 @@ def test_op_payload_reencode_is_byte_identical(op, op_payloads):
         stream, since = wire.decode_hh_snapshot(payload)
         again = wire.encode_hh_snapshot(stream, since)
     elif op == "hh_aggregate":
-        stream, gen, batch_ids, plan = wire.decode_hh_aggregate(payload)
-        again = wire.encode_hh_aggregate(stream, gen, batch_ids, plan)
+        stream, gen, batch_ids, plan, ex = wire.decode_hh_aggregate(payload)
+        again = wire.encode_hh_aggregate(
+            stream, gen, batch_ids, plan, epoch=ex["epoch"],
+            publish=ex["publish"], audit=ex["audit"],
+            quarantine=ex["quarantine"],
+        )
     else:
         params, keys, plan, group = wire.decode_hierarchical(payload)
         again = wire.encode_hierarchical(params, keys, plan, group)
@@ -375,6 +379,48 @@ def test_payloads_reject_missing_fields():
         wire.decode_hh_snapshot(b"")
     with pytest.raises(InvalidArgumentError):
         wire.decode_hh_aggregate(b"")
+
+
+def test_hh_aggregate_extras_round_trip():
+    """ISSUE 16 appended fields (epoch / publish / audit / quarantine)
+    survive the wire byte-identically, a PR 15 payload still decodes to
+    the old meaning, and a notification-only leg (no level trail) is
+    valid as long as SOMETHING rides it."""
+    pub = {"generation": 4, "batch_ids": ["a"], "keys": 2,
+           "prefixes": ["9"], "counts": ["2"], "lease": True}
+    payload = wire.encode_hh_aggregate(
+        "hh", 4, [], [], epoch=7, publish=pub, audit=True,
+        quarantine=["q-1", "q-2"],
+    )
+    stream, gen, bids, plan, ex = wire.decode_hh_aggregate(payload)
+    assert (stream, gen, bids, plan) == ("hh", 4, [], [])
+    assert ex["epoch"] == 7 and ex["audit"] is True
+    assert ex["quarantine"] == ["q-1", "q-2"]
+    assert ex["publish"] == pub
+    again = wire.encode_hh_aggregate(
+        stream, gen, bids, plan, epoch=ex["epoch"], publish=ex["publish"],
+        audit=ex["audit"], quarantine=ex["quarantine"],
+    )
+    assert again == payload
+    # The PR 15 shape decodes to the extras' defaults — old wires work.
+    old = wire.encode_hh_aggregate("hh", 1, ["b"], [(0, [])])
+    *_, ex0 = wire.decode_hh_aggregate(old)
+    assert ex0 == {
+        "epoch": 0, "publish": None, "audit": False, "quarantine": [],
+    }
+    # A pure quarantine notification is a valid payload; an EMPTY leg
+    # (no trail, no notification) is not.
+    wire.decode_hh_aggregate(
+        wire.encode_hh_aggregate("hh", 0, [], [], quarantine=["x"])
+    )
+    with pytest.raises(InvalidArgumentError):
+        wire.decode_hh_aggregate(wire.encode_hh_aggregate("hh", 0, [], []))
+    with pytest.raises(InvalidArgumentError, match="not JSON"):
+        from distributed_point_functions_tpu.protos import wire as pb
+
+        wire.decode_hh_aggregate(
+            pb.len_field(1, b"hh") + pb.len_field(6, b"\x00garbage")
+        )
 
 
 def test_json_result_arrays_round_trip():

@@ -761,6 +761,99 @@ STREAM_THRESHOLD = 4
 STREAM_WINDOW_KEYS = 16
 STREAM_PENDING = 2
 STREAM_KEYS_PER_BATCH = 3
+#: the failover arm's stream: arm A's spec + the per-batch share audit
+#: (ISSUE 16) — a beta != 1 key batch is quarantined, never published.
+STREAM_SPEC_AUDIT = STREAM_SPEC + ":audit"
+
+
+def _free_port() -> int:
+    """Reserves an ephemeral port by bind-and-release: the failover arm
+    must PRESET both servers' ports (the leader and the follower each
+    name the other's endpoint on the command line) before either process
+    exists — ReplicaPool re-binds whatever sits in ``ports[i]``."""
+    import socket as _socket
+
+    s = _socket.socket()
+    try:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def _stream_kit(seed):
+    """The seeded batch/key fixtures shared by the ISSUE 16 stream
+    arms: (params, draw_batch, key_pair_for), where ``key_pair_for``
+    takes ``beta`` — beta != 1 keys are the malicious-client shape the
+    audit quarantines (each key claims beta mass instead of one-hot)."""
+    from distributed_point_functions_tpu.core.dpf import (
+        DistributedPointFunction,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+
+    bits, bpl = 12, 2
+    params = [
+        DpfParameters(lds, Int(64)) for lds in range(bpl, bits + 1, bpl)
+    ]
+    dpf = DistributedPointFunction.create_incremental(params)
+    n_levels = len(params)
+    rng = np.random.default_rng(seed)
+    hot = [int(v) for v in rng.integers(0, 1 << bits, size=3)]
+
+    def draw_batch():
+        pool = hot * 3 + [int(v) for v in rng.integers(0, 1 << bits, size=4)]
+        idx = rng.integers(0, len(pool), size=STREAM_KEYS_PER_BATCH)
+        return [pool[i] for i in idx]
+
+    def key_pair_for(vals, beta=1):
+        keys0, keys1 = [], []
+        for v in vals:
+            k0, k1 = dpf.generate_keys_incremental(
+                int(v), [beta] * n_levels
+            )
+            keys0.append(k0)
+            keys1.append(k1)
+        return keys0, keys1
+
+    return params, draw_batch, key_pair_for
+
+
+def _assert_stream_oracle(snap, batch_values, failures, label):
+    """Per-window EXACT equality with the honest-batch oracle plus
+    exactly-once membership over ``batch_values`` — the acceptance
+    assertion every stream arm shares. A batch id outside
+    ``batch_values`` (a poisoned or fenced-zombie id) failing into a
+    published window is its own failure line."""
+    import collections as _c
+
+    seen = []
+    for w in snap["published"]:
+        seen.extend(w["batch_ids"])
+        unknown = [b for b in w["batch_ids"] if b not in batch_values]
+        if unknown:
+            failures.append(
+                f"{label}: window {w['generation']} published non-honest "
+                f"batches {unknown}"
+            )
+            continue
+        cnt = _c.Counter(
+            v for b in w["batch_ids"] for v in batch_values[b]
+        )
+        want = {v: c for v, c in cnt.items() if c >= STREAM_THRESHOLD}
+        got = {int(p): int(c) for p, c in zip(w["prefixes"], w["counts"])}
+        if got != want:
+            failures.append(
+                f"{label}: window {w['generation']} published {got} != "
+                f"oracle {want}"
+            )
+    if sorted(seen) != sorted(batch_values):
+        dup = len(seen) - len(set(seen))
+        failures.append(
+            f"{label}: membership not exactly-once: {dup} duplicates, "
+            f"missing {sorted(set(batch_values) - set(seen))[:4]}, "
+            f"foreign {sorted(set(seen) - set(batch_values))[:4]}"
+        )
 
 
 def stream_main(args) -> int:
@@ -1185,6 +1278,493 @@ def stream_main(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Stream failover mode (ISSUE 16): leader kill, lease promotion, audits
+# ---------------------------------------------------------------------------
+
+
+def stream_failover_main(args) -> int:
+    """The leader-failover soak (ISSUE 16): a leader and a
+    lease-watching follower over one ``--stream-lease-root``, a seeded
+    poisoning client mixed into honest traffic, and the LEADER
+    SIGKILLED mid-stream. Asserts:
+
+      1. **failover by lease**: the follower promotes itself within
+         ~TTL of the kill and every honest batch publishes EXACTLY ONCE
+         across the flip — per-window counts equal the honest-batch
+         oracle and both parties' published logs converge;
+      2. **zombie fencing**: an hh_aggregate at the superseded epoch is
+         refused FAILED_PRECONDITION at the new leader and its payload
+         (a quarantine verdict for a fake batch id) is NEVER merged;
+      3. **boot arbitration**: the ex-leader restarted with its
+         ORIGINAL leader flags finds the live lease and demotes itself
+         to follower instead of split-braining;
+      4. **malicious-client audit**: both poisoned batches (beta != 1
+         key material) are quarantined on BOTH parties — and on exactly
+         the two of them — and appear in no published window.
+
+    engine=host everywhere: zero XLA programs (the wire-soak
+    discipline)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from distributed_point_functions_tpu.serving import (
+        DpfClient,
+        ReplicaPool,
+        RetryPolicy,
+        TwoServerClient,
+    )
+    from distributed_point_functions_tpu.utils.errors import (
+        FailedPreconditionError,
+    )
+
+    params, draw_batch, key_pair_for = _stream_kit(args.seed + 1)
+    tmp = tempfile.mkdtemp(prefix="dpf-stream-failover-")
+    lease_root = os.path.join(tmp, "lease")
+    pools = [None, None]
+    failures = []
+    batch_values = {}
+    t_start = time.perf_counter()
+    policy = RetryPolicy(
+        attempts=6, base_backoff=0.1, max_backoff=1.0,
+        attempt_timeout=20.0, connect_attempts=160, connect_backoff=0.25,
+        seed=args.seed,
+    )
+    try:
+        # Both ports preset: the leader's --stream-peer and the
+        # follower's --stream-follower-of each name the other.
+        port0, port1 = _free_port(), _free_port()
+        pools[0] = ReplicaPool(
+            replicas=1,
+            server_args=["--engine", "host", "--max-wait-ms", "2",
+                         "--stream", STREAM_SPEC_AUDIT,
+                         "--stream-peer", f"127.0.0.1:{port1}",
+                         "--stream-lease-root", lease_root,
+                         "--stream-lease-ttl", "1.0"],
+            base_dir=os.path.join(tmp, "party0"),
+            journal_base=os.path.join(tmp, "journal0"),
+        )
+        pools[0].ports[0] = port0
+        pools[1] = ReplicaPool(
+            replicas=1,
+            server_args=["--engine", "host", "--max-wait-ms", "2",
+                         "--stream", STREAM_SPEC_AUDIT,
+                         "--stream-follower-of", f"127.0.0.1:{port0}",
+                         "--stream-lease-root", lease_root,
+                         "--stream-lease-ttl", "1.0"],
+            base_dir=os.path.join(tmp, "party1"),
+            journal_base=os.path.join(tmp, "journal1"),
+        )
+        pools[1].ports[0] = port1
+        pools[0].start()
+        pools[1].start()
+        endpoints = [("127.0.0.1", port0), ("127.0.0.1", port1)]
+        print(f"failover soak: leader pid={pools[0].procs[0].pid} "
+              f"port={port0}, follower pid={pools[1].procs[0].pid} "
+              f"port={port1}, lease ttl=1.0s, tmp={tmp}")
+
+        client = TwoServerClient(endpoints, policy=policy)
+        client.wait_ready(timeout=180)
+        probe0 = DpfClient("127.0.0.1", port0, policy=policy)
+        probe1 = DpfClient("127.0.0.1", port1, policy=policy)
+
+        def _push(bid, pair, vals=None):
+            # One batch to BOTH parties, retried with the SAME key
+            # material (the client half of exactly-once) until accepted.
+            t_retry = time.perf_counter() + 120
+            while True:
+                try:
+                    client.hh_ingest("hh", params, pair, bid,
+                                     deadline=30.0)
+                    if vals is not None:
+                        batch_values[bid] = vals
+                    return
+                except Exception:  # noqa: BLE001 — keep trying
+                    if time.perf_counter() > t_retry:
+                        failures.append(f"{bid}: never accepted")
+                        return
+                    time.sleep(0.25)
+
+        # ---- pre-flip: honest batches + one poisoned batch -----------
+        for i in range(4):
+            vals = draw_batch()
+            _push(f"fb-{i}", key_pair_for(vals), vals)
+        _push("poison-pre", key_pair_for(draw_batch(), beta=3))
+        client.hh_ingest("hh", params, ([], []), "", flush=True,
+                         deadline=60.0)
+        t_end = time.perf_counter() + 120
+        while time.perf_counter() < t_end:
+            if probe0.hh_snapshot("hh", deadline=10.0)["published"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("pre-flip window never published")
+
+        # ---- SIGKILL the leader: the follower must promote by lease --
+        t_kill = time.perf_counter()
+        pools[0].kill(0)
+        print("failover soak: SIGKILLed the leader mid-stream")
+        flip_epoch = 0
+        t_end = time.perf_counter() + 60
+        while time.perf_counter() < t_end:
+            try:
+                st1 = probe1.stats(timeout=5.0)["streams"]["hh"]
+            except Exception:  # noqa: BLE001 — promotion poll
+                time.sleep(0.1)
+                continue
+            if st1["role"] == "leader" and st1["lease_epoch"] >= 2:
+                flip_epoch = st1["lease_epoch"]
+                break
+            time.sleep(0.05)
+        if not flip_epoch:
+            failures.append("follower never promoted itself by lease")
+        else:
+            print(f"failover soak: follower promoted to epoch "
+                  f"{flip_epoch} in {time.perf_counter() - t_kill:.2f}s "
+                  "after the kill")
+            # -- zombie fence: the superseded epoch at the new leader,
+            # carrying a quarantine verdict that must never merge.
+            try:
+                probe1.hh_aggregate("hh", 0, [], [],
+                                    epoch=flip_epoch - 1,
+                                    quarantine=["zombie-probe"],
+                                    deadline=20.0)
+                failures.append("zombie epoch accepted at the new "
+                                "leader (no FAILED_PRECONDITION)")
+            except FailedPreconditionError:
+                print("failover soak: zombie leg fenced with "
+                      "FAILED_PRECONDITION at the new leader")
+            except Exception as exc:  # noqa: BLE001 — soak reports
+                failures.append(f"zombie probe: unexpected "
+                                f"{type(exc).__name__}: {exc}")
+
+        # ---- the ex-leader returns with its ORIGINAL leader flags ----
+        pools[0].restart(0)
+        t_end = time.perf_counter() + 60
+        demoted = False
+        while time.perf_counter() < t_end:
+            try:
+                st0 = probe0.stats(timeout=5.0)["streams"]["hh"]
+            except Exception:  # noqa: BLE001 — restart poll
+                time.sleep(0.1)
+                continue
+            if st0["role"] == "follower":
+                demoted = True
+                break
+            time.sleep(0.05)
+        if not demoted:
+            failures.append("restarted ex-leader never demoted itself "
+                            "(boot lease arbitration broken)")
+        else:
+            print("failover soak: restarted ex-leader booted as follower")
+
+        # ---- post-flip: more honest traffic + a second poison --------
+        for i in range(4):
+            vals = draw_batch()
+            _push(f"fa-{i}", key_pair_for(vals), vals)
+        _push("poison-post", key_pair_for(draw_batch(), beta=3))
+
+        # ---- drain at the NEW leader ---------------------------------
+        honest = set(batch_values)
+        t_end = time.perf_counter() + 300
+        snap = None
+        while time.perf_counter() < t_end:
+            try:
+                client.hh_ingest("hh", params, ([], []), "", flush=True,
+                                 deadline=30.0)
+                snap = probe1.hh_snapshot("hh", deadline=10.0)
+            except Exception:  # noqa: BLE001 — drain keeps trying
+                time.sleep(0.25)
+                continue
+            done = {b for w in snap["published"] for b in w["batch_ids"]}
+            if done == honest and snap["pending_windows"] == 0:
+                break
+            time.sleep(0.25)
+        else:
+            got = {b for w in (snap or {"published": []})["published"]
+                   for b in w["batch_ids"]}
+            failures.append(
+                f"drain timeout: missing {sorted(honest - got)[:4]}, "
+                f"foreign {sorted(got - honest)[:4]}"
+            )
+
+        if snap is not None:
+            _assert_stream_oracle(snap, batch_values, failures,
+                                  "failover soak")
+            # -- both parties' published logs converge exactly ---------
+            snap0 = probe0.hh_snapshot("hh", deadline=10.0)
+            mine = {w["generation"]: sorted(w["batch_ids"])
+                    for w in snap["published"]}
+            theirs = {w["generation"]: sorted(w["batch_ids"])
+                      for w in snap0["published"]}
+            if mine != theirs:
+                failures.append(
+                    f"published logs diverge across the flip: new leader "
+                    f"{mine} != ex-leader {theirs}"
+                )
+            # -- quarantine: exactly the two poisons, on BOTH parties —
+            # one more would mean the fenced zombie's verdict leaked in.
+            t_end = time.perf_counter() + 30
+            qs = (0, 0)
+            while time.perf_counter() < t_end:
+                qs = (
+                    probe0.stats(timeout=5.0)["streams"]["hh"]["quarantined"],
+                    probe1.stats(timeout=5.0)["streams"]["hh"]["quarantined"],
+                )
+                if qs[0] >= 2 and qs[1] >= 2:
+                    break
+                time.sleep(0.25)
+            if qs != (2, 2):
+                failures.append(
+                    f"quarantined counts {qs} != (2, 2): the two "
+                    "poisoned batches on both parties and nothing else"
+                )
+            else:
+                print("failover soak: both poisons quarantined on both "
+                      "parties; zombie verdict never merged")
+        probe0.close()
+        probe1.close()
+        client.close()
+    finally:
+        for pool in pools:
+            if pool is not None:
+                pool.stop()
+        if not failures:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    total = time.perf_counter() - t_start
+    if failures:
+        print(f"failover soak: FAIL in {total:.1f}s (logs kept in {tmp}):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"failover soak: PASS in {total:.1f}s")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Fleet-sheltered stream mode (ISSUE 16): shared volume, owner kill
+# ---------------------------------------------------------------------------
+
+
+def stream_fleet_main(args) -> int:
+    """The fleet-sheltered stream soak (ISSUE 16): party 1 is TWO
+    replicas over one ``--stream-journal-root`` volume behind a
+    FleetProxy, party 0 a standalone leader peering at the proxy, and
+    the replica that OWNS the stream SIGKILLED mid-stream. Asserts:
+
+      1. **re-homing**: the survivor takes the per-stream ownership
+         lease inside the shared volume, resumes the dead replica's
+         journals ("streaming.rehomed" fires) and ingest + window
+         advance continue through the SAME proxy endpoint;
+      2. **exactly-once across the re-home**: a retried old batch
+         dedups on the survivor (the shared ingest journal is the dedup
+         spine) and the published union holds every batch exactly once;
+      3. **exact counts**: per-window counts equal the batch oracle.
+
+    engine=host everywhere: zero XLA programs."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from distributed_point_functions_tpu.serving import (
+        DpfClient,
+        FleetProxy,
+        ReplicaPool,
+        RetryPolicy,
+        TwoServerClient,
+    )
+
+    params, draw_batch, key_pair_for = _stream_kit(args.seed + 2)
+    tmp = tempfile.mkdtemp(prefix="dpf-stream-fleet-")
+    shared = os.path.join(tmp, "shared-journal")
+    pools = [None, None]
+    proxy = None
+    failures = []
+    batch_values = {}
+    batch_pairs = {}
+    t_start = time.perf_counter()
+    policy = RetryPolicy(
+        attempts=8, base_backoff=0.1, max_backoff=1.0,
+        attempt_timeout=20.0, connect_attempts=160, connect_backoff=0.25,
+        seed=args.seed,
+    )
+    try:
+        pools[1] = ReplicaPool(
+            replicas=2,
+            server_args=["--engine", "host", "--max-wait-ms", "2",
+                         "--stream", STREAM_SPEC,
+                         "--stream-lease-ttl", "1.0"],
+            base_dir=os.path.join(tmp, "party1"),
+            stream_journal_root=shared,
+        )
+        pools[1].start()
+        proxy = FleetProxy(pools[1].endpoints).start()
+        pools[0] = ReplicaPool(
+            replicas=1,
+            server_args=["--engine", "host", "--max-wait-ms", "2",
+                         "--stream", STREAM_SPEC,
+                         "--stream-peer", f"127.0.0.1:{proxy.port}"],
+            base_dir=os.path.join(tmp, "party0"),
+            journal_base=os.path.join(tmp, "journal0"),
+        )
+        pools[0].start()
+        endpoints = [("127.0.0.1", pools[0].ports[0]),
+                     ("127.0.0.1", proxy.port)]
+        print(f"stream fleet soak: leader port={endpoints[0][1]}, "
+              f"party-1 replicas {pools[1].ports} behind proxy port="
+              f"{proxy.port}, shared journal {shared}")
+
+        client = TwoServerClient(endpoints, policy=policy)
+        client.wait_ready(timeout=180)
+
+        def _push(bid, pair, vals):
+            t_retry = time.perf_counter() + 120
+            while True:
+                try:
+                    client.hh_ingest("hh", params, pair, bid,
+                                     deadline=30.0)
+                    batch_values[bid] = vals
+                    return
+                except Exception:  # noqa: BLE001 — keep trying
+                    if time.perf_counter() > t_retry:
+                        failures.append(f"{bid}: never accepted")
+                        return
+                    time.sleep(0.25)
+
+        # ---- warm: prove the full advance path through the proxy -----
+        vals = draw_batch()
+        batch_pairs["cw-0"] = key_pair_for(vals)
+        client.hh_ingest("hh", params, batch_pairs["cw-0"], "cw-0",
+                         flush=True, deadline=120.0)
+        batch_values["cw-0"] = vals
+        t_end = time.perf_counter() + 120
+        while time.perf_counter() < t_end:
+            snap = client.clients[0].hh_snapshot("hh", deadline=10.0)
+            if snap["published"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise RuntimeError("warm window never published via the proxy")
+
+        # ---- find the OWNING replica, feed it, SIGKILL it ------------
+        owner = None
+        for i in range(2):
+            rc = DpfClient("127.0.0.1", pools[1].ports[i], policy=policy)
+            st = rc.stats(timeout=10.0)["streams"]["hh"]
+            rc.close()
+            if st["accepted_batches"] > 0:
+                owner = i
+        if owner is None:
+            raise RuntimeError("no replica owns the stream after the "
+                               "warm window — ownership lease broken?")
+        for i in range(3):
+            v = draw_batch()
+            batch_pairs[f"cb-{i}"] = key_pair_for(v)
+            _push(f"cb-{i}", batch_pairs[f"cb-{i}"], v)
+        survivor = 1 - owner
+        pools[1].kill(owner)
+        print(f"stream fleet soak: SIGKILLed owning replica {owner} "
+              f"(port {pools[1].ports[owner]}); survivor is replica "
+              f"{survivor}")
+
+        # ---- post-kill: the stream must re-home and keep accepting ---
+        for i in range(3, 6):
+            v = draw_batch()
+            batch_pairs[f"cb-{i}"] = key_pair_for(v)
+            _push(f"cb-{i}", batch_pairs[f"cb-{i}"], v)
+        # Exactly-once across the re-home: a retry of an OLD batch with
+        # its ORIGINAL key material must dedup on the survivor.
+        try:
+            (_g0, d0), (_g1, d1) = client.hh_ingest(
+                "hh", params, batch_pairs["cb-0"], "cb-0", deadline=60.0
+            )
+            if not d1:
+                failures.append(
+                    "re-homed survivor re-admitted cb-0 (dedup spine "
+                    "lost in the shared-journal handoff)"
+                )
+            if not d0:
+                failures.append("leader re-admitted cb-0 (dedup lost)")
+        except Exception as exc:  # noqa: BLE001 — soak reports
+            failures.append(f"cb-0 retry after the re-home: "
+                            f"{type(exc).__name__}: {exc}")
+
+        # ---- drain + oracle ------------------------------------------
+        honest = set(batch_values)
+        t_end = time.perf_counter() + 300
+        snap = None
+        while time.perf_counter() < t_end:
+            try:
+                client.hh_ingest("hh", params, ([], []), "", flush=True,
+                                 deadline=30.0)
+                snap = client.clients[0].hh_snapshot("hh", deadline=10.0)
+            except Exception:  # noqa: BLE001 — drain keeps trying
+                time.sleep(0.25)
+                continue
+            done = {b for w in snap["published"] for b in w["batch_ids"]}
+            if done == honest and snap["pending_windows"] == 0:
+                break
+            time.sleep(0.25)
+        else:
+            got = {b for w in (snap or {"published": []})["published"]
+                   for b in w["batch_ids"]}
+            failures.append(
+                f"drain timeout: missing {sorted(honest - got)[:4]}"
+            )
+        if snap is not None:
+            _assert_stream_oracle(snap, batch_values, failures,
+                                  "stream fleet soak")
+
+        # ---- the survivor really re-homed the stream -----------------
+        sc = DpfClient("127.0.0.1", pools[1].ports[survivor],
+                       policy=policy)
+        st = sc.stats(timeout=10.0)
+        sc.close()
+        rehomed = _counter_sum(st, "streaming.rehomed")
+        hh = st["streams"]["hh"]
+        if rehomed < 1:
+            failures.append(
+                "survivor never counted streaming.rehomed — who served "
+                "the post-kill batches?"
+            )
+        if hh["accepted_batches"] < len(honest):
+            failures.append(
+                f"survivor resumed {hh['accepted_batches']} accepted "
+                f"batches < {len(honest)} uploaded (shared journal "
+                "reload incomplete)"
+            )
+        if not failures:
+            print(f"stream fleet soak: survivor re-homed with "
+                  f"{hh['accepted_batches']} accepted batches, "
+                  f"lease_epoch={hh['lease_epoch']}, "
+                  f"{len(snap['published'])} windows published")
+        client.close()
+    finally:
+        if proxy is not None:
+            proxy.stop()
+        for pool in pools:
+            if pool is not None:
+                pool.stop()
+        if not failures:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    total = time.perf_counter() - t_start
+    if failures:
+        print(f"stream fleet soak: FAIL in {total:.1f}s (logs kept in "
+              f"{tmp}):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"stream fleet soak: PASS in {total:.1f}s")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # Fleet mode (ISSUE 14): replica pools behind FleetProxy, kill + rehash
 # ---------------------------------------------------------------------------
 
@@ -1426,14 +2006,24 @@ def main() -> int:
     ap.add_argument("--fleet-requests", type=int, default=480)
     ap.add_argument("--fleet-threads", type=int, default=6)
     ap.add_argument("--stream", action="store_true",
-                    help="streaming heavy-hitters soak: windowed "
-                    "ingestion + follower kill mid-window (ISSUE 15)")
+                    help="streaming heavy-hitters soaks: follower kill "
+                    "mid-window (ISSUE 15), then leader-kill lease "
+                    "failover + poisoning client, then fleet-sheltered "
+                    "owner-replica kill (ISSUE 16)")
     ap.add_argument("--stream-batches", type=int, default=12,
                     help="ingest batches per client thread in --stream")
     ap.add_argument("--stream-threads", type=int, default=3)
     args = ap.parse_args()
     if args.stream:
-        return stream_main(args)
+        # Three arms, every process role killed once across them:
+        # follower (ISSUE 15), leader (lease failover), fleet replica
+        # (shared-volume re-home). Any arm failing fails the soak.
+        rc = stream_main(args)
+        if rc == 0:
+            rc = stream_failover_main(args)
+        if rc == 0:
+            rc = stream_fleet_main(args)
+        return rc
     if args.fleet:
         return fleet_main(args)
     if args.wire:

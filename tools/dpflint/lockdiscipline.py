@@ -46,6 +46,7 @@ THREADED_MODULES = (
     f"{PACKAGE}/serving/server.py",
     f"{PACKAGE}/serving/fleet.py",
     f"{PACKAGE}/serving/streaming.py",
+    f"{PACKAGE}/serving/lease.py",
 )
 
 _LOCK_CTORS = {
