@@ -30,7 +30,21 @@ def bench(jax, smoke):
     num_queries = int(os.environ.get("BENCH_QUERIES", 8 if smoke else 64))
     key_chunk = int(os.environ.get("BENCH_KEY_CHUNK", 8))
     n_dev = len(jax.devices())
-    if smoke and n_dev >= 8:
+    # BENCH_PIR_MESH=KxD selects the pod-scale sharded-megakernel path
+    # (ISSUE 17): the megakernel-order DB rows shard over 'domain', the
+    # query batch over 'keys', one shard_map program per key chunk.
+    mesh_spec = os.environ.get("BENCH_PIR_MESH", "")
+    pir_mesh = None
+    if mesh_spec:
+        try:
+            k_s, d_s = (int(p) for p in mesh_spec.lower().split("x"))
+        except ValueError:
+            raise SystemExit(
+                f"BENCH_PIR_MESH must be KxD (e.g. 2x4), got {mesh_spec!r}"
+            )
+        pir_mesh = sharded.make_mesh(k_s, d_s)
+        mesh = pir_mesh
+    elif smoke and n_dev >= 8:
         mesh = sharded.make_mesh(2, 4)
     else:
         mesh = sharded.make_mesh(1, n_dev)
@@ -66,6 +80,8 @@ def bench(jax, smoke):
     # "fused" value-emission shape (and 3.2/1.7 q/s respectively on the
     # XLA bitslice, where HBM pressure made slabbed fused win).
     mode = os.environ.get("BENCH_PIR_MODE", "fold")
+    if pir_mesh is not None:
+        mode = "megakernel"  # the only mode the sharded path dispatches
     # The DB is the server's static state: permute/upload once at setup
     # (prepare_pir_database) — per-query upload would measure the host
     # link, not the query engine. Each mode consumes its own row order:
@@ -76,15 +92,27 @@ def bench(jax, smoke):
     import jax.numpy as jnp
 
     with Timer() as tdb:
-        db_dev = (
-            sharded.prepare_pir_database(dpf, db, order=db_order)
-            if single_chip
-            else jnp.asarray(db)
+        if pir_mesh is not None:
+            # Shard-direct upload: each device gets its own megakernel-order
+            # row slab at prepare time (no post-hoc resharding of the DB).
+            db_dev = sharded.prepare_pir_database(
+                dpf, db, order="megakernel", mesh=pir_mesh
+            )
+        elif single_chip:
+            db_dev = sharded.prepare_pir_database(dpf, db, order=db_order)
+        else:
+            db_dev = jnp.asarray(db)
+        jax.block_until_ready(
+            db_dev.lane_db if (single_chip or pir_mesh is not None) else db_dev
         )
-        jax.block_until_ready(db_dev.lane_db if single_chip else db_dev)
     log(f"db setup (permute + upload): {tdb.elapsed:.1f}s")
 
     def run(qkeys):
+        if pir_mesh is not None:
+            return sharded.pir_query_batch_chunked(
+                dpf, qkeys, db_dev, key_chunk=key_chunk,
+                mode="megakernel", mesh=pir_mesh,
+            )
         if single_chip:
             # One device: the chunked bulk path — no shard_map needed.
             return sharded.pir_query_batch_chunked(
@@ -122,6 +150,22 @@ def bench(jax, smoke):
     result_extra = {} if verified else {
         "error": "two-server reconstruction failed on the warmup batch"
     }
+    roofline_fields = {}
+    if pir_mesh is not None:
+        # Per-shard AND aggregate HBM roofline for the sharded record: the
+        # per-eval byte model is mesh-invariant (each DB row is read on
+        # exactly one 'domain' shard), the ceilings scale with chip count.
+        from distributed_point_functions_tpu.utils import roofline
+
+        n_chips = pir_mesh.shape["keys"] * pir_mesh.shape["domain"]
+        roofline_fields = roofline.hbm_fields(
+            scanned / t.elapsed,
+            log_domain,
+            strategy="megakernel",
+            lpe=db.shape[1],
+            pir=True,
+            n_chips=n_chips,
+        )
     return {
         **result_extra,
         "bench": "pir",
@@ -136,6 +180,8 @@ def bench(jax, smoke):
             "log_domain": log_domain,
             "num_queries": num_queries,
             "mesh": dict(mesh.shape),
+            **({"mode": mode} if (pir_mesh is not None or mode != "fold") else {}),
+            **roofline_fields,
         },
         "db_bytes_scanned_per_s": round(scanned * 16 / t.elapsed),
     }

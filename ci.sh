@@ -18,6 +18,12 @@
 #   ./ci.sh faults     integrity tier: the runtime-integrity /
 #                      fault-injection suite (tests marked 'faults'),
 #                      forced onto XLA:CPU.
+#   ./ci.sh multichip  mesh tier: the full __graft_entry__ dryrun on a
+#                      forced 8-device CPU platform — sharded PIR,
+#                      sharded expansion, key-sharded fused hierarchy,
+#                      and the bounded real-circuit sharded-megakernel
+#                      PIR regime (ISSUE 17; replay engine, zero pallas
+#                      configs).
 #   ./ci.sh all        lint + fast + smoke.
 #
 # Every tier exits nonzero on the first failure. Tests force a virtual
@@ -98,6 +104,20 @@ run_device() {
   CHECK_EXTRAS=all python tools/check_device.py
 }
 
+run_multichip() {
+  # ISSUE 17: the multi-device regression gate. __graft_entry__ forces a
+  # virtual 8-device CPU platform itself (_force_cpu_mesh) and runs all
+  # four sharded regimes, including the bounded sharded-megakernel PIR
+  # dryrun: the real AES circuit pins the per-shard decomposition via
+  # EAGER megakernel replays (the ~27K-eqn row graph cannot compile
+  # through any jitted program on XLA-CPU in CI time), and the jitted
+  # shard_map machinery runs with a cheap lane-local stand-in, full mesh
+  # vs the 1x1 degenerate mesh — zero pallas interpret configs either
+  # way. JAX_PLATFORMS=cpu pinned here too so the tier can never contend
+  # for the TPU claim.
+  JAX_PLATFORMS=cpu python __graft_entry__.py
+}
+
 run_faults() {
   # Runtime-integrity / fault-injection suite (ISSUE 1): every injected
   # fault class must be detected by sentinel verification and recovered by
@@ -155,7 +175,8 @@ case "$tier" in
   smoke) run_smoke ;;
   device) run_device ;;
   faults) run_faults ;;
+  multichip) run_multichip ;;
   all) run_lint; run_fast; run_smoke ;;
-  *) echo "unknown tier: $tier (lint|fast|slow|smoke|device|faults|all)" >&2; exit 2 ;;
+  *) echo "unknown tier: $tier (lint|fast|slow|smoke|device|faults|multichip|all)" >&2; exit 2 ;;
 esac
 echo "ci: tier '$tier' passed"

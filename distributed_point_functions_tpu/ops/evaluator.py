@@ -1626,6 +1626,7 @@ def plan_megakernel(
     hierarchy_level: int = -1,
     host_levels: Optional[int] = None,
     vmem_budget: Optional[int] = None,
+    domain_shards: int = 1,
 ) -> MegakernelPlan:
     """Sizes the megakernel's slab geometry from a VMEM budget, analogous
     to `plan_slabs` sizing HBM output slabs.
@@ -1637,7 +1638,18 @@ def plan_megakernel(
     [K, lpe, fold_words <= 128] no matter what this chooses: unlike
     `plan_slabs`, there is no output-size wall to plan around — the
     >= 16M-leaf materialization threshold is structurally unreachable
-    (pinned by tests/test_megakernel.py)."""
+    (pinned by tests/test_megakernel.py).
+
+    `domain_shards` > 1 sizes the PER-SHARD plan for the mesh-sharded PIR
+    path (parallel/sharded.build_sharded_megakernel_step): each 'domain'
+    shard owns a contiguous 1/domain_shards slice of the level-host_levels
+    entry tile — entry lane index IS the tree node id at that level, and
+    the doubling expansion is data-independent of node id, so the shard's
+    kernel on its entry slice computes exactly the leaves of its contiguous
+    domain slice. Both entry_words and total_words divide by the shard
+    count; the VMEM budget stays naturally per-chip, so DB capacity scales
+    linearly with domain shards at a constant per-chip footprint. The
+    kernel body is UNCHANGED — a shard plan is just a smaller plan."""
     v = dpf.validator
     if hierarchy_level < 0:
         hierarchy_level = v.num_hierarchy_levels - 1
@@ -1662,6 +1674,21 @@ def plan_megakernel(
     w_v_max = _floor_pow2(max(1, (vmem_budget // 4) // (129 * 4)))
     entry_words = 1 << (host_levels - 5)
     total_words = 1 << (stop - 5)
+    if domain_shards != 1:
+        if domain_shards < 1 or domain_shards & (domain_shards - 1):
+            raise InvalidArgumentError(
+                f"domain_shards must be a power of two, got {domain_shards}"
+            )
+        if entry_words % domain_shards:
+            raise InvalidArgumentError(
+                f"sharded megakernel needs host_levels >= 5 + "
+                f"log2(domain_shards): the {entry_words}-word entry tile at "
+                f"host_levels {host_levels} does not split across "
+                f"{domain_shards} domain shards (each shard owns whole "
+                "packed entry words)"
+            )
+        entry_words //= domain_shards
+        total_words //= domain_shards
     final_words = min(total_words, w_f_max)
     num_slabs = total_words // final_words
     if num_slabs > (1 << 20):

@@ -289,17 +289,25 @@ def dcf_chain(dcf, mode: Optional[str]) -> Tuple[Rung, ...]:
 
 
 def fold_chain(mode: Optional[str]) -> Tuple[Rung, ...]:
-    """The full-domain-fold / PIR chain: megakernel → fold/pallas →
-    fold/jax → numpy (host fold)."""
+    """The full-domain-fold / PIR chain: sharded-megakernel (PIR only,
+    needs a mesh) → megakernel → fold/pallas → fold/jax → numpy (host
+    fold). 'sharded-megakernel' never resolves from the env default — it
+    only enters the chain when the caller asked for the mesh path
+    (pir_query_batch_robust mode=/mesh=), and its first downgrade rung is
+    the SAME kernel on one device, so a mesh-layer fault (collective
+    timeout, device loss) sheds to single-chip before shedding engines."""
     from . import evaluator
 
     resolved = mode if mode is not None else evaluator._fold_mode_default()
-    if resolved not in ("fold", "megakernel"):
+    if resolved not in ("fold", "megakernel", "sharded-megakernel"):
         raise InvalidArgumentError(
-            f"mode must be 'fold' or 'megakernel', got {resolved!r}"
+            f"mode must be 'fold', 'megakernel' or 'sharded-megakernel', "
+            f"got {resolved!r}"
         )
     rungs = []
-    if resolved == "megakernel":
+    if resolved == "sharded-megakernel":
+        rungs.append(("sharded-megakernel", "pallas"))
+    if resolved in ("megakernel", "sharded-megakernel"):
         rungs.append(("megakernel", "pallas"))
     if evaluator._pallas_default():
         rungs.append(("fold", "pallas"))
@@ -1209,18 +1217,37 @@ def pir_query_batch_robust(
     policy: DegradationPolicy = DEFAULT_POLICY,
     pipeline: Optional[bool] = None,
     mode: Optional[str] = None,
+    mesh=None,
 ) -> np.ndarray:
     """`parallel.sharded.pir_query_batch_chunked` behind the supervisor:
-    megakernel → fold/pallas → fold/jax → numpy (host fold), sentinel-
-    verified per rung via the existing probe machinery. A mode downgrade
-    that invalidates the prepared database's ``order=`` row layout
-    (megakernel's streaming tiles vs the lane permutation) re-prepares it
+    sharded-megakernel (mesh) → megakernel → fold/pallas → fold/jax →
+    numpy (host fold), sentinel-verified per rung via the existing probe
+    machinery. A mode downgrade that invalidates the prepared database's
+    ``order=``/mesh row layout (megakernel's streaming tiles vs the lane
+    permutation; one mesh's column blocks vs another's) re-prepares it
     from the cached natural-order host copy — served queries keep their
     answers bit-exact across the transition. `db_limbs` is a host
-    uint32[D, lpe] array or any-order ``PreparedPirDatabase``."""
+    uint32[D, lpe] array or any-order ``PreparedPirDatabase``.
+
+    `mesh` (a sharded.make_mesh / multihost.local_mesh (keys, domain)
+    mesh; default: the DPF_TPU_PIR_MESH env via
+    sharded.pir_mesh_from_env when mode='sharded-megakernel' asks for
+    one) puts the pod-scale rung on top of the chain: the sharded
+    megakernel's first downgrade is the SAME kernel on one device, so a
+    mesh-layer fault sheds to single-chip before shedding engines."""
     from ..parallel import sharded
     from . import evaluator
 
+    if mesh is not None and mode is None:
+        mode = "sharded-megakernel"
+    if mode == "sharded-megakernel" and mesh is None:
+        mesh = sharded.pir_mesh_from_env()
+        if mesh is None:
+            raise InvalidArgumentError(
+                "mode='sharded-megakernel' needs a mesh: pass mesh= (see "
+                "sharded.make_mesh / multihost.local_mesh) or set "
+                "DPF_TPU_PIR_MESH=KxD"
+            )
     v = dpf.validator
     bits, _xor = evaluator._value_kind(v.parameters[-1].value_type)
     chain = fold_chain(mode)
@@ -1236,47 +1263,61 @@ def pir_query_batch_robust(
             )
         return nat_cache["nat"]
 
-    def _db_for(want_order: str):
+    def _db_for(want_order: str, want_mesh=None):
         if (
             isinstance(db_limbs, sharded.PreparedPirDatabase)
             and db_limbs.order == want_order
+            and db_limbs.mesh == want_mesh
         ):
             return db_limbs
-        if want_order not in prepared_cache:
-            prepared_cache[want_order] = sharded.prepare_pir_database(
-                dpf, _nat_db(), host_levels, order=want_order
+        cache_key = (want_order, want_mesh)
+        if cache_key not in prepared_cache:
+            prepared_cache[cache_key] = sharded.prepare_pir_database(
+                dpf, _nat_db(), host_levels, order=want_order,
+                mesh=want_mesh,
             )
             if isinstance(db_limbs, sharded.PreparedPirDatabase):
                 integrity.emit_event(
                     "pir-db-reprepared",
                     "pir_query_batch_robust: mode rung needs a "
-                    f"{want_order!r}-order database; re-prepared from the "
-                    f"{db_limbs.order!r}-order original's natural-order "
-                    "host copy (one upload per downgrade, not per query)",
+                    f"{want_order!r}-order (mesh "
+                    f"{sharded._mesh_desc(want_mesh)}) database; "
+                    "re-prepared from the "
+                    f"{db_limbs.order!r}-order (mesh "
+                    f"{sharded._mesh_desc(db_limbs.mesh)}) original's "
+                    "natural-order host copy (one upload per downgrade, "
+                    "not per query)",
                     "",
                     op="pir_query_batch",
                     from_order=db_limbs.order,
                     to_order=want_order,
                 )
                 _tm.counter("supervisor.pir_db_reprepared", op="pir_query_batch")
-        return prepared_cache[want_order]
+        return prepared_cache[cache_key]
 
     def attempt(mode_r: Optional[str], backend: str, chunk: Optional[int]):
         ck = chunk if chunk is not None else key_chunk
         if backend == "numpy":
             return _host_pir_fold(dpf, keys, _nat_db(), bits)
-        want_order = "megakernel" if mode_r == "megakernel" else "lane"
+        sharded_rung = mode_r == "sharded-megakernel"
+        want_order = (
+            "megakernel" if mode_r in ("megakernel", "sharded-megakernel")
+            else "lane"
+        )
         try:
-            pdb = _db_for(want_order)
+            pdb = _db_for(want_order, mesh if sharded_rung else None)
             return sharded.pir_query_batch_chunked(
                 dpf, keys, pdb,
                 key_chunk=ck,
                 host_levels=host_levels,
-                mode=mode_r or "fold",
+                mode="megakernel" if sharded_rung else (mode_r or "fold"),
+                mesh=mesh if sharded_rung else None,
                 integrity=True if policy.verify is None else policy.verify,
                 pipeline=pipeline,
                 use_pallas=(
-                    None if mode_r == "megakernel" else backend == "pallas"
+                    None
+                    if mode_r in ("megakernel", "sharded-megakernel")
+                    else backend == "pallas"
                 ),
             )
         except NotImplementedError as exc:

@@ -112,14 +112,33 @@ def _multi_host_markers_present() -> bool:
 def local_mesh(
     n_key_shards: Optional[int] = None,
     n_domain_shards: Optional[int] = None,
+    shape: Optional[Tuple[int, int]] = None,
 ):
     """A (keys, domain) mesh over THIS host's chips only.
 
     Domain collectives stay on the host's ICI by construction. Defaults to
     all local devices on the domain axis (n_key_shards=1).
+
+    `shape` is the explicit ``(keys, domain)`` pair form (the tuple the
+    "KxD" knobs — DPF_TPU_PIR_MESH, BENCH_PIR_MESH — parse to); mutually
+    exclusive with the per-axis arguments. A shape whose product is not
+    `jax.local_device_count()` raises InvalidArgumentError naming both,
+    instead of surfacing as a raw mesh-construction error deep in jax.
     """
     import jax
 
+    if shape is not None:
+        if n_key_shards is not None or n_domain_shards is not None:
+            raise InvalidArgumentError(
+                "pass shape=(keys, domain) OR "
+                "n_key_shards/n_domain_shards, not both"
+            )
+        try:
+            n_key_shards, n_domain_shards = (int(s) for s in shape)
+        except (TypeError, ValueError):
+            raise InvalidArgumentError(
+                f"shape must be a (keys, domain) pair, got {shape!r}"
+            )
     devices = jax.local_devices()
     n_local = len(devices)
     for name, v in (("n_key_shards", n_key_shards), ("n_domain_shards", n_domain_shards)):

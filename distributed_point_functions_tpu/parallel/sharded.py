@@ -285,6 +285,212 @@ def build_pir_step(
     return jax.jit(step)
 
 
+def pir_mesh_from_env():
+    """Optional serving-default PIR mesh from the DPF_TPU_PIR_MESH env
+    ("KxD", e.g. "2x4" — keys x domain shards, utils/envflags). Returns
+    None when unset, so single-device deployments pay nothing; a malformed
+    value raises InvalidArgumentError rather than silently running
+    unsharded."""
+    spec = envflags.env_str("DPF_TPU_PIR_MESH", "")
+    if not spec:
+        return None
+    parts = spec.lower().split("x")
+    if len(parts) != 2 or not all(p.isdigit() and int(p) > 0 for p in parts):
+        raise errors.InvalidArgumentError(
+            f"DPF_TPU_PIR_MESH must be 'KxD' (keys x domain shards, e.g. "
+            f"'2x4'), got {spec!r}"
+        )
+    return make_mesh(int(parts[0]), int(parts[1]))
+
+
+def _mesh_desc(mesh) -> str:
+    """'KxD' (or 'none (single-device)') for error messages."""
+    if mesh is None:
+        return "none (single-device)"
+    return f"{mesh.shape['keys']}x{mesh.shape['domain']}"
+
+
+@functools.lru_cache(maxsize=None)
+def build_sharded_megakernel_step(
+    mesh: Mesh,
+    plan,  # evaluator.MegakernelPlan — the PER-SHARD plan
+    bits: int,
+    party: int,
+    xor_group: bool,
+    keep: int,
+    engine: str,  # "pallas" (real Mosaic kernel) | "replay" (XLA reference)
+):
+    """Compiles one server's mesh-sharded slab-megakernel PIR step.
+
+    Returns jitted fn(seeds [K, M, 4], control_mask [K, M//32],
+    cw_planes [K, L, 128], ccl [K, L], ccr [K, L], corrections
+    [K, epb, lpe], db_rows [keep*lpe*32, D*shard_words]) -> [K, lpe]:
+    keys sharded over 'keys'; the entry-plane tile AND the DB's
+    megakernel-order rows sharded over 'domain'; ONE program per call.
+
+    The sharding trick is the entry-plane fast-forward: at level
+    host_levels the entry lane index IS the tree node id, and the
+    doubling expansion applies the same per-level correction words to
+    every lane — so shard d's kernel, run UNCHANGED on its contiguous
+    slice of the entry tile with the per-shard plan
+    (evaluator.plan_megakernel(domain_shards=D)), computes exactly the
+    leaves of domain slice [d*domain/D, (d+1)*domain/D) and ANDs them
+    against its own DB tile streamed from its own HBM. Each shard emits a
+    [Kl, lpe] partial inner product; XOR has no hardware collective, so
+    the partials ride one all_gather over 'domain' and reduce locally
+    (the `build_pir_step` tail — bytes on the wire: D * Kl * lpe * 4).
+
+    `engine` picks the per-shard fold program: "pallas" is the real
+    Mosaic megakernel (`aes_pallas.megakernel_fold_pallas_batched`,
+    kernel body untouched — the Mosaic surface and the dpflint
+    mosaic-opset baseline stay frozen); "replay" traces
+    `megakernel_reference_rows` as a plain XLA program — the off-TPU
+    default, so the forced-host-device mesh tests and dryruns add ZERO
+    interpret-pallas compile configs (pallas-inside-shard_map stays
+    staged for a hardware window)."""
+    if engine not in ("pallas", "replay"):
+        raise errors.InvalidArgumentError(
+            f"engine must be 'pallas' or 'replay', got {engine!r}"
+        )
+    from ..ops import aes_pallas
+
+    def device_fn(seeds, control_mask, cw_planes, ccl, ccr, corrections, db_rows):
+        # Pack INSIDE the sharded program: the whole per-chunk computation
+        # (pack + expand + in-kernel inner product + collective) is one
+        # device program — the megakernel's one-dispatch-per-chunk contract
+        # survives sharding (tests/test_dispatch_audit.py pins it).
+        planes = jax.vmap(aes_jax.pack_to_planes)(seeds)  # [Kl, 128, ew]
+        if engine == "pallas":
+            folds = aes_pallas.megakernel_fold_pallas_batched(
+                planes, control_mask, cw_planes, ccl, ccr, corrections,
+                db_rows, plan=plan, bits=bits, party=party,
+                xor_group=xor_group, keep=keep,
+            )  # [Kl, lpe, fold_words]
+            partial = jnp.bitwise_xor.reduce(folds, axis=2)
+        else:
+            ref = functools.partial(
+                aes_pallas.megakernel_reference_rows,
+                plan=plan, bits=bits, party=party,
+                xor_group=xor_group, keep=keep,
+            )
+            partial = jax.vmap(ref, in_axes=(0, 0, 0, 0, 0, 0, None))(
+                planes, control_mask, cw_planes, ccl, ccr, corrections,
+                db_rows,
+            )  # [Kl, lpe]
+        gathered = jax.lax.all_gather(partial, "domain")  # [D, Kl, lpe]
+        return jnp.bitwise_xor.reduce(gathered, axis=0)
+
+    step = backend_jax.shard_map(
+        device_fn,
+        mesh=mesh,
+        in_specs=(
+            P("keys", "domain", None),  # seeds: entry lanes follow the tree
+            P("keys", "domain"),  # control_mask: whole packed entry words
+            P("keys"),  # cw_planes
+            P("keys"),  # ccl
+            P("keys"),  # ccr
+            P("keys"),  # corrections
+            P(None, "domain"),  # db_rows: one column block per shard
+        ),
+        out_specs=P("keys"),
+    )
+    return jax.jit(step)
+
+
+def _sharded_megakernel_fold_chunks(
+    dpf, keys, pdb, mesh, key_chunk, host_levels, pipeline
+):
+    """Yields (num_valid_keys, fold [chunk, lpe] sharded P('keys')) per key
+    chunk through `build_sharded_megakernel_step` — the mesh twin of
+    evaluator.full_domain_fold_chunks(mode='megakernel'). Host chunk prep
+    stays on `evaluator._prepare_chunk_host`; every device upload is a
+    shard-direct `device_put` onto its NamedSharding (a transfer, never a
+    device program — uploading single-device and letting shard_map reshard
+    costs extra eager dispatches per chunk, the round-5 audit lesson)."""
+    from jax.sharding import NamedSharding
+
+    from ..ops import pipeline as _pl
+
+    v = dpf.validator
+    hierarchy_level = v.num_hierarchy_levels - 1
+    value_type = v.parameters[hierarchy_level].value_type
+    bits, xor_group = evaluator._value_kind(value_type)
+    if bits % 32:
+        raise NotImplementedError(
+            f"megakernel value correction handles 32-bit-multiple widths "
+            f"(Int/XorWrapper 32/64/128), got {bits}-bit values"
+        )
+    batch = evaluator.KeyBatch.from_keys(dpf, keys, hierarchy_level)
+    spec = batch.spec
+    if not (spec.is_scalar_direct and spec.blocks_needed == 1):
+        raise NotImplementedError(
+            "the sharded megakernel folds scalar Int/XorWrapper value "
+            "types; evaluate IntModN/Tuple outputs via "
+            "sharded_full_domain_evaluate"
+        )
+    stop = batch.num_levels
+    lds = v.parameters[hierarchy_level].log_domain_size
+    keep = 1 << (lds - stop)
+    plan = pdb.plan  # the PER-SHARD plan (prepare validated it)
+    hl = plan.host_levels
+    evaluator._inject_batch_faults(batch, True)
+    backend_jax.log_backend_once()
+
+    # Pad the key axis to a multiple of the 'keys' mesh axis and make the
+    # chunk width a shard multiple too, so every chunk's shard_map splits
+    # evenly (padded rows repeat key 0; the caller trims).
+    n_keys = batch.seeds.shape[0]
+    k_shards = mesh.shape["keys"]
+    pad = (-n_keys) % k_shards
+    if pad:
+        batch = batch.take(
+            np.concatenate([np.arange(n_keys), np.zeros(pad, dtype=np.int64)])
+        )
+    n_padded = n_keys + pad
+    key_chunk = max(k_shards, -(-int(key_chunk) // k_shards) * k_shards)
+    # Off-TPU the per-shard fold runs the XLA replay of the SAME slab
+    # computation (zero interpret-pallas configs on the forced-host mesh);
+    # on TPU it is the real Mosaic megakernel, unchanged.
+    engine = "pallas" if jax.default_backend() == "tpu" else "replay"
+    _tm.decision("pir_query_batch_chunked", f"sharded-megakernel/{engine}",
+                 "backend-default")
+    step = build_sharded_megakernel_step(
+        mesh, plan, bits, batch.party, xor_group, keep, engine
+    )
+    ks_s = NamedSharding(mesh, P("keys"))
+    kd3_s = NamedSharding(mesh, P("keys", "domain", None))
+    kd2_s = NamedSharding(mesh, P("keys", "domain"))
+    db_dev = pdb.lane_db
+
+    def _dispatch(kb, valid):
+        seeds_h, control_mask, cw, ccl, ccr, corr, _m = (
+            evaluator._prepare_chunk_host(kb, hl, True, bits)
+        )
+        if _tm.enabled():
+            _tm.counter(
+                "bytes.h2d",
+                _tm.nbytes_of([seeds_h, control_mask, cw, ccl, ccr, corr]),
+            )
+        return valid, step(
+            jax.device_put(seeds_h, kd3_s),
+            jax.device_put(control_mask, kd2_s),
+            jax.device_put(cw, ks_s),
+            jax.device_put(ccl, ks_s),
+            jax.device_put(ccr, ks_s),
+            jax.device_put(corr, ks_s),
+            db_dev,
+        )
+
+    def _thunks():
+        for kb, valid in evaluator._key_chunks(batch, n_padded, key_chunk):
+            yield functools.partial(_dispatch, kb, valid)
+
+    pipe = _pl.resolve(pipeline)
+    yield from _pl.prefetch_thunks(
+        _thunks(), pipe, backend="pallas", op="pir_query_batch_chunked"
+    )
+
+
 def _pir_probe(dpf, keys, integrity_flag, context: str, backend: str):
     """PIR-side alias of the shared probe setup (utils/integrity.py).
     `backend` is the fault-injection level of the call, so backend-scoped
@@ -513,14 +719,16 @@ class PreparedPirDatabase:
     `pir_query_batch`'s shape check and silently produce XOR inner
     products against a permuted DB."""
 
-    __slots__ = ("lane_db", "order", "host_levels", "plan", "_nat_host")
+    __slots__ = ("lane_db", "order", "host_levels", "plan", "mesh",
+                 "_nat_host")
 
     def __init__(self, lane_db, order: str = "lane", host_levels=None,
-                 plan=None):
+                 plan=None, mesh=None):
         self.lane_db = lane_db
         self.order = order
         self.host_levels = host_levels  # the lane permutation's parameter
         self.plan = plan  # megakernel order: the MegakernelPlan it encodes
+        self.mesh = mesh  # sharded megakernel: the Mesh the layout targets
         self._nat_host = None
 
     def natural_host(self, dpf) -> np.ndarray:
@@ -539,21 +747,31 @@ class PreparedPirDatabase:
             elif self.order == "megakernel":
                 # Invert megakernel_db_rows: row (e*lpe + l)*32 + i at
                 # word w holds limb l of element e of the block at global
-                # lane 32w+i, whose domain row is leaves[g]*keep + e.
+                # lane 32w+i, whose domain row is leaves[g]*keep + e. Mesh
+                # layouts concatenate one such tile per domain shard along
+                # the word axis; shard d's local leaf g is global leaf
+                # g + d * leaves_per_shard (the entry-plane fast-forward:
+                # contiguous domain slices per shard).
                 v = dpf.validator
                 stop = v.hierarchy_to_tree[-1]
                 lds = v.parameters[-1].log_domain_size
                 keep = 1 << (lds - stop)
                 lpe = lane_host.shape[0] // (keep * 32)
                 leaves = ev._megakernel_block_leaves(self.plan)
-                blocks = leaves.reshape(-1, 32)  # [W_total, 32]
+                d_shards = (
+                    self.mesh.shape["domain"] if self.mesh is not None else 1
+                )
+                shard_w = lane_host.shape[1] // d_shards
                 nat = np.zeros(((1 << lds), lpe), np.uint32)
-                for e in range(keep):
-                    rows = blocks * keep + e
-                    for l in range(lpe):
-                        nat[rows, l] = lane_host[
-                            (e * lpe + l) * 32 : (e * lpe + l + 1) * 32, :
-                        ].T
+                for d in range(d_shards):
+                    shard = lane_host[:, d * shard_w : (d + 1) * shard_w]
+                    blocks = (leaves + d * leaves.shape[0]).reshape(-1, 32)
+                    for e in range(keep):
+                        rows = blocks * keep + e
+                        for l in range(lpe):
+                            nat[rows, l] = shard[
+                                (e * lpe + l) * 32 : (e * lpe + l + 1) * 32, :
+                            ].T
                 self._nat_host = nat
             else:
                 # Invert the one-time permutation to recover the
@@ -573,6 +791,7 @@ def prepare_pir_database(
     db_limbs: np.ndarray,  # uint32[D, lpe]
     host_levels=None,
     order: str = "lane",
+    mesh: Mesh = None,
 ) -> "PreparedPirDatabase":
     """Uploads a PIR database to the device ONCE, permuted for its consumer:
     order="lane" (default) permutes into the per-level expansion's lane
@@ -584,7 +803,21 @@ def prepare_pir_database(
     grid step — evaluator.megakernel_db_rows). A PIR server's DB is
     static: re-uploading it per query batch would put the host link
     (megabytes/s through this image's tunnel) on the query path — prepare
-    at setup, query forever after."""
+    at setup, query forever after.
+
+    `mesh` (order="megakernel" only) lays the rows out for the
+    mesh-sharded megakernel path: the domain splits into
+    mesh.shape['domain'] contiguous slices (shard d owns
+    [d*D/n, (d+1)*D/n) — at the entry plane the lane index IS the tree
+    node id, so each shard's subtree covers exactly its slice), each
+    slice gets its OWN megakernel row tile under the per-shard plan
+    (evaluator.plan_megakernel(domain_shards=n) — slabs sized against
+    per-chip VMEM, so total DB capacity scales linearly with domain
+    shards), and the concatenated [keep*lpe*32, n*shard_words] array
+    uploads via ONE `device_put` onto NamedSharding(P(None, 'domain')) —
+    each column block lands shard-direct on its owning chip as a
+    transfer; nothing reshards a 100+MB array post-hoc (the round-5
+    dispatch-audit lesson)."""
     from ..ops import evaluator as ev
 
     v = dpf.validator
@@ -596,11 +829,42 @@ def prepare_pir_database(
             f"db has {db_limbs.shape[0]} rows; the DPF domain has {domain} "
             "elements — they must match exactly"
         )
+    if mesh is not None and order != "megakernel":
+        raise errors.InvalidArgumentError(
+            f"mesh-sharded preparation exists only for order='megakernel' "
+            f"(got order={order!r}); the other orders feed single-device "
+            "consumers"
+        )
     if order == "natural":
         # Walk-mode output is already trimmed to the domain, so the natural
         # DB uploads as-is.
         return PreparedPirDatabase(jnp.asarray(db_limbs), order="natural")
     if order == "megakernel":
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            d_shards = mesh.shape["domain"]
+            plan = ev.plan_megakernel(
+                dpf, hierarchy_level, host_levels, domain_shards=d_shards
+            )
+            per = domain // d_shards
+            rows = np.concatenate(
+                [
+                    ev.megakernel_db_rows(
+                        dpf, db_limbs[d * per : (d + 1) * per], plan,
+                        hierarchy_level,
+                    )
+                    for d in range(d_shards)
+                ],
+                axis=1,
+            )
+            lane = jax.device_put(
+                rows, NamedSharding(mesh, P(None, "domain"))
+            )
+            return PreparedPirDatabase(
+                lane, order="megakernel",
+                host_levels=plan.host_levels, plan=plan, mesh=mesh,
+            )
         plan = ev.plan_megakernel(dpf, hierarchy_level, host_levels)
         rows = ev.megakernel_db_rows(dpf, db_limbs, plan, hierarchy_level)
         return PreparedPirDatabase(
@@ -631,6 +895,7 @@ def pir_query_batch_chunked(
     integrity=None,
     pipeline=None,
     use_pallas=None,
+    mesh: Mesh = None,
 ) -> np.ndarray:
     """Single-device PIR answers via the chunked bulk evaluator.
 
@@ -660,8 +925,16 @@ def pir_query_batch_chunked(
     INSIDE the expansion kernel against database tiles streamed from HBM
     with double-buffered DMA, so the DB is read once per key per batch and
     the expansion itself never touches HBM at all; takes the "megakernel"-
-    order PreparedPirDatabase. For multi-chip domain sharding use
-    `pir_query_batch`.
+    order PreparedPirDatabase. With `mesh` (a make_mesh/local_mesh
+    (keys, domain) mesh), mode="megakernel" runs POD-SCALE: the key batch
+    shards over 'keys', each chunk is ONE jitted shard_map program whose
+    per-shard body packs + fast-forwards the entry plane of its OWN
+    domain slice and runs the slab megakernel UNCHANGED against its OWN
+    DB column block (prepare_pir_database(order='megakernel',
+    mesh=mesh) — per-shard plans sized against per-chip VMEM/HBM, so DB
+    capacity scales linearly with domain shards and throughput with key
+    shards), and the [Kl, lpe] partial inner products reduce by one XOR
+    all-gather over 'domain'. `mesh` is rejected for every other mode.
 
     `db_limbs` may be a host uint32[D, lpe] array (permuted + uploaded on
     every call — fine for tests, wrong for serving) or the
@@ -702,6 +975,12 @@ def pir_query_batch_chunked(
             ev._pallas_default() if use_pallas is None else use_pallas
         )
     )
+    if mesh is not None and mode != "megakernel":
+        raise errors.InvalidArgumentError(
+            f"mesh sharding exists only for mode='megakernel' (got "
+            f"mode={mode!r}); the per-level sharded path is "
+            "pir_query_batch"
+        )
     keys, probe = _pir_probe(
         dpf, keys, integrity, "pir_query_batch_chunked", fi_backend
     )
@@ -724,11 +1003,23 @@ def pir_query_batch_chunked(
                 f"PreparedPirDatabase, got {db_limbs.order!r}"
             )
         if mode == "megakernel":
-            # The row layout encodes one slab plan; a budget/host_levels
-            # change between prepare and query would silently AND against
-            # mis-ordered tiles.
+            # The row layout encodes one slab plan AND one mesh; a
+            # budget/host_levels/mesh change between prepare and query
+            # would silently AND against mis-ordered tiles, so both are
+            # REJECTED — never silently re-laid-out (a re-layout is a
+            # 100+MB host round trip hiding on the query path).
+            db_mesh = db_limbs.mesh
+            if db_mesh != mesh:
+                raise errors.InvalidArgumentError(
+                    "database prepared for mesh "
+                    f"{_mesh_desc(db_mesh)} but the query asked for mesh "
+                    f"{_mesh_desc(mesh)}; re-run prepare_pir_database("
+                    "order='megakernel', mesh=...) for the query mesh"
+                )
             current = ev.plan_megakernel(
-                dpf, -1, host_levels or db_limbs.plan.host_levels
+                dpf, -1, host_levels or db_limbs.plan.host_levels,
+                domain_shards=(mesh.shape["domain"] if mesh is not None
+                               else 1),
             )
             if current != db_limbs.plan:
                 raise errors.InvalidArgumentError(
@@ -737,6 +1028,7 @@ def pir_query_batch_chunked(
                     "prepare_pir_database(order='megakernel')"
                 )
             host_levels = db_limbs.plan.host_levels
+        pdb = db_limbs
         db_dev = db_limbs.lane_db
     elif isinstance(db_limbs, jax.Array):
         raise errors.InvalidArgumentError(
@@ -744,9 +1036,10 @@ def pir_query_batch_chunked(
             "host array); a bare device array's row order is ambiguous"
         )
     else:
-        db_dev = prepare_pir_database(
-            dpf, db_limbs, host_levels, order=want_order
-        ).lane_db
+        pdb = prepare_pir_database(
+            dpf, db_limbs, host_levels, order=want_order, mesh=mesh
+        )
+        db_dev = pdb.lane_db
     db_nat = None
     if probe is not None:
         if isinstance(db_limbs, PreparedPirDatabase):
@@ -759,6 +1052,25 @@ def pir_query_batch_chunked(
         n_valid, fold = item
         return np.asarray(fold)[:n_valid]
 
+    if mode == "megakernel" and mesh is not None:
+        rows = list(
+            _pl.consume(
+                _sharded_megakernel_fold_chunks(
+                    dpf, keys, pdb, mesh, key_chunk=key_chunk,
+                    host_levels=host_levels, pipeline=pipeline,
+                ),
+                _pull,
+                pipe,
+                backend=fi_backend,
+                op="pir_query_batch_chunked",
+            )
+        )
+        # Trim the key-shard padding the sharded generator added so every
+        # chunk's shard_map splits evenly over the 'keys' axis.
+        res = np.concatenate(rows, axis=0)[: len(keys)]
+        return _pir_verify_fold(
+            probe, res, db_nat, "pir_query_batch_chunked", fi_backend
+        )
     if mode in ("fold", "megakernel"):
         rows = list(
             _pl.consume(

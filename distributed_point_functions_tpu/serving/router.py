@@ -26,7 +26,7 @@ module turns it into a per-batch decision::
   routes around itself.
 
 Modes with **no verified device measurement** (megakernel / walkkernel /
-hierkernel — all staged-for-tunnel, ROADMAP) are *not* candidates by
+hierkernel / sharded-megakernel — all staged-for-tunnel, ROADMAP) are *not* candidates by
 default: routing production traffic on a projection would re-create the
 caching-illusion era PERF.md documents. They enter the candidate set only
 once a live measurement teaches them (``observe`` / a calibration file
@@ -133,7 +133,12 @@ UNVERIFIED_MODES: Dict[Tuple[str, str], Tuple[str, ...]] = {
     ("evaluate_at", "device"): ("walkkernel",),
     ("dcf", "device"): ("walkkernel",),
     ("hierarchical", "device"): ("hierkernel",),
-    ("pir", "device"): ("megakernel",),
+    # ISSUE 17: the mesh-sharded megakernel has never run on hardware
+    # (the forced-host-device mesh checks bit-exactness, not rate); its
+    # projection scales the single-chip VPU ceiling by the Workload's
+    # mesh chip count (throughput with 'keys' shards, capacity with
+    # 'domain' shards).
+    ("pir", "device"): ("megakernel", "sharded-megakernel"),
     # ISSUE 13: device keygen (the plane-space XLA / Mosaic row-kernel
     # modes of ops/keygen_batch.py) has never run on hardware — host
     # wins every keygen batch until a measurement teaches it.
@@ -182,6 +187,13 @@ class Workload:
     value_bits: int = 64
     value_kind: str = "u64"
     key_chunk: Optional[int] = None
+    #: (keys, domain) mesh axes of a pod-scale PIR workload (ISSUE 17);
+    #: (1, 1) = single-device. Only the "sharded-megakernel" candidate
+    #: reads them: its projected rate is the single-chip ceiling times
+    #: the chip count (learned rates already embody the mesh they were
+    #: measured on and are NOT rescaled).
+    mesh_keys: int = 1
+    mesh_domain: int = 1
     #: shape-bucketed device axes (the front door's _bucket_target
     #: padding; None = same as the request axes): the device engine runs
     #: THE PADDED PROGRAM, so its cost must be predicted — and its rate
@@ -355,7 +367,8 @@ class CostModel:
         return roofline.host_thread_speedup(self.host_threads)
 
     def rate(
-        self, op: str, engine: str, mode: Optional[str], kind: str, bits: int
+        self, op: str, engine: str, mode: Optional[str], kind: str,
+        bits: int, n_chips: int = 1,
     ) -> Optional[float]:
         """items/s for a candidate, or None when the candidate has no
         basis (unverified mode with no learned rate and projections off).
@@ -379,19 +392,28 @@ class CostModel:
             and mode in UNVERIFIED_MODES.get((anchor_op, "device"), ())
             and self.include_projections
         ):
-            return self._projection_rate(anchor_op, mode, bits)
+            return self._projection_rate(anchor_op, mode, bits, n_chips)
         return None
 
-    def _projection_rate(self, op: str, mode: str, bits: int) -> float:
+    def _projection_rate(
+        self, op: str, mode: str, bits: int, n_chips: int = 1
+    ) -> float:
         """Roofline-ceiling estimate for a staged-for-tunnel kernel mode,
-        derated by PROJECTION_DERATE. Explicit opt-in only."""
+        derated by PROJECTION_DERATE. Explicit opt-in only. `n_chips`
+        (mesh-sharded modes only — predict() passes 1 otherwise) scales
+        the VPU ceiling by the mesh size: every chip expands its own
+        domain slice of its own key shard, and the only cross-chip work
+        is the [Kl, lpe] XOR all-gather."""
         from ..utils import roofline
 
         lpe = max(1, bits // 32)
         ops_per = roofline.hash_ops_per_block()["element_ops_per_block"]
         if op in ("full_domain", "pir"):
             # megakernel: ~3 hashes per leaf (hashes_per_eval at depth).
-            return roofline.V5E_VPU_OPS_PER_SEC / (3.0 * ops_per) * PROJECTION_DERATE
+            return (
+                roofline.V5E_VPU_OPS_PER_SEC * max(1, n_chips)
+                / (3.0 * ops_per) * PROJECTION_DERATE
+            )
         if op in ("evaluate_at", "dcf", "mic", "gate"):
             caps = 33 if op in ("dcf", "mic", "gate") else 1
             f = roofline.walk_hbm_fields(1.0, 32, "walkkernel", lpe, caps)
@@ -474,7 +496,14 @@ class CostModel:
         out: Dict[Tuple[str, Optional[str]], float] = {}
         op = _anchor_op(w.op)
         for engine, mode in self.candidates(w.op):
-            rate = self.rate(w.op, engine, mode, w.value_kind, w.value_bits)
+            nc = (
+                max(1, w.mesh_keys * w.mesh_domain)
+                if mode == "sharded-megakernel"
+                else 1
+            )
+            rate = self.rate(
+                w.op, engine, mode, w.value_kind, w.value_bits, n_chips=nc
+            )
             if rate is None or rate <= 0:
                 continue
             disp = (

@@ -670,7 +670,14 @@ def run_device_check(
     pallas on Mosaic platforms, else the plane-space XLA mode — must
     byte-match the scalar oracle on spot rows AND its keys must evaluate
     bit-exact under the HOST engine at alpha and off-alpha points —
-    CHECK_MODE=keygen, the hardware gate for device-side dealers).
+    CHECK_MODE=keygen, the hardware gate for device-side dealers), or
+    "sharded" (ISSUE 17: per shape, a two-server PIR batch through the
+    mesh-sharded slab megakernel — parallel.sharded.
+    pir_query_batch_chunked(mode='megakernel', mesh=...) on the
+    DPF_TPU_PIR_MESH mesh, else 2 x n/2 over the local devices — must
+    reconstruct DB[alpha] against the host oracle AND byte-match the
+    single-device megakernel on the same keys — CHECK_MODE=sharded, the
+    hardware gate for the pod-scale PIR path).
 
     `pipeline` (None = DPF_TPU_PIPELINE env / platform default) drives the
     chunk generators through the pipelined executor (ops/pipeline.py) —
@@ -714,6 +721,10 @@ def run_device_check(
         return failures + _run_keygen_check(
             shapes, rng, report, pipeline=pipeline
         )
+    if mode == "sharded":
+        return failures + _run_sharded_check(
+            shapes, rng, report, pipeline=pipeline
+        )
     for num_keys, lds in shapes:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
         alphas = [int(x) for x in rng.integers(0, 1 << lds, size=num_keys)]
@@ -755,6 +766,111 @@ def run_device_check(
                 mode=mode,
             )
         failures += bad
+    return failures
+
+
+def _run_sharded_check(shapes, rng, report, pipeline=None) -> int:
+    """CHECK_MODE=sharded body of `run_device_check` (ISSUE 17): the
+    mesh-sharded slab-megakernel PIR path on the live backend.
+
+    Per (num_keys, log_domain) shape, a two-server XorWrapper(128) PIR
+    batch runs through `parallel.sharded.pir_query_batch_chunked(
+    mode='megakernel', mesh=...)` — DB column blocks sharded over the
+    'domain' axis, keys over 'keys', one shard_map program per chunk —
+    and must (a) reconstruct DB[alpha] for every key pair (the two
+    servers' responses XOR to the database row: the host-oracle check,
+    with the sentinel probe riding every batch via integrity=True) and
+    (b) byte-match the SINGLE-DEVICE megakernel on the same keys and
+    database (the degenerate-mesh cross-engine check — the collective,
+    the per-shard plans and the column-block layout must be exactly
+    invisible in the answers). The mesh comes from DPF_TPU_PIR_MESH when
+    set, else 2 x n/2 over the local devices (n/1 when n is odd)."""
+    import jax
+
+    from ..core.dpf import DistributedPointFunction
+    from ..core.params import DpfParameters
+    from ..core.value_types import XorWrapper
+    from ..parallel import sharded
+
+    failures = 0
+    mesh = sharded.pir_mesh_from_env()
+    if mesh is None:
+        n = jax.local_device_count()
+        k = 2 if n % 2 == 0 and n > 1 else 1
+        mesh = sharded.make_mesh(k, n // k)
+    d_shards = mesh.shape["domain"]
+    # Each domain shard must own whole packed entry words: host_levels >=
+    # 5 + log2(domain shards) (plan_megakernel validates the same bound).
+    need_hl = 5 + max(0, (d_shards - 1).bit_length())
+    for num_keys, lds in shapes:
+        if lds < need_hl + 1:
+            report(
+                f"keys={num_keys:4d} log_domain={lds:3d} mode=sharded: "
+                f"SKIP (needs log_domain > {need_hl} for "
+                f"{d_shards} domain shards)"
+            )
+            continue
+        dpf = DistributedPointFunction.create(
+            DpfParameters(lds, XorWrapper(128))
+        )
+        domain = 1 << lds
+        db = rng.integers(
+            0, 1 << 32, size=(domain, 4), dtype=np.uint64
+        ).astype(np.uint32)
+        alphas = [int(x) for x in rng.integers(0, domain, size=num_keys)]
+        beta = (1 << 128) - 1
+        pairs = [dpf.generate_keys(a, beta) for a in alphas]
+        pdb = sharded.prepare_pir_database(
+            dpf, db, host_levels=need_hl, order="megakernel", mesh=mesh
+        )
+        pdb_one = sharded.prepare_pir_database(
+            dpf, db, host_levels=need_hl, order="megakernel"
+        )
+        res, res_one = [], []
+        for party in (0, 1):
+            pk = [p[party] for p in pairs]
+            res.append(
+                sharded.pir_query_batch_chunked(
+                    dpf, pk, pdb, key_chunk=num_keys, host_levels=need_hl,
+                    mode="megakernel", mesh=mesh, pipeline=pipeline,
+                    integrity=True,
+                )
+            )
+            res_one.append(
+                sharded.pir_query_batch_chunked(
+                    dpf, pk, pdb_one, key_chunk=num_keys,
+                    host_levels=need_hl, mode="megakernel",
+                    pipeline=pipeline, integrity=True,
+                )
+            )
+        rec = np.bitwise_xor(res[0], res[1])
+        want = db[np.asarray(alphas)]
+        bad = int((rec != want).any(axis=1).sum())
+        bad_eng = int(
+            (res[0] != res_one[0]).any(axis=1).sum()
+            + (res[1] != res_one[1]).any(axis=1).sum()
+        )
+        status = (
+            "OK" if bad == 0 and bad_eng == 0
+            else f"MISMATCH ({bad} keys vs oracle, "
+                 f"{bad_eng} vs single-device)"
+        )
+        report(
+            f"keys={num_keys:4d} log_domain={lds:3d} mode=sharded "
+            f"mesh={sharded._mesh_desc(mesh)}: {status}"
+        )
+        if bad or bad_eng:
+            emit_event(
+                "corruption",
+                f"sharded device check: {bad} keys mismatch the oracle, "
+                f"{bad_eng} the single-device megakernel at "
+                f"log_domain={lds} mesh={sharded._mesh_desc(mesh)}",
+                _backend_name(),
+                num_keys=num_keys,
+                log_domain=lds,
+                mode="sharded",
+            )
+        failures += bad + bad_eng
     return failures
 
 

@@ -200,27 +200,50 @@ def hbm_fields(
     lpe: int = 2,
     keep: int = 2,
     pir: bool = False,
+    n_chips: int = 1,
 ) -> dict:
     """HBM-bandwidth roofline fields for a measured record, next to the
     VPU ones (`mfu_fields`): which wall — VPU arithmetic or HBM traffic —
-    the record sits against, per the traffic model above."""
+    the record sits against, per the traffic model above.
+
+    With `n_chips` > 1 (a sharded-megakernel mesh), the PER-EVAL byte
+    model is unchanged — sharding the database along `domain` means each
+    row is still read from HBM exactly once, on exactly one shard — but
+    the aggregate walls scale: the fleet has n_chips HBM pipes and
+    n_chips VPUs, so both ceilings multiply by n_chips (the binding wall
+    is therefore mesh-invariant) and utilization is measured against the
+    aggregate bandwidth. `evals_per_sec` must then be the whole-mesh
+    throughput, and the per-chip figures are also emitted so a record can
+    be compared against single-chip runs directly.
+    """
+    if n_chips < 1:
+        raise errors.InvalidArgumentError(
+            f"`n_chips` must be positive, got {n_chips}"
+        )
     bpe = hbm_bytes_per_eval(log_domain, strategy, lpe, keep, pir)
     vpu = mfu_fields(evals_per_sec, log_domain)
-    vpu_ceiling = vpu["roofline_ceiling_evals_per_sec"]
+    vpu_ceiling = vpu["roofline_ceiling_evals_per_sec"] * n_chips
     if bpe <= 0:
         hbm_ceiling = float("inf")
     else:
-        hbm_ceiling = V5E_HBM_BYTES_PER_SEC / bpe
+        hbm_ceiling = n_chips * V5E_HBM_BYTES_PER_SEC / bpe
     binding = "hbm" if hbm_ceiling < vpu_ceiling else "vpu"
     out = {
         "hbm_bytes_per_eval_model": round(bpe, 2),
         "hbm_bw_utilization_model": (
-            round(evals_per_sec * bpe / V5E_HBM_BYTES_PER_SEC, 4)
+            round(evals_per_sec * bpe / (n_chips * V5E_HBM_BYTES_PER_SEC), 4)
         ),
         "binding_wall": binding,
     }
     if hbm_ceiling != float("inf"):
         out["hbm_ceiling_evals_per_sec"] = round(hbm_ceiling)
+    if n_chips > 1:
+        out["roofline_n_chips"] = n_chips
+        out["evals_per_sec_per_chip"] = round(evals_per_sec / n_chips)
+        if hbm_ceiling != float("inf"):
+            out["hbm_ceiling_evals_per_sec_per_chip"] = round(
+                hbm_ceiling / n_chips
+            )
     return out
 
 

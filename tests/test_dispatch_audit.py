@@ -283,6 +283,60 @@ def test_megakernel_program_budget(program_counter, monkeypatch):
         jax.clear_caches()  # drop cheap-circuit traces
 
 
+def test_sharded_megakernel_program_budget(program_counter, monkeypatch):
+    """ISSUE 17: the mesh-sharded megakernel PIR path is EXACTLY one
+    device program per key chunk — pack + per-shard slab fold + the XOR
+    all-gather are ONE jitted shard_map program, and every per-chunk host
+    input lands shard-direct via device_put onto its NamedSharding (a
+    transfer, never a program) — with the pipelined executor on AND off.
+    Cheap `_aes_rows` stand-in (the count is circuit-independent); the
+    2x4 mesh rides the forced 8-device CPU platform."""
+    import jax
+
+    from distributed_point_functions_tpu.core.value_types import XorWrapper
+    from distributed_point_functions_tpu.ops import aes_pallas
+    from test_aes_pallas import _CheapRows
+
+    jax.clear_caches()
+    sharded.build_sharded_megakernel_step.cache_clear()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    lds, hl = 9, 8
+    dpf = DistributedPointFunction.create(DpfParameters(lds, XorWrapper(128)))
+    db = np.random.default_rng(7).integers(
+        0, 2**32, size=(1 << lds, 4), dtype=np.uint64
+    ).astype(np.uint32)
+    keys = [
+        dpf.generate_keys(a, (1 << 128) - 1)[0] for a in (3, 77, 500, 129)
+    ]
+    mesh = sharded.make_mesh(2, 4)
+    pdb = sharded.prepare_pir_database(
+        dpf, db, host_levels=hl, order="megakernel", mesh=mesh
+    )
+
+    def run(pipe):
+        return sharded.pir_query_batch_chunked(
+            dpf, keys, pdb, key_chunk=2, host_levels=hl, mode="megakernel",
+            mesh=mesh, integrity=False, pipeline=pipe,
+        )
+
+    try:
+        for pipe in (False, True):
+            run(pipe)  # warm: compiles + constant uploads are allowed
+            program_counter["programs"] = 0
+            run(pipe)
+            got = program_counter["programs"]
+            assert got == 2, (
+                f"sharded megakernel[pipeline={pipe}]: {got} device "
+                "programs for 2 chunks (pinned at EXACTLY 1 shard_map "
+                "program per key chunk — an eager reshard of a sharded "
+                "input lowers to ~7 programs each, the round-5 audit "
+                "lesson)"
+            )
+    finally:
+        jax.clear_caches()  # drop cheap-circuit traces
+        sharded.build_sharded_megakernel_step.cache_clear()
+
+
 @pytest.mark.slow
 def test_walkkernel_program_budget(program_counter, monkeypatch):
     """ISSUE 4: mode='walkkernel' is EXACTLY one device program per chunk

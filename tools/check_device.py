@@ -124,7 +124,14 @@ def _main() -> int:
     # plane-space XLA elsewhere — must byte-match the scalar oracle on
     # spot rows AND its keys must evaluate bit-exact under the HOST
     # engine; tpu_measure.sh's keygen_device stage, the hardware gate
-    # for dealer offload) — the program shapes fail independently on a broken
+    # for dealer offload) or "sharded" (the mesh-sharded slab-megakernel
+    # PIR path, ISSUE 17: a two-server PIR batch through
+    # pir_query_batch_chunked(mode='megakernel', mesh=...) — DB column
+    # blocks over the 'domain' axis, keys over 'keys' — must reconstruct
+    # DB[alpha] vs the host oracle AND byte-match the single-device
+    # megakernel; the mesh comes from DPF_TPU_PIR_MESH, else 2 x n/2
+    # over the local chips; tpu_measure.sh's gate-sharded stage, the
+    # hardware gate for pod-scale PIR) — the program shapes fail independently on a broken
     # backend (PERF.md). This tool measures the RAW platform:
     # auto-slabbing would hide exactly the over-threshold programs being
     # probed, so it is force-disabled regardless of the caller's

@@ -111,6 +111,26 @@ BENCH_PIR_MODE=megakernel \
   stage pir_megakernel 1800 python tools/run_bench_stage.py bench_pir.py \
   RECORD_SUFFIX=_megakernel SUPERSEDES=pir
 
+# 2b-bis. Pod-scale sharded megakernel PIR (ISSUE 17), same discipline:
+# the correctness gate first (CHECK_MODE=sharded runs the mesh-sharded
+# megakernel path on every local chip — DB rows over 'domain', keys over
+# 'keys' — and verifies two-server reconstruction AND bit-exactness
+# against the single-device megakernel on-chip), then the sharded PIR
+# bench in its own results.json slot. Mesh defaults to 2 x n/2 over the
+# local chips (override with PIR_MESH=KxD); DB capacity scales with the
+# 'domain' extent, throughput with 'keys'. SUPERSEDES=pir: a verified
+# faster sharded record retires the single-chip record in place.
+pir_mesh="${PIR_MESH:-$(python -c '
+import jax
+n = jax.local_device_count()
+k = 2 if n % 2 == 0 and n > 1 else 1
+print(f"{k}x{n // k}")' 2>/dev/null || echo 1x1)}"
+CHECK_MODE=sharded DPF_TPU_PIR_MESH="$pir_mesh" CHECK_SHAPES=16x14,64x18 \
+  stage gate-sharded 900 python tools/check_device.py
+BENCH_PIR_MESH="$pir_mesh" \
+  stage pir_sharded 1800 python tools/run_bench_stage.py bench_pir.py \
+  RECORD_SUFFIX=_sharded SUPERSEDES=pir
+
 # 2b'. Walk-megakernel A/B records (ISSUE 4), same discipline: the
 # correctness gate first (CHECK_MODE=walkkernel differential-verifies
 # evaluate_at + DCF through the single-program walk kernel on-chip —
@@ -247,6 +267,7 @@ stage exp-direct 3600 bash -c "cd experiments && python synthetic_data_benchmark
 # Sentinel: every resumable stage above is marked done -> the watcher can
 # stop re-firing sessions.
 required="headline gate-megakernel headline_megakernel pir_megakernel \
+gate-sharded pir_sharded \
 gate-walkkernel evaluate_at_walkkernel dcf_walkkernel \
 gate-hierkernel heavy_hitters_hierkernel \
 serving_router serving gates gates_walkkernel \
