@@ -1,20 +1,27 @@
-"""FSS gate family benchmark (ISSUE 9): DReLU + spline(ReLU) through the
-shared framework at production batch shapes.
+"""FSS gate family benchmark (ISSUE 9): DReLU + spline(ReLU) + the wide
+sigmoid/tanh activations through the shared framework at production
+batch shapes.
 
 Each gate evaluation is ONE fused batched-DCF pass of
 (num_components keys) x (num_sites * batch points) — the record's
 headline is gate evaluations/s, and the config carries the
 DCF-invocations-per-gate-eval accounting (components x sites: the walks
 the program actually runs, including the uniform-program-family waste
-PERF.md's "FSS gate family" table documents) plus the walk roofline
-fields. Host-oracle spot verification (gate.eval, exact Python ints)
-gates the `verified` flag — an unverified device number must never
-SUPERSEDE a stored record (the bench_dcf pattern, tools/run_bench_stage.py).
+PERF.md's "FSS gate family" table documents), the serialized
+key_bytes_per_gate, and the walk roofline fields. Host-oracle spot
+verification (gate.eval, exact Python ints) gates the `verified` flag —
+an unverified device number must never SUPERSEDE a stored record (the
+bench_dcf pattern, tools/run_bench_stage.py).
 
-Knobs: BENCH_GATES_GATE (drelu|relu, default both), BENCH_LOG_GROUP (16),
-BENCH_GATE_BATCH (2048), BENCH_GATES_ENGINE (host when the native engine
-is available, else device), BENCH_GATES_MODE (walk|walkkernel — a device
-strategy, forces engine=device like bench_dcf's BENCH_DCF_MODE).
+Knobs: BENCH_GATES_GATE (drelu|relu|sigmoid|tanh, default all),
+BENCH_LOG_GROUP (16), BENCH_GATE_BATCH (2048),
+BENCH_GATES_PAYLOAD (vector|scalar — the spline component-key codec
+A/B, ISSUE 18: vector packs all coefficients into ONE tuple-payload DCF
+key, scalar flattens to one Int(128) key per shifted coefficient; both
+arms record the same fields so stored records compare directly),
+BENCH_GATES_ENGINE (host when the native engine is available, else
+device), BENCH_GATES_MODE (walk|walkkernel — a device strategy, forces
+engine=device like bench_dcf's BENCH_DCF_MODE).
 """
 
 import os
@@ -27,14 +34,19 @@ from common import Timer, log, run_bench
 def _one_gate(jax, gate_name, gate, log_group, batch, reps, engine, mode, rng):
     from distributed_point_functions_tpu.utils import roofline, telemetry
 
+    from distributed_point_functions_tpu.protos import serialization as ser
+
     n = gate.n
     r_in = int(rng.integers(0, n))
     r_outs = [int(r) for r in rng.integers(0, n, size=gate.num_outputs)]
     with Timer() as tk:
         k0, _ = gate.gen(r_in, r_outs)
+    key_bytes = len(
+        ser.serialize_gate_key(k0, gate.dcf.dpf.validator.parameters)
+    )
     log(
         f"{gate_name}: keygen {tk.elapsed:.2f}s "
-        f"({gate.num_components} component DCF keys)"
+        f"({gate.num_components} component DCF keys, {key_bytes}B on the wire)"
     )
     kwargs = {} if engine == "host" else {"mode": mode}
     xs_sets = [
@@ -67,6 +79,10 @@ def _one_gate(jax, gate_name, gate, log_group, batch, reps, engine, mode, rng):
         **({"mode": mode} if engine != "host" else {}),
         "num_components": gate.num_components,
         "num_sites": gate.num_sites,
+        "payload": getattr(gate, "payload", "scalar"),
+        # Serialized dealer->server key size: the vector codec's other
+        # headline axis (ONE packed tuple key vs m(d+1) scalar keys).
+        "key_bytes_per_gate": key_bytes,
         # The fused pass walks every component at every site: the DCF
         # invocations one gate evaluation costs (PERF.md "FSS gate family").
         "dcf_invocations_per_gate_eval": dcf_walks_per_eval,
@@ -74,13 +90,17 @@ def _one_gate(jax, gate_name, gate, log_group, batch, reps, engine, mode, rng):
         **telemetry_fields,
     }
     if engine != "host":
-        # Walk traffic model at the DCF-walk rate (lpe=4: Int(128) payload
-        # limbs), same fields as bench_dcf's device records.
+        # Walk traffic model at the DCF-walk rate, same fields as
+        # bench_dcf's device records. lpe follows the component value
+        # type: Int(128) scalars carry 4 limbs, a vector gate's Int(w)
+        # tuple elements carry w/32.
+        vt = gate.dcf.dpf.validator.parameters[-1].value_type
+        lpe = max((ser._uniform_tuple_bits(vt) or 128) // 32, 1)
         T = gate.dcf.dpf.validator.hierarchy_to_tree[-1]
         fields.update(
             roofline.walk_hbm_fields(
                 gate_evals * dcf_walks_per_eval / t.elapsed,
-                T, mode, lpe=4, captures=T + 1,
+                T, mode, lpe=lpe, captures=T + 1,
             )
         )
     return {
@@ -103,12 +123,20 @@ def _one_gate(jax, gate_name, gate, log_group, batch, reps, engine, mode, rng):
 
 def bench(jax, smoke):
     from distributed_point_functions_tpu import native
-    from distributed_point_functions_tpu.gates import DReluGate, ReluGate
+    from distributed_point_functions_tpu.gates import (
+        DReluGate,
+        ReluGate,
+        SigmoidGate,
+        TanhGate,
+    )
 
     log_group = int(os.environ.get("BENCH_LOG_GROUP", 8 if smoke else 16))
     batch = int(os.environ.get("BENCH_GATE_BATCH", 64 if smoke else 2048))
     reps = int(os.environ.get("BENCH_REPS", 2 if smoke else 5))
     which = os.environ.get("BENCH_GATES_GATE", "")
+    # The component-key codec A/B arm (ISSUE 18). DReLU is a single
+    # 1-payload DCF either way — only the spline gates change layout.
+    payload = os.environ.get("BENCH_GATES_PAYLOAD", "vector")
     # Host engine default when available (the DCF engine-table winner at
     # point-walk shapes); walkkernel/walk are device strategies.
     engine = os.environ.get(
@@ -119,13 +147,20 @@ def bench(jax, smoke):
         engine = "device"
     if engine == "host" and not native.available():
         engine = "device"
-    log(f"engine: {engine} mode: {mode}")
+    log(f"engine: {engine} mode: {mode} payload: {payload}")
     rng = np.random.default_rng(0x9A7E)
 
+    # The activations' +/-6.0 input range must fit the signed fixed-point
+    # domain: 6 * 2^frac_bits < 2^(log_group - 1).
+    frac_bits = min(5, log_group - 4)
     results = []
     gates_to_run = [
         ("drelu", DReluGate.create(log_group)),
-        ("relu", ReluGate.create(log_group)),
+        ("relu", ReluGate.create(log_group, payload=payload)),
+        ("sigmoid", SigmoidGate.create(log_group, frac_bits=frac_bits,
+                                       payload=payload)),
+        ("tanh", TanhGate.create(log_group, frac_bits=frac_bits,
+                                 payload=payload)),
     ]
     for name, gate in gates_to_run:
         if which and name != which:
@@ -136,17 +171,22 @@ def bench(jax, smoke):
             )
         )
     # One JSON line per run (the common.py contract): the primary record
-    # is the ReLU (the spline workhorse); the DReLU record rides in config
-    # unless it was the only gate requested.
+    # is the ReLU (the spline workhorse); the other gates' records ride
+    # in config unless a single gate was requested.
     if len(results) == 1:
         return results[0]
-    primary = results[-1]
-    primary["config"]["drelu"] = {
-        "value": results[0]["value"],
-        "unit": results[0]["unit"],
-        "verified": results[0]["verified"],
-        **results[0]["config"],
-    }
+    primary = next(
+        r for r in results if r["bench"] == "gates_relu"
+    )
+    for r in results:
+        if r is primary:
+            continue
+        primary["config"][r["bench"].removeprefix("gates_")] = {
+            "value": r["value"],
+            "unit": r["unit"],
+            "verified": r["verified"],
+            **r["config"],
+        }
     primary["verified"] = all(r["verified"] for r in results)
     return primary
 

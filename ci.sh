@@ -65,7 +65,13 @@ run_fast() {
   # plumbing, every gate's batch_eval reusing the already-compiled
   # fused-DCF walk program families: again ZERO new pallas configs;
   # kernel-path coverage stays with the MIC walkkernel differentials
-  # in test_mic_gate.py, which the whole family flattens onto); pytest
+  # in test_mic_gate.py, which the whole family flattens onto) and the
+  # vector-payload gate codec suite (tests/test_gate_payload.py,
+  # ISSUE 18 — vector-vs-scalar-vs-plaintext edge matrix, packed-wire
+  # and golden pins, the >=8x key-bytes/walks acceptance; device
+  # coverage rides the cheap log_group=6 ReLU shape on the SAME
+  # tuple-capture program family the walk engine already compiles:
+  # ZERO new pallas configs); pytest
   # collects them with the rest of tests/ — no
   # separate invocation, which would run them twice. JAX_PLATFORMS=cpu
   # is pinned explicitly (belt to conftest.py's in-process suspenders)

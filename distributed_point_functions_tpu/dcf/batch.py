@@ -57,13 +57,21 @@ def _capture_tables(dcf, xs_padded: np.ndarray, num_points: int):
     return acc_mask, block_sel, depth_to_hierarchy
 
 
-def _value_corrections_all(dcf, keys, depth_to_hierarchy) -> np.ndarray:
-    """uint32[K, T+1, epb, 4]: per-key value-correction limbs by tree depth."""
+def _value_corrections_all(dcf, keys, depth_to_hierarchy, n_elems=1) -> np.ndarray:
+    """uint32[K, T+1, E, 4]: per-key value-correction limbs by tree depth.
+
+    E = elements_per_block for scalar payloads; for uniform tuple payloads
+    (`n_elems` > 1) each level's single tuple correction flattens to its
+    n_elems member limbs — row e carries element e, matching slot e % epb
+    of value-hash block e // epb in the packed capture stream (the
+    `_correction_limbs` pass downstream slices each row to the element
+    width's limbs)."""
     v = dcf.dpf.validator
     epb = dcf.value_type.elements_per_block()
     k = len(keys)
     T = len(depth_to_hierarchy) - 1
-    vc = np.zeros((k, T + 1, epb, 4), dtype=np.uint32)
+    rows = n_elems if n_elems > 1 else epb
+    vc = np.zeros((k, T + 1, rows, 4), dtype=np.uint32)
     for ki, key in enumerate(keys):
         dpf_key = key.key
         for d, i in enumerate(depth_to_hierarchy):
@@ -74,15 +82,46 @@ def _value_corrections_all(dcf, keys, depth_to_hierarchy) -> np.ndarray:
             else:
                 corrections = dpf_key.correction_words[d].value_correction
             for j, c in enumerate(corrections):
-                vc[ki, d, j] = uint128.to_limbs(int(c))
+                if isinstance(c, tuple):
+                    for e, ce in enumerate(c):
+                        vc[ki, d, e] = uint128.to_limbs(int(ce))
+                else:
+                    vc[ki, d, j] = uint128.to_limbs(int(c))
     return vc
 
 
-def _capture(planes, control, vc_d, block_sel_d, acc_mask_d, bits, xor_group):
-    """Hash + select + correct + mask one depth; returns [P_pad, lpe]."""
+def _capture(
+    planes, control, vc_d, block_sel_d, acc_mask_d, bits, xor_group, n_elems=1
+):
+    """Hash + select + correct + mask one depth; returns [P_pad, lpe]
+    (scalar) or [P_pad, n_elems, lpe] (uniform tuple payload).
+
+    Tuple payloads pack densely into ceil(n_elems * bits / 128) value-hash
+    blocks (every tree depth is a hierarchy level, block index always 0):
+    the capture widens to the hash(seed + j) stream, splits each block into
+    its 128 // bits elements, and keeps the first n_elems — only the tail
+    grows, the walk above is untouched."""
+    ctrl = backend_jax.unpack_mask_device(control)  # uint32[P_pad] 0/1
+    if n_elems > 1:
+        # ONE lane-concatenated AES pass hashes seed+j for every block
+        # (separate hash calls would put nb full AES graphs in the scan
+        # body and explode XLA compile time).
+        nb = -(-(n_elems * bits) // 128)
+        seeds = aes_jax.unpack_from_planes(planes)
+        p_pad = seeds.shape[0]
+        blocks = backend_jax._hash_expanded_blocks_jit(seeds, nb)
+        sel = blocks.transpose(1, 0, 2)  # [P_pad, nb, 4]
+        elems = evaluator._split_elements(sel, bits)  # [P_pad, nb, epb, lpe]
+        lpe = elems.shape[-1]
+        sel = elems.reshape(p_pad, -1, lpe)[:, :n_elems]
+        gated = vc_d[None, :, :] * ctrl[:, None, None]
+        if xor_group:
+            value = sel ^ gated
+        else:
+            value = evaluator._limb_add(sel, gated, bits)
+        return value * acc_mask_d[:, None, None]
     hashed = backend_jax.hash_value_planes(planes)
     blocks = aes_jax.unpack_from_planes(hashed)
-    ctrl = backend_jax.unpack_mask_device(control)  # uint32[P_pad] 0/1
     elems = evaluator._split_elements(blocks, bits)  # [P_pad, epb, lpe]
     p_pad = elems.shape[0]
     sel = elems[jnp.arange(p_pad), block_sel_d]  # [P_pad, lpe]
@@ -108,24 +147,30 @@ def _dcf_walk_one_key(
     cw_planes,  # uint32[T, 128]
     ccl,  # uint32[T]
     ccr,  # uint32[T]
-    vc,  # uint32[T+1, epb, lpe]
+    vc,  # uint32[T+1, epb, lpe] / uint32[T+1, n_elems, lpe] for tuples
     block_sel,  # int32[T+1, P_pad]
     acc_mask,  # uint32[T+1, P_pad]
     bits: int,
     party: int,
     xor_group: bool,
+    n_elems: int = 1,
 ):
     rk_left = backend_jax._rk("left")
     rk_diff = backend_jax._rk("lr_diff")
     planes = aes_jax.pack_to_planes(seeds)
     p_pad = seeds.shape[0]
     lpe = vc.shape[-1]
-    acc0 = jnp.zeros((p_pad, lpe), dtype=jnp.uint32)
+    if n_elems > 1:
+        acc0 = jnp.zeros((p_pad, n_elems, lpe), dtype=jnp.uint32)
+    else:
+        acc0 = jnp.zeros((p_pad, lpe), dtype=jnp.uint32)
 
     def body(carry, xs):
         planes, control, acc = carry
         path_mask, cw, l, r, vc_d, bs_d, am_d = xs
-        value = _capture(planes, control, vc_d, bs_d, am_d, bits, xor_group)
+        value = _capture(
+            planes, control, vc_d, bs_d, am_d, bits, xor_group, n_elems
+        )
         acc = _accumulate(acc, value, bits, xor_group)
         h = aes_jax.hash_planes(planes, rk_left, rk_diff, path_mask)
         h = h ^ (cw[:, None] & control[None, :])
@@ -140,7 +185,8 @@ def _dcf_walk_one_key(
         (path_masks, cw_planes, ccl, ccr, vc[:-1], block_sel[:-1], acc_mask[:-1]),
     )
     value = _capture(
-        planes, control, vc[-1], block_sel[-1], acc_mask[-1], bits, xor_group
+        planes, control, vc[-1], block_sel[-1], acc_mask[-1], bits, xor_group,
+        n_elems,
     )
     acc = _accumulate(acc, value, bits, xor_group)
     if party == 1 and not xor_group:
@@ -148,31 +194,24 @@ def _dcf_walk_one_key(
     return acc
 
 
-@functools.partial(jax.jit, static_argnames=("bits", "party", "xor_group"))
+@functools.partial(
+    jax.jit, static_argnames=("bits", "party", "xor_group", "n_elems")
+)
 def _dcf_batch_jit(
     seeds, control, path_masks, cw_planes, ccl, ccr, vc, block_sel, acc_mask,
-    bits, party, xor_group,
+    bits, party, xor_group, n_elems=1,
 ):
     fn = functools.partial(
-        _dcf_walk_one_key, bits=bits, party=party, xor_group=xor_group
+        _dcf_walk_one_key, bits=bits, party=party, xor_group=xor_group,
+        n_elems=n_elems,
     )
     return jax.vmap(fn, in_axes=(0, None, None, 0, 0, 0, 0, None, None))(
         seeds, control, path_masks, cw_planes, ccl, ccr, vc, block_sel, acc_mask
     )
 
 
-def _capture_batched(
-    planes,  # uint32[K, 128, W]
-    ctrl,  # uint32[K, W]
-    vc_d,  # uint32[K, epb, lpe]
-    block_sel_d,  # int32[P_pad] (shared across keys)
-    acc_mask_d,  # uint32[P_pad]
-    bits: int,
-    xor_group: bool,
-    use_pallas: bool,
-    interpret: bool,
-):
-    """Key-batched `_capture`: hash + select + correct + mask one depth."""
+def _hash_blocks_batched(planes, use_pallas, interpret):
+    """uint32[K, 128, W] packed seeds -> uint32[K, P_pad, 4] value hashes."""
     if use_pallas and planes.shape[2] >= 256:
         from ..ops import aes_pallas
 
@@ -181,8 +220,56 @@ def _capture_batched(
         )
     else:
         hashed = jax.vmap(backend_jax.hash_value_planes)(planes)
-    blocks = jax.vmap(aes_jax.unpack_from_planes)(hashed)  # [K, P_pad, 4]
+    return jax.vmap(aes_jax.unpack_from_planes)(hashed)
+
+
+def _capture_batched(
+    planes,  # uint32[K, 128, W]
+    ctrl,  # uint32[K, W]
+    vc_d,  # uint32[K, epb, lpe] / uint32[K, n_elems, lpe] for tuples
+    block_sel_d,  # int32[P_pad] (shared across keys)
+    acc_mask_d,  # uint32[P_pad]
+    bits: int,
+    xor_group: bool,
+    use_pallas: bool,
+    interpret: bool,
+    n_elems: int = 1,
+):
+    """Key-batched `_capture`: hash + select + correct + mask one depth."""
     ctrlb = jax.vmap(backend_jax.unpack_mask_device)(ctrl)  # [K, P_pad]
+    if n_elems > 1:
+        # Tuple payload: elements pack densely into nb value-hash blocks
+        # (hash(seed + j), j < nb). All blocks' inputs concatenate along
+        # the lane axis into ONE hash program per depth — same Mosaic
+        # kernel family, wider W.
+        nb = -(-(n_elems * bits) // 128)
+        seeds = jax.vmap(aes_jax.unpack_from_planes)(planes)  # [K, P_pad, 4]
+        k, p_pad = seeds.shape[0], seeds.shape[1]
+        flat = seeds.reshape(k * p_pad, 4)
+        inputs = jnp.concatenate(
+            [
+                seeds
+                if j == 0
+                else backend_jax._add_small_constant(
+                    flat, np.uint32(j)
+                ).reshape(k, p_pad, 4)
+                for j in range(nb)
+            ],
+            axis=1,
+        )  # [K, nb * P_pad, 4]
+        big = jax.vmap(aes_jax.pack_to_planes)(inputs)
+        blocks = _hash_blocks_batched(big, use_pallas, interpret)
+        sel = blocks.reshape(k, nb, p_pad, 4).transpose(0, 2, 1, 3)
+        elems = evaluator._split_elements(sel, bits)  # [K, P, nb, epb, lpe]
+        lpe = elems.shape[-1]
+        sel = elems.reshape(k, p_pad, -1, lpe)[:, :, :n_elems]
+        gated = vc_d[:, None] * ctrlb[..., None, None]
+        if xor_group:
+            value = sel ^ gated
+        else:
+            value = evaluator._limb_add(sel, gated, bits)
+        return value * acc_mask_d[None, :, None, None]
+    blocks = _hash_blocks_batched(planes, use_pallas, interpret)  # [K, P_pad, 4]
     elems = evaluator._split_elements(blocks, bits)  # [K, P_pad, epb, lpe]
     p_pad = elems.shape[1]
     sel = elems[:, jnp.arange(p_pad), block_sel_d]  # [K, P_pad, lpe]
@@ -214,7 +301,9 @@ def _dcf_key_tile(k: int, p_pad: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bits", "party", "xor_group", "key_tile", "interpret"),
+    static_argnames=(
+        "bits", "party", "xor_group", "key_tile", "interpret", "n_elems"
+    ),
 )
 def _dcf_batch_pallas_jit(
     seeds,  # uint32[K, P_pad, 4] root seed broadcast
@@ -223,7 +312,7 @@ def _dcf_batch_pallas_jit(
     cw_planes,  # uint32[K, T, 128]
     ccl,  # uint32[K, T]
     ccr,  # uint32[K, T]
-    vc,  # uint32[K, T+1, epb, lpe]
+    vc,  # uint32[K, T+1, epb, lpe] / uint32[K, T+1, n_elems, lpe]
     block_sel,  # int32[T+1, P_pad]
     acc_mask,  # uint32[T+1, P_pad]
     bits: int,
@@ -231,6 +320,7 @@ def _dcf_batch_pallas_jit(
     xor_group: bool,
     key_tile: int,
     interpret: bool = False,
+    n_elems: int = 1,
 ):
     """Mosaic-kernel variant of `_dcf_batch_jit`: the same O(n) fused walk,
     but each tree level runs the batched Pallas walk kernel
@@ -248,11 +338,15 @@ def _dcf_batch_pallas_jit(
     T = path_masks.shape[0]
     lpe = vc.shape[-1]
     p_pad = block_sel.shape[1]
-    acc = jnp.zeros((k, p_pad, lpe), jnp.uint32)
+    if n_elems > 1:
+        acc = jnp.zeros((k, p_pad, n_elems, lpe), jnp.uint32)
+    else:
+        acc = jnp.zeros((k, p_pad, lpe), jnp.uint32)
     for d in range(T + 1):
         value = _capture_batched(
             planes, ctrl, vc[:, d], block_sel[d], acc_mask[d],
             bits, xor_group, use_pallas=True, interpret=interpret,
+            n_elems=n_elems,
         )
         acc = _accumulate(acc, value, bits, xor_group)
         if d < T:
@@ -301,7 +395,12 @@ def batch_evaluate(
     dcf, keys: Sequence, xs: Sequence[int], use_pallas=None, interpret=False,
     key_chunk=None, pipeline=None, mode=None,
 ) -> np.ndarray:
-    """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe].
+    """Evaluates every DCF key at every point x. Returns uint32[K, P, lpe]
+    for scalar value types, uint32[K, P, n_elems, 4] for uniform tuple
+    payloads (the vector gate codec: elements pack densely into value-hash
+    blocks of the same seed, so only the capture tail widens — walk work is
+    unchanged; narrow elements accumulate at their own limb width and
+    zero-pad to 4 limbs on the way out).
 
     `use_pallas` (default: on for real TPU backends, see
     evaluator._pallas_default) routes the per-level tree walk through the
@@ -327,13 +426,14 @@ def batch_evaluate(
     keeps "walk"); off-TPU it runs through the Pallas interpreter."""
     from ..ops import pipeline as _pl
 
-    bits, xor_group = evaluator._value_kind(dcf.value_type)
+    bits, xor_group, n_elems = evaluator._payload_kind(dcf.value_type)
     num_points = len(xs)
     k = len(keys)
 
     v = dcf.dpf.validator
     mode = evaluator._resolve_walk_mode(
-        mode, True, bits, v.hierarchy_to_tree[v.num_hierarchy_levels - 1],
+        mode, n_elems == 1, bits,
+        v.hierarchy_to_tree[v.num_hierarchy_levels - 1],
         use_pallas,
         op="dcf.batch_evaluate",
     )
@@ -349,7 +449,7 @@ def batch_evaluate(
     )
     T = batch.num_levels
     path_masks = backend_jax._path_bit_masks(paths, T, p_pad)
-    vc_full = _value_corrections_all(dcf, keys, depth_to_hierarchy)
+    vc_full = _value_corrections_all(dcf, keys, depth_to_hierarchy, n_elems)
     vc = np.ascontiguousarray(
         evaluator._correction_limbs(
             vc_full.reshape(k * (T + 1), -1, 4), bits
@@ -419,6 +519,7 @@ def batch_evaluate(
                 xor_group=xor_group,
                 key_tile=_dcf_key_tile(kk, p_pad),
                 interpret=interpret,
+                n_elems=n_elems,
             )
         else:
             out = _dcf_batch_jit(
@@ -434,6 +535,7 @@ def batch_evaluate(
                 bits=bits,
                 party=batch.party,
                 xor_group=xor_group,
+                n_elems=n_elems,
             )
         return valid, out
 
@@ -450,6 +552,11 @@ def batch_evaluate(
         )
     )
     out = pieces[0] if len(pieces) == 1 else np.concatenate(pieces, axis=0)
+    if n_elems > 1 and out.shape[-1] < 4:
+        # Uniform [K, P, n_elems, 4] contract regardless of element width:
+        # narrow elements walked at lpe < 4 limbs zero-extend host-side.
+        pad = [(0, 0)] * (out.ndim - 1) + [(0, 4 - out.shape[-1])]
+        out = np.pad(np.asarray(out), pad)
     # Output-corruption seam for the runtime integrity layer (ISSUE 7):
     # DCF has no sentinel-probe hook, so the supervisor's host-oracle spot
     # check is what detects device-side corruption — this is where the
@@ -535,12 +642,18 @@ def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
     kernel (`dpf_dcf_evaluate_wide`); IntModN outputs use the per-point host
     path (DistributedComparisonFunction.evaluate). Returns uint64[K, P] shares for
     bits <= 64, uint64[K, P, 2] (lo, hi) for 128-bit values — bit-identical
-    to the device path.
+    to the device path. Uniform tuple payloads (the vector gate codec) run
+    the same fused walk through the backend_numpy seed primitives (native
+    AES-NI when built, numpy otherwise) and return uint64[K, P, n_elems, 2].
     """
     from .. import native
     from ..core import backend_numpy
 
-    bits, xor_group = evaluator._value_kind(dcf.value_type)
+    bits, xor_group, n_elems = evaluator._payload_kind(dcf.value_type)
+    if n_elems > 1:
+        return _batch_evaluate_host_tuple(
+            dcf, keys, xs, bits, xor_group, n_elems
+        )
     if not native.available():
         raise errors.UnavailableError(
             "native AES-NI engine unavailable on this host; use the device "
@@ -592,3 +705,118 @@ def batch_evaluate_host(dcf, keys: Sequence, xs: Sequence[int]) -> np.ndarray:
             vc_wide[j], capture, am, bs, paths, bits, xor_group,
         )
     return out if bits > 64 else out[..., 0]
+
+
+def _batch_evaluate_host_tuple(
+    dcf, keys: Sequence, xs: Sequence[int], bits: int, xor_group: bool,
+    n_elems: int,
+) -> np.ndarray:
+    """Host fused DCF walk for uniform tuple payloads.
+
+    Same O(n) pass as the scalar host kernels, built from the per-level
+    backend_numpy primitives (native AES-NI when built, numpy fallback
+    otherwise): one `evaluate_seeds` call per tree level with the level's
+    path bit in the LSB, a `hash_expanded_seeds(seeds, nb)` capture at
+    every output depth with the packed blocks split into their 128 // bits
+    elements, and element-wise mod-2^bits accumulation (uint64 lanes for
+    32/64-bit elements, limb adds for 128). Returns uint64[K, P, n_elems, 2]
+    (lo, hi; hi == 0 for elements <= 64 bits) shares."""
+    from ..core import backend_numpy, host_eval
+
+    num_points = len(xs)
+    k = len(keys)
+    batch, paths, acc_mask, block_sel, depth_to_hierarchy = _prep_points(
+        dcf, keys, xs, num_points
+    )
+    T = batch.num_levels
+    nb = -(-(n_elems * bits) // 128)
+    vc_limbs = _value_corrections_all(dcf, keys, depth_to_hierarchy, n_elems)
+    # Per-depth path-bit arrays: `evaluate_seeds` reads bit L-1-level of its
+    # paths argument relative to the call's own correction count, so a
+    # one-level call reads the LSB — stage depth d's bit (T-1-d of the full
+    # path) there.
+    path_bits = np.zeros((T, num_points, 4), dtype=np.uint32)
+    for d in range(T):
+        idx = T - 1 - d
+        path_bits[d, :, 0] = (paths[:, idx // 32] >> np.uint32(idx % 32)) & 1
+    narrow = bits <= 64
+    if narrow:
+        # uint64-lane arithmetic: elements and corrections both < 2^bits.
+        mask_w = np.uint64((1 << bits) - 1)
+        vc64 = vc_limbs[..., 0].astype(np.uint64) | (
+            vc_limbs[..., 1].astype(np.uint64) << np.uint64(32)
+        )  # [K, T+1, n_elems]
+        acc64 = np.zeros((k, num_points, n_elems), dtype=np.uint64)
+    else:
+        acc = np.zeros((k, num_points, n_elems, 4), dtype=np.uint32)
+
+    def _elements(hashed):
+        # uint32[P, nb, 4] packed blocks -> uint64[P, n_elems] (bits <= 64).
+        flat = hashed.reshape(num_points, nb * 4).astype(np.uint64)
+        if bits == 32:
+            return flat[:, :n_elems]
+        return (flat[:, 0::2] | (flat[:, 1::2] << np.uint64(32)))[
+            :, :n_elems
+        ]
+
+    for ki in range(k):
+        seeds = np.broadcast_to(
+            batch.seeds[ki][None, :], (num_points, 4)
+        ).copy()
+        control = np.full(num_points, bool(batch.party), dtype=bool)
+        for d in range(T + 1):
+            if depth_to_hierarchy[d] >= 0:
+                hashed = backend_numpy.hash_expanded_seeds(seeds, nb)
+                if narrow:
+                    els = _elements(hashed)
+                    gated = vc64[ki, d][None] * control.astype(np.uint64)[
+                        :, None
+                    ]
+                    if xor_group:
+                        value = els ^ gated
+                    else:
+                        value = (els + gated) & mask_w
+                    value = value * acc_mask[d, :num_points, None].astype(
+                        np.uint64
+                    )
+                    if xor_group:
+                        acc64[ki] ^= value
+                    else:
+                        acc64[ki] = (acc64[ki] + value) & mask_w
+                else:
+                    gated = (
+                        vc_limbs[ki, d][None]
+                        * control.astype(np.uint32)[:, None, None]
+                    )
+                    if xor_group:
+                        value = hashed ^ gated
+                    else:
+                        value = host_eval._add128(hashed, gated)
+                    value = value * acc_mask[d, :num_points, None, None]
+                    if xor_group:
+                        acc[ki] ^= value
+                    else:
+                        acc[ki] = host_eval._add128(acc[ki], value)
+            if d < T:
+                seeds, control = backend_numpy.evaluate_seeds(
+                    seeds, control, path_bits[d],
+                    batch.cw_seeds[ki, d : d + 1],
+                    batch.cw_left[ki, d : d + 1],
+                    batch.cw_right[ki, d : d + 1],
+                )
+        if batch.party == 1 and not xor_group:
+            if narrow:
+                acc64[ki] = (np.uint64(0) - acc64[ki]) & mask_w
+            else:
+                acc[ki] = host_eval._neg128(acc[ki])
+    out = np.zeros((k, num_points, n_elems, 2), dtype=np.uint64)
+    if narrow:
+        out[..., 0] = acc64
+        return out
+    out[..., 0] = acc[..., 0].astype(np.uint64) | (
+        acc[..., 1].astype(np.uint64) << np.uint64(32)
+    )
+    out[..., 1] = acc[..., 2].astype(np.uint64) | (
+        acc[..., 3].astype(np.uint64) << np.uint64(32)
+    )
+    return out

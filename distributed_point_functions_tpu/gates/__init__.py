@@ -8,7 +8,10 @@ batch, walk or walkkernel mode).
 * :class:`DReluGate` / :class:`ReluGate` — the secure-ML activation pair
   (comparison gate; ReLU as the fixed two-piece spline).
 * :class:`SplineGate` — piecewise-polynomial evaluation, the fixed-point
-  math workhorse.
+  math workhorse (vector-codec payload by default: ONE tuple-payload DCF
+  key per gate instead of m(d+1) scalar keys).
+* :class:`SigmoidGate` / :class:`TanhGate` — wide (8-16 piece, degree-1)
+  fixed-point activation splines on the vector codec.
 * :class:`BitDecompositionGate` — arithmetic-to-boolean share conversion.
 """
 
@@ -22,4 +25,4 @@ from .framework import (  # noqa: F401
 from .mic import MicKey, MultipleIntervalContainmentGate  # noqa: F401
 from .prng import BasicRng, CounterRng, SecurePrng  # noqa: F401
 from .relu import DReluGate, ReluGate  # noqa: F401
-from .spline import SplineGate  # noqa: F401
+from .spline import SigmoidGate, SplineGate, TanhGate  # noqa: F401

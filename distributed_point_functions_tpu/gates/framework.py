@@ -16,12 +16,15 @@ decomposes into three dealer-computable ingredients:
 
 * **Component DCF keys** — one or more DCF key pairs at
   ``alpha = r_in' - 1`` with a payload ``beta`` the dealer picks
-  (:meth:`MaskedGate._component_specs`). Scalar ``Int(128)`` payloads
-  only: a vector-payload gate (BCG+'s spline form) is expressed as one
-  component key per payload element, which keeps every gate inside the
-  exact fused-DCF program family the MIC gate already compiles
-  (dcf/batch.py walk + walkkernel) — see gates/spline.py for the
-  key-size tradeoff note.
+  (:meth:`MaskedGate._component_specs`). Payloads come in two layouts:
+  scalar ``Int(128)`` (one component key per payload element — the
+  original program family the MIC gate compiles) and the vector codec
+  (BCG+'s native spline form: ONE component key whose value type is
+  ``TupleType`` over all payload elements, ``payload_elems`` > 1). A
+  vector key rides the same fused-DCF walk — only the value-capture
+  tail widens (dcf/batch.py) — so key bytes, dealer work, and walk
+  count all drop ``payload_elems``× while the combine algebra sees the
+  identical coefficient-row matrix either way.
 * **Mask shares** — additive shares of dealer-computed correction values
   (the interval wrap counts of BCG+ Lemma 1, payload shares, output
   masks), split by the gate's :class:`~.prng.SecurePrng`.
@@ -115,6 +118,22 @@ def ic_share(
     return (pub * w_share - s_p + s_q_prime + z_share) % n
 
 
+def resolve_payload(payload: Optional[str] = None) -> str:
+    """Resolve a gate's payload layout: an explicit "scalar"/"vector"
+    wins, else the DPF_TPU_GATE_PAYLOAD env (default "vector" — the
+    BCG+-native codec; "scalar" keeps the PR 9 flattening as the
+    selectable oracle path)."""
+    from ..utils import envflags
+
+    if payload is None:
+        payload = envflags.env_str("DPF_TPU_GATE_PAYLOAD", "vector") or "vector"
+    if payload not in ("scalar", "vector"):
+        raise InvalidArgumentError(
+            f'payload must be "scalar" or "vector", got {payload!r}'
+        )
+    return payload
+
+
 def split_share(value: int, modulus: int, prng: SecurePrng) -> Tuple[int, int]:
     """Additive 2-sharing of ``value`` mod ``modulus`` (party-0 share
     drawn from the prng — one rand128 per split, the draw order golden
@@ -149,18 +168,30 @@ class GateKey:
 
 def _values_as_ints(evals, engine: str) -> np.ndarray:
     """Normalize a batched-DCF result to an object ndarray of Python ints
-    [K, P]: host engine returns uint64 (lo, hi) pairs for the gates'
-    Int(128) payloads, the device engine uint32 limb vectors."""
+    [K, P] (scalar payloads) or [K, P, t] (vector payloads): host engine
+    returns uint64 (lo, hi) pairs for the gates' Int(128) payloads, the
+    device engine uint32 limb vectors."""
     from ..ops import evaluator
 
     evals = np.asarray(evals)
     if engine == "host":
-        if evals.ndim == 3:  # uint64[K, P, 2] (lo, hi)
+        if evals.dtype == np.uint64 and evals.ndim >= 3 and evals.shape[-1] == 2:
+            # uint64[K, P, 2] / uint64[K, P, t, 2] (lo, hi)
             return evals[..., 0].astype(object) | (
                 evals[..., 1].astype(object) << 64
             )
         return evals.astype(object)
     return evaluator.values_to_numpy(evals, 128)
+
+
+def _flatten_payload(values: np.ndarray) -> np.ndarray:
+    """Vector-payload [K, P, t] int matrices -> the logical [K*t, P]
+    coefficient-row matrix the combine algebra consumes (key-major, the
+    scalar component-key order); scalar [K, P] passes through."""
+    if values.ndim == 3:
+        k, p, t = values.shape
+        return values.transpose(0, 2, 1).reshape(k * t, p)
+    return values
 
 
 @dataclasses.dataclass
@@ -219,7 +250,7 @@ class GatePlan:
         s = gate.num_sites
         dcf_keys, shares = gate._key_parts(key)
         party = dcf_keys[0].key.party
-        values = np.asarray(values, dtype=object)
+        values = _flatten_payload(np.asarray(values, dtype=object))
         out = np.zeros((len(self.xs), gate.num_outputs), dtype=object)
         for xi, x in enumerate(self.xs):
             vals = values[:, s * xi : s * (xi + 1)] % n
@@ -238,9 +269,11 @@ class MaskedGate(abc.ABC):
 
     Subclasses declare the dealer algebra (component DCF specs, mask
     values) and the eval plan (sites, combine); ``gen`` / ``eval`` /
-    ``batch_eval`` are the shared templates. All component DCFs ride
+    ``batch_eval`` are the shared templates. Component DCFs ride
     ``Int(128)`` payloads over a 2^log_group_size domain — the program
-    family gates/mic.py established.
+    family gates/mic.py established — or, for vector-codec gates
+    (``payload_elems`` > 1), one ``TupleType`` key carrying every
+    coefficient through the same walk.
     """
 
     def __init__(self, log_group_size: int, dcf, num_outputs: int):
@@ -250,15 +283,32 @@ class MaskedGate(abc.ABC):
 
     # -- shared construction ----------------------------------------------
     @staticmethod
-    def _create_dcf(log_group_size: int):
-        from ..core.value_types import Int
+    def _create_dcf(log_group_size: int, num_elements: int = 1):
+        """The gate's component DCF: ``Int(128)`` for scalar payloads, a
+        uniform ``TupleType(Int(w) x num_elements)`` for the vector codec
+        with w the narrowest whole-limb width holding Z_N (32, 64, or
+        128 — N | 2^w keeps the masked-wire algebra exact while the
+        per-level value corrections shrink 128/w x). ``num_elements == 1``
+        ALWAYS yields the plain scalar ``Int(128)`` DCF — a 1-element
+        vector gate therefore degenerates to the scalar program and wire
+        format exactly (the byte-identity pin)."""
+        from ..core.value_types import Int, TupleType
         from ..dcf.dcf import DistributedComparisonFunction
 
         if log_group_size < 1 or log_group_size > 127:
             raise InvalidArgumentError(
                 "log_group_size should be in > 0 and < 128"
             )
-        return DistributedComparisonFunction.create(log_group_size, Int(128))
+        if num_elements < 1:
+            raise InvalidArgumentError("num_elements must be >= 1")
+        if num_elements == 1:
+            vt = Int(128)
+        else:
+            width = 32 if log_group_size <= 32 else (
+                64 if log_group_size <= 64 else 128
+            )
+            vt = TupleType(*([Int(width)] * num_elements))
+        return DistributedComparisonFunction.create(log_group_size, vt)
 
     @property
     def n(self) -> int:
@@ -268,6 +318,14 @@ class MaskedGate(abc.ABC):
     def dcf(self):
         """The shared component DCF (its DPF drives the fused walk)."""
         return self._dcf
+
+    @property
+    def payload_elems(self) -> int:
+        """Tuple elements per component DCF key: 1 for scalar payloads,
+        the coefficient count for vector-codec gates. The combine algebra
+        always consumes ``num_components * payload_elems`` coefficient
+        rows, whichever layout carried them."""
+        return 1
 
     # -- subclass contract -------------------------------------------------
     @property
@@ -511,10 +569,16 @@ class MaskedGate(abc.ABC):
         n = self.n
         dcf_keys, shares = self._key_parts(key)
         pts = self._points(int(x))
-        vals = np.zeros((self.num_components, self.num_sites), dtype=object)
+        t = self.payload_elems
+        vals = np.zeros((self.num_components * t, self.num_sites), dtype=object)
         for c, dk in enumerate(dcf_keys):
             for s, pt in enumerate(pts):
-                vals[c, s] = self._dcf.evaluate(dk, pt) % n
+                v = self._dcf.evaluate(dk, pt)
+                if isinstance(v, tuple):  # vector payload: t rows per key
+                    for e, ve in enumerate(v):
+                        vals[c * t + e, s] = int(ve) % n
+                else:
+                    vals[c, s] = v % n
         return self._combine_one(dcf_keys[0].key.party, shares, int(x), vals)
 
     @_tm.traced("gate.batch_eval")
@@ -583,12 +647,13 @@ def bundle_eval(
                 "reconstruct garbage, not raise)"
             )
         all_dcf.extend(dcf_keys)
-    values = plan.evaluate(all_dcf, engine=engine, **device_kwargs)
+    values = _flatten_payload(plan.evaluate(all_dcf, engine=engine, **device_kwargs))
     n = gate.n
     party = all_dcf[0].key.party
+    rows = c * gate.payload_elems
     out = np.zeros((len(keys), gate.num_outputs), dtype=object)
     for b, (key, x) in enumerate(zip(keys, plan.xs)):
         _, shares = gate._key_parts(key)
-        vals = values[b * c : (b + 1) * c, b * s : (b + 1) * s] % n
+        vals = values[b * rows : (b + 1) * rows, b * s : (b + 1) * s] % n
         out[b] = gate._combine_one(party, shares, x, vals)
     return out

@@ -11,14 +11,14 @@ ReLU, and the comparison primitive ``[a < b]`` via x_real = a - b.
 ReLU: additive shares mod N of ``max(x_real, 0)`` (signed). Exactly the
 two-piece degree-1 spline ``[0, N/2-1] -> X``, ``[N/2, N-1] -> 0``, so
 :class:`ReluGate` is a :class:`~.spline.SplineGate` factory — the gate
-the framework exists to make free. 4 component keys, 4 sites per input,
-still ONE fused batched-DCF pass (and one walk-megakernel program under
-``mode="walkkernel"``).
+the framework exists to make free. On the default vector payload: ONE
+component key carrying all 4 coefficients, 4 sites per input, one fused
+batched-DCF pass (``payload="scalar"`` keeps the 4-key oracle layout).
 """
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -85,7 +85,9 @@ class ReluGate(SplineGate):
     signed plaintext domain and the gate's Z_N representation."""
 
     @classmethod
-    def create(cls, log_group_size: int) -> "ReluGate":  # noqa: D417
+    def create(
+        cls, log_group_size: int, payload: Optional[str] = None
+    ) -> "ReluGate":  # noqa: D417
         if log_group_size < 2:
             raise InvalidArgumentError(
                 "ReLU needs log_group_size >= 2 (a sign bit and at least "
@@ -96,6 +98,7 @@ class ReluGate(SplineGate):
             log_group_size,
             intervals=[(0, n // 2 - 1), (n // 2, n - 1)],
             coefficients=[[0, 1], [0, 0]],
+            payload=payload,
         )
 
     # -- signed-domain helpers (demo/test convenience) ---------------------

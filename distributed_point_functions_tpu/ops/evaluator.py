@@ -2295,6 +2295,37 @@ def _value_kind(value_type) -> Tuple[int, bool]:
     )
 
 
+def _payload_kind(value_type) -> Tuple[int, bool, int]:
+    """(bits, xor_group, n_elems) for scalar OR uniform packed tuples.
+
+    Vector payloads are restricted to `TupleType` over identical 32/64/128-
+    bit Int/XorWrapper elements: whole-limb widths dividing the block, so
+    elements pack densely into ceil(n_elems * bits / 128) value-hash blocks
+    (128 // bits per block, reference byte layout) and never straddle a
+    block boundary — the capture tail splits blocks with the same
+    `_split_elements` codec the scalar epb path uses.
+    """
+    from ..core.value_types import TupleType
+
+    if isinstance(value_type, TupleType):
+        elems = value_type.elements
+        first = elems[0]
+        if not all(e == first for e in elems[1:]):
+            raise NotImplementedError(
+                "batched evaluator supports uniform tuple payloads only, "
+                f"got {value_type}"
+            )
+        bits, xor_group = _value_kind(first)
+        if bits not in (32, 64, 128):
+            raise NotImplementedError(
+                "batched evaluator supports tuples of 32/64/128-bit "
+                f"elements only (whole-limb block packing), got {value_type}"
+            )
+        return bits, xor_group, len(elems)
+    bits, xor_group = _value_kind(value_type)
+    return bits, xor_group, 1
+
+
 def _correction_limbs(vc: np.ndarray, bits: int) -> np.ndarray:
     """uint32[K, epb, 4] full-block limbs -> uint32[K, epb, lpe]."""
     if bits >= 32:

@@ -282,9 +282,13 @@ def dcf_chain(dcf, mode: Optional[str]) -> Tuple[Rung, ...]:
     hierarchy level drives the walk)."""
     from . import evaluator
 
-    bits, _ = evaluator._value_kind(dcf.value_type)
+    bits, _, n_elems = evaluator._payload_kind(dcf.value_type)
     v = dcf.dpf.validator
-    ok = bits % 32 == 0 and v.hierarchy_to_tree[v.num_hierarchy_levels - 1] >= 1
+    ok = (
+        n_elems == 1
+        and bits % 32 == 0
+        and v.hierarchy_to_tree[v.num_hierarchy_levels - 1] >= 1
+    )
     return _walk_rungs(ok, mode, explicit=mode is not None)
 
 
@@ -643,18 +647,28 @@ def _dcf_host_limbs(
     from .. import native
     from ..core import host_eval
     from ..dcf import batch as dcf_batch
+    from . import evaluator
 
+    _, _, n_elems = evaluator._payload_kind(dcf.value_type)
     with integrity._faults_suspended():
-        if native.available():
+        # Tuple payloads run the fused host walk regardless of the native
+        # build: its backend_numpy primitives carry their own numpy
+        # fallback, so it IS the rung of last resort.
+        if native.available() or n_elems > 1:
             raw = dcf_batch.batch_evaluate_host(dcf, keys, xs)
-            if raw.ndim == 3:  # uint64 (lo, hi) pairs: 128-bit values
-                lpe = max(bits // 32, 1)
-                limbs = np.zeros(raw.shape[:2] + (4,), np.uint32)
+            if raw.ndim >= 3 and raw.dtype == np.uint64 and raw.shape[-1] == 2:
+                # uint64 (lo, hi) pairs: [K, P(, n_elems), 2]. Tuple
+                # payloads keep the full 4-limb lane (the device contract
+                # zero-pads narrow elements to 4 limbs); scalars slice to
+                # the value width's limbs.
+                limbs = np.zeros(raw.shape[:-1] + (4,), np.uint32)
                 limbs[..., 0] = raw[..., 0] & np.uint64(0xFFFFFFFF)
                 limbs[..., 1] = raw[..., 0] >> np.uint64(32)
                 limbs[..., 2] = raw[..., 1] & np.uint64(0xFFFFFFFF)
                 limbs[..., 3] = raw[..., 1] >> np.uint64(32)
-                return limbs[..., :lpe], len(xs)
+                if n_elems > 1:
+                    return limbs, len(xs)
+                return limbs[..., : max(bits // 32, 1)], len(xs)
             return host_eval.values_to_limbs(raw, bits), len(xs)
         covered = len(xs) if cap is None else min(len(xs), cap)
         vals = [
@@ -731,7 +745,7 @@ def batch_evaluate_robust(
     limbs on every rung, including the host one."""
     from . import evaluator
 
-    bits, _xor = evaluator._value_kind(dcf.value_type)
+    bits, _xor, _n_elems = evaluator._payload_kind(dcf.value_type)
     chain = dcf_chain(dcf, mode)
     verify = policy.verify is not False
 

@@ -457,12 +457,128 @@ def parse_mic_key(buf: bytes):
 # GateKey therefore serializes BYTE-IDENTICALLY to a MicKey carrying the
 # same DCF key and shares — the framework's wire form is a superset of the
 # reference's gate message, not a fork (pinned in tests).
+#
+# Vector-payload component keys (uniform TupleType(Int(w) x t) value types,
+# the gate codec) ride field 3 instead: a packed VectorDcfKey message whose
+# per-level tuple corrections concatenate into ONE little-endian bytes field
+# at their true element width, instead of t nested Value messages per level
+# whose per-element proto framing would triple the key. Scalar keys —
+# including every 1-element vector gate, which degenerates to a plain
+# Int(128) DCF by construction — never take this path, so the MIC-superset
+# and byte-identity pins are untouched.
+#
+# VectorDcfKey layout:
+#   field 1: root seed, 16 raw little-endian bytes
+#   field 2 (repeated, one per correction word): 17 raw bytes —
+#            seed (16, little-endian) + flags (bit 0 control_left,
+#            bit 1 control_right)
+#   field 3: party varint
+#   field 4: element bitsize w varint
+#   field 5: packed value corrections — every level's tuple concatenated
+#            (correction words in order, then the last level), each element
+#            w/8 little-endian bytes; t = len / ((num_cw + 1) * w/8)
+
+
+def _uniform_tuple_bits(value_type) -> int:
+    """Element bitsize of a uniform Int tuple, or 0 when `value_type` is
+    not one (the packed VectorDcfKey form applies only when > 0)."""
+    if not isinstance(value_type, TupleType) or len(value_type.elements) < 2:
+        return 0
+    first = value_type.elements[0]
+    if not isinstance(first, Int) or first.bitsize not in (32, 64, 128):
+        return 0
+    if any(e != first for e in value_type.elements[1:]):
+        return 0
+    return first.bitsize
+
+
+def _serialize_vector_dcf_key(dcf_key, bits: int) -> bytes:
+    key = dcf_key.key
+    nbytes = bits // 8
+    out = wire.len_field(1, int(key.seed).to_bytes(16, "little"))
+    packed = b""
+    for cw in key.correction_words:
+        flags = int(cw.control_left) | (int(cw.control_right) << 1)
+        out += wire.len_field(
+            2, int(cw.seed).to_bytes(16, "little") + bytes([flags])
+        )
+        (corr,) = cw.value_correction
+        packed += b"".join(int(c).to_bytes(nbytes, "little") for c in corr)
+    out += wire.tag(3, wire.VARINT) + wire.encode_varint(key.party)
+    out += wire.tag(4, wire.VARINT) + wire.encode_varint(bits)
+    (last,) = key.last_level_value_correction
+    packed += b"".join(int(c).to_bytes(nbytes, "little") for c in last)
+    out += wire.len_field(5, packed)
+    return out
+
+
+def _parse_vector_dcf_key(buf: bytes):
+    from ..core.keys import CorrectionWord, DpfKey
+    from ..dcf.dcf import DcfKey
+
+    seed = 0
+    cws: List = []
+    party = 0
+    bits = 0
+    packed = b""
+    for field, _, value in wire.iter_fields(buf):
+        if field == 1:
+            seed = int.from_bytes(value, "little")
+        elif field == 2:
+            if len(value) != 17:
+                raise InvalidArgumentError(
+                    "VectorDcfKey correction word must be 17 bytes"
+                )
+            cws.append(
+                (int.from_bytes(value[:16], "little"), value[16])
+            )
+        elif field == 3:
+            party = int(value)
+        elif field == 4:
+            bits = int(value)
+        elif field == 5:
+            packed = value
+    if bits not in (32, 64, 128):
+        raise InvalidArgumentError(
+            f"VectorDcfKey element bitsize {bits} unsupported"
+        )
+    nbytes = bits // 8
+    levels = len(cws) + 1
+    if not packed or len(packed) % (levels * nbytes):
+        raise InvalidArgumentError(
+            "VectorDcfKey packed corrections length does not divide into "
+            f"{levels} levels of {nbytes}-byte elements"
+        )
+    t = len(packed) // (levels * nbytes)
+    tuples = []
+    for lv in range(levels):
+        base = lv * t * nbytes
+        tuples.append(
+            tuple(
+                int.from_bytes(
+                    packed[base + e * nbytes : base + (e + 1) * nbytes],
+                    "little",
+                )
+                for e in range(t)
+            )
+        )
+    correction_words = [
+        CorrectionWord(s, bool(flags & 1), bool(flags & 2), [tuples[i]])
+        for i, (s, flags) in enumerate(cws)
+    ]
+    return DcfKey(
+        key=DpfKey(seed, correction_words, party, [tuples[-1]])
+    )
 
 
 def serialize_gate_key(gate_key, parameters: Sequence[DpfParameters]) -> bytes:
     out = b""
+    vec_bits = _uniform_tuple_bits(parameters[-1].value_type)
     for dk in gate_key.dcf_keys:
-        out += wire.len_field(1, serialize_dcf_key(dk, parameters))
+        if vec_bits:
+            out += wire.len_field(3, _serialize_vector_dcf_key(dk, vec_bits))
+        else:
+            out += wire.len_field(1, serialize_dcf_key(dk, parameters))
     for share in gate_key.mask_shares:
         out += wire.len_field(2, _encode_value_integer(share))
     return out
@@ -478,6 +594,8 @@ def parse_gate_key(buf: bytes):
             dcf_keys.append(parse_dcf_key(value))
         elif field == 2:
             shares.append(_decode_value_integer(value))
+        elif field == 3:
+            dcf_keys.append(_parse_vector_dcf_key(value))
     if not dcf_keys:
         raise InvalidArgumentError("GateKey has no component DCF keys set")
     return GateKey(dcf_keys=dcf_keys, mask_shares=shares)

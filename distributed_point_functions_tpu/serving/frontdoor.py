@@ -359,9 +359,15 @@ class FrontDoor:
             comps, sites = r0.obj.num_components, r0.obj.num_sites
             merged = len(union[0])
             dev_pts = _bucket_target(merged, floor=wt) if self.bucket else None
+            # Vector-payload gates collapse num_components to their real
+            # walk count (ONE tuple key) — cost prediction must track the
+            # walks that run, not the coefficient count; the widened
+            # capture tail is flagged through value_kind.
+            elems = getattr(r0.obj, "payload_elems", 1)
             return Workload(
                 op=r0.op, num_keys=comps, points=merged * sites,
-                value_bits=128, value_kind="u128",
+                value_bits=128,
+                value_kind="codec" if elems > 1 else "u128",
                 device_points=dev_pts and dev_pts * sites,
             )
         hl = r0.hierarchy_level if r0.op in ("full_domain", "evaluate_at") else -1

@@ -1,28 +1,39 @@
-"""Two-server private inference: a secure ReLU layer over the wire format.
+"""Two-server private inference: secure ReLU + sigmoid layers over the
+wire format.
 
 The secure-ML deployment story of the FSS gate family (BCG+ eprint
 2020/1392; the preprocessing model of BGI eprint 2018/707): a dealer
 (offline phase) knows nothing about the data but hands each of two
-non-colluding servers one ReLU gate key per activation; at inference time
+non-colluding servers one gate key per activation; at inference time
 the servers see only *masked* activations ``x = x_real + r_in mod N`` —
 uniformly random values that leak nothing — and return additive shares
-whose sum (minus the output mask) is exactly ``ReLU(x_real)``. One round,
-no interaction between the servers.
+whose sum (minus the output mask) is exactly the gate function of
+``x_real``. One round, no interaction between the servers.
+
+Two layer legs, both on the vector-payload codec (ISSUE 18 — ONE
+tuple-payload DCF key per gate instead of one key per shifted
+coefficient):
+
+* **ReLU** — the two-piece degree-1 spline, signed fixed point.
+* **Sigmoid** — an 8-piece degree-1 chord spline of 1/(1+e^-x) in
+  fixed point (outputs carry 2x the fractional bits, the standard
+  pre-truncation FSS spline form). The scalar layout would ship 16
+  component keys per activation; the vector codec ships one.
 
 Flow (roles separated the way a deployment separates them):
 
 1. **Dealer (offline)**: per activation, draw ``r_in`` / ``r_out``, run
-   ``ReluGate.gen`` (4 component DCF keys per party — the two-piece
-   degree-1 spline), serialize each party's key bundle through the
-   byte-compatible wire format (protos/serialization.serialize_gate_key).
-2. **Client / previous layer (online)**: mask its real-valued activation
-   vector and broadcast the SAME masked vector to both servers.
+   ``gate.gen``, serialize each party's key bundle through the
+   byte-compatible wire format (protos/serialization.serialize_gate_key;
+   vector keys ride the packed VectorDcfKey form).
+2. **Client / previous layer (online)**: mask its activation vector and
+   broadcast the SAME masked vector to both servers.
 3. **Servers**: parse their key bundles and evaluate the whole layer in
    ONE fused batched-DCF pass each (gates/framework.bundle_eval — the
    per-activation keys and sites flatten into a single program; under
    ``mode="walkkernel"`` on hardware, a single walk-megakernel program).
 4. **Client**: adds the two share vectors, removes the output masks, and
-   checks bit-exactness against the plaintext ReLU.
+   checks bit-exactness against the exact-int plaintext gate.
 
 Run: python examples/secure_relu_demo.py  (CPU; a few seconds)
 Knobs: RELU_BITS (default 16), RELU_BATCH (default 24).
@@ -40,12 +51,13 @@ BITS = int(os.environ.get("RELU_BITS", 16))
 BATCH = int(os.environ.get("RELU_BATCH", 24))
 
 
-def main() -> int:
-    from distributed_point_functions_tpu.gates import ReluGate, framework
+def run_layer(name, gate, x_raw, plain, rng) -> bool:
+    """One secure layer end to end: dealer keys -> wire -> two servers ->
+    client reconstruction, checked bit-exactly against ``plain`` (the
+    exact-int plaintext outputs, raw mod-N). Returns True on success."""
+    from distributed_point_functions_tpu.gates import framework
     from distributed_point_functions_tpu.protos import serialization as ser
 
-    rng = np.random.default_rng(0xAC71)
-    gate = ReluGate.create(BITS)
     n = gate.n
     params = gate.dcf.dpf.validator.parameters
 
@@ -60,24 +72,31 @@ def main() -> int:
         wire_b.append(ser.serialize_gate_key(k1, params))
     key_bytes = sum(len(b) for b in wire_a)
     print(
-        f"# dealer: {BATCH} ReLU keys ({BITS}-bit fixed point) in "
+        f"# dealer[{name}]: {BATCH} keys ({BITS}-bit fixed point) in "
         f"{time.time() - t0:.2f}s, {key_bytes / BATCH:.0f} B/key on the wire "
-        f"({gate.num_components} component DCFs each)"
+        f"({gate.num_components} component DCFs x {gate.payload_elems} "
+        f"payload elements each)"
     )
 
-    # --- client: signed activations, masked once, sent to both servers ----
-    x_real = [int(v) for v in rng.integers(-(n // 2), n // 2, size=BATCH)]
-    masked = [(gate.signed_lift(v) + r) % n for v, r in zip(x_real, r_ins)]
+    # --- client: activations masked once, sent to both servers ------------
+    masked = [(x + r) % n for x, r in zip(x_raw, r_ins)]
+    # The servers learn nothing: each masked value is x_real shifted by an
+    # independent uniform r_in, i.e. itself uniform on [0, N).
+    spread = len(set(masked))
+    print(
+        f"# client[{name}]: {BATCH} masked activations "
+        f"({spread} distinct values in [0, {n}) — uniform, input-independent)"
+    )
 
     # --- servers: parse keys, evaluate the layer in ONE fused pass each ---
     shares = []
-    for name, blobs in (("A", wire_a), ("B", wire_b)):
+    for server, blobs in (("A", wire_a), ("B", wire_b)):
         keys = [ser.parse_gate_key(b) for b in blobs]
         t0 = time.time()
         out = framework.bundle_eval(gate, keys, masked, engine="device")
         print(
-            f"# server {name}: {BATCH} activations in {time.time() - t0:.2f}s "
-            f"(one fused batched-DCF pass: "
+            f"# server {server}[{name}]: {BATCH} activations in "
+            f"{time.time() - t0:.2f}s (one fused batched-DCF pass: "
             f"{BATCH * gate.num_components} keys x "
             f"{BATCH * gate.num_sites} sites)"
         )
@@ -87,20 +106,51 @@ def main() -> int:
     ok = True
     for b in range(BATCH):
         y = (int(shares[0][b, 0]) + int(shares[1][b, 0]) - r_outs[b]) % n
-        want = max(0, x_real[b])
-        if gate.to_signed(y) != want:
+        if y != plain[b]:
             ok = False
-            print(f"MISMATCH at {b}: got {gate.to_signed(y)}, want {want}")
-    sample = ", ".join(
-        f"{x_real[b]}->{max(0, x_real[b])}" for b in range(min(6, BATCH))
-    )
-    print(f"# reconstructed: {sample}, ...")
-    if not ok:
+            print(f"MISMATCH[{name}] at {b}: got {y}, want {plain[b]}")
+    return ok
+
+
+def main() -> int:
+    from distributed_point_functions_tpu.gates import ReluGate, SigmoidGate
+
+    rng = np.random.default_rng(0xAC71)
+
+    # --- leg 1: ReLU -------------------------------------------------------
+    relu = ReluGate.create(BITS)
+    n = relu.n
+    x_real = [int(v) for v in rng.integers(-(n // 2), n // 2, size=BATCH)]
+    x_raw = [relu.signed_lift(v) for v in x_real]
+    plain = [relu.plaintext(x) for x in x_raw]
+    ok = run_layer("relu", relu, x_raw, plain, rng)
+    if ok:
+        sample = ", ".join(
+            f"{x_real[b]}->{max(0, x_real[b])}" for b in range(min(6, BATCH))
+        )
+        print(f"# reconstructed[relu]: {sample}, ...")
+
+    # --- leg 2: sigmoid ----------------------------------------------------
+    sig = SigmoidGate.create(BITS)
+    frac = 1 << 5  # frac_bits=5 default; outputs carry 2*frac_bits
+    lim = int(6.0 * frac)
+    xs_fixed = [int(v) for v in rng.integers(-lim, lim + 1, size=BATCH)]
+    x_raw = [v % n for v in xs_fixed]
+    plain = [sig.plaintext(x) for x in x_raw]
+    ok2 = run_layer("sigmoid", sig, x_raw, plain, rng)
+    if ok2:
+        sample = ", ".join(
+            f"{v / frac:+.2f}->{sig.plaintext(v % n) / frac**2:.3f}"
+            for v in xs_fixed[: min(6, BATCH)]
+        )
+        print(f"# reconstructed[sigmoid]: {sample}, ...")
+
+    if not (ok and ok2):
         print("MISMATCH")
         return 1
     print(
-        "OK: ReLU reconstructed bit-exactly; servers saw only uniformly "
-        "masked activations"
+        "OK: ReLU and sigmoid layers reconstructed bit-exactly; servers "
+        "saw only uniformly masked activations"
     )
     return 0
 

@@ -411,21 +411,26 @@ def test_gate_family_program_budget(program_counter):
     """ISSUE 9 acceptance pin: every framework gate's batch_eval flattens
     to the SAME single fused batched-DCF pass MIC uses — EXACTLY one
     device program per key chunk in walk mode (here: one chunk = one
-    program per call, multi-component keys included), and serving a gate
-    through the front door launches exactly the programs the direct
-    robust call launches (routing, GatePlan combine, and slicing are all
+    program per call, multi-component keys included), the vector-payload
+    codec keeps that pin (ONE tuple-payload key -> ONE program, no
+    per-coefficient dispatches), and serving a vector gate through the
+    front door launches exactly the programs the direct robust call
+    launches (routing, GatePlan combine, and slicing are all
     host-side)."""
     from distributed_point_functions_tpu import gates, serving
     from distributed_point_functions_tpu.ops import supervisor
 
-    relu = gates.ReluGate.create(6)
+    relu = gates.ReluGate.create(6, payload="vector")
     rk, _ = relu.gen(11, [3])
+    relu_s = gates.ReluGate.create(6, payload="scalar")
+    rk_s, _ = relu_s.gen(11, [3])
     bits = gates.BitDecompositionGate.create(6)
     bk, _ = bits.gen(45, [0] * 6)
     xs = [0, 9, 32, 63]
 
     for name, gate, key, want in (
-        ("relu.batch_eval[4 components]", relu, rk, 1),
+        ("relu.batch_eval[vector: 1 tuple-payload key]", relu, rk, 1),
+        ("relu.batch_eval[scalar: 4 components]", relu_s, rk_s, 1),
         ("bitdecomp.batch_eval[6 components]", bits, bk, 1),
     ):
         fn = lambda: gate.batch_eval(key, xs, mode="walk")  # noqa: B023

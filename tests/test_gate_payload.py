@@ -1,0 +1,261 @@
+"""Vector-payload gate codec: bit-exactness, wire pins, and the perf
+acceptance (ISSUE 18).
+
+The vector codec packs a gate's m(d+1) spline coefficients into ONE DCF
+key with a uniform Int(w) tuple value type (w = the narrowest of
+{32, 64, 128} that holds the group), evaluated in ONE batched-DCF pass.
+This suite pins it against three oracles:
+
+* the scalar-flattened layout (one DCF key per shifted coefficient),
+* the exact-integer plaintext gate function,
+* the serialized wire bytes (packed VectorDcfKey form, and the
+  1-element degeneration that must stay byte-identical to scalar).
+
+Device-engine coverage rides the cheap ReLU shape (log_group_size=6,
+w=32); the wide sigmoid/tanh gates are exercised through the host AES
+engine and the per-point evaluator so the matrix stays inside the fast
+tier.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import gates, serving
+from distributed_point_functions_tpu.gates import framework
+from distributed_point_functions_tpu.protos import serialization as ser
+
+
+def _params(gate):
+    return gate.dcf.dpf.validator.parameters
+
+
+def _reconstruct(gate, k0, k1, x, r_out, engine):
+    e0 = gate.batch_eval(k0, [x], engine=engine)
+    e1 = gate.batch_eval(k1, [x], engine=engine)
+    return gate.to_signed((int(e0[0][0]) + int(e1[0][0]) - r_out) % gate.n)
+
+
+# ---------------------------------------------------------------------------
+# Bit-exactness: vector vs scalar oracle vs exact-int plaintext
+# ---------------------------------------------------------------------------
+
+
+def test_relu_vector_edge_matrix_host():
+    """The PR 9 edge matrix on both payload arms: r_in at the wrap
+    points, x_real at the interval endpoints, both parties contributing.
+    Vector and scalar reconstructions must equal the exact-int plaintext
+    gate for every cell."""
+    n = 1 << 6
+    gv = gates.ReluGate.create(6, payload="vector")
+    gs = gates.ReluGate.create(6, payload="scalar")
+    assert gv.num_components == 1 and gs.num_components == 4
+    r_out = 5
+    for r_in in (0, 1, n // 2, n - 1):
+        kv0, kv1 = gv.gen(r_in, [r_out])
+        ks0, ks1 = gs.gen(r_in, [r_out])
+        for xr in (-(n // 2), -(n // 2) + 1, -1, 0, 1, n // 2 - 1):
+            x = (gv.signed_lift(xr) + r_in) % n
+            want = max(0, xr)
+            got_v = _reconstruct(gv, kv0, kv1, x, r_out, "host")
+            got_s = _reconstruct(gs, ks0, ks1, x, r_out, "host")
+            assert got_v == want, (r_in, xr, got_v)
+            assert got_s == want, (r_in, xr, got_s)
+
+
+def test_relu_vector_device_engine():
+    """Device engine (the jax batched walk with the tuple capture tail)
+    agrees with the host engine and the per-point evaluator on the
+    vector arm."""
+    n = 1 << 6
+    gv = gates.ReluGate.create(6, payload="vector")
+    r_in, r_out = 13, 7
+    k0, k1 = gv.gen(r_in, [r_out])
+    xs = [(gv.signed_lift(xr) + r_in) % n for xr in (-5, 0, 11)]
+    dev0 = gv.batch_eval(k0, xs, engine="device")
+    host0 = gv.batch_eval(k0, xs, engine="host")
+    assert np.array_equal(np.asarray(dev0), np.asarray(host0))
+    for x, row in zip(xs, dev0):
+        assert list(gv.eval(k0, x)) == [int(v) for v in row]
+    dev1 = gv.batch_eval(k1, xs, engine="device")
+    for xr, r0, r1 in zip((-5, 0, 11), dev0, dev1):
+        got = gv.to_signed((int(r0[0]) + int(r1[0]) - r_out) % n)
+        assert got == max(0, xr)
+
+
+@pytest.mark.parametrize("cls", [gates.SigmoidGate, gates.TanhGate])
+def test_wide_spline_vector_bit_exact(cls):
+    """8-piece degree-1 sigmoid/tanh on the vector codec: ONE component
+    key whose reconstruction equals both the scalar oracle and the
+    exact-int plaintext spline, across parties and the wrap mask. The
+    point set hits every piece's interval endpoints (raw mod-N domain —
+    negative fixed-point inputs ride two's complement)."""
+    gv = cls.create(12, payload="vector")
+    gs = cls.create(12, payload="scalar")
+    assert gv.num_components == 1 and gs.num_components == 16
+    n = gv.n
+    r_out = 3
+    endpoints = sorted({e for pq in gv.intervals for e in pq})
+    for r_in in (0, n - 1):
+        kv0, kv1 = gv.gen(r_in, [r_out])
+        ks0, ks1 = gs.gen(r_in, [r_out])
+        for x_raw in endpoints:
+            x = (x_raw + r_in) % n
+            want = gv.plaintext(x_raw)
+            assert gs.plaintext(x_raw) == want
+            e0 = gv.batch_eval(kv0, [x], engine="host")
+            e1 = gv.batch_eval(kv1, [x], engine="host")
+            got_v = (int(e0[0][0]) + int(e1[0][0]) - r_out) % n
+            s0 = gs.batch_eval(ks0, [x], engine="host")
+            s1 = gs.batch_eval(ks1, [x], engine="host")
+            got_s = (int(s0[0][0]) + int(s1[0][0]) - r_out) % n
+            assert got_v == want, (r_in, x_raw)
+            assert got_s == want, (r_in, x_raw)
+
+
+def test_vector_bundle_eval():
+    """bundle_eval fuses B tuple-payload keys into one pass and each
+    bundle element still reconstructs exactly."""
+    n = 1 << 6
+    gv = gates.ReluGate.create(6, payload="vector")
+    r_ins, r_out = [3, 40, 63], 9
+    pairs = [gv.gen(r, [r_out]) for r in r_ins]
+    xrs = [-7, 0, 20]
+    xs = [(gv.signed_lift(xr) + r) % n for xr, r in zip(xrs, r_ins)]
+    out0 = framework.bundle_eval(gv, [p[0] for p in pairs], xs, engine="host")
+    out1 = framework.bundle_eval(gv, [p[1] for p in pairs], xs, engine="host")
+    for xr, r0, r1 in zip(xrs, out0, out1):
+        got = gv.to_signed((int(r0[0]) + int(r1[0]) - r_out) % n)
+        assert got == max(0, xr)
+
+
+# ---------------------------------------------------------------------------
+# Wire pins
+# ---------------------------------------------------------------------------
+
+
+def test_one_element_vector_key_byte_identical_to_scalar():
+    """A 1-element vector gate degenerates to a scalar Int(128) DCF by
+    construction, so its serialized GateKey must be BYTE-IDENTICAL to
+    the scalar arm's — the packed VectorDcfKey form only ever applies to
+    true tuples (the MIC-superset wire pin survives the codec)."""
+    gv = gates.SplineGate.create(6, [(0, 31)], [[5]], payload="vector")
+    gs = gates.SplineGate.create(6, [(0, 31)], [[5]], payload="scalar")
+    assert gv.num_components == 1 and gs.num_components == 1
+    kv = gv.gen(3, [9], prng=gates.CounterRng(b"pin"), dcf_seeds=[(1, 2)])
+    ks = gs.gen(3, [9], prng=gates.CounterRng(b"pin"), dcf_seeds=[(1, 2)])
+    for v, s in zip(kv, ks):
+        assert ser.serialize_gate_key(v, _params(gv)) == ser.serialize_gate_key(
+            s, _params(gs)
+        )
+
+
+def test_vector_gate_golden_digest():
+    """gen() on the vector arm with an injected CounterRng + pinned DCF
+    seeds is deterministic and its serialized fingerprint is pinned —
+    the vector twin of the scalar golden in test_gates_framework.py.
+    Changes only if the tuple keygen algebra or the packed wire format
+    changes; regenerate deliberately."""
+    gate = gates.ReluGate.create(8, payload="vector")
+    seeds = [(0x1111111122222222, 0x3333333344444444)]
+
+    def make():
+        return gate.gen(
+            77, [5], prng=gates.CounterRng(seed=b"relu-golden"),
+            dcf_seeds=seeds,
+        )
+
+    k0_a, k1_a = make()
+    k0_b, k1_b = make()
+    assert k0_a == k0_b and k1_a == k1_b
+    blob = ser.serialize_gate_key(k0_a, _params(gate))
+    assert hashlib.sha256(blob).hexdigest() == (
+        "15bb02fda75426a610e78068677656e448fce6d69cb46c292e4fe8608f8feead"
+    )
+    n = gate.n
+    for xr in (-100, -1, 0, 1, 100):
+        x = (gate.signed_lift(xr) + 77) % n
+        e0 = gate.eval(k0_a, x)
+        e1 = gate.eval(k1_a, x)
+        assert gate.to_signed((e0[0] + e1[0] - 5) % n) == max(0, xr)
+
+
+def test_packed_vector_key_roundtrip():
+    """The packed VectorDcfKey wire form round-trips field-exactly and
+    the parsed key evaluates identically to the original."""
+    gv = gates.SigmoidGate.create(12, payload="vector")
+    k0, _ = gv.gen(7, [3])
+    blob = ser.serialize_gate_key(k0, _params(gv))
+    back = ser.parse_gate_key(blob)
+    assert back.mask_shares == k0.mask_shares
+    a, b = back.dcf_keys[0].key, k0.dcf_keys[0].key
+    assert (a.seed, a.party) == (b.seed, b.party)
+    assert a.last_level_value_correction == b.last_level_value_correction
+    assert len(a.correction_words) == len(b.correction_words)
+    for ca, cb in zip(a.correction_words, b.correction_words):
+        assert (ca.seed, ca.control_left, ca.control_right,
+                ca.value_correction) == (
+            cb.seed, cb.control_left, cb.control_right, cb.value_correction)
+    for x in (0, 1, 2048, 4095):
+        assert gv.eval(back, x) == gv.eval(k0, x)
+
+
+# ---------------------------------------------------------------------------
+# Merge safety
+# ---------------------------------------------------------------------------
+
+
+def test_scalar_vector_requests_never_merge():
+    """A scalar-payload gate batch and a vector-payload gate batch land
+    in DIFFERENT batcher queues: merging them would hand one program a
+    mix of Int(128) scalar keys and Int(w)-tuple keys."""
+    gv = gates.ReluGate.create(6, payload="vector")
+    gs = gates.ReluGate.create(6, payload="scalar")
+    kv, _ = gv.gen(11, [3])
+    ks, _ = gs.gen(11, [3])
+    sig_v = serving.Request.gate(gv, kv, [5]).signature()
+    sig_s = serving.Request.gate(gs, ks, [5]).signature()
+    assert sig_v != sig_s
+    # same-config requests on the same arm DO share a queue
+    assert sig_v == serving.Request.gate(gv, kv, [9]).signature()
+
+
+# ---------------------------------------------------------------------------
+# Perf acceptance: >= 8x key bytes AND >= 8x DCF walks (8-piece sigmoid)
+# ---------------------------------------------------------------------------
+
+
+def test_sigmoid_key_bytes_and_walks_drop_8x():
+    """The ISSUE 18 acceptance: for an 8-piece degree-1 sigmoid spline,
+    serialized key bytes and DCF walks per gate eval both drop >= 8x on
+    the vector arm, bit-exact across arms (bit-exactness is pinned by
+    test_wide_spline_vector_bit_exact)."""
+    gv = gates.SigmoidGate.create(12, payload="vector")
+    gs = gates.SigmoidGate.create(12, payload="scalar")
+    kv, _ = gv.gen(7, [3])
+    ks, _ = gs.gen(7, [3])
+
+    bytes_v = len(ser.serialize_gate_key(kv, _params(gv)))
+    bytes_s = len(ser.serialize_gate_key(ks, _params(gs)))
+    assert bytes_s >= 8 * bytes_v, (bytes_s, bytes_v)
+
+    def count_walks(gate, key):
+        walks = []
+        orig = gate.dcf.batch_evaluate
+
+        def spy(keys, points, **kw):
+            walks.append(len(keys) * len(points))
+            return orig(keys, points, **kw)
+
+        gate.dcf.batch_evaluate = spy
+        try:
+            gate.batch_eval(key, [100], engine="host")
+        finally:
+            gate.dcf.batch_evaluate = orig
+        assert len(walks) == 1, "gate eval must be ONE batched-DCF pass"
+        return walks[0]
+
+    walks_v = count_walks(gv, kv)
+    walks_s = count_walks(gs, ks)
+    assert walks_s >= 8 * walks_v, (walks_s, walks_v)

@@ -267,7 +267,10 @@ def test_gate_gen_deterministic_golden():
     test provides, extended to the framework's multi-key form."""
     import hashlib
 
-    gate = gates.ReluGate.create(8)
+    # Pinned on the scalar-flattened layout (one DCF key per shifted
+    # coefficient); the vector codec has its own pins in
+    # tests/test_gate_payload.py.
+    gate = gates.ReluGate.create(8, payload="scalar")
     seeds = [
         (0x1111111122222222 + i, 0x3333333344444444 + i)
         for i in range(gate.num_components)
@@ -330,7 +333,13 @@ def test_gate_validation():
     with pytest.raises(InvalidArgumentError):  # masked input out of group
         gate.batch_eval(k0, [64])
     with pytest.raises(InvalidArgumentError):  # seeds-per-component check
-        gates.ReluGate.create(6).gen(0, [0], dcf_seeds=[(1, 2)])
+        gates.ReluGate.create(6, payload="scalar").gen(
+            0, [0], dcf_seeds=[(1, 2)]
+        )
+    with pytest.raises(InvalidArgumentError):  # vector: ONE component key
+        gates.ReluGate.create(6, payload="vector").gen(
+            0, [0], dcf_seeds=[(1, 2), (3, 4)]
+        )
 
 
 def test_gate_serving_roundtrip():

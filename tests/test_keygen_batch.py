@@ -144,7 +144,7 @@ def test_gate_gen_and_bundle_ride_the_batch_path():
     from distributed_point_functions_tpu.gates.prng import CounterRng
     from distributed_point_functions_tpu.gates.relu import ReluGate
 
-    gate = ReluGate.create(8)
+    gate = ReluGate.create(8, payload="scalar")
     assert gate.num_components == 4  # two pieces x degree-1 coefficients
     rng = np.random.default_rng(RNG_SEED + 3)
     params = gate.dcf.dpf.parameters
