@@ -3,11 +3,15 @@
 Methodology of BM_KeyGeneration
 (/root/reference/dpf/distributed_point_function_benchmark.cc:228-260):
 single-level DPFs across tree depths. The primary record is the batched
-level-major path at BENCH_KEYGEN_MODE ("numpy" = the vectorized host
-batch, the production default; "jax"/"pallas" = the device circuits of
-ops/keygen_batch.py — device strategies, staged-for-tunnel), A/B'd
-against the scalar per-key loop (the reference's shape) on a sampled
-prefix, plus a batch-size sweep at the headline depth.
+level-major path at BENCH_KEYGEN_MODE ("numpy-threaded" = the
+thread-parallel host dealer, the production default; "numpy" = the
+single-thread vectorized host batch; "jax"/"pallas"/"megakernel" = the
+device circuits of ops/keygen_batch.py — device strategies,
+staged-for-tunnel), A/B'd against the scalar per-key loop (the
+reference's shape) on a sampled prefix, plus a batch-size sweep at the
+headline depth. Host modes also run a BENCH_KEYGEN_THREADS worker sweep
+(default "1,2,4,0"; 0 = all cores) at the deepest depth, each point
+paired with the roofline host-thread model's predicted speedup.
 
 The `verified` flag — spot keys byte-compared (serialized) against the
 scalar oracle from the same seeds — is what lets run_bench_stage.py's
@@ -32,7 +36,7 @@ def bench(jax, smoke):
 
     num_keys = int(os.environ.get("BENCH_KEYS", 64 if smoke else 1024))
     depths = [20, 64, 128]
-    mode = os.environ.get("BENCH_KEYGEN_MODE", "numpy")
+    mode = os.environ.get("BENCH_KEYGEN_MODE", "numpy-threaded")
     # The scalar-loop A/B arm samples this many keys and extrapolates —
     # the loop is the ~1 ms/key reference shape being beaten.
     scalar_sample = min(
@@ -95,6 +99,46 @@ def bench(jax, smoke):
             f"{scalar_sample} keys byte-checked)"
         )
 
+    # Host-thread sweep (ISSUE 19) at the deepest depth — the shape where
+    # per-key work is largest and thread-parallel sharding of the dealer
+    # pays most. Each point carries the roofline model's prediction so
+    # measured-vs-modeled scaling lands in the record (on a 1-core box
+    # every point degenerates to the single-thread rate by design: the
+    # pool is sized min(threads, cores)).
+    threads_sweep = {}
+    threads_model = {}
+    if mode in ("numpy", "numpy-threaded"):
+        from distributed_point_functions_tpu.utils import roofline
+
+        deep = depths[-1]
+        dpf = DistributedPointFunction.create(DpfParameters(deep, Int(64)))
+        alphas = [
+            int.from_bytes(rng.bytes(16), "little") % (1 << deep)
+            for _ in range(num_keys)
+        ]
+        betas = [int(x) for x in rng.integers(1, 1 << 62, size=num_keys)]
+        seeds = rng.integers(0, 2**32, size=(num_keys, 2, 4), dtype=np.uint32)
+        spec = os.environ.get("BENCH_KEYGEN_THREADS", "1,2,4,0")
+        for raw in spec.split(","):
+            t_n = int(raw)
+            label = "all" if t_n == 0 else str(t_n)
+            eff = t_n if t_n else (os.cpu_count() or 1)
+            keygen_batch.generate_keys_batch(
+                dpf, alphas, [betas], mode="numpy-threaded", seeds=seeds,
+                threads=eff,
+            )
+            with Timer() as t:
+                keygen_batch.generate_keys_batch(
+                    dpf, alphas, [betas], mode="numpy-threaded", seeds=seeds,
+                    threads=eff,
+                )
+            threads_sweep[label] = round(num_keys / t.elapsed)
+            threads_model[label] = round(roofline.host_thread_speedup(eff), 2)
+        log(f"thread sweep depth {deep} [numpy-threaded]: " + ", ".join(
+            f"{k}: {v} keys/s (model {threads_model[k]}x)"
+            for k, v in threads_sweep.items()
+        ))
+
     # Batch-size sweep at the headline depth: where amortization lands.
     sweep_rates = {}
     dpf = DistributedPointFunction.create(DpfParameters(20, Int(64)))
@@ -126,7 +170,14 @@ def bench(jax, smoke):
             "speedup_vs_scalar_depth20": round(
                 per_depth[20] / max(1, scalar_per_depth[20]), 1
             ),
+            "speedup_vs_scalar_depth128": round(
+                per_depth[128] / max(1, scalar_per_depth[128]), 1
+            ),
             "batch_sweep_keys_per_s": sweep_rates,
+            "threads_keys_per_s_depth128": threads_sweep,
+            "threads_model_speedup": threads_model,
+            "host_threads_default": keygen_batch.keygen_threads(),
+            "host_cores": os.cpu_count(),
         },
     }
 

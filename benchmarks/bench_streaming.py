@@ -4,17 +4,19 @@ The write-heavy tier's throughput question: how many client keys per
 second can the two-server pair ACCEPT — journal-fsync'd, deduped,
 windowed — and how fast do closed windows publish behind the ingest
 front? By design the system is **keygen-bound**: every uploaded key is a
-client-side incremental DPF keygen (PR 13's batched dealer measured
-8.5 K keys/s at depth 20 — the feed-rate ceiling for any client fleet),
-so the serving-side interesting numbers are the ingest ack rate (the
-fsync + dedup + window accounting path) and the publish lag.
+client-side incremental DPF keygen (fed here through the ISSUE 19
+threaded batched dealer, `host_generate_keys_batch` — the feed-rate
+ceiling for any client fleet), so the serving-side interesting numbers
+are the ingest ack rate (the fsync + dedup + window accounting path)
+and the publish lag.
 
 Arms, one seeded run on loopback (two in-process servers; the leader
 drives the advance against the follower over the real wire):
 
 * ``ingest`` — keys/s acknowledged across ``BENCH_STREAM_THREADS``
-  concurrent uploading clients (keys pre-generated: the client keygen
-  cost is PR 13's record, not re-measured here);
+  concurrent uploading clients (keys pre-generated through the threaded
+  dealer; the measured feed rate lands in
+  ``client_keygen_keys_per_sec``);
 * ``publish`` — wall from the final flush to every window published
   (the level-by-level advance + peer exchange for the whole backlog);
 * ``failover`` (ISSUE 16) — the leader is stopped WITHOUT releasing its
@@ -175,6 +177,7 @@ def bench_streaming(jax, smoke):
     from distributed_point_functions_tpu.core.dpf import (
         DistributedPointFunction,
     )
+    from distributed_point_functions_tpu.ops import keygen_batch
 
     n_threads = int(os.environ.get("BENCH_STREAM_THREADS", 4))
     n_batches = int(os.environ.get(
@@ -221,16 +224,14 @@ def bench_streaming(jax, smoke):
                 pool[j]
                 for j in rng.integers(0, len(pool), size=keys_per_batch)
             ]
-            k0s, k1s = [], []
-            for v in vals:
-                k0, k1 = dpf.generate_keys_incremental(v, [1] * n_levels)
-                k0s.append(k0)
-                k1s.append(k1)
+            k0s, k1s = keygen_batch.host_generate_keys_batch(
+                dpf, vals, [[1] * len(vals)] * n_levels
+            )
             schedule[f"t{t}-b{i}"] = (k0s, k1s)
     keygen_wall = time.perf_counter() - t0
     total_keys = n_threads * n_batches * keys_per_batch
     log(f"client keygen: {total_keys} keys in {keygen_wall:.2f}s "
-        f"({total_keys / keygen_wall:.0f} keys/s scalar loop)")
+        f"({total_keys / keygen_wall:.0f} keys/s threaded batched dealer)")
 
     endpoints = [("127.0.0.1", leader.port), ("127.0.0.1", follower.port)]
     warm = serving.TwoServerClient(endpoints, policy=policy)
@@ -314,8 +315,9 @@ def bench_streaming(jax, smoke):
         "engine": "host",
         "notes": (
             "write path is journal-fsync-per-batch by contract; the "
-            "system feed rate is keygen-bound by design (PR 13 batched "
-            "dealer: 8504 keys/s at depth 20)"
+            "system feed rate is keygen-bound by design (client keys "
+            "fed through the ISSUE 19 threaded batched dealer — see "
+            "client_keygen_keys_per_sec)"
         ),
     }
 

@@ -13,6 +13,7 @@ same random seeds, so they can be exchanged with C++ evaluators.
 
 from __future__ import annotations
 
+import gc
 import secrets
 from typing import List, Optional, Sequence, Tuple
 
@@ -114,7 +115,7 @@ def batch_level_step(
     Returns (new_seeds uint32[K, 2, 4], new_control bool[K, 2],
     seed_correction uint32[K, 4], control_correction bool[K, 2])."""
     k = left.shape[0]
-    exp = np.stack([left, right], axis=1).astype(np.uint32)  # [K, br, party, 4]
+    exp = np.stack([left, right], axis=1).astype(np.uint32, copy=False)  # [K, br, party, 4]
     exp_bits = (exp[..., 0] & 1).astype(bool)  # [K, branch, party]
     exp[..., 0] &= np.uint32(0xFFFFFFFE)
 
@@ -134,6 +135,72 @@ def batch_level_step(
     keep_cc = cc[rows, keep]  # [K]
     new_control = exp_bits[rows, keep] ^ (control & keep_cc[:, None])
     return new_seeds, new_control, seed_correction, cc
+
+
+def assemble_batch_keys(
+    out_keys: Tuple[List[DpfKey], List[DpfKey]],
+    level_records: Sequence[Tuple[np.ndarray, np.ndarray, Optional[List[list]]]],
+    last_cw: List[list],
+) -> None:
+    """Appends all correction words + the final value correction to K
+    pre-seeded key pairs from level-major arrays.
+
+    ``level_records`` is one tuple per tree level (the
+    :func:`batch_level_step` outputs): seed_correction uint32[K, 4],
+    control_correction bool[K, 2], and the level's typed value
+    corrections (None off output levels). The limb->int conversion runs
+    ONCE vectorized over all levels — the per-key/per-level
+    ``from_limbs`` + keyword-argument construction loop this replaces
+    was ~85% of a depth-128 host keygen pass (AES itself is 2-3%
+    behind the native engine). Both the host batched path and the
+    device/megakernel dealers assemble through here, so the wire form
+    cannot drift between them."""
+    k = len(out_keys[0])
+    # A deep batch materializes hundreds of thousands of acyclic
+    # containers (CorrectionWord + its value list, per key per level per
+    # party); every gen-0 threshold trip rescans the survivors, which
+    # doubled depth-128 assembly time. Pause collection for the bounded
+    # allocation burst — nothing built here can form a cycle.
+    gc_was_enabled = gc.isenabled()
+    if gc_was_enabled:
+        gc.disable()
+    try:
+        _assemble_batch_keys(out_keys, level_records, last_cw, k)
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+
+
+def _assemble_batch_keys(out_keys, level_records, last_cw, k) -> None:
+    if level_records:
+        sc_ints = uint128.limb_rows_to_ints(
+            np.stack([rec[0] for rec in level_records]).reshape(-1, 4)
+        )
+        cc_flat = np.stack([rec[1] for rec in level_records]).reshape(-1, 2)
+        cls = cc_flat[:, 0].tolist()
+        crs = cc_flat[:, 1].tolist()
+
+        for party in range(2):
+            keys_p = out_keys[party]
+            # Level-major stream of value-correction lists, one FRESH
+            # list per correction word (the scalar oracle gives each
+            # party its own list — shared lists would alias mutations
+            # across parties).
+            vc_flat: list = []
+            for rec in level_records:
+                vcs = rec[2]
+                if vcs is None:
+                    vc_flat += [[] for _ in range(k)]
+                else:
+                    vc_flat += [list(vc) for vc in vcs]
+            all_cws = list(map(CorrectionWord, sc_ints, cls, crs, vc_flat))
+            for i in range(k):
+                # Level-major layout: level l of key i sits at l*k + i, so
+                # the stride slice is this key's per-level sequence.
+                keys_p[i].correction_words += all_cws[i::k]
+    for i in range(k):
+        out_keys[0][i].last_level_value_correction = list(last_cw[i])
+        out_keys[1][i].last_level_value_correction = list(last_cw[i])
 
 
 #: numpy view dtypes for the vectorized value-correction fast path.
@@ -300,12 +367,14 @@ class KeyGenerator:
         control[:, 1] = True
         alpha_limbs = uint128.u128_to_limb_rows(uint128.u128_array(alphas))
 
+        seed_ints = uint128.limb_rows_to_ints(seeds_l.reshape(-1, 4))
         out_keys: Tuple[List[DpfKey], List[DpfKey]] = (
-            [DpfKey(seed=uint128.from_limbs(seeds_l[i, 0]), correction_words=[], party=0)
+            [DpfKey(seed=seed_ints[2 * i], correction_words=[], party=0)
              for i in range(k)],
-            [DpfKey(seed=uint128.from_limbs(seeds_l[i, 1]), correction_words=[], party=1)
+            [DpfKey(seed=seed_ints[2 * i + 1], correction_words=[], party=1)
              for i in range(k)],
         )
+        level_records: List[Tuple[np.ndarray, np.ndarray, Optional[List[list]]]] = []
 
         for tree_level in range(1, v.tree_levels_needed):
             # Value correction for the previous level if it is an output
@@ -350,18 +419,7 @@ class KeyGenerator:
                 control, current_bit,
             )
 
-            for i in range(k):
-                vc = value_corrections[i] if value_corrections is not None else []
-                sc = uint128.from_limbs(seed_correction[i])
-                for party in range(2):
-                    out_keys[party][i].correction_words.append(
-                        CorrectionWord(
-                            seed=sc,
-                            control_left=bool(cc[i, 0]),
-                            control_right=bool(cc[i, 1]),
-                            value_correction=list(vc),
-                        )
-                    )
+            level_records.append((seed_correction, cc, value_corrections))
 
         last_level = v.num_hierarchy_levels - 1
         blocks_needed = v.blocks_needed[last_level]
@@ -371,9 +429,7 @@ class KeyGenerator:
         last_cw = self._value_corrections_from_hashed(
             last_level, hashed, control, alphas, beta_cols[-1]
         )
-        for i in range(k):
-            out_keys[0][i].last_level_value_correction = list(last_cw[i])
-            out_keys[1][i].last_level_value_correction = list(last_cw[i])
+        assemble_batch_keys(out_keys, level_records, last_cw)
         return out_keys
 
     def _value_corrections_from_hashed(

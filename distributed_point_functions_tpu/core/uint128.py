@@ -54,6 +54,19 @@ def from_limbs(limbs: np.ndarray) -> int:
     return from_bytes(limbs.tobytes())
 
 
+def limb_rows_to_ints(rows: np.ndarray) -> list:
+    """uint32[N, 4] little-endian limb rows -> list of N Python ints.
+
+    Two vectorized u64 combines + one ``tolist`` per half instead of
+    per-row ``tobytes``/``from_bytes`` — the per-row form dominated
+    depth-128 batched keygen assembly (one conversion per key per tree
+    level)."""
+    r = np.asarray(rows, dtype=np.uint64).reshape(-1, 4)
+    lo = (r[:, 0] | (r[:, 1] << np.uint64(32))).tolist()
+    hi = (r[:, 2] | (r[:, 3] << np.uint64(32))).tolist()
+    return [(h << 64) | l for h, l in zip(hi, lo)]
+
+
 def array_to_limbs(xs) -> np.ndarray:
     """Iterable of 128-bit ints -> uint32[N, 4]."""
     xs = list(xs)

@@ -134,8 +134,14 @@ class DistributedComparisonFunction:
         if mode is None and not envflags.env_str("DPF_TPU_KEYGEN", None):
             # The pure host path stays import-light (no jax): servers and
             # benches that never touch a device mode pay nothing for it.
-            keys_a, keys_b = self._dpf.generate_keys_batch(
-                shifted, per_level, seeds=seeds
+            # Routed through the threaded host dealer (ISSUE 19, itself
+            # jax-free) so gate dealers ride DPF_TPU_KEYGEN_THREADS; all
+            # seeds are drawn before the pool fans out, so DCF keys stay
+            # byte-identical at any thread count.
+            from ..ops import keygen_batch
+
+            keys_a, keys_b = keygen_batch.host_generate_keys_batch(
+                self._dpf, shifted, per_level, seeds=seeds
             )
         else:
             from ..ops import keygen_batch

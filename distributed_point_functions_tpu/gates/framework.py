@@ -470,9 +470,11 @@ class MaskedGate(abc.ABC):
         component) — together they make ``gen`` fully deterministic.
 
         All component keys are seeded through ONE batched level-major
-        keygen pass (ISSUE 13); ``keygen_mode`` selects its engine
-        ("numpy" / "jax" / "pallas", None = DPF_TPU_KEYGEN default) —
-        every mode produces byte-identical keys."""
+        keygen pass (ISSUE 13); ``keygen_mode`` selects its engine (any
+        of ops/keygen_batch.KEYGEN_MODES; None = the threaded host
+        dealer unless DPF_TPU_KEYGEN overrides, so gate dealers ride
+        DPF_TPU_KEYGEN_THREADS) — every mode produces byte-identical
+        keys."""
         if prng is None:
             prng = BasicRng()
         self._check_masks(r_in, r_outs)

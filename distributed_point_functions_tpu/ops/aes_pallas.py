@@ -1585,3 +1585,276 @@ def walk_megakernel_pallas_batched(
         out_specs=pl.BlockSpec((1, lpe * 32, tw), lambda kk, j: (kk, 0, j)),
         interpret=interpret,
     )(seed_planes, path_masks, cw_planes, cc, corrections, sel_bits)
+
+
+# ---------------------------------------------------------------------------
+# Keygen megakernel: single-program batched key generation (ISSUE 19)
+# ---------------------------------------------------------------------------
+#
+# The jax/pallas keygen modes (ops/keygen_batch.py) pay exactly
+# `tree_levels_needed` device programs per batch — one expand dispatch per
+# level — so deep domains sit on the ~66 ms/dispatch floor regardless of
+# batch size. This kernel runs the WHOLE Fig.-11 dealer loop as ONE
+# pallas_call: both parties' seed planes and control rows stay resident in
+# VMEM across all levels, and the correction-word algebra
+# (core/keygen.batch_level_step, lines 5-12 of Fig. 11) is computed
+# in-kernel from the expanded planes — the dealer holds BOTH parties'
+# seeds, so every correction word is a pure function of rows already on
+# chip.
+#
+# Lane layout: lanes are KEYS (bit j of word w = key 32w+j), the transpose
+# of the walk kernels' point lanes. Seed planes come in as
+# `aes_jax.pack_to_planes` of the interleaved 2K seed rows split by party;
+# path bits as `aes_jax.pack_bit_mask` rows per level. With keys in lanes,
+# every per-key quantity (control bit, path bit, control correction) is a
+# packed row and the whole level step is elementwise row algebra:
+#
+#   lose_p  = left_p if path bit set else right_p     (keep = alpha bit)
+#   sc      = lose_0 ^ lose_1                         (seed correction)
+#   ccl     = ~(ebl_0 ^ ebl_1 ^ path)                 (control corrections)
+#   ccr     =   ebr_0 ^ ebr_1 ^ path
+#   rows_p ^= sc & c_p ; c_p = ebk_p ^ (c_p & keep_cc)
+#
+# with eb* the bit-0 rows extracted (and zeroed) from each branch hash,
+# exactly `batch_level_step`'s exp_bits handling. Value captures land
+# BEFORE the level step that consumes the same seeds (the
+# blocks_needed == 1 fusion in `generate_keys_batch`: the value-PRG inputs
+# ARE the parent seeds), plus the unconditional final capture after the
+# last level; the host applies the typed beta algebra
+# (`_value_corrections_from_hashed`) to the captured hash rows — value
+# typing stays host-side, the kernel only moves AES.
+#
+# Outputs are row-major planes shared across the whole batch tile:
+# correction-word planes [levels*128, Wp], control-correction rows
+# [levels*2, Wp] (row 2l = ccl, 2l+1 = ccr), captured value-hash planes
+# [slots*256, Wp] (slot s, party p, plane q at row s*256 + p*128 + q), and
+# party-1 control rows at each capture [slots, Wp] (the only control the
+# typed correction consumes). Grid is (key tiles,): each tile is
+# self-contained — no cross-step scratch, no concatenate, no iota — so the
+# body sits strictly inside the op set the walk megakernel already proved
+# on hardware (6 masked-AES instantiations per level+capture: left/right
+# per party plus value per party per capture).
+
+
+def _keygen_megakernel_core(
+    rows0,  # list of 128 uint32 rows: party-0 seed planes (keys in lanes)
+    rows1,  # list of 128 uint32 rows: party-1 seed planes
+    c0,  # uint32 row: party-0 control bits (starts all-zero)
+    c1,  # uint32 row: party-1 control bits (starts all-one)
+    path_row,  # path_row(lvl) -> uint32 row of this level's packed alpha bits
+    *,
+    levels: int,
+    captures,  # tuple[bool, levels + 1]: value-capture depths (final True)
+    rk_left,
+    rk_right,
+    rk_value,
+):
+    """The whole dealer loop on indexable operands — used VERBATIM by the
+    kernel body (reading refs) and by `keygen_megakernel_reference_rows`
+    (reading plain arrays), the `_walk_megakernel_core` sharing contract:
+    interpret plumbing tests and the eager real-circuit oracle replay
+    exercise this exact code. Returns flat row lists
+    (cw_rows[levels*128], cc_rows[levels*2], vh_rows[slots*256],
+    ctrl_rows[slots])."""
+    cw_rows = []
+    cc_rows = []
+    vh_rows = []
+    ctrl_rows = []
+
+    def _branch_hashes(rows, pmask, notp):
+        """Both branch hashes of one party's seeds; returns the bit-0 rows
+        (exp_bits, pre-clear) plus the lose/keep child selected per lane by
+        the path mask and the keep branch's exp-bit row."""
+        sig = [rows[64 + p] for p in range(64)] + [
+            rows[64 + p] ^ rows[p] for p in range(64)
+        ]
+        encl = _aes_rows(sig, rk_left, None, None)
+        encr = _aes_rows(sig, rk_right, None, None)
+        hl = [encl[p] ^ sig[p] for p in range(128)]
+        hr = [encr[p] ^ sig[p] for p in range(128)]
+        ebl = hl[0]
+        ebr = hr[0]
+        hl[0] = jnp.zeros_like(hl[0])
+        hr[0] = jnp.zeros_like(hr[0])
+        # keep = alpha bit: path bit 1 keeps right (loses left).
+        lose = [(hl[p] & pmask) | (hr[p] & notp) for p in range(128)]
+        keep = [(hr[p] & pmask) | (hl[p] & notp) for p in range(128)]
+        ebk = (ebr & pmask) | (ebl & notp)
+        return lose, keep, ebl, ebr, ebk
+
+    def _capture(rows_a, rows_b, ctrl):
+        for rows in (rows_a, rows_b):
+            sig = [rows[64 + p] for p in range(64)] + [
+                rows[64 + p] ^ rows[p] for p in range(64)
+            ]
+            enc = _aes_rows(sig, rk_value, None, None)
+            # Raw value hash — bit 0 is value payload here, NOT a control
+            # bit; no clearing (matches KeygenPrg.expand want_value).
+            vh_rows.extend([enc[p] ^ sig[p] for p in range(128)])
+        ctrl_rows.append(ctrl)
+
+    for d in range(levels + 1):
+        if captures[d]:
+            _capture(rows0, rows1, c1)
+        if d == levels:
+            break
+        pmask = path_row(d)
+        notp = ~pmask
+        lose0, keep0, ebl0, ebr0, ebk0 = _branch_hashes(rows0, pmask, notp)
+        lose1, keep1, ebl1, ebr1, ebk1 = _branch_hashes(rows1, pmask, notp)
+        sc = [lose0[p] ^ lose1[p] for p in range(128)]
+        ccl = ~(ebl0 ^ ebl1 ^ pmask)
+        ccr = ebr0 ^ ebr1 ^ pmask
+        keep_cc = (ccr & pmask) | (ccl & notp)
+        # Seed correction applies under the OLD control bit (Fig. 11 line
+        # 11); compute both parties' new rows before updating controls.
+        rows0 = [keep0[p] ^ (sc[p] & c0) for p in range(128)]
+        rows1 = [keep1[p] ^ (sc[p] & c1) for p in range(128)]
+        c0 = ebk0 ^ (c0 & keep_cc)
+        c1 = ebk1 ^ (c1 & keep_cc)
+        cw_rows.extend(sc)
+        cc_rows.append(ccl)
+        cc_rows.append(ccr)
+    return cw_rows, cc_rows, vh_rows, ctrl_rows
+
+
+def keygen_megakernel_reference_rows(
+    planes0,  # uint32[128, W] party-0 seed planes (keys packed in lanes)
+    planes1,  # uint32[128, W] party-1 seed planes
+    path_masks,  # uint32[levels, W] packed per-key alpha bits
+    *,
+    captures,  # tuple[bool, levels + 1]
+):
+    """Pure-array replay of the keygen megakernel — the same row functions
+    on plain jnp arrays, no pallas_call (the established reference twin).
+    Run eagerly with the REAL circuit it is bit-exact against the host
+    dealer; run with a cheap `_aes_rows` stand-in it anchors the
+    interpret-mode plumbing tests. Returns (cw [levels*128, W],
+    cc [levels*2, W], vh [slots*256, W], ctrl [slots, W])."""
+    w = path_masks.shape[1]
+    levels = path_masks.shape[0]
+    rows0 = [planes0[p] for p in range(128)]
+    rows1 = [planes1[p] for p in range(128)]
+    c0 = jnp.zeros((w,), jnp.uint32)
+    c1 = jnp.full((w,), jnp.uint32(0xFFFFFFFF), jnp.uint32)
+    cw, cc, vh, ctrl = _keygen_megakernel_core(
+        rows0,
+        rows1,
+        c0,
+        c1,
+        lambda lvl: path_masks[lvl],
+        levels=levels,
+        captures=captures,
+        rk_left=backend_jax._rk_np("left"),
+        rk_right=backend_jax._rk_np("right"),
+        rk_value=backend_jax._rk_np("value"),
+    )
+    return (
+        jnp.stack(cw),
+        jnp.stack(cc),
+        jnp.stack(vh),
+        jnp.stack(ctrl),
+    )
+
+
+def _keygen_megakernel_body(rk_left, rk_right, rk_value, levels, captures, tw):
+    """Builds the keygen-megakernel kernel fn for one (levels, captures,
+    tile) config. The body reads refs and delegates every computation to
+    `_keygen_megakernel_core` (shared with the replay)."""
+
+    def kernel(
+        planes0_ref,  # uint32[128, tw]
+        planes1_ref,  # uint32[128, tw]
+        path_ref,  # uint32[levels, tw]
+        cw_ref,  # uint32[levels * 128, tw]
+        cc_ref,  # uint32[levels * 2, tw]
+        vh_ref,  # uint32[slots * 256, tw]
+        ctrl_ref,  # uint32[slots, tw]
+    ):
+        rows0 = [planes0_ref[p, :] for p in range(128)]
+        rows1 = [planes1_ref[p, :] for p in range(128)]
+        c0 = jnp.zeros((tw,), jnp.uint32)
+        c1 = jnp.full((tw,), jnp.uint32(0xFFFFFFFF), jnp.uint32)
+        cw, cc, vh, ctrl = _keygen_megakernel_core(
+            rows0,
+            rows1,
+            c0,
+            c1,
+            lambda lvl: path_ref[lvl, :],
+            levels=levels,
+            captures=captures,
+            rk_left=rk_left,
+            rk_right=rk_right,
+            rk_value=rk_value,
+        )
+        for r in range(len(cw)):
+            cw_ref[r, :] = cw[r]
+        for r in range(len(cc)):
+            cc_ref[r, :] = cc[r]
+        for r in range(len(vh)):
+            vh_ref[r, :] = vh[r]
+        for r in range(len(ctrl)):
+            ctrl_ref[r, :] = ctrl[r]
+
+    return kernel
+
+
+def keygen_megakernel_pallas_batched(
+    planes0: jnp.ndarray,  # uint32[128, Wp] party-0 seed planes
+    planes1: jnp.ndarray,  # uint32[128, Wp] party-1 seed planes
+    path_masks: jnp.ndarray,  # uint32[levels, Wp] packed per-key alpha bits
+    *,
+    captures,  # tuple[bool, levels + 1]: value-capture depths
+    block_w: int = 32,
+    interpret: bool = False,
+):
+    """The keygen megakernel: ONE pallas_call per key batch running every
+    tree level in VMEM, grid (key tiles,). `Wp` must be a multiple of
+    `block_w` (the host pads the key batch). Returns
+    (cw [levels*128, Wp], cc [levels*2, Wp], vh [slots*256, Wp],
+    ctrl [slots, Wp]) — see the section comment for row layouts; the host
+    (ops/keygen_batch._megakernel_generate) unpacks these into the SAME
+    level-record stream the numpy dealer feeds `assemble_batch_keys`, so
+    wire keys are byte-identical by construction."""
+    levels = path_masks.shape[0]
+    wp = planes0.shape[1]
+    assert levels >= 1, "keygen megakernel needs at least one tree level"
+    assert planes0.shape == (128, wp), planes0.shape
+    assert planes1.shape == (128, wp), planes1.shape
+    assert wp % block_w == 0, (wp, block_w)
+    captures = tuple(bool(x) for x in captures)
+    assert len(captures) == levels + 1, (len(captures), levels)
+    assert captures[levels], "final level is always a value capture"
+    slots = sum(1 for x in captures if x)
+    kernel = _keygen_megakernel_body(
+        backend_jax._rk_np("left"),
+        backend_jax._rk_np("right"),
+        backend_jax._rk_np("value"),
+        levels,
+        captures,
+        block_w,
+    )
+    num_tiles = wp // block_w
+    tw = block_w
+    return pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((levels * 128, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((levels * 2, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((slots * 256, wp), jnp.uint32),
+            jax.ShapeDtypeStruct((slots, wp), jnp.uint32),
+        ),
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec((128, tw), lambda j: (0, j)),
+            pl.BlockSpec((128, tw), lambda j: (0, j)),
+            pl.BlockSpec((levels, tw), lambda j: (0, j)),
+        ],
+        out_specs=(
+            pl.BlockSpec((levels * 128, tw), lambda j: (0, j)),
+            pl.BlockSpec((levels * 2, tw), lambda j: (0, j)),
+            pl.BlockSpec((slots * 256, tw), lambda j: (0, j)),
+            pl.BlockSpec((slots, tw), lambda j: (0, j)),
+        ),
+        interpret=interpret,
+    )(planes0, planes1, path_masks)

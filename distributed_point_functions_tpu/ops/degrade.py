@@ -154,6 +154,12 @@ def classify_exception(exc: BaseException) -> Optional[DpfError]:
         err = UnavailableError(text)
     elif "INTERNAL" in upper and "XLARUNTIMEERROR" in type(exc).__name__.upper():
         err = InternalError(text)
+    elif "ONLY INTERPRET MODE IS SUPPORTED" in upper:
+        # Pallas lowering on a non-Mosaic backend (jax raises a bare
+        # ValueError): the rung's PLATFORM is absent, not broken — a
+        # compiled-kernel entry mode on a CPU host must degrade down its
+        # chain (e.g. keygen/megakernel → … → jax), not crash the call.
+        err = UnavailableError(text)
     else:
         return None
     err.__cause__ = exc

@@ -9,13 +9,20 @@ correction-word circuit is the same circuit the evaluator kernels
 already run — just party-pairwise — so this module ports it onto the
 existing batched PRG row circuits.
 
-Three execution modes behind one entry point (``DPF_TPU_KEYGEN`` env
-default, "numpy" until a hardware window verifies the device modes):
+Five execution modes behind one entry point (``DPF_TPU_KEYGEN`` env
+default, "numpy-threaded" — the device modes stay gated until a
+hardware window verifies them):
 
-* ``"numpy"`` — the host batched path (core/keygen.py): one vectorized
-  numpy AES call per tree level over all 2K seeds. The production
-  default, ~10x the scalar per-key loop at 1024 keys (PERF.md
+* ``"numpy"`` — the single-thread host batched path (core/keygen.py):
+  one vectorized numpy AES call per tree level over all 2K seeds. ~28x
+  the scalar per-key loop at 1024 keys / depth 128 (PERF.md
   "Device-side keygen").
+* ``"numpy-threaded"`` — the production default: the same host batched
+  path sharded across a worker pool (``DPF_TPU_KEYGEN_THREADS``, 0 =
+  all cores, unset = ``roofline.host_threads_default``). Keys in a
+  batch are independent and all CSPRNG seeds are drawn ONCE before the
+  pool fans out, so assembled keys are byte-identical to the
+  single-thread run at any thread count.
 * ``"jax"`` — the per-level expansion through the plane-space XLA
   bitslice (ops/aes_jax): all 2K parent seeds pack into bit-planes on a
   doubled key axis and ONE jitted program computes H_left, H_right (and,
@@ -28,6 +35,11 @@ default, "numpy" until a hardware window verifies the device modes):
   out), and ``hash_value_planes_pallas_batched`` is the value PRG. No
   new kernel body, no new Mosaic risk surface (dpflint's op-surface pins
   are untouched). Staged-for-tunnel like every kernel since round 5.
+* ``"megakernel"`` — ONE ``pallas_call`` per key batch
+  (``aes_pallas.keygen_megakernel_pallas_batched``): the whole level
+  loop resident in VMEM, correction-word algebra in-kernel, erasing the
+  per-level dispatch floor the jax/pallas modes pay. Staged-for-tunnel;
+  gated behind ``router.UNVERIFIED_MODES`` like every device mode.
 
 Every mode feeds the SAME level-step algebra (core/keygen.py's
 ``KeygenPrg`` seam / ``batch_level_step``), so the assembled
@@ -42,38 +54,147 @@ remaining per-key work.
 
 from __future__ import annotations
 
+import concurrent.futures
 import functools
+import os
+import secrets
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..core import keygen as core_keygen
+from ..core import uint128
 from ..utils import envflags, faultinject
 from ..utils import telemetry as _tm
 from ..utils.errors import InvalidArgumentError
 
 #: Execution modes of the batched keygen entry points.
-KEYGEN_MODES = ("numpy", "jax", "pallas")
+KEYGEN_MODES = ("numpy", "numpy-threaded", "jax", "pallas", "megakernel")
 
 #: The degradation ladder, fastest rung first —
 #: ops/supervisor.keygen_chain slices its rungs from here, so a new mode
 #: must take a position in BOTH tuples (a mode missing from the ladder
-#: fails loudly at chain build, never silently runs a different rung).
-KEYGEN_RUNG_ORDER = ("pallas", "jax", "numpy")
+#: fails loudly at chain build, never silently runs a different rung —
+#: the supervisor asserts set-equality of the two at import).
+KEYGEN_RUNG_ORDER = ("megakernel", "pallas", "jax", "numpy-threaded", "numpy")
+
+# Import-time agreement check (ISSUE 19 fix): a mode in one tuple but not
+# the other would either crash `order.index(resolved)` late or silently
+# start chains at the wrong rung — fail the import instead.
+assert set(KEYGEN_RUNG_ORDER) == set(KEYGEN_MODES), (
+    f"KEYGEN_RUNG_ORDER {KEYGEN_RUNG_ORDER} must be a permutation of "
+    f"KEYGEN_MODES {KEYGEN_MODES}"
+)
 
 
 def _keygen_mode_default() -> str:
-    """DPF_TPU_KEYGEN env resolution ("numpy" unset — the host batched
-    path is the production default until a hardware window verifies the
-    device modes, the same gating every staged kernel follows)."""
+    """DPF_TPU_KEYGEN env resolution ("numpy-threaded" unset — the
+    threaded host batched path is the production default until a
+    hardware window verifies the device modes, the same gating every
+    staged kernel follows)."""
     mode = envflags.env_str("DPF_TPU_KEYGEN", None)
     if mode is None:
-        return "numpy"
+        return "numpy-threaded"
     if mode not in KEYGEN_MODES:
         raise InvalidArgumentError(
             f"DPF_TPU_KEYGEN must be one of {KEYGEN_MODES}, got {mode!r}"
         )
     return mode
+
+
+def keygen_threads() -> int:
+    """Worker count of the threaded host dealer.
+
+    ``DPF_TPU_KEYGEN_THREADS``: a positive count is taken literally, 0
+    means all cores, unset falls back to the fleet-wide host sizing knob
+    (``roofline.host_threads_default`` — DPF_TPU_THREADS, default 1) so
+    a host sized for threaded evaluation threads its dealer the same
+    way without a second flag."""
+    n = envflags.env_int("DPF_TPU_KEYGEN_THREADS", -1)
+    if n == -1:
+        from ..utils import roofline
+
+        return roofline.host_threads_default()
+    if n < 0:
+        raise InvalidArgumentError(
+            f"DPF_TPU_KEYGEN_THREADS must be >= 0 (0 = all cores), got {n}"
+        )
+    if n == 0:
+        return os.cpu_count() or 1
+    return n
+
+
+def host_generate_keys_batch(
+    dpf,
+    alphas: Sequence[int],
+    betas: Sequence,
+    seeds: Optional[np.ndarray] = None,
+    threads: Optional[int] = None,
+) -> Tuple[List, List]:
+    """The threaded host dealer: ``dpf.generate_keys_batch`` sharded
+    over contiguous key slices on a thread pool (keys in a batch are
+    independent — the level-major numpy AES calls release the GIL, so
+    slices overlap on a multi-core host).
+
+    ALL CSPRNG seeds are drawn up front (one ``secrets`` draw, exactly
+    the single-thread path's stream) and sliced to workers, so the
+    assembled keys are byte-identical to a single-thread run of the same
+    batch at ANY thread count — the PR 13 contract, pinned by the
+    serialized-bytes tests. Import-light: no jax at any thread count
+    (the dcf fast path and the serving host engine route here).
+
+    Emits one `keygen.worker` span per slice and the dealer-plane
+    `keygen.keys_per_sec` gauge."""
+    k = len(alphas)
+    n = keygen_threads() if threads is None else int(threads)
+    if n < 1:
+        raise InvalidArgumentError(
+            f"keygen thread count must be >= 1, got {n}"
+        )
+    n = max(1, min(n, k))
+    if seeds is None:
+        raw = secrets.token_bytes(16 * 2 * k)
+        seeds = np.frombuffer(raw, dtype=np.uint32).reshape(k, 2, 4).copy()
+    else:
+        seeds = np.array(seeds, dtype=np.uint32).reshape(k, 2, 4)
+    start = time.perf_counter()
+    if n == 1:
+        out = dpf.generate_keys_batch(alphas, betas, seeds=seeds)
+    else:
+        beta_cols = core_keygen.normalize_beta_cols(
+            betas, k, dpf.validator.num_hierarchy_levels
+        )
+        parent = _tm.current_span_id()
+        bounds = [i * k // n for i in range(n + 1)]
+        spans = [
+            (bounds[i], bounds[i + 1])
+            for i in range(n)
+            if bounds[i + 1] > bounds[i]
+        ]
+
+        def run_slice(ab):
+            a, b = ab
+            with _tm.span(
+                "keygen.worker", parent=parent, lo=a, hi=b, keys=b - a
+            ):
+                return dpf.generate_keys_batch(
+                    alphas[a:b],
+                    [col[a:b] for col in beta_cols],
+                    seeds=seeds[a:b],
+                )
+        with concurrent.futures.ThreadPoolExecutor(max_workers=n) as pool:
+            parts = list(pool.map(run_slice, spans))
+        keys_0: List = []
+        keys_1: List = []
+        for p0, p1 in parts:
+            keys_0 += p0
+            keys_1 += p1
+        out = (keys_0, keys_1)
+    elapsed = time.perf_counter() - start
+    if k and elapsed > 0:
+        _tm.gauge("keygen.keys_per_sec", k / elapsed, op="keygen")
+    return out
 
 
 #: Lane floor of the pallas expansion: pad the doubled seed axis to full
@@ -295,6 +416,238 @@ class DeviceKeygenPrg(core_keygen.KeygenPrg):
 
 
 # ---------------------------------------------------------------------------
+# Keygen megakernel host path: pack, ONE program, unpack, assemble
+# ---------------------------------------------------------------------------
+
+
+def _pack_planes_np(flat: np.ndarray) -> np.ndarray:
+    """Numpy twin of ``aes_jax.pack_to_planes``: uint32[N, 4] block rows
+    -> uint32[128, N//32] bit planes (plane p word w bit i = bit p of
+    block 32w+i). The megakernel host path packs/unpacks on the host so
+    the jitted program is EXACTLY the pallas_call — the 1-program pin."""
+    n = flat.shape[0]
+    assert n % 32 == 0, n
+    w = n // 32
+    bits = np.unpackbits(
+        np.ascontiguousarray(flat).view(np.uint8).reshape(n, 16),
+        axis=1,
+        bitorder="little",
+    )  # [N, 128]
+    b = bits.reshape(w, 32, 128).astype(np.uint32)
+    planes = (b << np.arange(32, dtype=np.uint32)[None, :, None]).sum(
+        axis=1, dtype=np.uint32
+    )  # [w, 128]
+    return np.ascontiguousarray(planes.T)
+
+
+def _unpack_planes_np(planes: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`_pack_planes_np`: uint32[128, W] -> uint32[32W, 4]."""
+    w = planes.shape[1]
+    bits = (
+        (planes[:, :, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(np.uint8)  # [128, W, 32]
+    rows = bits.transpose(1, 2, 0).reshape(w * 32, 128)
+    packed = np.ascontiguousarray(
+        np.packbits(rows, axis=1, bitorder="little")
+    )
+    return packed.view(np.uint32).reshape(-1, 4).copy()
+
+
+def _unpack_lane_bits_np(row: np.ndarray, k: int) -> np.ndarray:
+    """Packed lane-mask row (bit i of word w = key 32w+i) -> bool[k]."""
+    bits = (
+        (np.asarray(row)[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).reshape(-1)
+    return bits[:k].astype(bool)
+
+
+@functools.lru_cache(maxsize=None)
+def _keygen_megakernel_jit(
+    levels: int, captures, block_w: int, interpret: bool
+):
+    """The megakernel's ONE compiled program per (levels, captures,
+    tile) config: jit strictly around the pallas_call (pack/unpack stay
+    host-side numpy), so a warm batch is a single dispatch — the
+    dispatch-audit pin. Interpret mode traces the kernel emulation into
+    the jit (fine with the cheap test rows; the real circuit compiles on
+    hardware only, like every staged kernel)."""
+    import jax
+
+    from . import aes_pallas
+
+    def run(planes0, planes1, path_masks):
+        return aes_pallas.keygen_megakernel_pallas_batched(
+            planes0,
+            planes1,
+            path_masks,
+            captures=captures,
+            block_w=block_w,
+            interpret=interpret,
+        )
+
+    return jax.jit(run)
+
+
+def _megakernel_generate(
+    dpf,
+    alphas: Sequence[int],
+    betas: Sequence,
+    seeds: Optional[np.ndarray] = None,
+    block_w: int = 32,
+    interpret: bool = False,
+    reference: bool = False,
+) -> Tuple[List, List]:
+    """Batched keygen through the single-program megakernel.
+
+    Host side: draw seeds, pack both parties' seed planes and the
+    per-level alpha bits (keys in lanes), run ONE device program
+    (`aes_pallas.keygen_megakernel_pallas_batched`), unpack the
+    correction-word / control-correction / value-hash planes, apply the
+    typed beta algebra (`_value_corrections_from_hashed` — value typing
+    stays host-side), and feed the SAME level-record stream the numpy
+    dealer feeds `core_keygen.assemble_batch_keys` — wire keys are
+    byte-identical by construction.
+
+    ``reference=True`` replays through
+    `keygen_megakernel_reference_rows` (no pallas_call): the eager
+    real-circuit oracle-identity test and the interpret plumbing tests
+    share this exact host prep/assembly."""
+    from ..ops import degrade
+
+    v = dpf.validator
+    levels = v.tree_levels_needed - 1
+    if levels < 1:
+        raise degrade.RungUnsupported(
+            "keygen megakernel needs at least one tree level"
+        )
+    if any(b != 1 for b in v.blocks_needed):
+        raise degrade.RungUnsupported(
+            "keygen megakernel requires blocks_needed == 1 at every "
+            "output level (wide-value input offsets are host-only)"
+        )
+    hier_in_loop = [
+        v.tree_to_hierarchy[d] for d in range(levels) if d in v.tree_to_hierarchy
+    ]
+    if hier_in_loop != list(range(v.num_hierarchy_levels - 1)):
+        raise degrade.RungUnsupported(
+            "keygen megakernel requires one capture depth per hierarchy "
+            f"level, got {hier_in_loop} of {v.num_hierarchy_levels}"
+        )
+    captures = tuple(d in v.tree_to_hierarchy for d in range(levels)) + (
+        True,
+    )
+
+    k = len(alphas)
+    if k == 0:
+        return [], []
+    beta_cols = core_keygen.normalize_beta_cols(
+        betas, k, v.num_hierarchy_levels
+    )
+    for level, col in enumerate(beta_cols):
+        for val in col:
+            v.validate_value(val, level)
+    last_log = v.parameters[-1].log_domain_size
+    alphas = [int(a) for a in alphas]
+    for alpha in alphas:
+        if alpha < 0 or (last_log < 128 and alpha >= (1 << last_log)):
+            raise InvalidArgumentError(
+                "`alpha` must be smaller than the output domain size"
+            )
+    if seeds is None:
+        raw = secrets.token_bytes(16 * 2 * k)
+        seeds_l = np.frombuffer(raw, dtype=np.uint32).reshape(k, 2, 4).copy()
+    else:
+        seeds_l = np.array(seeds, dtype=np.uint32).reshape(k, 2, 4)
+
+    # Keys in lanes: pad to whole words of whole tiles.
+    wp = -(-(-(-k // 32)) // block_w) * block_w  # ceil(ceil(k/32)/bw)*bw
+    kp = wp * 32
+    pad = np.zeros((kp - k, 4), dtype=np.uint32)
+    planes0 = _pack_planes_np(np.concatenate([seeds_l[:, 0, :], pad]))
+    planes1 = _pack_planes_np(np.concatenate([seeds_l[:, 1, :], pad]))
+
+    alpha_limbs = uint128.u128_to_limb_rows(uint128.u128_array(alphas))
+    path_bits = np.zeros((levels, kp), dtype=np.uint32)
+    for d in range(levels):
+        bit_index = last_log - (d + 1)
+        if 0 <= bit_index < 128:
+            path_bits[d, :k] = (
+                alpha_limbs[:, bit_index // 32] >> (bit_index % 32)
+            ) & 1
+    path_masks = (
+        path_bits.reshape(levels, wp, 32)
+        << np.arange(32, dtype=np.uint32)[None, None, :]
+    ).sum(axis=2, dtype=np.uint32)
+
+    if reference:
+        from . import aes_pallas
+
+        outs = aes_pallas.keygen_megakernel_reference_rows(
+            planes0, planes1, path_masks, captures=captures
+        )
+    else:
+        outs = _keygen_megakernel_jit(levels, captures, block_w, interpret)(
+            planes0, planes1, path_masks
+        )
+    cw, cc, vh, ctrl = (np.asarray(o) for o in outs)
+
+    seed_ints = uint128.limb_rows_to_ints(seeds_l.reshape(-1, 4))
+    out_keys: Tuple[List, List] = (
+        [
+            core_keygen.DpfKey(
+                seed=seed_ints[2 * i], correction_words=[], party=0
+            )
+            for i in range(k)
+        ],
+        [
+            core_keygen.DpfKey(
+                seed=seed_ints[2 * i + 1], correction_words=[], party=1
+            )
+            for i in range(k)
+        ],
+    )
+
+    def typed_corrections(slot: int, hierarchy_level: int):
+        base = slot * 256
+        hashed = np.stack(
+            [
+                _unpack_planes_np(vh[base : base + 128])[:k],
+                _unpack_planes_np(vh[base + 128 : base + 256])[:k],
+            ],
+            axis=1,
+        )[:, :, None, :]  # [K, 2, 1, 4]
+        control = np.zeros((k, 2), dtype=bool)
+        control[:, 1] = _unpack_lane_bits_np(ctrl[slot], k)
+        return dpf._keygen._value_corrections_from_hashed(
+            hierarchy_level,
+            hashed,
+            control,
+            alphas,
+            beta_cols[hierarchy_level],
+        )
+
+    level_records = []
+    slot = 0
+    for d in range(levels):
+        value_corrections = None
+        if captures[d]:
+            value_corrections = typed_corrections(slot, v.tree_to_hierarchy[d])
+            slot += 1
+        seed_correction = _unpack_planes_np(cw[d * 128 : (d + 1) * 128])[:k]
+        cc_pair = np.stack(
+            [
+                _unpack_lane_bits_np(cc[2 * d], k),
+                _unpack_lane_bits_np(cc[2 * d + 1], k),
+            ],
+            axis=1,
+        )
+        level_records.append((seed_correction, cc_pair, value_corrections))
+    last_cw = typed_corrections(slot, v.num_hierarchy_levels - 1)
+    core_keygen.assemble_batch_keys(out_keys, level_records, last_cw)
+    return out_keys
+
+
+# ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------
 
@@ -326,11 +679,48 @@ def resolve_mode(mode: Optional[str], op: str = "keygen") -> str:
 def make_prg(
     mode: str, block_w: int = 32, interpret: bool = False
 ) -> Optional[core_keygen.KeygenPrg]:
-    """The PRG provider for a resolved mode (None = the core host
-    default)."""
-    if mode == "numpy":
+    """The PRG provider for a per-level resolved mode (None = the core
+    host default). Only the per-level modes have a provider form —
+    "numpy-threaded" and "megakernel" restructure the loop itself and
+    dispatch through :func:`run_resolved`."""
+    if mode in ("numpy", "numpy-threaded"):
         return None
+    if mode == "megakernel":
+        raise InvalidArgumentError(
+            "the megakernel keygen mode has no per-level PRG provider; "
+            "dispatch through run_resolved/generate_keys_batch"
+        )
     return DeviceKeygenPrg(mode, block_w=block_w, interpret=interpret)
+
+
+def run_resolved(
+    dpf,
+    resolved: str,
+    alphas: Sequence[int],
+    betas: Sequence,
+    seeds: Optional[np.ndarray] = None,
+    block_w: int = 32,
+    interpret: bool = False,
+    threads: Optional[int] = None,
+) -> Tuple[List, List]:
+    """Dispatches an ALREADY-RESOLVED mode to its engine, with no
+    telemetry decision of its own — the seam the robust chain's rungs
+    call (a rung is the CHAIN's choice, recorded by its
+    decision(source="degrade") stream) and the tail of
+    :func:`generate_keys_batch`."""
+    if resolved == "numpy":
+        return dpf.generate_keys_batch(alphas, betas, seeds=seeds)
+    if resolved == "numpy-threaded":
+        return host_generate_keys_batch(
+            dpf, alphas, betas, seeds=seeds, threads=threads
+        )
+    if resolved == "megakernel":
+        return _megakernel_generate(
+            dpf, alphas, betas, seeds=seeds, block_w=block_w,
+            interpret=interpret,
+        )
+    prg = DeviceKeygenPrg(resolved, block_w=block_w, interpret=interpret)
+    return dpf.generate_keys_batch(alphas, betas, seeds=seeds, prg=prg)
 
 
 def generate_keys_batch(
@@ -341,6 +731,7 @@ def generate_keys_batch(
     seeds: Optional[np.ndarray] = None,
     block_w: int = 32,
     interpret: bool = False,
+    threads: Optional[int] = None,
 ) -> Tuple[List, List]:
     """K DPF key pairs at once on the selected engine.
 
@@ -348,16 +739,21 @@ def generate_keys_batch(
     (alphas: K points; betas: per hierarchy level, scalar or length-K;
     seeds: optional uint32[K, 2, 4] CSPRNG override) plus:
 
-    * ``mode`` — "numpy" / "jax" / "pallas" (None = DPF_TPU_KEYGEN env,
-      default "numpy"). All modes produce byte-identical keys.
+    * ``mode`` — "numpy" / "numpy-threaded" / "jax" / "pallas" /
+      "megakernel" (None = DPF_TPU_KEYGEN env, default
+      "numpy-threaded"). All modes produce byte-identical keys.
     * ``block_w`` / ``interpret`` — pallas lane-block width and the
       interpret-mode escape hatch (tests; real hardware compiles Mosaic).
+    * ``threads`` — threaded-mode worker override (None =
+      DPF_TPU_KEYGEN_THREADS / roofline.host_threads_default).
 
     Returns (keys of party 0, keys of party 1), each length K.
     """
     resolved = resolve_mode(mode)
-    prg = make_prg(resolved, block_w=block_w, interpret=interpret)
-    return dpf.generate_keys_batch(alphas, betas, seeds=seeds, prg=prg)
+    return run_resolved(
+        dpf, resolved, alphas, betas, seeds=seeds, block_w=block_w,
+        interpret=interpret, threads=threads,
+    )
 
 
 def generate_key_batches(

@@ -347,16 +347,27 @@ def full_domain_chain() -> Tuple[Rung, ...]:
 
 
 def keygen_chain(mode: Optional[str]) -> Tuple[Rung, ...]:
-    """The batched-keygen chain (ISSUE 13): keygen/pallas → keygen/jax →
-    keygen/numpy (the vectorized host batch) → numpy — the rung of last
-    resort being the SCALAR per-key oracle loop, the one keygen
-    implementation that shares no code with the batched paths. The
-    resolved mode decides the entry rung; every rung generates the same
-    bytes from the same seeds, so degradation is invisible to callers."""
+    """The batched-keygen chain (ISSUE 13, megakernel rung ISSUE 19):
+    keygen/megakernel → keygen/pallas → keygen/jax →
+    keygen/numpy-threaded → keygen/numpy (the vectorized host batch) →
+    numpy — the rung of last resort being the SCALAR per-key oracle
+    loop, the one keygen implementation that shares no code with the
+    batched paths. The resolved mode decides the entry rung; every rung
+    generates the same bytes from the same seeds, so degradation is
+    invisible to callers."""
     from . import keygen_batch
 
     resolved = keygen_batch.validated_mode(mode)
     order = keygen_batch.KEYGEN_RUNG_ORDER
+    # ROADMAP: a mode present in KEYGEN_MODES but missing from the rung
+    # ladder would make `order.index` miss (explicit modes) or silently
+    # start the chain at the wrong rung (prefix slicing) — assert
+    # set-agreement of the two tuples HERE, where the slice happens, so
+    # any drift fails the first chain build of the process.
+    assert set(order) == set(keygen_batch.KEYGEN_MODES), (
+        "keygen rung ladder out of sync with KEYGEN_MODES: "
+        f"{order} vs {keygen_batch.KEYGEN_MODES}"
+    )
     rungs = [("keygen", b) for b in order[order.index(resolved):]]
     rungs.append((None, "numpy"))
     return tuple(rungs)
@@ -879,8 +890,9 @@ def generate_keys_robust(
     policy: DegradationPolicy = DEFAULT_POLICY,
 ) -> Tuple[list, list]:
     """Batched two-party keygen behind the supervisor (ISSUE 13): the
-    chain walks keygen/pallas → keygen/jax → keygen/numpy → numpy (the
-    scalar per-key oracle). The CSPRNG seeds are drawn ONCE up front and
+    chain walks keygen/megakernel → keygen/pallas → keygen/jax →
+    keygen/numpy-threaded → keygen/numpy → numpy (the scalar per-key
+    oracle). The CSPRNG seeds are drawn ONCE up front and
     handed to every rung, so rungs are interchangeable — a degraded
     retry produces the SAME key pairs, and each non-oracle rung is
     spot-verified by regenerating the last key pair through the scalar
@@ -929,18 +941,18 @@ def generate_keys_robust(
                 out_1.append(b)
             return out_0, out_1
         ck = chunk if chunk is not None else k
-        # Direct engine call (make_prg + the core path), NOT the
-        # resolve_mode entry point: a rung is the chain's choice — its
+        # Direct engine dispatch (run_resolved), NOT the resolve_mode
+        # entry point: a rung is the chain's choice — its
         # decision(source="degrade") stream is the record — and a
         # per-attempt decision(source="explicit") would inflate and
         # mislabel the telemetry consumers count engines by.
-        prg = keygen_batch.make_prg(backend)
         out_0, out_1 = [], []
         for s in range(0, k, ck):
-            part_0, part_1 = dpf.generate_keys_batch(
+            part_0, part_1 = keygen_batch.run_resolved(
+                dpf, backend,
                 alphas[s : s + ck],
                 [col[s : s + ck] for col in beta_cols],
-                seeds=seeds[s : s + ck], prg=prg,
+                seeds=seeds[s : s + ck],
             )
             out_0.extend(part_0)
             out_1.extend(part_1)

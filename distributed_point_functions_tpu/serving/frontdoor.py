@@ -683,8 +683,10 @@ class FrontDoor:
         request's slice is answered as serialized key blobs — 2*Kr uint8
         arrays, Kr party-0 then Kr party-1 (`wire.keygen_result_arrays`'
         layout), so the RPC server's generic result-array path carries
-        them unchanged. Host engine = the vectorized numpy batch; device
-        = the "jax"/"pallas" plane-circuit modes (staged-for-tunnel)."""
+        them unchanged. Host engine = the threaded vectorized numpy
+        batch (ISSUE 19's production default); device = the
+        "jax"/"pallas"/"megakernel" plane-circuit modes
+        (staged-for-tunnel)."""
         del union
         from ..ops import keygen_batch, supervisor
         from . import wire
@@ -696,7 +698,7 @@ class FrontDoor:
             [b for r in reqs for b in r.betas[level]]
             for level in range(levels)
         ]
-        kg_mode = (mode or "jax") if engine == "device" else "numpy"
+        kg_mode = (mode or "jax") if engine == "device" else "numpy-threaded"
         if self.robust:
             keys_0, keys_1 = supervisor.generate_keys_robust(
                 dpf, alphas, beta_cols, mode=kg_mode, policy=self.policy,

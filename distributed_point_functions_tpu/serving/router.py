@@ -141,8 +141,9 @@ UNVERIFIED_MODES: Dict[Tuple[str, str], Tuple[str, ...]] = {
     ("pir", "device"): ("megakernel", "sharded-megakernel"),
     # ISSUE 13: device keygen (the plane-space XLA / Mosaic row-kernel
     # modes of ops/keygen_batch.py) has never run on hardware — host
-    # wins every keygen batch until a measurement teaches it.
-    ("keygen", "device"): ("jax", "pallas"),
+    # wins every keygen batch until a measurement teaches it. ISSUE 19
+    # adds the single-program keygen megakernel behind the same gate.
+    ("keygen", "device"): ("jax", "pallas", "megakernel"),
 }
 
 #: Fallback key chunking for standalone Workloads — the dispatch-count
@@ -240,7 +241,11 @@ class Workload:
         and chunk-multiple padding never changes the count."""
         keys, _ = self._axes("device")
         if self.op == "keygen":
-            # The keygen level loop is sequential in tree depth: one
+            if mode == "megakernel":
+                # ISSUE 19: the keygen megakernel runs the whole level
+                # loop in ONE program per batch (dispatch-audit pin).
+                return 1
+            # The per-level keygen loop is sequential in tree depth: one
             # fused L/R/value program per level + the final value hash
             # (tests/test_dispatch_audit's keygen pin), independent of
             # the key count.
@@ -382,9 +387,11 @@ class CostModel:
         table = ANCHORS.get((anchor_op, engine, mode))
         if table is not None:
             rate = _kind_rate(table, kind, bits)
-            # keygen's host batch is level-major single-core numpy — the
-            # native-engine thread-speedup model does not apply to it.
-            if engine == "host" and anchor_op != "keygen":
+            # ISSUE 19: the host dealer threads its key slices
+            # (keygen_batch.host_generate_keys_batch), so keygen now
+            # rides the same native-engine thread-speedup model as the
+            # evaluation ops.
+            if engine == "host":
                 rate = rate * self._host_speedup()
             return rate
         if (

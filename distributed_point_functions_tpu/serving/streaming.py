@@ -237,7 +237,8 @@ class _Window:
 
     __slots__ = (
         "generation", "journal", "batch_ids", "keys", "shas", "keys_total",
-        "closed", "next_index",
+        "closed", "next_index", "first_ingest_at", "closed_at",
+        "advance_started",
     )
 
     def __init__(self, generation: int, journal):
@@ -248,6 +249,14 @@ class _Window:
         self.shas: Dict[str, str] = {}
         self.keys_total = 0
         self.closed = False
+        #: dealer-plane accounting (ISSUE 19): the feed phase (first
+        #: ingest -> close) is keygen-bound by design — clients generate
+        #: every uploaded key — so the publish record turns that comment
+        #: into a measured share. None on crash-recovered windows (the
+        #: wall clocks died with the process).
+        self.first_ingest_at: Optional[float] = None
+        self.closed_at: Optional[float] = None
+        self.advance_started: Optional[float] = None
         #: the next ChunkJournal record index — counts every journaled
         #: entry, including quarantined batches the reload skips, so a
         #: live append never collides with a skipped index.
@@ -644,6 +653,8 @@ class HeavyHitterStream:
                     f"{self._party} keys; batch {batch_id!r} carries "
                     f"party {party}"
                 )
+            if w.first_ingest_at is None:
+                w.first_ingest_at = time.monotonic()
             w.batch_ids.append(batch_id)
             w.keys[batch_id] = keys
             w.shas[batch_id] = hashlib.sha256(b"".join(blobs)).hexdigest()
@@ -1134,6 +1145,7 @@ class HeavyHitterStream:
                 return
             w.journal.finalize()
             w.closed = True
+            w.closed_at = time.monotonic()
             _tm.counter("streaming.windows_closed", op=self.config.name)
             self._open = self._new_window(w.generation + 1)
             self._wake.notify_all()
@@ -1200,6 +1212,7 @@ class HeavyHitterStream:
 
         cfg = self.config
         v = self._dpf.validator
+        w.advance_started = time.monotonic()
         if not w.journal.finalized:
             w.journal.finalize()  # durably close a crash-recovered window
         # Membership of record: the segment's batches MINUS anything the
@@ -1302,6 +1315,37 @@ class HeavyHitterStream:
             "prefixes": [str(p) for p in prefixes],
             "counts": [str(counts_of[p]) for p in prefixes],
         }
+        # Dealer-plane share (ISSUE 19): the feed phase (first ingest ->
+        # close) is the client keygen bound; the advance phase is this
+        # leader's level walk + publish. Recording both walls makes
+        # "keygen-bound by design" a measured number on every published
+        # window. None on crash-recovered windows (walls died with the
+        # process).
+        feed = (
+            None
+            if w.first_ingest_at is None or w.closed_at is None
+            else max(0.0, w.closed_at - w.first_ingest_at)
+        )
+        adv = (
+            None
+            if w.advance_started is None
+            else max(0.0, time.monotonic() - w.advance_started)
+        )
+        share = (
+            None
+            if feed is None or adv is None or feed + adv <= 0
+            else round(feed / (feed + adv), 4)
+        )
+        line["keygen"] = {
+            "keys": line["keys"],
+            "feed_ms": None if feed is None else round(feed * 1e3, 3),
+            "advance_ms": None if adv is None else round(adv * 1e3, 3),
+            "share": share,
+        }
+        if share is not None:
+            _tm.gauge(
+                "streaming.keygen_share", share, op=self.config.name
+            )
         if self._lease is not None:
             line["lease"] = True
         # Durability order: the published line lands (fsync) BEFORE the

@@ -52,10 +52,12 @@ import numpy as np
 
 from . import faultinject, telemetry
 from .envflags import env_bool as _env_bool
+from .envflags import env_str as _env_str
 from .errors import (
     DataCorruptionError,
     DataLossError,
     InternalError,
+    InvalidArgumentError,
     UnavailableError,
 )
 
@@ -880,8 +882,9 @@ def _run_keygen_check(shapes, rng, report, pipeline=None) -> int:
 
     Per (num_keys, log_domain) shape, a batched keygen runs in the
     platform's device mode ("pallas" on Mosaic platforms — compiled, not
-    interpreted — else the plane-space XLA "jax" mode) from pinned
-    seeds, then two independent verdicts:
+    interpreted — else the plane-space XLA "jax" mode; CHECK_KEYGEN_MODE
+    overrides, e.g. "megakernel" to burn in the single-program dealer)
+    from pinned seeds, then two independent verdicts:
 
     1. **Byte-match spot rows** — the first and last key pairs are
        regenerated through the scalar per-key oracle from the same seeds
@@ -904,7 +907,17 @@ def _run_keygen_check(shapes, rng, report, pipeline=None) -> int:
     from ..ops import evaluator, keygen_batch
     from ..protos import serialization
 
-    mode = "pallas" if evaluator._pallas_default() else "jax"
+    # CHECK_KEYGEN_MODE pins the engine under test (e.g. "megakernel" to
+    # burn in the single-program dealer on new hardware); the default
+    # stays the platform's device mode.
+    mode = _env_str("CHECK_KEYGEN_MODE", None)
+    if mode is not None and mode not in keygen_batch.KEYGEN_MODES:
+        raise InvalidArgumentError(
+            f"CHECK_KEYGEN_MODE must be one of {keygen_batch.KEYGEN_MODES}, "
+            f"got {mode!r}"
+        )
+    if mode is None:
+        mode = "pallas" if evaluator._pallas_default() else "jax"
     failures = 0
     for num_keys, lds in shapes:
         dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))

@@ -752,6 +752,83 @@ def test_keygen_batch_program_budget(program_counter, monkeypatch):
         )
 
 
+@pytest.mark.slow  # ~40 s interpret-mode XLA-CPU compile per pipeline arm
+def test_keygen_megakernel_program_budget(program_counter, monkeypatch):
+    """ISSUE 19 pin: megakernel-mode batched keygen launches EXACTLY ONE
+    device program per warm batch — the whole level loop + CW algebra +
+    value hashes are one pallas_call inside one jit; pack/unpack stay
+    host-side — independent of depth and key count, with the pipeline
+    env on AND off. Cheap `_aes_rows` stand-in keeps the interpreted
+    kernel's XLA-CPU compile tractable; the program COUNT is
+    circuit-independent."""
+    import jax
+
+    from distributed_point_functions_tpu.ops import aes_pallas, keygen_batch
+    from test_aes_pallas import _CheapRows
+
+    jax.clear_caches()
+    keygen_batch._keygen_megakernel_jit.cache_clear()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    try:
+        rng = np.random.default_rng(6)
+        # Shallow tree on purpose: the interpreted kernel's XLA-CPU
+        # compile scales with the unrolled level loop, and the program
+        # COUNT is depth-independent.
+        dpf = DistributedPointFunction.create(DpfParameters(5, Int(64)))
+        alphas = [3, 17, 29]
+        betas = [[5, 9, 40]]
+        seeds = rng.integers(0, 2**32, size=(3, 2, 4), dtype=np.uint32)
+
+        for pipeline_env in ("0", "1"):
+            monkeypatch.setenv("DPF_TPU_PIPELINE", pipeline_env)
+            run = lambda: keygen_batch.generate_keys_batch(
+                dpf, alphas, betas, mode="megakernel", seeds=seeds,
+                interpret=True,
+            )
+            run()  # warm: compiles allowed
+            program_counter["programs"] = 0
+            run()
+            got = program_counter["programs"]
+            assert got == 1, (
+                f"megakernel keygen ran {got} device programs per warm "
+                f"batch with DPF_TPU_PIPELINE={pipeline_env} (pinned: "
+                "ONE — the single-program dealer)"
+            )
+    finally:
+        keygen_batch._keygen_megakernel_jit.cache_clear()
+        jax.clear_caches()
+
+
+def test_keygen_threaded_runs_zero_device_programs(program_counter):
+    """ISSUE 19 pin: the production-default threaded host dealer is pure
+    numpy at ANY worker count — a warm threaded batch launches ZERO
+    device programs (the thread pool shards the host batch; nothing
+    touches a device)."""
+    from distributed_point_functions_tpu.ops import keygen_batch
+
+    rng = np.random.default_rng(7)
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    alphas = [5, 9, 44, 77]
+    betas = [[1, 2, 3, 4]]
+    seeds = rng.integers(0, 2**32, size=(4, 2, 4), dtype=np.uint32)
+
+    def run(threads):
+        return keygen_batch.generate_keys_batch(
+            dpf, alphas, betas, mode="numpy-threaded", seeds=seeds,
+            threads=threads,
+        )
+
+    for threads in (1, 2):
+        run(threads)  # warm (object caches)
+        program_counter["programs"] = 0
+        run(threads)
+        assert program_counter["programs"] == 0, (
+            f"threaded keygen at {threads} workers launched "
+            f"{program_counter['programs']} device programs — the host "
+            "dealer must launch none"
+        )
+
+
 def test_serving_keygen_runs_zero_device_programs(program_counter):
     """ISSUE 13 acceptance pin: the keygen-offload serving path routes
     to the host batched dealer (device keygen modes are unverified,

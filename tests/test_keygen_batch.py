@@ -13,6 +13,8 @@ stand-in (the real row circuit is pinned by test_aes_pallas; real-
 circuit interpret of the batched row kernels is not CI-computable —
 the walkkernel lesson)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -94,6 +96,93 @@ def test_numpy_batch_matches_scalar_bytes(value_type, lds, betas):
         want_0, want_1 = _scalar_pair(dpf, alphas[i], [betas[i]], seeds[i])
         assert _key_bytes(keys_0[i], params) == _key_bytes(want_0, params)
         assert _key_bytes(keys_1[i], params) == _key_bytes(want_1, params)
+
+
+@pytest.mark.parametrize(
+    "value_type,lds,betas",
+    [
+        (Int(64), 10, [5, 900, (1 << 60) + 3, 1]),
+        (Int(128), 9, [(1 << 100) + 7, 2, 3, (1 << 127) - 1]),
+        (XorWrapper(64), 10, [0xDEADBEEF, 1, 2, 3]),
+        (IntModN(64, 4294967291), 10, [5, 4294967290, 17, 0]),
+        (TupleType(Int(32), Int(64)), 8,
+         [(1, 2), (0, 5), ((1 << 32) - 1, 9), (7, 8)]),
+    ],
+)
+def test_threaded_matches_scalar_bytes_any_thread_count(
+    value_type, lds, betas
+):
+    """ISSUE 19 contract: the threaded host dealer is byte-identical to
+    the scalar oracle at ANY thread count — seeds are drawn once up
+    front and sliced to workers, so the per-key PRNG streams never
+    depend on the pool shape. Thread counts 1 (inline), 2 (two slices)
+    and 5 > K (clamped to one key per worker) over every pinned
+    value-type class, both parties."""
+    rng = np.random.default_rng(RNG_SEED + 5)
+    dpf = DistributedPointFunction.create(DpfParameters(lds, value_type))
+    k = len(betas)
+    alphas = [int(x) for x in rng.integers(0, 1 << lds, size=k)]
+    seeds = _seeds(rng, k)
+    params = dpf.parameters
+    want = [
+        _scalar_pair(dpf, alphas[i], [betas[i]], seeds[i]) for i in range(k)
+    ]
+    for threads in (1, 2, 5, os.cpu_count() or 1):
+        keys_0, keys_1 = keygen_batch.host_generate_keys_batch(
+            dpf, alphas, [betas], seeds=seeds, threads=threads
+        )
+        for i in range(k):
+            assert _key_bytes(keys_0[i], params) == _key_bytes(
+                want[i][0], params
+            ), f"party 0 key {i} differs at threads={threads}"
+            assert _key_bytes(keys_1[i], params) == _key_bytes(
+                want[i][1], params
+            ), f"party 1 key {i} differs at threads={threads}"
+
+
+def test_dcf_threaded_byte_identical_via_env(monkeypatch):
+    """The DCF dealer's import-light fast path rides
+    DPF_TPU_KEYGEN_THREADS: keys are byte-identical to the scalar DCF
+    dealer at thread counts 1/2/all (seeds pinned), both parties."""
+    rng = np.random.default_rng(RNG_SEED + 6)
+    dcf = DistributedComparisonFunction.create(6, Int(64))
+    alphas = [3, 17, 30, 61, 44]
+    seeds = _seeds(rng, 5)
+    params = dcf.dpf.parameters
+    want = []
+    for i, a in enumerate(alphas):
+        s = (
+            int.from_bytes(seeds[i, 0].tobytes(), "little"),
+            int.from_bytes(seeds[i, 1].tobytes(), "little"),
+        )
+        want.append(dcf.generate_keys(a, 9, seeds=s))
+    monkeypatch.delenv("DPF_TPU_KEYGEN", raising=False)
+    for threads in ("1", "2", "0"):
+        monkeypatch.setenv("DPF_TPU_KEYGEN_THREADS", threads)
+        keys_0, keys_1 = dcf.generate_keys_batch(alphas, 9, seeds=seeds)
+        for i in range(len(alphas)):
+            for got, w in ((keys_0[i], want[i][0]), (keys_1[i], want[i][1])):
+                assert serialization.serialize_dcf_key(
+                    got, params
+                ) == serialization.serialize_dcf_key(
+                    w, params
+                ), f"DCF key {i} differs at DPF_TPU_KEYGEN_THREADS={threads}"
+
+
+def test_keygen_threads_env_resolution(monkeypatch):
+    """DPF_TPU_KEYGEN_THREADS: positive literal, 0 = all cores, unset
+    defers to roofline.host_threads_default (DPF_TPU_THREADS), negative
+    rejected."""
+    monkeypatch.setenv("DPF_TPU_KEYGEN_THREADS", "3")
+    assert keygen_batch.keygen_threads() == 3
+    monkeypatch.setenv("DPF_TPU_KEYGEN_THREADS", "0")
+    assert keygen_batch.keygen_threads() == (os.cpu_count() or 1)
+    monkeypatch.delenv("DPF_TPU_KEYGEN_THREADS")
+    monkeypatch.setenv("DPF_TPU_THREADS", "4")
+    assert keygen_batch.keygen_threads() == 4
+    monkeypatch.setenv("DPF_TPU_KEYGEN_THREADS", "-2")
+    with pytest.raises(InvalidArgumentError):
+        keygen_batch.keygen_threads()
 
 
 def test_jax_mode_byte_identical_to_numpy():
@@ -417,18 +506,41 @@ def test_generate_key_batches_helper():
 
 
 def test_keygen_chain_shapes():
+    assert supervisor.keygen_chain("megakernel") == (
+        ("keygen", "megakernel"), ("keygen", "pallas"), ("keygen", "jax"),
+        ("keygen", "numpy-threaded"), ("keygen", "numpy"), (None, "numpy"),
+    )
     assert supervisor.keygen_chain("pallas") == (
-        ("keygen", "pallas"), ("keygen", "jax"), ("keygen", "numpy"),
-        (None, "numpy"),
+        ("keygen", "pallas"), ("keygen", "jax"),
+        ("keygen", "numpy-threaded"), ("keygen", "numpy"), (None, "numpy"),
     )
     assert supervisor.keygen_chain("jax") == (
-        ("keygen", "jax"), ("keygen", "numpy"), (None, "numpy"),
+        ("keygen", "jax"), ("keygen", "numpy-threaded"),
+        ("keygen", "numpy"), (None, "numpy"),
+    )
+    assert supervisor.keygen_chain("numpy-threaded") == (
+        ("keygen", "numpy-threaded"), ("keygen", "numpy"), (None, "numpy"),
     )
     assert supervisor.keygen_chain("numpy") == (
         ("keygen", "numpy"), (None, "numpy"),
     )
     with pytest.raises(InvalidArgumentError):
         supervisor.keygen_chain("walk")
+
+
+def test_keygen_ladder_agreement_regression(monkeypatch):
+    """ISSUE 19 fix: a mode present in KEYGEN_MODES but missing from the
+    rung ladder used to be a silent hole (chains would slice past it).
+    The chain builder now asserts set-agreement of the two tuples, so
+    drift fails the first chain build instead."""
+    assert set(keygen_batch.KEYGEN_RUNG_ORDER) == set(
+        keygen_batch.KEYGEN_MODES
+    )
+    monkeypatch.setattr(
+        keygen_batch, "KEYGEN_RUNG_ORDER", ("pallas", "jax", "numpy")
+    )
+    with pytest.raises(AssertionError, match="out of sync"):
+        supervisor.keygen_chain("jax")
 
 
 def test_validation_matches_scalar_contract():

@@ -1,0 +1,252 @@
+"""Keygen megakernel (ISSUE 19): the single-program batched dealer.
+
+The real circuit is covered EAGERLY through
+`keygen_megakernel_reference_rows`, which shares `_keygen_megakernel_core`
+with the kernel body verbatim (the replay-parity family dpflint pins):
+byte-identity against the scalar `generate_keys_incremental` oracle for
+DPF and DCF, both parties, u64/u128/Xor/IntModN/tuple. The pallas_call
+plumbing (BlockSpecs, grid, lane padding, output-plane unpack) runs in
+interpret mode on the cheap-rows stand-in only — the real row circuit
+inside an interpreted kernel is not CI-computable (the walkkernel
+lesson).
+
+Compile budget: every interpret-pallas call in this module funnels
+through the ONE module-level helper below, the module's single
+interpret config.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import (
+    Int,
+    IntModN,
+    TupleType,
+    XorWrapper,
+)
+from distributed_point_functions_tpu.dcf.dcf import (
+    DistributedComparisonFunction,
+)
+from distributed_point_functions_tpu.ops import keygen_batch, supervisor
+from distributed_point_functions_tpu.ops.degrade import (
+    DegradationPolicy,
+    RungUnsupported,
+)
+from distributed_point_functions_tpu.protos import serialization
+
+RNG_SEED = 0x19ACE
+
+POLICY = DegradationPolicy(backoff_seconds=0.0)
+
+# Entry mode for the chain-degradation test, held in a name on purpose:
+# the rung fails at Mosaic lowering on CPU — no interpret config is ever
+# constructed — so the call must not read as one to the compile-budget
+# checker (which keys on mode= literals).
+MEGAKERNEL = "megakernel"
+
+
+def _seeds(rng, k):
+    return rng.integers(0, 2**32, size=(k, 2, 4), dtype=np.uint32)
+
+
+def _scalar_pair(dpf, alpha, per_level_betas, seeds_row):
+    return dpf.generate_keys_incremental(
+        alpha, per_level_betas,
+        seeds=(
+            int.from_bytes(seeds_row[0].tobytes(), "little"),
+            int.from_bytes(seeds_row[1].tobytes(), "little"),
+        ),
+    )
+
+
+def _key_bytes(key, params):
+    return serialization.serialize_dpf_key(key, params)
+
+
+def _megakernel_interpret(dpf, alphas, betas, seeds):
+    """The module's single interpret-pallas config (cheap rows only):
+    every interpret call routes here so the kernel compiles under ONE
+    (levels, captures, block_w) family per test run."""
+    return keygen_batch._megakernel_generate(
+        dpf, alphas, betas, seeds=seeds, block_w=8, interpret=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Eager real-circuit replay: byte-identity vs the scalar oracle
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "value_type,lds,betas",
+    [
+        (Int(64), 10, [5, 900, (1 << 60) + 3, 1]),
+        (Int(128), 9, [(1 << 100) + 7, 2, 3, (1 << 127) - 1]),
+        (XorWrapper(64), 10, [0xDEADBEEF, 1, 2, 3]),
+        (IntModN(64, 4294967291), 10, [5, 4294967290, 17, 0]),
+        (TupleType(Int(32), Int(64)), 8,
+         [(1, 2), (0, 5), ((1 << 32) - 1, 9), (7, 8)]),
+    ],
+)
+def test_reference_replay_matches_scalar_bytes(value_type, lds, betas):
+    """`reference=True` replays the kernel algebra through the REAL AES
+    circuit eagerly (no pallas_call, same `_keygen_megakernel_core`):
+    serialized keys must match the scalar oracle, both parties, for
+    every pinned value-type class including u128 exact-int."""
+    rng = np.random.default_rng(RNG_SEED)
+    dpf = DistributedPointFunction.create(DpfParameters(lds, value_type))
+    k = len(betas)
+    alphas = [int(x) for x in rng.integers(0, 1 << lds, size=k)]
+    seeds = _seeds(rng, k)
+    keys_0, keys_1 = keygen_batch._megakernel_generate(
+        dpf, alphas, [betas], seeds=seeds, reference=True,
+    )
+    params = dpf.validator.parameters
+    for i in range(k):
+        want_0, want_1 = _scalar_pair(dpf, alphas[i], [betas[i]], seeds[i])
+        assert _key_bytes(keys_0[i], params) == _key_bytes(want_0, params)
+        assert _key_bytes(keys_1[i], params) == _key_bytes(want_1, params)
+
+
+def test_reference_replay_dcf_matches_scalar_bytes():
+    """DCF through the megakernel core: the incremental multi-level
+    hierarchy (one capture per tree depth) byte-matches the scalar DCF
+    dealer from the same seeds, both parties."""
+    rng = np.random.default_rng(RNG_SEED + 1)
+    n = 8
+    dcf = DistributedComparisonFunction.create(n, Int(64))
+    dpf = dcf.dpf
+    k = 4
+    alphas = [int(x) for x in rng.integers(1, 1 << n, size=k)]
+    beta = 41
+    seeds = _seeds(rng, k)
+    zero = dcf.value_type.zero()
+    per_level = [
+        [
+            beta if (alphas[j] >> (n - i - 1)) & 1 else zero
+            for j in range(k)
+        ]
+        for i in range(n)
+    ]
+    shifted = [a >> 1 for a in alphas]
+    keys_0, keys_1 = keygen_batch._megakernel_generate(
+        dpf, shifted, per_level, seeds=seeds, reference=True,
+    )
+    params = dpf.validator.parameters
+    for i in range(k):
+        s = (
+            int.from_bytes(seeds[i, 0].tobytes(), "little"),
+            int.from_bytes(seeds[i, 1].tobytes(), "little"),
+        )
+        want_0, want_1 = dcf.generate_keys(alphas[i], beta, seeds=s)
+        assert _key_bytes(keys_0[i], params) == _key_bytes(want_0.key, params)
+        assert _key_bytes(keys_1[i], params) == _key_bytes(want_1.key, params)
+
+
+# ---------------------------------------------------------------------------
+# Pallas plumbing (cheap rows, the module's one interpret config)
+# ---------------------------------------------------------------------------
+
+
+class _CheapRows:
+    """The test_aes_pallas stand-in: shape/lane-preserving row rotation +
+    key-mask XOR so interpret mode can execute the kernel plumbing."""
+
+    def __call__(self, rows, rk_base, rk_diff, key_mask):
+        out = []
+        for p in range(128):
+            row = rows[(p + 1) % 128]
+            if rk_diff is not None and key_mask is not None:
+                row = row ^ key_mask
+            out.append(row)
+        return out
+
+
+@pytest.mark.slow  # ~60 s of interpret-mode XLA-CPU compile
+def test_megakernel_pallas_plumbing_matches_reference(monkeypatch):
+    """Interpreted pallas megakernel == the eager reference replay on
+    the SAME cheap circuit: pins BlockSpecs, grid tiling, lane padding
+    and the output-plane unpack — everything the pallas_call adds over
+    the shared core. Runs a non-multiple-of-block_w lane count so the
+    pad/trim path executes."""
+    import jax
+
+    from distributed_point_functions_tpu.ops import aes_pallas
+
+    jax.clear_caches()
+    keygen_batch._keygen_megakernel_jit.cache_clear()
+    monkeypatch.setattr(aes_pallas, "_aes_rows", _CheapRows())
+    try:
+        rng = np.random.default_rng(RNG_SEED + 2)
+        lds = 6  # shallow on purpose: interpret compile scales with levels
+        dpf = DistributedPointFunction.create(DpfParameters(lds, Int(64)))
+        k = 72  # ceil(72/32)=3 words -> pads to 8 lanes of block_w=8
+        alphas = [int(x) for x in rng.integers(0, 1 << lds, size=k)]
+        betas = [int(x) for x in rng.integers(1, 1 << 62, size=k)]
+        seeds = _seeds(rng, k)
+        got_0, got_1 = _megakernel_interpret(dpf, alphas, [betas], seeds)
+        want_0, want_1 = keygen_batch._megakernel_generate(
+            dpf, alphas, [betas], seeds=seeds, reference=True,
+        )
+        params = dpf.validator.parameters
+        for i in range(k):
+            assert _key_bytes(got_0[i], params) == _key_bytes(
+                want_0[i], params
+            )
+            assert _key_bytes(got_1[i], params) == _key_bytes(
+                want_1[i], params
+            )
+    finally:
+        keygen_batch._keygen_megakernel_jit.cache_clear()
+        jax.clear_caches()
+
+
+# ---------------------------------------------------------------------------
+# Rung gating + chain degradation
+# ---------------------------------------------------------------------------
+
+
+def test_megakernel_rejects_multiblock_values():
+    """blocks_needed > 1 (value wider than one AES block) is outside the
+    kernel's one-value-hash-per-party layout: RungUnsupported, so the
+    supervisor chain skips the rung without burning retries."""
+    dpf = DistributedPointFunction.create(
+        DpfParameters(8, TupleType(Int(128), Int(64)))
+    )
+    with pytest.raises(RungUnsupported):
+        keygen_batch._megakernel_generate(dpf, [3], [[(1, 2)]])
+
+
+def test_megakernel_rejects_degenerate_tree():
+    """A tree with no level steps (every hierarchy level at depth 0) has
+    no resident level loop to fuse: RungUnsupported."""
+    dpf = DistributedPointFunction.create(DpfParameters(1, Int(64)))
+    with pytest.raises(RungUnsupported):
+        keygen_batch._megakernel_generate(dpf, [1], [[7]])
+
+
+@pytest.mark.slow  # two failed Mosaic traces + a jax fallback: ~55 s
+def test_robust_chain_degrades_from_megakernel_rung():
+    """Entry mode "megakernel" on a CPU host: the compiled Mosaic rungs
+    fail, the chain degrades megakernel -> pallas -> jax (or further),
+    and the served keys still byte-match the scalar oracle — degradation
+    is invisible in the wire bytes."""
+    rng = np.random.default_rng(RNG_SEED + 3)
+    dpf = DistributedPointFunction.create(DpfParameters(6, Int(64)))
+    k = 3
+    alphas = [int(x) for x in rng.integers(0, 1 << 6, size=k)]
+    betas = [int(x) for x in rng.integers(1, 1 << 62, size=k)]
+    seeds = _seeds(rng, k)
+    # max_retries=0: a failed Mosaic lowering is deterministic — retrying
+    # it only re-pays the trace under the tier-1 wall clock.
+    policy = DegradationPolicy(backoff_seconds=0.0, max_retries=0)
+    keys_0, keys_1 = supervisor.generate_keys_robust(
+        dpf, alphas, [betas], mode=MEGAKERNEL, seeds=seeds, policy=policy,
+    )
+    params = dpf.validator.parameters
+    for i in range(k):
+        want_0, want_1 = _scalar_pair(dpf, alphas[i], [betas[i]], seeds[i])
+        assert _key_bytes(keys_0[i], params) == _key_bytes(want_0, params)
+        assert _key_bytes(keys_1[i], params) == _key_bytes(want_1, params)

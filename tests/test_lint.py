@@ -90,9 +90,10 @@ def test_mosaic_baseline_matches_watchlist_exactly():
     }
 
 
-def test_replay_parity_covers_all_three_megakernel_families():
-    """Slab, walk and hier megakernels each share their core with the
-    replay — the structural form of the verbatim-sharing contract."""
+def test_replay_parity_covers_all_four_megakernel_families():
+    """Slab, walk, hier and keygen megakernels each share their core
+    with the replay — the structural form of the verbatim-sharing
+    contract."""
     _, observed = dpflint.run(
         REPO_ROOT, load_baseline(dpflint.DEFAULT_BASELINE),
         checkers=("replay-parity",), modules=repo_modules(),
@@ -105,6 +106,8 @@ def test_replay_parity_covers_all_three_megakernel_families():
         "::_walk_megakernel_core": 1,
         f"{kp}::hier_megakernel_pallas_batched~hier_megakernel_reference_rows"
         "::_hier_megakernel_core": 1,
+        f"{kp}::keygen_megakernel_pallas_batched~keygen_megakernel_reference_rows"
+        "::_keygen_megakernel_core": 1,
     }
 
 

@@ -208,6 +208,19 @@ BENCH_KEYGEN_MODE=pallas \
   stage keygen_device 1500 python tools/run_bench_stage.py bench_keygen.py \
   RECORD_SUFFIX=_device SUPERSEDES=keygen
 
+# 2b''''''. Keygen megakernel (ISSUE 19): the single-program dealer —
+# ONE pallas_call per key batch, the level loop resident in VMEM with
+# the CW algebra in-kernel. The dealer gate burns it in first
+# (CHECK_KEYGEN_MODE=megakernel reuses the CHECK_MODE=keygen verdicts:
+# byte-match spot rows vs the scalar oracle AND host-engine evaluation
+# of every key), then its bench record lands in its own results.json
+# slot, superseding the host keygen record only when verified-faster.
+CHECK_MODE=keygen CHECK_KEYGEN_MODE=megakernel CHECK_SHAPES=64x20 \
+  stage gate-keygen-megakernel 900 python tools/check_device.py
+BENCH_KEYGEN_MODE=megakernel \
+  stage keygen_megakernel 1500 python tools/run_bench_stage.py bench_keygen.py \
+  RECORD_SUFFIX=_megakernel SUPERSEDES=keygen
+
 # 2c. Pipeline A/B records (ISSUE 2): the headline and PIR benches with
 # the pipelined chunk executor forced OFF land in their own results.json
 # slots, so the on/off pair is a first-class record pair (not just the
@@ -271,7 +284,7 @@ gate-sharded pir_sharded \
 gate-walkkernel evaluate_at_walkkernel dcf_walkkernel \
 gate-hierkernel heavy_hitters_hierkernel \
 serving_router serving gates gates_walkkernel \
-gate-keygen keygen_device \
+gate-keygen keygen_device gate-keygen-megakernel keygen_megakernel \
 headline-syncexec pir-syncexec evalat dcf hh-device \
 extras fold-128x20 fold-fused-hash \
 pir keygen full-domain intmodn-sample intmodn-hierarchy isrg \
