@@ -427,9 +427,298 @@ def _bench_fleet(jax, smoke):
     }
 
 
+def _drive_timed(serving, port, calls, threads_n, duration):
+    """threads_n serial clients hammering pre-encoded calls against
+    `port` until `duration` elapses; returns (latencies, errors)."""
+    import threading
+    import time
+
+    lock = threading.Lock()
+    latencies, errors = [], []
+    t_stop = time.perf_counter() + duration
+
+    def _worker(t):
+        cli = serving.DpfClient("127.0.0.1", port)
+        try:
+            i = 0
+            while time.perf_counter() < t_stop:
+                op, payload = calls[(t * 997 + i) % len(calls)]
+                i += 1
+                t0 = time.perf_counter()
+                try:
+                    cli.call(op, payload, deadline=120.0)
+                except Exception as exc:  # noqa: BLE001 — counted, not fatal
+                    with lock:
+                        errors.append(f"{type(exc).__name__}: {exc}")
+                    continue
+                dt = time.perf_counter() - t0
+                with lock:
+                    latencies.append(dt)
+        finally:
+            cli.close()
+
+    workers = [threading.Thread(target=_worker, args=(t,), daemon=True)
+               for t in range(threads_n)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=900)
+    return latencies, errors
+
+
+def _bench_tenant_qos(serving, rng):
+    """The multi-tenant admission-quota A/B, in-process: a flood tenant
+    submitting 10 per-key gate batches per round vs a minority tenant's
+    evaluate_at stream, all arms under FIFO flush ordering so quotas —
+    not fair rotation — are what the A/B isolates. Records the minority
+    tenant's p95 uncontended, under the unquota'd flood, and under a
+    flood bounded by its admission quota (the flood sheds ONLY itself:
+    over-quota submits fail fast with RESOURCE_EXHAUSTED)."""
+    import time
+
+    from distributed_point_functions_tpu.core.dpf import (
+        DistributedPointFunction,
+    )
+    from distributed_point_functions_tpu.core.params import DpfParameters
+    from distributed_point_functions_tpu.core.value_types import Int
+    from distributed_point_functions_tpu.gates.mic import (
+        MultipleIntervalContainmentGate,
+    )
+    from distributed_point_functions_tpu.utils.errors import (
+        ResourceExhaustedError,
+    )
+
+    params = DpfParameters(8, Int(64))
+    dpf = DistributedPointFunction.create(params)
+    mkey, _ = dpf.generate_keys(3, 5)
+    intervals = [(2, 1000), (2000, 9000), (20000, 40000)]
+    gate = MultipleIntervalContainmentGate.create(16, intervals)
+    gkeys = [gate.gen(int(rng.integers(0, 1 << 16)), [3, 7, 11])[0]
+             for _ in range(16)]
+    rounds = int(os.environ.get("BENCH_SERVING_FAIR_ROUNDS", 25))
+
+    def _run(flood, quota):
+        minority_lat, shed = [], [0]
+        kwargs = {}
+        if quota:
+            kwargs["tenant_quotas"] = {"flood": quota}
+        with serving.FrontDoor(
+            engine="host", max_wait_ms=2.0, width_target=64, fair=False,
+            **kwargs,
+        ) as door:
+            futures = []
+            for r in range(rounds):
+                if flood:
+                    for j in range(10):
+                        xs = [int(x) for x in rng.integers(0, 1 << 16,
+                                                          size=8)]
+                        gk = gkeys[(r * 10 + j) % len(gkeys)]
+                        req = serving.Request.mic(gate, gk, xs).with_tenant(
+                            "flood"
+                        )
+                        try:
+                            futures.append(door.submit(req))
+                        except ResourceExhaustedError:
+                            shed[0] += 1  # the flood sheds only itself
+                fut = door.submit(
+                    serving.Request.evaluate_at(
+                        dpf, [mkey], [1, 2, 3, 4]
+                    ).with_tenant("minority")
+                )
+                futures.append(fut)
+                minority_lat.append(fut)
+                time.sleep(0.002)
+            for f in futures:
+                f.result(timeout=300)
+        lats = sorted(f.latency_seconds for f in minority_lat)
+        p95 = lats[min(len(lats) - 1, int(len(lats) * 0.95))] * 1e3
+        return p95, shed[0]
+
+    # Warm the host caches out of the timed arms (the fairness-bench
+    # pattern: the uncontended arm must not read as cold-start).
+    quota = int(os.environ.get("BENCH_SERVING_FLOOD_QUOTA", 2))
+    _run(flood=False, quota=0)
+    p95_u, _ = _run(flood=False, quota=0)
+    p95_fifo, _ = _run(flood=True, quota=0)
+    p95_quota, shed = _run(flood=True, quota=quota)
+    return {
+        "rounds": rounds,
+        "flood_ratio": 10,
+        "flood_quota": quota,
+        "uncontended_p95_ms": round(p95_u, 2),
+        "flood_fifo_p95_ms": round(p95_fifo, 2),
+        "flood_quota_p95_ms": round(p95_quota, 2),
+        "flood_shed": shed,
+        "fifo_factor_vs_uncontended": round(p95_fifo / max(p95_u, 1e-9), 2),
+        "quota_factor_vs_uncontended": round(
+            p95_quota / max(p95_u, 1e-9), 2
+        ),
+    }
+
+
+def _bench_autoscale(jax, smoke):
+    """BENCH_SERVING_MODE=autoscale (ISSUE 20): the diurnal elasticity
+    A/B. A seeded 4x day/night client swing (night -> day -> night ->
+    idle tail) is served by two arms over the IDENTICAL phase schedule:
+
+    * **static** — a fleet held at peak size for the whole run (the
+      capacity-planning baseline: provisioned for the day, idle all
+      night);
+    * **autoscale** — one replica plus the AutoScaler on the proxy's
+      stats/health signal (min 1, max = the same peak).
+
+    The headline is replica-seconds (integrated live-replica count over
+    the schedule) autoscaled vs static-peak, with the p95 ratio as the
+    latency-cost guard. A second, in-process measurement records the
+    tenant-quota QoS A/B (10:1 flood, minority p95 quota'd vs FIFO)."""
+    import threading
+    import time
+
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.serving import fleet as fleet_mod
+
+    peak = int(os.environ.get("BENCH_SERVING_REPLICAS", 3))
+    lo = max(1, int(os.environ.get("BENCH_SERVING_THREADS", 8)) // 4)
+    hi = lo * 4  # the 4x diurnal swing
+    # A 24-beat diurnal cycle (1 beat ~ 1 hour, day peak = 6 beats): the
+    # peak is a MINORITY of the cycle — the whole reason static-peak
+    # provisioning wastes replica-seconds.
+    scale_t = 0.5 if smoke else 1.0
+    phases = [
+        ("night", lo, 6.0 * scale_t),
+        ("day", hi, 6.0 * scale_t),
+        ("night", lo, 6.0 * scale_t),
+        ("idle", 0, 6.0 * scale_t),
+    ]
+    rng = np.random.default_rng(int(os.environ.get("BENCH_SEED", 17)))
+    calls = _fleet_workload(rng)
+    server_args = ["--engine", "host", "--max-wait-ms", "2"]
+
+    def _run_arm(autoscale):
+        label = "autoscale" if autoscale else "static"
+        pool = fleet_mod.ReplicaPool(
+            replicas=1 if autoscale else peak, server_args=server_args,
+        )
+        proxy = scaler = sampler = None
+        stop_sampling = threading.Event()
+        sample = {"rs": 0.0, "peak": 0, "floor": peak + 1}
+        try:
+            pool.start()
+            proxy = serving.FleetProxy(
+                pool.endpoints, probe_interval=0.25,
+            ).start()
+            probe = serving.DpfClient("127.0.0.1", proxy.port)
+            probe.wait_ready(timeout=180)
+            probe.close()
+            if autoscale:
+                scaler = serving.AutoScaler(
+                    proxy, pool, plane="eval", min_replicas=1,
+                    max_replicas=peak, interval=0.2, up_backlog=3.0,
+                    down_backlog=1.25, sustain=2, cooldown=1.0,
+                    drain_timeout=10.0,
+                )
+                scaler.start()
+            # warm every op family out of the measured window
+            _drive_fleet(serving, proxy.port, calls, 8, 4)
+
+            def _sampler():
+                prev = time.perf_counter()
+                while not stop_sampling.is_set():
+                    time.sleep(0.05)
+                    now = time.perf_counter()
+                    live = len(pool.running_indices())
+                    sample["rs"] += live * (now - prev)
+                    sample["peak"] = max(sample["peak"], live)
+                    sample["floor"] = min(sample["floor"], live)
+                    prev = now
+
+            sampler = threading.Thread(target=_sampler, daemon=True)
+            sampler.start()
+            lats, errs, per_phase = [], [], []
+            for name, threads_n, duration in phases:
+                if threads_n == 0:
+                    time.sleep(duration)
+                    per_phase.append({"phase": name, "served": 0})
+                    continue
+                pl, pe = _drive_timed(
+                    serving, proxy.port, calls, threads_n, duration
+                )
+                lats += pl
+                errs += pe
+                p50, p95 = _pcts(pl) if pl else (None, None)
+                per_phase.append({
+                    "phase": name, "threads": threads_n,
+                    "served": len(pl), "p95_ms": p95,
+                })
+                log(f"{label}/{name}: {len(pl)} served at {threads_n} "
+                    f"threads, p95 {p95} ms, replicas now "
+                    f"{len(pool.running_indices())}")
+            stop_sampling.set()
+            sampler.join(timeout=10)
+            if not lats:
+                raise RuntimeError(
+                    f"{label} arm served 0 requests; errors: {errs[:3]}"
+                )
+            p50, p95 = _pcts(lats)
+            arm = {
+                "replicas_peak_observed": sample["peak"],
+                "replicas_floor_observed": sample["floor"],
+                "replica_seconds": round(sample["rs"], 1),
+                "served": len(lats),
+                "errors": len(errs),
+                "error_samples": errs[:3],
+                "latency_ms": {"p50": p50, "p95": p95},
+                "phases": per_phase,
+            }
+            if scaler is not None:
+                arm["scaler"] = scaler.stats()
+            log(f"{label}: {len(lats)} served, p95 {p95} ms, "
+                f"{arm['replica_seconds']} replica-seconds "
+                f"(floor {sample['floor']}, peak {sample['peak']})")
+            return arm
+        finally:
+            stop_sampling.set()
+            if scaler is not None:
+                scaler.stop()
+            if proxy is not None:
+                proxy.stop()
+            pool.stop()
+
+    arms = {"static": _run_arm(False), "autoscale": _run_arm(True)}
+    tenant_qos = _bench_tenant_qos(serving, rng)
+    log(f"tenant QoS: {tenant_qos}")
+    rs_ratio = arms["autoscale"]["replica_seconds"] / max(
+        arms["static"]["replica_seconds"], 1e-9
+    )
+    p95_ratio = arms["autoscale"]["latency_ms"]["p95"] / max(
+        arms["static"]["latency_ms"]["p95"], 1e-9
+    )
+    return {
+        "bench": "serving",
+        "metric": "autoscale_replica_seconds_vs_static_peak",
+        "value": round(rs_ratio, 3),
+        "unit": "x",
+        "config": {
+            "mode": "autoscale",
+            "peak_replicas": peak,
+            "diurnal_threads": [lo, hi],
+            "phases": [
+                {"phase": n, "threads": t, "seconds": d}
+                for n, t, d in phases
+            ],
+            "p95_ratio_vs_static": round(p95_ratio, 3),
+            "arms": arms,
+            "tenant_qos": tenant_qos,
+        },
+    }
+
+
 def bench(jax, smoke):
-    if os.environ.get("BENCH_SERVING_MODE", "ab") == "fleet":
+    mode = os.environ.get("BENCH_SERVING_MODE", "ab")
+    if mode == "fleet":
         return _bench_fleet(jax, smoke)
+    if mode == "autoscale":
+        return _bench_autoscale(jax, smoke)
     from distributed_point_functions_tpu import serving
     from distributed_point_functions_tpu.core.dpf import (
         DistributedPointFunction,

@@ -154,6 +154,17 @@ run_faults() {
   # XLA:CPU, host engine — zero pallas configs.
   JAX_PLATFORMS=cpu python tools/chaos_soak.py --fleet --replicas 2 \
     --fleet-requests 120 --fleet-threads 4 --seed 7
+  # ISSUE 20: the elastic-fleet soak — party 0 starts at ONE replica with
+  # a live AutoScaler on its FleetProxy; a client flood drives the
+  # backlog signal over threshold, the seed replica is SIGKILLed DURING
+  # the resulting scale event (newcomer spawned, not yet admitted), and
+  # the lull after the flood drains the fleet back down gracefully.
+  # Asserts bit-exact shares with ZERO caller-visible errors through
+  # flood + mid-scale kill + drain, >= 1 scale-up and >= 1 retirement in
+  # the proxy counters, and the killed seed probing back alive. Bounded
+  # (<30 s), loopback, XLA:CPU, host engine — zero pallas configs.
+  JAX_PLATFORMS=cpu python tools/chaos_soak.py --fleet-scale \
+    --fleet-threads 4 --seed 7
   # ISSUE 15: the streaming heavy-hitters soak — two server
   # subprocesses (party 0 the aggregation leader via --stream-peer), a
   # seeded client fleet uploading key batches into rolling window

@@ -11,6 +11,7 @@ per batch — executed through the resilient job supervisor.
 """
 
 from . import wire  # noqa: F401
+from .autoscale import DEALER_OPS, AutoScaler  # noqa: F401
 from .batcher import (  # noqa: F401
     ContinuousBatcher,
     Request,

@@ -188,6 +188,14 @@ class Request:
     #: acknowledges each batch individually.
     ingest: Optional[tuple] = None
     hierarchy_level: int = -1
+    #: multi-tenant QoS token (ISSUE 20): which tenant submitted this
+    #: request — "" means untenanted (the wire absent-field default).
+    #: Deliberately NOT part of :meth:`signature`: requests from
+    #: different tenants still merge into one device batch (splitting
+    #: them would forfeit the batching the front door exists for);
+    #: the tenant drives admission quotas, flush ordering within an op
+    #: class, and per-tenant telemetry only.
+    tenant: str = ""
     future: ServedFuture = dataclasses.field(default_factory=ServedFuture)
     #: absolute completion deadline on the ``time.perf_counter`` clock,
     #: or None (unbounded). Set via :meth:`with_deadline`; the RPC server
@@ -210,6 +218,12 @@ class Request:
                     f"deadline must be > 0 seconds, got {seconds!r}"
                 )
             self.deadline = time.perf_counter() + float(seconds)
+        return self
+
+    def with_tenant(self, tenant: str) -> "Request":
+        """Tags this request with a tenant token (construction chaining,
+        like :meth:`with_deadline`); "" clears the tag."""
+        self.tenant = str(tenant)
         return self
 
     def remaining(self, now: Optional[float] = None) -> Optional[float]:
@@ -455,7 +469,21 @@ class ContinuousBatcher:
     missing ops are class 0); within a class, ripe queues are served
     round-robin across ops (``fair=True``) so no op class starves behind
     a flood of another. ``adaptive_wait`` scales each queue's batch
-    deadline by its flushed-width history (see the module docstring).
+    deadline by its flushed-width history (see the module docstring);
+    since ISSUE 20 it defaults ON — tenant quotas bound the failure
+    mode (one tenant's flood holding every window at full width) that
+    kept it opt-in.
+
+    Multi-tenant QoS (ISSUE 20): ``tenant_quotas`` maps tenant token ->
+    max queued requests for that tenant (0 / missing = the
+    ``tenant_default_quota``, itself 0 = unbounded); past its quota a
+    tenant's submit raises ``ResourceExhaustedError`` while other
+    tenants keep admitting — admission control per tenant, layered
+    INSIDE the global ``max_queue_depth``. ``tenant_priorities`` maps
+    tenant token -> scheduling class (lower first, missing = 0): within
+    an op class's flush rotation, a higher-priority tenant's ripe queue
+    flushes first. Tenants never affect :meth:`Request.signature` —
+    cross-tenant requests still merge into one batch.
     """
 
     def __init__(
@@ -466,12 +494,19 @@ class ContinuousBatcher:
         max_queue_depth: int = 1024,
         priorities: Optional[Dict[str, int]] = None,
         fair: bool = True,
-        adaptive_wait: bool = False,
+        adaptive_wait: bool = True,
+        tenant_quotas: Optional[Dict[str, int]] = None,
+        tenant_default_quota: int = 0,
+        tenant_priorities: Optional[Dict[str, int]] = None,
     ):
         if width_target < 1 or max_queue_depth < 1:
             raise InvalidArgumentError(
                 "width_target and max_queue_depth must be >= 1"
             )
+        if tenant_default_quota < 0 or any(
+            v < 0 for v in (tenant_quotas or {}).values()
+        ):
+            raise InvalidArgumentError("tenant quotas must be >= 0")
         self._flush = flush
         self.max_wait = max_wait_ms / 1e3
         self.width_target = width_target
@@ -479,6 +514,9 @@ class ContinuousBatcher:
         self.priorities = dict(priorities or {})
         self.fair = fair
         self.adaptive_wait = adaptive_wait
+        self.tenant_quotas = dict(tenant_quotas or {})
+        self.tenant_default_quota = int(tenant_default_quota)
+        self.tenant_priorities = dict(tenant_priorities or {})
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._queues: Dict[tuple, _Queue] = collections.OrderedDict()
@@ -489,6 +527,11 @@ class ContinuousBatcher:
         self._rate_ewma: "collections.OrderedDict[tuple, Tuple[float, int]]" = (
             collections.OrderedDict()
         )
+        #: per-tenant queued request counts (admission quota input) and
+        #: cumulative admission/serving counters — the stats-frame
+        #: ``tenants`` section (ISSUE 20). Both owned by self._lock.
+        self._tenant_pending: Dict[str, int] = {}
+        self._tenant_counters: Dict[str, Dict[str, int]] = {}
         #: fairness clock: op -> sequence number of its last flush.
         self._op_last_served: Dict[str, int] = {}
         self._serve_seq = 0
@@ -558,6 +601,23 @@ class ContinuousBatcher:
                     f"max_queue_depth={self.max_queue_depth}): admission "
                     "control rejected the request — retry with backoff"
                 )
+            quota = self.tenant_quotas.get(
+                req.tenant, self.tenant_default_quota
+            )
+            tenant_pending = self._tenant_pending.get(req.tenant, 0)
+            if quota > 0 and tenant_pending >= quota:
+                self._tenant_counters.setdefault(
+                    req.tenant, {"admitted": 0, "rejected": 0, "served": 0}
+                )["rejected"] += 1
+                _tm.counter("serving.rejected", op=req.op)
+                if req.tenant:
+                    _tm.counter("serving.tenant.rejected", op=req.tenant)
+                raise ResourceExhaustedError(
+                    f"tenant {req.tenant or '<untenanted>'} over its "
+                    f"admission quota ({tenant_pending} pending >= "
+                    f"{quota}): retry with backoff — other tenants are "
+                    "unaffected"
+                )
             q = self._queues.get(sig)
             new_queue = q is None
             if new_queue:
@@ -567,9 +627,15 @@ class ContinuousBatcher:
             q.width += width
             q.oldest = min(q.oldest, req.future.submitted_at)
             self._pending += 1
+            self._tenant_pending[req.tenant] = tenant_pending + 1
+            self._tenant_counters.setdefault(
+                req.tenant, {"admitted": 0, "rejected": 0, "served": 0}
+            )["admitted"] += 1
             if _tm.enabled():
                 _tm.counter("serving.submitted", op=req.op)
                 _tm.gauge("serving.queue_depth", self._pending)
+                if req.tenant:
+                    _tm.counter("serving.tenant.submitted", op=req.tenant)
             # Wake the worker only when this submit changes what it
             # should do: a NEW queue needs its deadline armed, a queue
             # crossing the width target needs flushing now. A submit
@@ -593,6 +659,39 @@ class ContinuousBatcher:
                 if q.requests:
                     op = q.requests[0].op
                     out[op] = out.get(op, 0) + len(q.requests)
+            return out
+
+    def arrival_rates(self) -> Dict[str, float]:
+        """Per-op arrival-rate EWMAs (requests/second), the SUM over the
+        op's signatures — the ``rates`` stats-frame field the autoscaler
+        consumes (ISSUE 20). Only signatures past the adaptive-wait
+        sample floor contribute: a one-flush rate is noise, and the
+        autoscaler must not scale on it any more than the window does.
+        Signatures lead with the op name, so the aggregation is a plain
+        group-by on the table adaptive_wait already maintains."""
+        with self._lock:
+            out: Dict[str, float] = {}
+            for sig, (rate, n) in self._rate_ewma.items():
+                if n < _ADAPT_MIN_SAMPLES:
+                    continue
+                op = sig[0]
+                out[op] = out.get(op, 0.0) + rate
+            return out
+
+    def tenant_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant admission/serving counters plus current pending —
+        the ``tenants`` stats-frame section (ISSUE 20). Untenanted
+        traffic appears under the "" token."""
+        with self._lock:
+            out = {
+                t: dict(c) for t, c in self._tenant_counters.items()
+            }
+            for t, n in self._tenant_pending.items():
+                out.setdefault(
+                    t, {"admitted": 0, "rejected": 0, "served": 0}
+                )["pending"] = n
+            for c in out.values():
+                c.setdefault("pending", 0)
             return out
 
     # -- flushing ----------------------------------------------------------
@@ -628,35 +727,63 @@ class ContinuousBatcher:
                 if force or expired or q.width >= self.width_target:
                     del self._queues[sig]
                     self._pending -= len(q.requests)
+                    for r in q.requests:
+                        left = self._tenant_pending.get(r.tenant, 1) - 1
+                        if left <= 0:
+                            self._tenant_pending.pop(r.tenant, None)
+                        else:
+                            self._tenant_pending[r.tenant] = left
+                        self._tenant_counters.setdefault(
+                            r.tenant,
+                            {"admitted": 0, "rejected": 0, "served": 0},
+                        )["served"] += 1
                     q.taken_elapsed = now - q.oldest
                     ripe.append(q)
             if _tm.enabled() and ripe:
                 _tm.gauge("serving.queue_depth", self._pending)
         return ripe
 
+    def _tenant_class(self, q: _Queue) -> int:
+        """A queue's tenant scheduling class: the BEST (minimum) class
+        among its merged requests — a shared batch carrying one
+        high-priority tenant's request must not wait behind that
+        tenant's class peers. Class 0 (the default) when no tenant
+        priorities are configured."""
+        if not self.tenant_priorities:
+            return 0
+        return min(
+            self.tenant_priorities.get(r.tenant, 0) for r in q.requests
+        )
+
     def _order_ripe(self, ripe: List[_Queue]) -> List[_Queue]:
         """Iteration-level fair flush order (the Orca scheduling idea at
         batch granularity): priority class first, then round-robin
         across op classes by least-recently-served, oldest queue first
-        within an op. ``fair=False`` keeps the ripeness-scan (FIFO)
+        within an op. Tenant classes (ISSUE 20) layer INSIDE the op
+        rotation: among one op's ripe queues, a higher-priority
+        tenant's queue flushes first — the op-level starvation guarantee
+        is untouched. ``fair=False`` keeps the ripeness-scan (FIFO)
         order within a priority class — the baseline a flood of per-key
-        gate queues starves — but an explicit ``priorities`` map still
-        applies (an operator who set classes gets classes, whichever
-        fairness arm is running)."""
+        gate queues starves — but explicit ``priorities`` /
+        ``tenant_priorities`` maps still apply (an operator who set
+        classes gets classes, whichever fairness arm is running)."""
         if len(ripe) <= 1:
             return ripe
         if not self.fair:
-            if not self.priorities:
+            if not self.priorities and not self.tenant_priorities:
                 return ripe
             return sorted(  # stable: FIFO within each priority class
                 ripe,
-                key=lambda q: self.priorities.get(q.requests[0].op, 0),
+                key=lambda q: (
+                    self.priorities.get(q.requests[0].op, 0),
+                    self._tenant_class(q),
+                ),
             )
         by_op: Dict[str, List[_Queue]] = collections.OrderedDict()
         for q in ripe:
             by_op.setdefault(q.requests[0].op, []).append(q)
         for queues in by_op.values():
-            queues.sort(key=lambda q: q.oldest)
+            queues.sort(key=lambda q: (self._tenant_class(q), q.oldest))
         out: List[_Queue] = []
         with self._lock:
             while by_op:
@@ -760,6 +887,7 @@ class ContinuousBatcher:
             ]
             self._queues.clear()
             self._pending = 0
+            self._tenant_pending.clear()
             self._cond.notify_all()
         _tm.counter("serving.worker_death")
         wrapped = InternalError(

@@ -48,6 +48,7 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..utils import envflags
 from ..utils import telemetry as _tm
 from ..utils.errors import (
     DpfError,
@@ -111,11 +112,20 @@ class DpfClient:
         port: int,
         policy: Optional[RetryPolicy] = None,
         max_body: int = wire.DEFAULT_MAX_BODY,
+        tenant: Optional[str] = None,
     ):
         self.host = host
         self.port = port
         self.policy = policy or RetryPolicy()
         self.max_body = max_body
+        #: ISSUE 20: QoS identity stamped on every request envelope.
+        #: None falls back to DPF_TPU_TENANT; "" stays untenanted and
+        #: encodes byte-identical to a pre-tenant client.
+        self.tenant = (
+            tenant
+            if tenant is not None
+            else envflags.env_str("DPF_TPU_TENANT", "")
+        )
         self._rng = random.Random(self.policy.seed)
         self._sock: Optional[socket.socket] = None
         self._next_id = 0
@@ -333,7 +343,9 @@ class DpfClient:
         )
         wire.write_frame(
             sock, wire.T_REQUEST, rid,
-            wire.encode_request_body(op, payload, deadline_ms=deadline_ms),
+            wire.encode_request_body(
+                op, payload, deadline_ms=deadline_ms, tenant=self.tenant
+            ),
         )
         frame = wire.read_frame(sock, max_body=self.max_body)
         if frame is None:
@@ -552,13 +564,15 @@ class TwoServerClient:
         self,
         endpoints: Sequence[Tuple[str, int]],
         policy: Optional[RetryPolicy] = None,
+        tenant: Optional[str] = None,
     ):
         if len(endpoints) != 2:
             raise InvalidArgumentError(
                 "TwoServerClient needs exactly two endpoints"
             )
         self.clients = [
-            DpfClient(host, port, policy=policy) for host, port in endpoints
+            DpfClient(host, port, policy=policy, tenant=tenant)
+            for host, port in endpoints
         ]
 
     def close(self) -> None:

@@ -100,13 +100,18 @@ class _Replica:
 
     __slots__ = (
         "host", "port", "alive", "inflight", "pending", "routed",
-        "failures", "epoch", "last_probe", "last_error", "health", "stats",
+        "failures", "epoch", "last_probe", "last_relay", "last_error",
+        "health", "stats", "retiring",
     )
 
     def __init__(self, host: str, port: int):
         self.host = host
         self.port = port
         self.alive = False      # flipped by the probe loop / failures
+        #: ISSUE 20 scale-down: a retiring replica is excluded from
+        #: _pick (no NEW requests) but stays probed and counted — the
+        #: graceful-drain half of the autoscaler's remove path.
+        self.retiring = False
         self.inflight = 0       # proxy-tracked requests outstanding
         self.pending = 0        # the replica's queued count (health frame)
         self.routed = 0         # requests ever routed here
@@ -116,6 +121,13 @@ class _Replica:
         #: the replica with its stale ready=True.
         self.epoch = 0
         self.last_probe = 0.0   # perf_counter of the last probe attempt
+        #: perf_counter of the last relayed-request completion. A stats
+        #: poll compares it against last_probe: a cached stats body
+        #: predating a completed request must be re-fetched no matter
+        #: how young it is (on warm loopback a request + stats poll fit
+        #: inside STATS_FRESHNESS, and the pre-request body would hide
+        #: counters the poller just caused).
+        self.last_relay = 0.0
         self.last_error: Optional[str] = None
         self.health: dict = {}
         self.stats: dict = {}
@@ -134,10 +146,14 @@ class FleetProxy:
 
     ``endpoints`` is the replica list as (host, port) pairs — in-process
     :class:`~.server.DpfServer` instances for tests, a
-    :class:`ReplicaPool`'s subprocesses in deployment. The set is fixed
-    for the proxy's lifetime; a dead replica is routed around (and
-    revived by the probe loop), never removed, so its rendezvous range
-    is stable.
+    :class:`ReplicaPool`'s subprocesses in deployment. A dead replica is
+    routed around (and revived by the probe loop), never dropped
+    implicitly, so its rendezvous range is stable across a crash. The
+    set IS elastic explicitly (ISSUE 20): :meth:`add_replica` /
+    :meth:`set_retiring` / :meth:`remove_replica` are the autoscaler's
+    seams — a retiring replica takes no new requests but finishes what
+    it holds (graceful drain), and only an explicit remove re-hashes its
+    digest range away.
 
     ``affinity=None`` reads ``DPF_TPU_FLEET_AFFINITY`` (default on).
     ``spill_margin`` is how far past the least-loaded replica the
@@ -182,6 +198,7 @@ class FleetProxy:
             "requests": 0, "affinity_hits": 0, "spills": 0,
             "least_loaded": 0, "failovers": 0, "replica_down": 0,
             "upstream_timeouts": 0, "no_replica": 0,
+            "replicas_added": 0, "replicas_removed": 0, "retired": 0,
         }
         #: chaos seam (tools/chaos_soak.py): one armed fault fires at the
         #: next request-response boundary. Production traffic never arms.
@@ -273,7 +290,9 @@ class FleetProxy:
         in-flight count is bumped under the same lock so concurrent
         picks see each other's load."""
         with self._lock:
-            alive = [r for r in self._replicas if r.alive]
+            alive = [
+                r for r in self._replicas if r.alive and not r.retiring
+            ]
             if not alive:
                 self.counters["no_replica"] += 1
                 return None
@@ -299,6 +318,7 @@ class FleetProxy:
     def _release(self, replica: _Replica) -> None:
         with self._lock:
             replica.inflight -= 1
+            replica.last_relay = time.perf_counter()
 
     def _mark_dead(self, replica: _Replica, exc: BaseException) -> None:
         with self._lock:
@@ -313,10 +333,95 @@ class FleetProxy:
         if was_alive:
             _tm.counter("fleet.failovers")
 
+    # -- elastic membership (ISSUE 20: the autoscaler's seams) -------------
+    def add_replica(self, host: str, port: int) -> None:
+        """Adds (or un-retires) an upstream endpoint. A new endpoint
+        starts dead and joins the candidate set when a probe sees it
+        ready (one is fired immediately, so a ready replica serves
+        within one round trip, not one probe interval); re-adding a
+        known endpoint clears its ``retiring`` flag — the
+        scale-up-after-scale-down path, where a remembered-port respawn
+        wins its old rendezvous range back."""
+        with self._lock:
+            replica = None
+            for r in self._replicas:
+                if r.host == host and r.port == port:
+                    r.retiring = False
+                    replica = r
+                    break
+            if replica is None:
+                replica = _Replica(host, port)
+                self._replicas.append(replica)
+                self.counters["replicas_added"] += 1
+        _tm.counter("fleet.scale.added")
+        self._probe(replica)
+
+    def set_retiring(
+        self, host: str, port: int, retiring: bool = True
+    ) -> bool:
+        """Marks an endpoint retiring (True: excluded from _pick, still
+        probed and still finishing its in-flight work — the graceful
+        drain) or back in service (False). Returns whether the endpoint
+        is known."""
+        with self._lock:
+            for r in self._replicas:
+                if r.host == host and r.port == port:
+                    if retiring and not r.retiring:
+                        self.counters["retired"] += 1
+                    r.retiring = retiring
+                    return True
+        return False
+
+    def remove_replica(self, host: str, port: int) -> bool:
+        """Drops an endpoint from the set — the ONLY operation that
+        re-hashes its digest range away. Refuses (returns False) while
+        the proxy still tracks in-flight requests on it: retire first,
+        wait for :meth:`replica_state`'s load to reach zero, then
+        remove."""
+        with self._lock:
+            for i, r in enumerate(self._replicas):
+                if r.host == host and r.port == port:
+                    if r.inflight > 0:
+                        return False
+                    del self._replicas[i]
+                    self.counters["replicas_removed"] += 1
+                    _tm.counter("fleet.scale.removed")
+                    return True
+        return False
+
+    def replica_state(self, host: str, port: int) -> Optional[dict]:
+        """One endpoint's routing-state snapshot (the autoscaler's
+        drained-yet? poll), or None for an unknown endpoint."""
+        with self._lock:
+            for r in self._replicas:
+                if r.host == host and r.port == port:
+                    return {
+                        "endpoint": r.key, "alive": r.alive,
+                        "retiring": r.retiring, "inflight": r.inflight,
+                        "pending": r.pending, "load": r.load,
+                        "routed": r.routed,
+                    }
+        return None
+
+    def health(self) -> dict:
+        """The T_HEALTH body, in-process — what a socket client would
+        see, without the round trip (the co-located autoscaler's poll)."""
+        return self._health()
+
+    def stats(self) -> dict:
+        """The T_STATS body, in-process (freshness-gated re-probe
+        included) — the autoscaler's backlog/rates signal source."""
+        return self._stats()
+
     # -- probing -----------------------------------------------------------
     def _probe_loop(self) -> None:
         while not self._stopped.is_set():
-            for replica in self._replicas:
+            # Snapshot under the lock: the autoscaler adds/removes
+            # replicas concurrently, and a probe of a just-removed
+            # replica is harmless (its _Replica is unreachable after).
+            with self._lock:
+                replicas = list(self._replicas)
+            for replica in replicas:
                 if self._stopped.is_set():
                     return
                 self._probe(replica)
@@ -534,7 +639,11 @@ class FleetProxy:
         upstreams: Dict[str, socket.socket],
     ) -> None:
         try:
-            op, deadline_ms, payload = wire.decode_request_body(frame.body)
+            # The tenant token (field 4) deliberately does NOT feed the
+            # routing digest: QoS is a replica-side scheduling concern,
+            # and splitting one batchable family across replicas by
+            # tenant would forfeit the merge affinity exists for.
+            op, deadline_ms, payload, _ = wire.decode_request_body(frame.body)
             digest = wire.routing_digest(op, payload)
         except DpfError as exc:
             # Undecodable request body: the replica could not serve it
@@ -708,6 +817,7 @@ class FleetProxy:
                 "replicas": [
                     {
                         "endpoint": r.key, "alive": r.alive,
+                        "retiring": r.retiring,
                         "inflight": r.inflight, "pending": r.pending,
                         "routed": r.routed, "failures": r.failures,
                         "last_error": r.last_error,
@@ -739,14 +849,24 @@ class FleetProxy:
     #: than the probe loop guarantees — but a stats poll must not sweep
     #: the whole fleet with 3 round trips per replica on every call
     #: (against a dead non-loopback replica each sweep costs the 1 s
-    #: connect timeout, serially).
+    #: connect timeout, serially). Age alone is NOT sufficient: on warm
+    #: loopback a relayed request plus the stats poll complete inside
+    #: this window, so a body cached moments before the request would be
+    #: served back missing the counters the request caused — a cached
+    #: body is therefore also stale whenever a relay completed after the
+    #: probe that fetched it started (last_relay vs last_probe).
     STATS_FRESHNESS = 0.05
 
     def _stats(self) -> dict:
         now = time.perf_counter()
-        for replica in self._replicas:
+        with self._lock:
+            replicas = list(self._replicas)
+        for replica in replicas:
             with self._lock:
-                stale = now - replica.last_probe > self.STATS_FRESHNESS
+                stale = (
+                    now - replica.last_probe > self.STATS_FRESHNESS
+                    or replica.last_relay >= replica.last_probe
+                )
             if stale:
                 self._probe(replica)
         with self._lock:
@@ -794,6 +914,14 @@ class ReplicaPool:
     across a crash — the fleet analog of the PR 10 same-port server
     restart.
 
+    The pool is elastic (ISSUE 20): :meth:`scale_up` revives a stopped
+    slot on its remembered port — or grows a brand-new one — and
+    :meth:`scale_down` is the graceful SIGTERM drain. One scaling
+    driver at a time (the autoscaler's control loop is single-
+    threaded); the internal lock protects the slot lists against the
+    concurrent spawn threads of :meth:`start`, not against competing
+    scalers.
+
     ``replicas=None`` reads ``DPF_TPU_FLEET_REPLICAS`` (default 3).
     """
 
@@ -825,6 +953,7 @@ class ReplicaPool:
             base_dir = tempfile.mkdtemp(prefix="dpf-fleet-")
         self.base_dir = base_dir
         os.makedirs(self.base_dir, exist_ok=True)
+        self._lock = threading.Lock()
         self.procs: List[Optional[subprocess.Popen]] = [None] * replicas
         self.ports: List[int] = [0] * replicas
         self._logs: List[str] = [
@@ -834,7 +963,8 @@ class ReplicaPool:
 
     @property
     def endpoints(self) -> List[Tuple[str, int]]:
-        return [("127.0.0.1", p) for p in self.ports]
+        with self._lock:
+            return [("127.0.0.1", p) for p in self.ports]
 
     def _ready_file(self, i: int) -> str:
         return os.path.join(self.base_dir, f"ready{i}")
@@ -860,23 +990,28 @@ class ReplicaPool:
             cmd += ["--stream-journal-root", self.stream_journal_root]
         env = dict(os.environ, JAX_PLATFORMS=self.platform)
         with open(self._logs[i], "ab") as log:
-            self.procs[i] = subprocess.Popen(
+            proc = subprocess.Popen(
                 cmd, cwd=_repo_root(), env=env, stdout=log, stderr=log
             )
+        with self._lock:
+            self.procs[i] = proc
         t_end = time.perf_counter() + timeout
         while time.perf_counter() < t_end:
             try:
                 with open(ready) as f:
-                    self.ports[i] = int(f.read().strip())
-                    return self.ports[i]
+                    port = int(f.read().strip())
             except (OSError, ValueError):
-                if self.procs[i].poll() is not None:
+                if proc.poll() is not None:
                     raise UnavailableError(
                         f"UNAVAILABLE: replica {i} exited with "
-                        f"{self.procs[i].returncode} before ready "
+                        f"{proc.returncode} before ready "
                         f"(log: {self._logs[i]})"
                     )
                 time.sleep(0.1)
+                continue
+            with self._lock:
+                self.ports[i] = port
+            return port
         # Timing out must not ORPHAN the slow child: it would finish
         # starting later and squat on the remembered port, making every
         # subsequent spawn/restart of this slot fail to bind.
@@ -908,7 +1043,9 @@ class ReplicaPool:
             raise errs[0]
         return self.endpoints
 
-    def kill(self, i: int, sig: int = _signal.SIGKILL) -> None:
+    def kill(
+        self, i: int, sig: int = _signal.SIGKILL, wait: float = 20.0
+    ) -> None:
         """Hard-kills replica `i` (the chaos arm; SIGTERM drains — with
         the drain wait bounded and escalated, so a wedged drain can
         never block the caller forever)."""
@@ -916,7 +1053,7 @@ class ReplicaPool:
         if proc is not None and proc.poll() is None:
             os.kill(proc.pid, sig)
             try:
-                proc.wait(timeout=20)
+                proc.wait(timeout=wait)
             except Exception:  # noqa: BLE001 — escalate a stuck drain
                 proc.kill()
                 proc.wait()
@@ -927,6 +1064,50 @@ class ReplicaPool:
         ready."""
         self.kill(i, _signal.SIGKILL)
         return self.spawn(i, timeout=timeout)
+
+    # -- elastic scaling (ISSUE 20) ----------------------------------------
+    def running_indices(self) -> List[int]:
+        """Slots whose subprocess is currently alive."""
+        with self._lock:
+            procs = list(self.procs)
+        return [
+            i for i, p in enumerate(procs)
+            if p is not None and p.poll() is None
+        ]
+
+    def scale_up(self, timeout: float = 180.0) -> Tuple[int, int, bool]:
+        """Brings one more replica up. Prefers respawning a stopped
+        slot — its remembered port wins its old rendezvous range back —
+        and grows a brand-new ephemeral-port slot only when every slot
+        is running. Returns ``(index, port, grew)``; the caller tells
+        the proxy either way (:meth:`FleetProxy.add_replica` is
+        idempotent: it un-retires a known endpoint, appends a new one).
+        """
+        with self._lock:
+            idx = None
+            for i, proc in enumerate(self.procs):
+                if proc is None or proc.poll() is not None:
+                    idx = i
+                    break
+            grew = idx is None
+            if grew:
+                idx = self.n
+                self.n += 1
+                self.procs.append(None)
+                self.ports.append(0)
+                self._logs.append(
+                    os.path.join(self.base_dir, f"replica{idx}.log")
+                )
+        port = self.spawn(idx, timeout=timeout)
+        return idx, port, grew
+
+    def scale_down(self, i: int, timeout: float = 30.0) -> None:
+        """Gracefully stops replica `i`: SIGTERM — the server's drain
+        path, which finishes queued work before exiting — with the wait
+        bounded and escalated to SIGKILL. The slot and its port are
+        remembered, so a later :meth:`scale_up` revives the same
+        endpoint."""
+        self.kill(i, _signal.SIGTERM, wait=timeout)
 
     def stop(self) -> None:
         for proc in self.procs:
@@ -983,6 +1164,18 @@ def main(argv=None) -> int:
                     "survivor")
     ap.add_argument("--ready-file", default=None,
                     help="write '<port>\\n' here once the proxy listens")
+    # ISSUE 20: the elastic fleet. --autoscale starts the stats-driven
+    # control loop over this pool+proxy; the plane picks which ops feed
+    # its backlog signal, so a keygen-only (dealer) fleet and an eval
+    # fleet scale independently. Thresholds/cadence come from the
+    # DPF_TPU_AUTOSCALE_* env knobs (see README).
+    ap.add_argument("--autoscale", action="store_true",
+                    help="scale the replica count from the fleet's "
+                    "backlog (DPF_TPU_AUTOSCALE_* knobs)")
+    ap.add_argument("--autoscale-plane", default="eval",
+                    choices=("eval", "dealer", "all"),
+                    help="which ops feed the backlog signal (a dealer "
+                    "fleet serves keygen only)")
     args, server_args = ap.parse_known_args(argv)
     if server_args and server_args[0] == "--":
         server_args = server_args[1:]
@@ -994,15 +1187,23 @@ def main(argv=None) -> int:
         stream_journal_root=args.stream_journal_root,
     )
     proxy = None
+    scaler = None
     try:
         endpoints = pool.start()
         proxy = FleetProxy(
             endpoints, host=args.host, port=args.port,
             affinity=False if args.no_affinity else None,
         ).start()
+        if args.autoscale:
+            from .autoscale import AutoScaler
+
+            scaler = AutoScaler(
+                proxy, pool, plane=args.autoscale_plane
+            ).start()
         print(
             f"dpf-fleet: pid={os.getpid()} proxy {args.host}:{proxy.port} "
-            f"over {pool.n} replicas {pool.ports}",
+            f"over {pool.n} replicas {pool.ports}"
+            + (f" (autoscale:{args.autoscale_plane})" if scaler else ""),
             file=sys.stderr, flush=True,
         )
         if args.ready_file:
@@ -1022,6 +1223,8 @@ def main(argv=None) -> int:
         while not stop_evt.wait(0.25):
             pass
     finally:
+        if scaler is not None:
+            scaler.stop()
         if proxy is not None:
             proxy.stop()
         pool.stop()

@@ -181,8 +181,13 @@ class FrontDoor:
     * ``priorities`` / ``fair`` / ``adaptive_wait`` — the batcher's Orca
       scheduling knobs (ISSUE 14): per-op priority classes, round-robin
       fairness across op classes (default on; ``False`` is the FIFO
-      baseline), and width-aware batch-deadline adaptation (default
-      off — a deployment choice, see README "Fleet deployment").
+      baseline), and width-aware batch-deadline adaptation (default ON
+      since ISSUE 20 — tenant quotas bound the flood failure mode that
+      kept it opt-in; see README "Fleet deployment").
+    * ``tenant_quotas`` / ``tenant_default_quota`` / ``tenant_priorities``
+      — the batcher's multi-tenant QoS knobs (ISSUE 20): per-tenant
+      admission quotas and scheduling classes, keyed by the wire
+      request's tenant token.
     * ``robust`` — execute through ops/supervisor.py (default) vs the raw
       entry points (enables the prepared-plan / prepared-keys warm tiers).
     * ``policy`` / ``pipeline`` — passed through to the execution layer.
@@ -207,7 +212,10 @@ class FrontDoor:
         max_queue_depth: int = 1024,
         priorities: Optional[Dict[str, int]] = None,
         fair: bool = True,
-        adaptive_wait: bool = False,
+        adaptive_wait: bool = True,
+        tenant_quotas: Optional[Dict[str, int]] = None,
+        tenant_default_quota: int = 0,
+        tenant_priorities: Optional[Dict[str, int]] = None,
         robust: bool = True,
         policy=None,
         pipeline: Optional[bool] = None,
@@ -250,6 +258,9 @@ class FrontDoor:
             priorities=priorities,
             fair=fair,
             adaptive_wait=adaptive_wait,
+            tenant_quotas=tenant_quotas,
+            tenant_default_quota=tenant_default_quota,
+            tenant_priorities=tenant_priorities,
         )
 
     # -- lifecycle ---------------------------------------------------------
@@ -494,6 +505,17 @@ class FrontDoor:
         for r, value in zip(reqs, results):
             r.future.choice = decision.choice
             r.future._resolve(value)
+            # Per-tenant latency histograms (ISSUE 20): the tenant token
+            # rides the telemetry op tag, so the bench's per-tenant p95
+            # table and an operator's dashboards read straight off the
+            # ISSUE 6 bus. Untenanted traffic stays untagged.
+            if r.tenant and _tm.enabled():
+                _tm.counter("serving.tenant.served", op=r.tenant)
+                _tm.observe(
+                    "serving.tenant.latency_ms",
+                    r.future.latency_seconds * 1e3,
+                    op=r.tenant,
+                )
 
     def _execute_hh_ingest(self, reqs: List[Request]) -> None:
         for r in reqs:

@@ -373,6 +373,11 @@ class DpfServer:
             "streams": {
                 name: s.stats_fields() for name, s in self._streams.items()
             },
+            # ISSUE 20: QoS/autoscale signals (wire.STATS_QOS_KEYS) —
+            # per-op arrival-rate EWMAs feed the autoscaler's backlog
+            # forecast, per-tenant counters its fairness dashboard.
+            "rates": self.door.batcher.arrival_rates(),
+            "tenants": self.door.batcher.tenant_stats(),
             "pid": os.getpid(),
         }
 
@@ -400,6 +405,8 @@ class DpfServer:
             "streams": {
                 name: s.stats_fields() for name, s in self._streams.items()
             },
+            "rates": self.door.batcher.arrival_rates(),
+            "tenants": self.door.batcher.tenant_stats(),
         }
 
     # -- request handling --------------------------------------------------
@@ -414,10 +421,12 @@ class DpfServer:
             # and keep the connection, unlike frame-level garbage which
             # has no resync point and drops it.
             try:
-                op, deadline_ms, payload = wire.decode_request_body(
+                op, deadline_ms, payload, tenant = wire.decode_request_body(
                     frame.body
                 )
                 _tm.counter("rpc.server.requests", op=op)
+                if tenant:
+                    _tm.counter("rpc.server.tenant_requests", op=tenant)
                 if self._draining:
                     raise UnavailableError(
                         "UNAVAILABLE: server is draining — retry another "
@@ -441,7 +450,9 @@ class DpfServer:
                         (time.perf_counter() - t0) * 1e3, op=op,
                     )
                     return
-                request = self._build_request(op, payload)
+                request = self._build_request(op, payload).with_tenant(
+                    tenant
+                )
             except (DpfError, ConnectionError, OSError):
                 raise
             except Exception as exc:
@@ -653,11 +664,33 @@ def main(argv=None) -> int:
     # classes is the default; --fifo is the starvation baseline arm.
     ap.add_argument("--fifo", action="store_true",
                     help="disable fair cross-op flush ordering (baseline)")
+    # ISSUE 20: adaptive wait is the default now that tenant quotas
+    # bound its failure mode. --adaptive-wait stays as a no-op so
+    # pre-20 launch scripts (and ReplicaPool server_args) keep working.
     ap.add_argument("--adaptive-wait", action="store_true",
-                    help="width-aware batch-deadline adaptation")
+                    help="width-aware batch-deadline adaptation "
+                    "(default since ISSUE 20; flag kept for "
+                    "compatibility)")
+    ap.add_argument("--no-adaptive-wait", action="store_true",
+                    help="disable width-aware batch-deadline adaptation "
+                    "(fixed max-wait baseline)")
     ap.add_argument("--priorities", default=None, metavar="OP=N[,OP=N]",
                     help="op priority classes, lower flushes first "
                     "(e.g. evaluate_at=0,full_domain=1)")
+    # ISSUE 20: multi-tenant QoS knobs. Quotas bound a tenant's pending
+    # requests (admission control); priorities order flushes within an
+    # op class; both key on the wire-envelope tenant token.
+    ap.add_argument("--tenant-quotas", default=None,
+                    metavar="TENANT=N[,TENANT=N]",
+                    help="per-tenant pending-request admission quotas "
+                    "(0 = unbounded; e.g. acme=64,probe=8)")
+    ap.add_argument("--tenant-default-quota", type=int, default=0,
+                    help="admission quota for tenants without an explicit "
+                    "--tenant-quotas entry (0 = unbounded)")
+    ap.add_argument("--tenant-priorities", default=None,
+                    metavar="TENANT=N[,TENANT=N]",
+                    help="tenant priority classes, lower flushes first "
+                    "within each op class")
     ap.add_argument("--key-chunk", type=int, default=None)
     ap.add_argument("--journal-dir", default=None,
                     help="full-domain chunk-journal directory (crash resume)")
@@ -721,32 +754,46 @@ def main(argv=None) -> int:
     except Exception:
         pass
 
-    priorities = None
-    if args.priorities:
-        priorities = {}
-        for part in args.priorities.split(","):
+    def _parse_class_map(flag: str, text):
+        """KEY=N[,KEY=N] maps (--priorities and the tenant knobs share
+        the grammar); ap.error exits with the usage message on a bad
+        entry."""
+        if not text:
+            return None
+        out = {}
+        for part in text.split(","):
             if not part:
                 continue
-            op, sep, val = part.partition("=")
+            key, sep, val = part.partition("=")
             bad = not sep
             if not bad:
                 try:
-                    priorities[op] = int(val)
+                    out[key] = int(val)
                 except ValueError:
                     bad = True
             if bad:
-                ap.error(  # exits with the argparse usage message
-                    f"--priorities entry {part!r}: want OP=N (e.g. "
+                ap.error(
+                    f"{flag} entry {part!r}: want KEY=N (e.g. "
                     "evaluate_at=0,full_domain=1)"
                 )
+        return out
+
+    priorities = _parse_class_map("--priorities", args.priorities)
+    tenant_quotas = _parse_class_map("--tenant-quotas", args.tenant_quotas)
+    tenant_priorities = _parse_class_map(
+        "--tenant-priorities", args.tenant_priorities
+    )
     server = DpfServer(
         host=args.host, port=args.port,
         engine=args.engine, mode=args.mode,
         max_wait_ms=args.max_wait_ms, width_target=args.width_target,
         max_queue_depth=args.max_queue_depth, key_chunk=args.key_chunk,
         journal_dir=args.journal_dir,
-        fair=not args.fifo, adaptive_wait=args.adaptive_wait,
+        fair=not args.fifo, adaptive_wait=not args.no_adaptive_wait,
         priorities=priorities,
+        tenant_quotas=tenant_quotas,
+        tenant_default_quota=args.tenant_default_quota,
+        tenant_priorities=tenant_priorities,
     )
     for name, db in args.pir_db:
         server.register_db(name, db)

@@ -266,9 +266,14 @@ _ID_TO_OP = {i: name for name, i in _OP_TO_ID.items()}
 # ---------------------------------------------------------------------------
 
 
-def encode_request_body(op: str, payload: bytes, deadline_ms: int = 0) -> bytes:
-    """T_REQUEST body: op id (1), deadline_ms remaining (2), payload (3).
-    deadline_ms=0 means no deadline."""
+def encode_request_body(
+    op: str, payload: bytes, deadline_ms: int = 0, tenant: str = ""
+) -> bytes:
+    """T_REQUEST body: op id (1), deadline_ms remaining (2), payload (3),
+    tenant token (4, ISSUE 20 — appended, so pre-tenant decoders skip it
+    as an unknown field). deadline_ms=0 means no deadline; tenant=""
+    (the absent-field default, like ``hierarchy_level``'s -1) means
+    untenanted: old clients simply never emit field 4 and decode to ""."""
     if op not in _OP_TO_ID:
         raise InvalidArgumentError(
             f"op {op!r} is not servable over the wire (one of {WIRE_OPS})"
@@ -278,12 +283,15 @@ def encode_request_body(op: str, payload: bytes, deadline_ms: int = 0) -> bytes:
     out = pb.uint64_field(1, _OP_TO_ID[op])
     out += pb.uint64_field(2, int(deadline_ms))
     out += pb.len_field(3, payload)
+    if tenant:
+        out += pb.len_field(4, tenant.encode("utf-8"))
     return out
 
 
-def decode_request_body(buf: bytes) -> Tuple[str, int, bytes]:
+def decode_request_body(buf: bytes) -> Tuple[str, int, bytes, str]:
     op_id = deadline_ms = 0
     payload = b""
+    tenant = b""
     for field, _, value in pb.iter_fields(buf):
         if field == 1:
             op_id = value
@@ -291,10 +299,12 @@ def decode_request_body(buf: bytes) -> Tuple[str, int, bytes]:
             deadline_ms = value
         elif field == 3:
             payload = value
+        elif field == 4:
+            tenant = value
     op = _ID_TO_OP.get(op_id)
     if op is None:
         raise InvalidArgumentError(f"request carries unknown op id {op_id}")
-    return op, int(deadline_ms), payload
+    return op, int(deadline_ms), payload, tenant.decode("utf-8", "replace")
 
 
 def encode_error_body(code: int, message: str) -> bytes:
@@ -927,6 +937,17 @@ STATS_FLEET_KEYS = ("queues", "inflight", "served", "warm")
 #: new fields and merge fine.
 STATS_STREAM_KEYS = ("streams",)
 
+#: Health/stats body keys added for the elastic serving plane
+#: (ISSUE 20), same additive contract as STATS_FLEET_KEYS /
+#: STATS_STREAM_KEYS: new keys in the existing JSON bodies that old
+#: consumers never read and old servers simply don't contribute.
+#: ``rates`` maps op -> the batcher's arrival-rate EWMA (requests per
+#: second — the signal the autoscaler consumes, summed across
+#: replicas); ``tenants`` maps tenant token -> its admission/serving
+#: counters (pending / admitted / rejected / served, summed across
+#: replicas).
+STATS_QOS_KEYS = ("rates", "tenants")
+
 #: Per-stream stats fields that aggregate by MAX across replicas (the
 #: open generation and the lease epoch are high-water marks, not
 #: rates); every other numeric field sums, non-numeric fields (role)
@@ -998,7 +1019,7 @@ def merge_stats(bodies: Sequence[dict]) -> dict:
         "decisions_by_source": {}, "integrity_by_kind": {},
         "queues": {}, "inflight": 0, "served": 0,
         "warm": {"pir": [], "plans": [], "keys": []},
-        "streams": {},
+        "streams": {}, "rates": {}, "tenants": {},
     }
     for body in bodies:
         out["wall_seconds"] = max(
@@ -1033,6 +1054,15 @@ def merge_stats(bodies: Sequence[dict]) -> dict:
                     agg[k] = max(agg.get(k, v), v)
                 else:
                     agg[k] = agg.get(k, 0) + v
+        # QoS fields (ISSUE 20): arrival-rate EWMAs sum (fleet demand is
+        # the sum of replica demand) and per-tenant counters sum. Old
+        # bodies simply lack the keys.
+        for op_name, rate in (body.get("rates") or {}).items():
+            out["rates"][op_name] = out["rates"].get(op_name, 0.0) + rate
+        for tenant, fields in (body.get("tenants") or {}).items():
+            agg = out["tenants"].setdefault(tenant, {})
+            for k, v in fields.items():
+                agg[k] = agg.get(k, 0) + v
     return out
 
 

@@ -1001,6 +1001,149 @@ def test_fleet_adds_zero_programs(program_counter):
             s.stop()
 
 
+def test_autoscaler_loop_adds_zero_programs(program_counter):
+    """ISSUE 20 acceptance pin: the autoscaler control plane is pure host
+    work — stats/health polling over the wire, the backlog signal,
+    streak/cooldown bookkeeping, victim picking, and a full scale-up +
+    drain-down + revive cycle through the proxy membership seams launch
+    ZERO device programs. Elasticity must never cost a dispatch."""
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.serving.autoscale import AutoScaler
+
+    class _InProcessPool:
+        def __init__(self):
+            self.servers = [
+                serving.DpfServer(engine="host", max_wait_ms=1.0).start()
+            ]
+            self.ports = [self.servers[0].port]
+
+        def running_indices(self):
+            return [i for i, s in enumerate(self.servers) if s is not None]
+
+        def scale_up(self, timeout=180.0):
+            for i, s in enumerate(self.servers):
+                if s is None:
+                    srv = serving.DpfServer(
+                        engine="host", max_wait_ms=1.0, port=self.ports[i],
+                    ).start()
+                    self.servers[i] = srv
+                    return i, srv.port, False
+            srv = serving.DpfServer(engine="host", max_wait_ms=1.0).start()
+            self.servers.append(srv)
+            self.ports.append(srv.port)
+            return len(self.servers) - 1, srv.port, True
+
+        def scale_down(self, i, timeout=30.0):
+            s, self.servers[i] = self.servers[i], None
+            if s is not None:
+                s.stop()
+
+        def stop(self):
+            for s in self.servers:
+                if s is not None:
+                    s.stop()
+
+    pool = _InProcessPool()
+    proxy = serving.FleetProxy(
+        [("127.0.0.1", pool.ports[0])], probe_interval=60.0,
+    ).start()
+    try:
+        ready = serving.DpfClient("127.0.0.1", proxy.port)
+        ready.wait_ready(timeout=60)
+        ready.close()
+        sc = AutoScaler(
+            proxy, pool, plane="eval", min_replicas=1, max_replicas=2,
+            up_backlog=10.0, down_backlog=1.0, sustain=1, cooldown=0.0,
+            drain_timeout=10.0,
+        )
+        program_counter["programs"] = 0
+        # The real stats-path signal: wire polls of /stats + /health.
+        for _ in range(3):
+            assert sc.backlog() == 0.0
+        # Forced signal drives a full up -> drain-down -> revive cycle
+        # (only the signal is stubbed; the membership plumbing is real).
+        sc.backlog = lambda: 50.0
+        assert sc.poll_once() == "up"
+        sc.backlog = lambda: 0.0
+        assert sc.poll_once() == "down"
+        sc.backlog = lambda: 50.0
+        assert sc.poll_once() == "up"
+        assert sc.stats()["ups"] == 2 and sc.stats()["downs"] == 1
+        assert program_counter["programs"] == 0, (
+            f"the autoscaler control loop launched "
+            f"{program_counter['programs']} device programs across polls "
+            "and a full scale cycle — elasticity must be pure host work"
+        )
+    finally:
+        proxy.stop()
+        pool.stop()
+
+
+def test_tenant_tagged_requests_add_zero_programs(program_counter):
+    """ISSUE 20 acceptance pin: tenant tokens on the wire — decode, QoS
+    admission (quotas + priority classing), per-tenant telemetry, and
+    cross-tenant batch merging — add ZERO device programs over the
+    untenanted wire path. Four clients under TWO tenants land the same
+    merged 4-key batch (tenant is excluded from the merge signature), so
+    the warm program count must EQUAL the direct merged call's."""
+    import threading
+
+    from distributed_point_functions_tpu import serving
+    from distributed_point_functions_tpu.ops import supervisor
+
+    dpf = DistributedPointFunction.create(DpfParameters(10, Int(64)))
+    keys, _ = dpf.generate_keys_batch([5, 9, 44, 77], [[1, 2, 3, 4]])
+    params = [DpfParameters(10, Int(64))]
+
+    def direct():
+        supervisor.full_domain_evaluate_robust(
+            dpf, list(keys), key_chunk=2, pipeline=False
+        )
+
+    direct()  # warm
+    program_counter["programs"] = 0
+    direct()
+    direct_count = program_counter["programs"]
+    assert direct_count >= 1
+
+    with serving.DpfServer(
+        engine="device", max_wait_ms=10_000.0, width_target=4, key_chunk=2,
+        pipeline=False, tenant_quotas={"acme": 8, "zeta": 8},
+        tenant_priorities={"acme": 1},
+    ) as srv:
+        def wire_pass():
+            # Two tenants, one key per client; the shared signature
+            # merges all four into ONE batch despite the tenant split.
+            def one(k, tenant):
+                cli = serving.DpfClient("127.0.0.1", srv.port, tenant=tenant)
+                try:
+                    cli.full_domain(params, [k], deadline=300)
+                finally:
+                    cli.close()
+
+            tenants = ["acme", "acme", "zeta", "zeta"]
+            threads = [
+                threading.Thread(target=one, args=(k, t))
+                for k, t in zip(keys, tenants)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+        wire_pass()  # warm (serialization caches, server object caches)
+        program_counter["programs"] = 0
+        wire_pass()
+        assert program_counter["programs"] == direct_count, (
+            f"tenant-tagged requests launched "
+            f"{program_counter['programs']} device programs vs "
+            f"{direct_count} for the direct merged call — the QoS plane "
+            "must add zero dispatches"
+        )
+        h = srv._health()
+        assert h["tenants"]["acme"]["served"] >= 2  # the tag rode the wire
+
+
 def test_streaming_adds_zero_programs(program_counter, tmp_path):
     """ISSUE 15 acceptance pin: the streaming heavy-hitters tier on the
     host route — ingest journaling, window close, the leader's full

@@ -1,0 +1,384 @@
+"""Multi-tenant QoS (ISSUE 20): the batcher's quota/priority/adaptive
+matrix, the tenant token's end-to-end ride over a real socket, and the
+per-tenant stats surfaces.
+
+The tenant token is an ENVELOPE field: it never enters the request
+signature (cross-tenant requests for the same program family still merge
+into one batch) and never feeds the routing digest (affinity is a
+program-family concern). What it does drive: admission quotas (a flooding
+tenant sheds ITS OWN requests, nobody else's), tenant priority classes
+(ordering within an op class), and per-tenant telemetry.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_point_functions_tpu import serving
+from distributed_point_functions_tpu.core.dpf import DistributedPointFunction
+from distributed_point_functions_tpu.core.params import DpfParameters
+from distributed_point_functions_tpu.core.value_types import Int
+from distributed_point_functions_tpu.utils import telemetry
+from distributed_point_functions_tpu.utils.errors import (
+    InvalidArgumentError,
+    ResourceExhaustedError,
+)
+
+
+def _dpf6(num_keys=8, seed=13):
+    rng = np.random.default_rng(seed)
+    dpf = DistributedPointFunction.create(DpfParameters(6, Int(64)))
+    alphas = [int(x) for x in rng.integers(0, 64, size=num_keys)]
+    betas = [[int(x) for x in rng.integers(1, 1000, size=num_keys)]]
+    keys, _ = dpf.generate_keys_batch(alphas, betas)
+    return dpf, keys
+
+
+def _collector():
+    batches = []
+
+    def flush(sig, reqs):
+        batches.append((sig, list(reqs)))
+        for r in reqs:
+            r.future._resolve(("served", len(reqs)))
+
+    return batches, flush
+
+
+# ---------------------------------------------------------------------------
+# Tenant token semantics
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_not_part_of_signature():
+    """Two tenants' requests for the same program family share one
+    compatibility queue — QoS must not forfeit the batching the front
+    door exists for."""
+    dpf, keys = _dpf6(2)
+    a = serving.Request.full_domain(dpf, keys[:1]).with_tenant("acme")
+    b = serving.Request.full_domain(dpf, keys[1:2]).with_tenant("zeta")
+    assert a.signature() == b.signature()
+    assert a.tenant == "acme" and b.tenant == "zeta"
+
+
+def test_cross_tenant_requests_merge_into_one_batch():
+    dpf, keys = _dpf6(2)
+    batches, flush = _collector()
+    b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=100)
+    b.submit(serving.Request.full_domain(dpf, keys[:1]).with_tenant("acme"))
+    b.submit(serving.Request.full_domain(dpf, keys[1:2]).with_tenant("zeta"))
+    assert b.pump(force=True) == 1  # ONE flush, both tenants inside
+    assert sorted(r.tenant for _, reqs in batches for r in reqs) == [
+        "acme", "zeta",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Admission quotas
+# ---------------------------------------------------------------------------
+
+
+class TestQuotas:
+    def test_flooding_tenant_sheds_only_itself(self):
+        """The core QoS pin: tenant A over ITS quota gets
+        RESOURCE_EXHAUSTED; tenant B (and the untenanted default) are
+        untouched — per-tenant shed, not global."""
+        dpf, keys = _dpf6(6)
+        _, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100,
+            tenant_quotas={"acme": 2},
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1]).with_tenant("acme")
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[1:2]).with_tenant("acme")
+        )
+        with telemetry.capture() as tel:
+            with pytest.raises(ResourceExhaustedError, match="acme"):
+                b.submit(
+                    serving.Request.full_domain(dpf, keys[2:3])
+                    .with_tenant("acme")
+                )
+        snap = tel.snapshot()["counters"]
+        assert snap.get("serving.tenant.rejected[acme]") == 1
+        # Other tenants and untenanted traffic admit freely.
+        b.submit(
+            serving.Request.full_domain(dpf, keys[3:4]).with_tenant("zeta")
+        )
+        b.submit(serving.Request.full_domain(dpf, keys[4:5]))
+        stats = b.tenant_stats()
+        assert stats["acme"]["rejected"] == 1
+        assert stats["acme"]["pending"] == 2
+        assert stats["zeta"]["pending"] == 1
+        b.pump(force=True)
+
+    def test_quota_reopens_after_flush(self):
+        """Pending is the quota unit: a served request frees its slot."""
+        dpf, keys = _dpf6(3)
+        _, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100,
+            tenant_quotas={"acme": 1},
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1]).with_tenant("acme")
+        )
+        with pytest.raises(ResourceExhaustedError):
+            b.submit(
+                serving.Request.full_domain(dpf, keys[1:2])
+                .with_tenant("acme")
+            )
+        b.pump(force=True)
+        b.submit(  # slot freed
+            serving.Request.full_domain(dpf, keys[2:3]).with_tenant("acme")
+        )
+        assert b.tenant_stats()["acme"]["served"] == 1
+        b.pump(force=True)
+
+    def test_default_quota_covers_unlisted_tenants(self):
+        dpf, keys = _dpf6(3)
+        _, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100,
+            tenant_quotas={"vip": 0}, tenant_default_quota=1,
+        )
+        # Unlisted tenant: bounded by the default.
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1]).with_tenant("guest")
+        )
+        with pytest.raises(ResourceExhaustedError, match="guest"):
+            b.submit(
+                serving.Request.full_domain(dpf, keys[1:2])
+                .with_tenant("guest")
+            )
+        # Explicit 0 = unbounded, overriding the default.
+        for i in range(3):
+            b.submit(
+                serving.Request.full_domain(dpf, keys[i:i + 1])
+                .with_tenant("vip")
+            )
+        b.pump(force=True)
+
+    def test_zero_default_is_unbounded(self):
+        dpf, keys = _dpf6(4)
+        _, flush = _collector()
+        b = serving.ContinuousBatcher(flush, max_wait_ms=1e6, width_target=100)
+        for i in range(4):
+            b.submit(
+                serving.Request.full_domain(dpf, keys[i:i + 1])
+                .with_tenant("any")
+            )
+        b.pump(force=True)
+
+    def test_negative_quota_rejected(self):
+        with pytest.raises(InvalidArgumentError):
+            serving.ContinuousBatcher(
+                lambda s, r: None, tenant_quotas={"acme": -1}
+            )
+        with pytest.raises(InvalidArgumentError):
+            serving.ContinuousBatcher(
+                lambda s, r: None, tenant_default_quota=-2
+            )
+
+    def test_quota_layers_under_global_admission(self):
+        """max_queue_depth still bounds the TOTAL; quotas slice inside
+        it. A quota that admits can still lose to the global bound."""
+        dpf, keys = _dpf6(3)
+        _, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100, max_queue_depth=2,
+            tenant_quotas={"acme": 10},
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1]).with_tenant("acme")
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[1:2]).with_tenant("acme")
+        )
+        with pytest.raises(ResourceExhaustedError, match="admission"):
+            b.submit(
+                serving.Request.full_domain(dpf, keys[2:3])
+                .with_tenant("acme")
+            )
+        b.pump(force=True)
+
+
+# ---------------------------------------------------------------------------
+# Tenant priority classes
+# ---------------------------------------------------------------------------
+
+
+class TestTenantPriorities:
+    def test_tenant_class_orders_within_op_class(self):
+        """Two queues of the SAME op (different hierarchy levels):
+        the lower tenant class flushes first even when submitted last."""
+        dpf, keys = _dpf6(2)
+        batches, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100,
+            tenant_priorities={"vip": 0, "batchy": 1},
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1], 0)
+            .with_tenant("batchy")
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1], 1).with_tenant("vip")
+        )
+        assert b.pump(force=True) == 2
+        assert [reqs[0].tenant for _, reqs in batches] == ["vip", "batchy"]
+
+    def test_op_priorities_dominate_tenant_classes(self):
+        """Op priority classes (ISSUE 14) rank first; tenant classes
+        tiebreak inside an op class — a vip tenant cannot jump an op
+        the operator ranked above its op."""
+        dpf, keys = _dpf6(2)
+        batches, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100, fair=False,
+            priorities={"evaluate_at": 0, "full_domain": 1},
+            tenant_priorities={"vip": 0, "batchy": 1},
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1]).with_tenant("vip")
+        )
+        b.submit(
+            serving.Request.evaluate_at(dpf, keys[:1], [1])
+            .with_tenant("batchy")
+        )
+        assert b.pump(force=True) == 2
+        assert [reqs[0].op for _, reqs in batches] == [
+            "evaluate_at", "full_domain",
+        ]
+
+    def test_unlisted_tenant_defaults_to_class_zero(self):
+        dpf, keys = _dpf6(2)
+        batches, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=1e6, width_target=100,
+            tenant_priorities={"batchy": 5},
+        )
+        b.submit(
+            serving.Request.full_domain(dpf, keys[:1], 0)
+            .with_tenant("batchy")
+        )
+        b.submit(serving.Request.full_domain(dpf, keys[:1], 1))  # class 0
+        assert b.pump(force=True) == 2
+        assert [reqs[0].tenant for _, reqs in batches] == ["", "batchy"]
+
+
+# ---------------------------------------------------------------------------
+# Adaptive-wait default (flipped ON in ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveDefault:
+    def test_batcher_and_frontdoor_default_on(self):
+        b = serving.ContinuousBatcher(lambda s, r: None)
+        assert b.adaptive_wait is True
+        assert (
+            serving.ContinuousBatcher(lambda s, r: None, adaptive_wait=False)
+            .adaptive_wait is False
+        )
+
+    def test_server_cli_flags(self):
+        """--no-adaptive-wait is the opt-out; --adaptive-wait stays a
+        compatibility no-op (pre-20 launch scripts and ReplicaPool
+        server_args pass it). Source-level pin: booting a real server
+        is the e2e suite's job."""
+        import inspect
+
+        from distributed_point_functions_tpu.serving import server as srv_mod
+
+        src = inspect.getsource(srv_mod.main)
+        assert "--no-adaptive-wait" in src
+        assert "--adaptive-wait" in src
+        assert "not args.no_adaptive_wait" in src
+
+    def test_quota_bounds_adaptive_failure_mode(self):
+        """The reason the default flipped: adaptive_wait shortens
+        windows under light traffic, and a flooding tenant used to be
+        able to keep every window busy; with a quota its flood sheds at
+        admission BEFORE it can distort the window signal."""
+        dpf, keys = _dpf6(6)
+        _, flush = _collector()
+        b = serving.ContinuousBatcher(
+            flush, max_wait_ms=200.0, width_target=8, adaptive_wait=True,
+            tenant_quotas={"flood": 2},
+        )
+        admitted = 0
+        for i in range(6):
+            try:
+                b.submit(
+                    serving.Request.full_domain(dpf, keys[i:i + 1], i)
+                    .with_tenant("flood")
+                )
+                admitted += 1
+            except ResourceExhaustedError:
+                pass
+        assert admitted == 2
+        assert b.tenant_stats()["flood"]["rejected"] == 4
+        b.pump(force=True)
+
+
+# ---------------------------------------------------------------------------
+# Stats surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_arrival_rates_aggregates_per_op():
+    dpf, keys = _dpf6(1)
+    _, flush = _collector()
+    b = serving.ContinuousBatcher(flush, max_wait_ms=200.0, width_target=8)
+    sig = serving.Request.full_domain(dpf, keys[:1]).signature()
+    with b._lock:
+        b._rate_ewma[sig] = (40.0, 3)
+    rates = b.arrival_rates()
+    assert rates == {"full_domain": 40.0}
+    # Under-sampled signatures stay out of the signal.
+    with b._lock:
+        b._rate_ewma[("evaluate_at", "x")] = (99.0, 1)
+    assert "evaluate_at" not in b.arrival_rates()
+
+
+def test_tenant_token_rides_the_wire_end_to_end():
+    """DpfClient(tenant=...) -> envelope field 4 -> server batcher ->
+    per-tenant stats in the health body — the full plumbing, over a
+    real socket, zero device programs (host engine)."""
+    rng = np.random.default_rng(5)
+    dpf = DistributedPointFunction.create(DpfParameters(6, Int(64)))
+    keys, _ = dpf.generate_keys_batch([3], [[7]])
+    params = [DpfParameters(6, Int(64))]
+    srv = serving.DpfServer(engine="host", max_wait_ms=1.0).start()
+    del rng
+    try:
+        with serving.DpfClient(
+            "127.0.0.1", srv.port, tenant="acme"
+        ) as cli:
+            cli.wait_ready(timeout=30)
+            cli.evaluate_at(params, [keys[0]], [1, 3], deadline=30)
+            h = cli.health()
+            assert "tenants" in h and "rates" in h
+            assert h["tenants"]["acme"]["served"] >= 1
+            assert h["tenants"]["acme"]["pending"] == 0
+    finally:
+        srv.stop()
+
+
+def test_untenanted_client_reports_no_tenant_rows():
+    dpf = DistributedPointFunction.create(DpfParameters(6, Int(64)))
+    keys, _ = dpf.generate_keys_batch([3], [[7]])
+    params = [DpfParameters(6, Int(64))]
+    srv = serving.DpfServer(engine="host", max_wait_ms=1.0).start()
+    try:
+        with serving.DpfClient("127.0.0.1", srv.port) as cli:
+            cli.wait_ready(timeout=30)
+            cli.evaluate_at(params, [keys[0]], [1], deadline=30)
+            h = cli.health()
+            # The untenanted bucket tracks quota state under "" only
+            # once a tenant field ever appears; a pure pre-20 workload
+            # reports an untenanted row at most.
+            assert set(h["tenants"]) <= {""}
+    finally:
+        srv.stop()

@@ -136,9 +136,38 @@ def test_version_mismatch_detected_per_frame():
 
 def test_request_body_round_trip():
     body = wire.encode_request_body("dcf", b"\x01\x02", deadline_ms=1500)
-    assert wire.decode_request_body(body) == ("dcf", 1500, b"\x01\x02")
+    assert wire.decode_request_body(body) == ("dcf", 1500, b"\x01\x02", "")
     body = wire.encode_request_body("pir", b"", deadline_ms=0)
-    assert wire.decode_request_body(body) == ("pir", 0, b"")
+    assert wire.decode_request_body(body) == ("pir", 0, b"", "")
+
+
+def test_request_body_tenant_is_backward_compatible():
+    """The ISSUE 20 tenant token is an APPENDED envelope field with
+    absent-field semantics (like hierarchy_level): an untenanted request
+    encodes byte-identically to a pre-tenant one, a tenanted request
+    decodes to the token, and a pre-tenant decoder skips field 4 as an
+    unknown field."""
+    plain = wire.encode_request_body("dcf", b"\x01", deadline_ms=9)
+    tagged = wire.encode_request_body("dcf", b"\x01", deadline_ms=9,
+                                      tenant="acme")
+    # Untenanted == pre-ISSUE-20 bytes (tenant="" emits no field 4).
+    assert plain == wire.encode_request_body("dcf", b"\x01", 9, tenant="")
+    assert wire.decode_request_body(tagged) == ("dcf", 9, b"\x01", "acme")
+    # The tenant rides the envelope, not the payload: routing digests —
+    # computed over the op payload — are unmoved, so affinity routing
+    # cannot split one batchable family across replicas by tenant.
+    _, _, payload_a, _ = wire.decode_request_body(plain)
+    _, _, payload_b, _ = wire.decode_request_body(tagged)
+    assert payload_a == payload_b
+    # An old decoder (fields 1-3 only) reads the same request: emulate
+    # by stripping field 4 and decoding.
+    from distributed_point_functions_tpu.protos import wire as pb
+
+    kept = b"".join(
+        pb.uint64_field(f, v) if isinstance(v, int) else pb.len_field(f, v)
+        for f, _, v in pb.iter_fields(tagged) if f != 4
+    )
+    assert wire.decode_request_body(kept) == ("dcf", 9, b"\x01", "")
 
 
 def test_request_body_rejects_unknown_op():
@@ -356,7 +385,7 @@ def test_op_payload_survives_a_real_socket(op, op_payloads):
     frame = wire.read_frame(b)
     t.join()
     assert frame.ftype == wire.T_REQUEST and frame.request_id == 7
-    got_op, got_deadline, got_payload = wire.decode_request_body(frame.body)
+    got_op, got_deadline, got_payload, _ = wire.decode_request_body(frame.body)
     assert (got_op, got_deadline) == (op, 250)
     assert got_payload == payload
     a.close(), b.close()

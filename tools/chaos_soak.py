@@ -1986,6 +1986,263 @@ def fleet_main(args) -> int:
     return 0
 
 
+def fleet_scale_main(args) -> int:
+    """The elastic-fleet soak (ISSUE 20): party 0 starts at ONE replica
+    with a live AutoScaler watching its FleetProxy; party 1 stays static.
+    A flood of concurrent clients drives the backlog signal over the
+    scale-up threshold; the moment the pool finishes spawning the new
+    replica — DURING the scale event, before the proxy has admitted it —
+    the seed replica is SIGKILLed, so the membership change and the
+    failure land in the same probe window. The flood then stops and the
+    lull drains the fleet back down gracefully. Asserts:
+
+      1. every reconstructed share bit-exact vs the host oracle, ZERO
+         caller-visible failures through flood, mid-scale kill, and
+         drain — retries + the retiring-exclusion absorb everything;
+      2. the scaler actually moved: >= 1 scale-up AND >= 1 drain-down,
+         observed both in its own stats and the proxy's membership
+         counters (replicas_added / retired);
+      3. the mid-scale-event kill was real (proxy counted the dead
+         replica / failovers) and the killed seed probes back alive
+         after restart.
+
+    engine=host on every replica: zero XLA programs, zero pallas
+    configs (the wire-soak budget discipline)."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS", "cpu"))
+    from distributed_point_functions_tpu.serving import (
+        AutoScaler,
+        FleetProxy,
+        ReplicaPool,
+        RetryPolicy,
+        TwoServerClient,
+    )
+
+    rng = np.random.default_rng(args.seed)
+    tmp = tempfile.mkdtemp(prefix="dpf-fleet-scale-soak-")
+    pools = [None, None]
+    proxies = [None, None]
+    scaler = None
+    failures = []
+    t_start = time.perf_counter()
+    try:
+        # ---- party 0: ONE replica + autoscaler; party 1: static --------
+        t0 = time.perf_counter()
+        for party in range(2):
+            pools[party] = ReplicaPool(
+                replicas=1,
+                server_args=["--engine", "host", "--max-wait-ms", "2",
+                             "--pir-db", "soak:8:1234"],
+                base_dir=os.path.join(tmp, f"party{party}"),
+            )
+            pools[party].start()
+            proxies[party] = FleetProxy(
+                pools[party].endpoints, probe_interval=0.25,
+            ).start()
+        print(f"fleet-scale soak: 2 parties x 1 replica up in "
+              f"{time.perf_counter() - t0:.1f}s, proxy ports "
+              f"{[p.port for p in proxies]} tmp={tmp}")
+
+        policy = RetryPolicy(
+            attempts=5, base_backoff=0.05, max_backoff=1.0,
+            attempt_timeout=30.0, connect_attempts=240,
+            connect_backoff=0.25, seed=args.seed,
+        )
+        endpoints = [("127.0.0.1", proxies[0].port),
+                     ("127.0.0.1", proxies[1].port)]
+        warm_client = TwoServerClient(endpoints, policy=policy)
+        warm_client.wait_ready(timeout=180)
+
+        fixtures, _kill = _wire_fixtures(rng)
+        names = sorted(fixtures)
+        t0 = time.perf_counter()
+        for name in names:
+            fixtures[name]["call"](warm_client, {"deadline": 120.0})
+        warm_client.close()
+        print(f"fleet-scale soak: warm pass ({len(names)} op families) in "
+              f"{time.perf_counter() - t0:.1f}s")
+
+        # ---- the scaler, with the mid-scale-event kill armed ------------
+        # The kill fires inside the pool's scale_up seam: the new replica
+        # has just spawned, the proxy has NOT yet admitted it — the seed
+        # dies in the same membership-transition window (the hardest
+        # ordering: for a beat the fleet's only admitted replica is dead
+        # and the retry budget must carry callers into the probe that
+        # admits the newcomer).
+        killed = {"done": False, "seed_port": pools[0].ports[0]}
+        orig_scale_up = pools[0].scale_up
+
+        def killing_scale_up(timeout=180.0):
+            out = orig_scale_up(timeout)
+            if not killed["done"]:
+                killed["done"] = True
+                print("fleet-scale soak: SIGKILL seed replica "
+                      f"127.0.0.1:{killed['seed_port']} MID-scale-event")
+                pools[0].kill(0)
+            return out
+
+        pools[0].scale_up = killing_scale_up
+        scaler = AutoScaler(
+            proxies[0], pools[0], plane="eval", min_replicas=1,
+            max_replicas=2, interval=0.2, up_backlog=2.0, down_backlog=0.5,
+            sustain=2, cooldown=2.0, drain_timeout=10.0,
+        )
+        scaler.start()
+
+        # ---- flood: concurrent clients until the scale-up lands --------
+        threads_n = args.fleet_threads
+        stop_flood = threading.Event()
+        lock = threading.Lock()
+        served = [0]
+
+        def _worker(t_index):
+            client = TwoServerClient(endpoints, policy=policy)
+            try:
+                i = 0
+                while not stop_flood.is_set():
+                    name = names[(t_index + i) % len(names)]
+                    i += 1
+                    try:
+                        got = fixtures[name]["call"](client,
+                                                     {"deadline": 120.0})
+                        _assert_shares(f"t{t_index} req {i} {name}", got,
+                                       fixtures[name])
+                        with lock:
+                            served[0] += 1
+                    except Exception as exc:  # noqa: BLE001 — soak reports
+                        with lock:
+                            failures.append(
+                                f"t{t_index} req {i} {name}: "
+                                f"{type(exc).__name__}: {exc}"
+                            )
+            finally:
+                client.close()
+
+        t0 = time.perf_counter()
+        workers = [
+            threading.Thread(target=_worker, args=(t,), daemon=True)
+            for t in range(threads_n)
+        ]
+        for w in workers:
+            w.start()
+
+        t_up = time.perf_counter() + 120
+        while time.perf_counter() < t_up and not scaler.stats()["ups"]:
+            time.sleep(0.05)
+        if not scaler.stats()["ups"]:
+            failures.append(
+                f"flood never triggered a scale-up (backlog "
+                f"{scaler.backlog():.1f} vs threshold 2.0 after 120s)"
+            )
+        else:
+            print(f"fleet-scale soak: scale-up at "
+                  f"{time.perf_counter() - t0:.1f}s into the flood "
+                  f"(served so far: {served[0]})")
+        if not killed["done"]:
+            failures.append("scale-up ran but the armed kill never fired")
+        else:
+            # Restart the killed seed on its remembered port mid-flood —
+            # ops bringing a crashed node back while the fleet is elastic.
+            pools[0].restart(0)
+            print("fleet-scale soak: killed seed restarted on "
+                  f"port {pools[0].ports[0]}")
+
+        # Let the grown fleet absorb load for a beat, then the lull.
+        t_hold = time.perf_counter() + 3.0
+        while time.perf_counter() < t_hold:
+            time.sleep(0.05)
+        stop_flood.set()
+        for w in workers:
+            w.join(timeout=600)
+        wall = time.perf_counter() - t0
+        alive = [w for w in workers if w.is_alive()]
+        if alive:
+            failures.append(f"{len(alive)} worker threads never finished")
+        print(f"fleet-scale soak: flood served {served[0]} requests in "
+              f"{wall:.1f}s ({served[0] / max(wall, 1e-9):.0f} q/s through "
+              "a scale-up + a mid-scale kill)")
+
+        # ---- lull: the drain-down must land on its own ------------------
+        t_down = time.perf_counter() + 120
+        while time.perf_counter() < t_down and not scaler.stats()["downs"]:
+            time.sleep(0.05)
+        if not scaler.stats()["downs"]:
+            failures.append(
+                f"lull never triggered a drain-down (backlog "
+                f"{scaler.backlog():.1f}, threshold 0.5, 120s)"
+            )
+        else:
+            print(f"fleet-scale soak: drain-down landed; scaler stats "
+                  f"{scaler.stats()}")
+        scaler.stop()
+
+        st = proxies[0]._stats()
+        counters = st["fleet"]["counters"]
+        print(f"fleet-scale soak: fleet counters {counters}")
+        if counters["replicas_added"] < 1:
+            failures.append("proxy never admitted the scaled-up replica")
+        if scaler.stats()["downs"] and counters["retired"] < 1:
+            failures.append("drain-down landed without a retirement "
+                            "(graceful-drain ordering broken)")
+        if killed["done"] and (
+            counters["failovers"] + counters["replica_down"] < 1
+        ):
+            failures.append("mid-scale kill was never observed by the "
+                            "proxy (no failover/replica_down counted)")
+
+        # ---- post-drain sanity: every family bit-exact, seed alive ------
+        t_rev = time.perf_counter() + 30
+        seed_alive = False
+        seed_key = f"127.0.0.1:{killed['seed_port']}"
+        while time.perf_counter() < t_rev:
+            reps = {r["endpoint"]: r
+                    for r in proxies[0]._stats()["fleet"]["replicas"]}
+            rep = reps.get(seed_key)
+            if rep is not None and rep["alive"] and not rep["retiring"]:
+                seed_alive = True
+                break
+            time.sleep(0.1)
+        if killed["done"] and not seed_alive:
+            failures.append(
+                f"killed seed {seed_key} never probed back alive+serving"
+            )
+        client = TwoServerClient(endpoints, policy=policy)
+        try:
+            for name in names:
+                got = fixtures[name]["call"](client, {"deadline": 120.0})
+                _assert_shares(f"post-drain {name}", got, fixtures[name])
+        except Exception as exc:  # noqa: BLE001 — soak reports all
+            failures.append(
+                f"post-drain batch failed: {type(exc).__name__}: {exc}"
+            )
+        finally:
+            client.close()
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        for proxy in proxies:
+            if proxy is not None:
+                proxy.stop()
+        for pool in pools:
+            if pool is not None:
+                pool.stop()
+        if not failures:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    total = time.perf_counter() - t_start
+    if failures:
+        print(f"fleet-scale soak: FAIL in {total:.1f}s (logs kept in {tmp}):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print(f"fleet-scale soak: PASS in {total:.1f}s")
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--seed", type=int, default=7)
@@ -2005,6 +2262,10 @@ def main() -> int:
                     help="replicas per party in --fleet mode")
     ap.add_argument("--fleet-requests", type=int, default=480)
     ap.add_argument("--fleet-threads", type=int, default=6)
+    ap.add_argument("--fleet-scale", action="store_true",
+                    help="elastic-fleet soak: flood -> autoscale up with a "
+                    "SIGKILL mid-scale-event, lull -> drain down "
+                    "(ISSUE 20)")
     ap.add_argument("--stream", action="store_true",
                     help="streaming heavy-hitters soaks: follower kill "
                     "mid-window (ISSUE 15), then leader-kill lease "
@@ -2024,6 +2285,8 @@ def main() -> int:
         if rc == 0:
             rc = stream_fleet_main(args)
         return rc
+    if args.fleet_scale:
+        return fleet_scale_main(args)
     if args.fleet:
         return fleet_main(args)
     if args.wire:

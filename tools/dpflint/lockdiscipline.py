@@ -47,6 +47,7 @@ THREADED_MODULES = (
     f"{PACKAGE}/serving/fleet.py",
     f"{PACKAGE}/serving/streaming.py",
     f"{PACKAGE}/serving/lease.py",
+    f"{PACKAGE}/serving/autoscale.py",
 )
 
 _LOCK_CTORS = {
